@@ -1,0 +1,74 @@
+"""Parity bench — runs on one real TPU chip; prints ONE JSON line.
+
+Measures the tensor-echo RPC step (the echo_c++ / rdma_performance analog,
+BASELINE.md config #1/#5) with the payload resident in HBM: per-request
+latency for small frames and sustained GB/s for large frames through the
+full device-side parse→verify→dispatch→respond path.
+
+Baseline anchor (BASELINE.md): reference same-machine large-payload
+throughput ~2.3 GB/s (docs/cn/benchmark.md:106). ``vs_baseline`` is our
+GB/s / 2.3.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def _bench_one(step, request, iters: int, warmup: int = 5):
+    for _ in range(warmup):
+        out = step(request)
+    out.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step(request)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    return dt / iters
+
+
+def main() -> None:
+    from incubator_brpc_tpu.models.tensor_echo import make_echo_step
+
+    results = {}
+
+    # Large-frame throughput (streaming/rdma_performance analog): 8 MiB payload
+    words_large = 2 * 1024 * 1024  # 8 MiB of uint32
+    step, request = make_echo_step(payload_words=words_large)
+    per_call = _bench_one(step, request, iters=30)
+    bytes_moved = words_large * 4 * 2  # request parsed + response framed
+    gbps = bytes_moved / per_call / 1e9
+    results["large_frame_gbps"] = gbps
+
+    # Small-frame latency (echo qps analog): 256-word payload
+    step_s, request_s = make_echo_step(payload_words=256)
+    per_call_s = _bench_one(step_s, request_s, iters=200)
+    results["small_frame_us"] = per_call_s * 1e6
+    results["small_frame_qps"] = 1.0 / per_call_s
+
+    baseline_gbps = 2.3  # reference same-machine large-payload max (BASELINE.md)
+    print(
+        json.dumps(
+            {
+                "metric": "tensor_echo_throughput",
+                "value": round(gbps, 3),
+                "unit": "GB/s",
+                "vs_baseline": round(gbps / baseline_gbps, 3),
+                "detail": {
+                    "payload_mib": words_large * 4 / 2**20,
+                    "small_frame_us": round(results["small_frame_us"], 2),
+                    "small_frame_qps": round(results["small_frame_qps"]),
+                    "device": str(jax.devices()[0]),
+                    "baseline": "brpc same-machine >=32KB multi-conn ~2.3 GB/s (docs/cn/benchmark.md:106)",
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

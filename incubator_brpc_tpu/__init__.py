@@ -1,0 +1,53 @@
+"""incubator_brpc_tpu — a TPU-native RPC fabric with the capabilities of Apache bRPC.
+
+Brand-new framework (not a port) re-architected for TPU:
+
+- the data plane is JAX/XLA/Pallas over a ``jax.sharding.Mesh`` — combo
+  channels (ParallelChannel / PartitionChannel / SelectiveChannel, see
+  reference ``src/brpc/parallel_channel.h``) lower to ICI collectives
+  (all_gather / all_to_all / psum) instead of N point-to-point writes;
+- the host/control plane is a native C++ runtime being built bottom-up in
+  ``src/`` (SURVEY.md §7 order): IOBuf zero-copy block chains with pluggable
+  (HBM-registered) allocators, M:N fiber scheduling parked on butexes, a
+  wait-free socket write path — bound into Python via ctypes;
+- observability (bvar metrics, rpcz spans, builtin status services) is kept
+  intact, as in the reference's L6 (``src/brpc/builtin/``).
+
+Reference: qingshui/incubator-brpc mounted at /root/reference (structural
+analysis in SURVEY.md). File:line citations throughout this package point at
+the reference behavior each component reproduces — the implementations here
+are new, TPU-first designs.
+"""
+
+__version__ = "0.1.0"
+
+from incubator_brpc_tpu.utils.status import Status, ErrorCode  # noqa: F401
+from incubator_brpc_tpu.utils.endpoint import EndPoint  # noqa: F401
+
+# Lazy subpackage access so that `import incubator_brpc_tpu` stays cheap and
+# does not force JAX initialization (the rpc/ and parallel/ subpackages pull
+# in jax; utils/ and bvar/ must stay importable without a device).
+_LAZY_SUBMODULES = (
+    "utils",
+    "bvar",
+    "ops",
+    "parallel",
+    "models",
+    "protocol",
+    "rpc",
+    "transport",
+    "runtime",
+    "naming",
+    "lb",
+    "builtin",
+)
+
+
+def __getattr__(name):
+    if name in _LAZY_SUBMODULES:
+        import importlib
+
+        mod = importlib.import_module(f"incubator_brpc_tpu.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'incubator_brpc_tpu' has no attribute {name!r}")

@@ -1,0 +1,71 @@
+"""Percentile sketch (reference src/bvar/detail/percentile.h).
+
+The reference keeps per-interval reservoirs bucketed by value magnitude and
+merges them on read. Here: a fixed-size uniform reservoir per thread merged
+on read — same accuracy class, simpler, adequate for /status and
+LatencyRecorder output.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import List
+
+
+class _Reservoir:
+    __slots__ = ("samples", "count", "capacity")
+
+    def __init__(self, capacity: int):
+        self.samples: List[float] = []
+        self.count = 0
+        self.capacity = capacity
+
+    def add(self, value: float) -> None:
+        self.count += 1
+        if len(self.samples) < self.capacity:
+            self.samples.append(value)
+        else:
+            i = random.randrange(self.count)
+            if i < self.capacity:
+                self.samples[i] = value
+
+
+class Percentile:
+    def __init__(self, capacity_per_thread: int = 512):
+        self._tls = threading.local()
+        self._all: List[_Reservoir] = []
+        self._lock = threading.Lock()
+        self._capacity = capacity_per_thread
+
+    def add(self, value: float) -> None:
+        r = getattr(self._tls, "res", None)
+        if r is None:
+            r = _Reservoir(self._capacity)
+            with self._lock:
+                self._all.append(r)
+            self._tls.res = r
+        r.add(value)
+
+    def merged_samples(self) -> List[float]:
+        with self._lock:
+            rs = list(self._all)
+        out: List[float] = []
+        for r in rs:
+            out.extend(r.samples)
+        return out
+
+    def get_number(self, ratio: float) -> float:
+        """Value at quantile ``ratio`` in [0,1] (reference
+        Percentile::get_number)."""
+        s = sorted(self.merged_samples())
+        if not s:
+            return 0.0
+        idx = min(len(s) - 1, int(ratio * len(s)))
+        return s[idx]
+
+    def reset(self) -> None:
+        with self._lock:
+            for r in self._all:
+                r.samples.clear()
+                r.count = 0

@@ -1,0 +1,1 @@
+"""lb — placeholder subpackage; populated per SURVEY.md §7 build order."""

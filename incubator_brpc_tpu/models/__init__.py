@@ -1,0 +1,29 @@
+"""models — flagship workloads of the fabric.
+
+The reference validates itself with its example pairs (echo_c++,
+streaming_echo, parallel_echo — /root/reference/example/); ours are device
+workloads:
+
+- ``tensor_echo``: the echo_c++ analog — a fully jitted echo RPC step whose
+  payload lives in HBM (framing + checksum + handler + response framing).
+- ``fabricnet``: the flagship multi-chip workload — a sharded MoE/pipeline
+  network whose forward/backward exercises every combo-channel lowering
+  (dp fan-out, tp partition, pp pipeline stream, sp ring, ep all_to_all).
+"""
+
+from incubator_brpc_tpu.models.tensor_echo import TensorEchoService, make_echo_step
+from incubator_brpc_tpu.models.fabricnet import (
+    FabricNetConfig,
+    init_params,
+    make_train_step,
+    make_forward_step,
+)
+
+__all__ = [
+    "TensorEchoService",
+    "make_echo_step",
+    "FabricNetConfig",
+    "init_params",
+    "make_train_step",
+    "make_forward_step",
+]

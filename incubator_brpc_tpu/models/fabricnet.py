@@ -1,0 +1,264 @@
+"""FabricNet — the flagship multi-chip workload of the fabric.
+
+The reference proves its distribution primitives with the combo-channel
+example pairs (example/parallel_echo_c++, partition_echo_c++,
+streaming_echo_c++); FabricNet composes *all* of their TPU lowerings into one
+training step over the fabric mesh (SURVEY.md §2.5):
+
+- **dp/ep data fan-out + gradient merge** — ParallelChannel scatter/gather
+  (parallel_channel.cpp): batch sharded over ('dp','ep'), gradients psummed
+  by the shard_map transpose (the ResponseMerger with merger='sum').
+- **tp partitioned service** — PartitionChannel (partition_channel.cpp):
+  Megatron-style MLP whose hidden dim is sharded over 'tp'; the reply merge
+  is a psum riding ICI.
+- **pp pipeline** — chained streaming RPC: GPipe microbatch schedule whose
+  stage handoff is a ppermute ring over 'pp' (the credit-window stream of
+  stream.cpp with window=1 frame in flight per neighbor).
+- **sp sequence ring** — ring exchange over 'sp' built on
+  parallel.collective.ring_stream (ring-attention-style context pass).
+- **ep expert exchange** — DynamicPartitionChannel
+  (partition_channel.h:134): static round-robin token routing via all_to_all
+  over 'ep'.
+
+Everything is shard_map'd over the fabric Mesh, static-shaped, and
+differentiable — the driver's ``dryrun_multichip`` jits the full train step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from incubator_brpc_tpu.parallel.collective import ring_stream
+
+
+@dataclasses.dataclass(frozen=True)
+class FabricNetConfig:
+    d_model: int = 32
+    d_ff: int = 64  # sharded over tp — must divide by mesh tp size
+    d_expert: int = 32
+    experts_per_rank: int = 2
+    layers_per_stage: int = 1
+    batch: int = 8  # global; must divide by dp*ep*microbatches
+    seq: int = 16  # global; must divide by sp
+    microbatches: int = 2
+    lr: float = 1e-2
+    dtype: jnp.dtype = jnp.float32
+
+
+def param_specs() -> Dict[str, P]:
+    """PartitionSpecs for the param pytree (leading 'pp' = pipeline stage)."""
+    return {
+        "w_in": P("pp", None, None, "tp"),
+        "w_out": P("pp", None, "tp", None),
+        "moe_w1": P("pp", "ep", None, None),
+        "moe_w2": P("pp", "ep", None, None),
+        "gate": P("pp", None, None),
+        "head": P(),
+    }
+
+
+def batch_specs() -> Tuple[P, P]:
+    x_spec = P(("dp", "ep"), "sp", None)
+    return x_spec, x_spec
+
+
+def init_params(cfg: FabricNetConfig, mesh: jax.sharding.Mesh, seed: int = 0):
+    """Initialize the sharded param pytree directly with target shardings so
+    XLA materializes each shard on its owner (no host broadcast)."""
+    pp = mesh.shape["pp"]
+    ep = mesh.shape["ep"]
+    d, f, fe = cfg.d_model, cfg.d_ff, cfg.d_expert
+    L = cfg.layers_per_stage
+    E = cfg.experts_per_rank * ep
+    keys = jax.random.split(jax.random.key(seed), 6)
+    specs = param_specs()
+
+    def mk(key, shape, spec, scale):
+        arr = jax.random.normal(key, shape, cfg.dtype) * scale
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    return {
+        "w_in": mk(keys[0], (pp, L, d, f), specs["w_in"], 1.0 / np.sqrt(d)),
+        "w_out": mk(keys[1], (pp, L, f, d), specs["w_out"], 1.0 / np.sqrt(f)),
+        "moe_w1": mk(keys[2], (pp, E, d, fe), specs["moe_w1"], 1.0 / np.sqrt(d)),
+        "moe_w2": mk(keys[3], (pp, E, fe, d), specs["moe_w2"], 1.0 / np.sqrt(fe)),
+        "gate": mk(keys[4], (pp, d, 1), specs["gate"], 1.0 / np.sqrt(d)),
+        "head": mk(keys[5], (d, d), specs["head"], 1.0 / np.sqrt(d)),
+    }
+
+
+def _rms_norm(x: jnp.ndarray) -> jnp.ndarray:
+    return x * lax.rsqrt(jnp.mean(jnp.square(x), axis=-1, keepdims=True) + 1e-6)
+
+
+def _mlp_tp(w_in_l, w_out_l, x):
+    """Megatron MLP: hidden sharded over 'tp', reply merged with psum —
+    the PartitionChannel request/merge path on ICI."""
+    h = jax.nn.gelu(jnp.einsum("bsd,df->bsf", x, w_in_l))
+    y = jnp.einsum("bsf,fd->bsd", h, w_out_l)
+    return lax.psum(y, "tp")
+
+
+def _ring_context(x: jnp.ndarray) -> jnp.ndarray:
+    """Sequence-parallel global context via the sp ring (streaming RPC
+    lowering): fold per-shard sequence means around the ring."""
+    sp = lax.axis_size("sp")
+    local = jnp.mean(x, axis=1)  # (mb, d)
+
+    def fold(acc, received):
+        return acc + received, received
+
+    total, _ = ring_stream(local, "sp", fold, jnp.zeros_like(local))
+    return (total / sp)[:, None, :]
+
+
+def _moe(moe_w1, moe_w2, gate_w, x):
+    """Static round-robin MoE over 'ep' — DynamicPartitionChannel lowering.
+
+    Tokens (replicated gate decides magnitude, routing is static round-robin
+    by token index) are exchanged with a tiled all_to_all, processed by the
+    rank-local experts, and exchanged back (all_to_all is an involution for
+    equal tiles).
+    """
+    ep = lax.axis_size("ep")
+    e_local = moe_w1.shape[0]
+    mb, sl, d = x.shape
+    t = mb * sl
+    tokens = x.reshape(t, d)
+    g = jax.nn.sigmoid(tokens @ gate_w)  # (t, 1) learned gate
+    # group tokens by destination rank (token i -> rank i % ep), chunk-contiguous
+    grouped = tokens.reshape(t // ep, ep, d).swapaxes(0, 1).reshape(t, d)
+    routed = lax.all_to_all(grouped, "ep", split_axis=0, concat_axis=0, tiled=True)
+    # rank-local expert apply: token r -> local expert r % e_local (static)
+    xr = routed.reshape(t // e_local, e_local, d).swapaxes(0, 1)  # (e_local, t/e_local, d)
+    h = jax.nn.gelu(jnp.einsum("etd,edf->etf", xr, moe_w1))
+    yr = jnp.einsum("etf,efd->etd", h, moe_w2)
+    routed_out = yr.swapaxes(0, 1).reshape(t, d)
+    back = lax.all_to_all(routed_out, "ep", split_axis=0, concat_axis=0, tiled=True)
+    ungrouped = back.reshape(ep, t // ep, d).swapaxes(0, 1).reshape(t, d)
+    return (ungrouped * g).reshape(mb, sl, d)
+
+
+def _stage_fn(sp_params, x):
+    """One pipeline stage: L residual [tp-MLP] layers + sp ring context +
+    ep MoE block."""
+    L = sp_params["w_in"].shape[0]
+    for l in range(L):
+        x = x + _mlp_tp(sp_params["w_in"][l], sp_params["w_out"][l], _rms_norm(x))
+    x = x + _ring_context(x)
+    x = x + _moe(sp_params["moe_w1"], sp_params["moe_w2"], sp_params["gate"], _rms_norm(x))
+    return x
+
+
+def _pipeline(sp_params, xs):
+    """GPipe over 'pp': scan of M + pp - 1 ticks; stage handoff is a
+    ppermute ring (streaming-RPC frame to the right neighbor each tick)."""
+    pp = lax.axis_size("pp")
+    sidx = lax.axis_index("pp")
+    m = xs.shape[0]
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+    buf = jnp.zeros_like(xs[0])
+    outs = jnp.zeros_like(xs)
+
+    def tick(carry, t):
+        buf, outs = carry
+        inp = jnp.where(sidx == 0, xs[jnp.clip(t, 0, m - 1)], buf)
+        out = _stage_fn(sp_params, inp)
+        ot = t - (pp - 1)
+        valid = (ot >= 0) & (ot < m) & (sidx == pp - 1)
+        outs = jnp.where(valid, outs.at[jnp.clip(ot, 0, m - 1)].set(out), outs)
+        buf = lax.ppermute(out, "pp", perm)
+        return (buf, outs), None
+
+    (buf, outs), _ = lax.scan(tick, (buf, outs), jnp.arange(m + pp - 1))
+    # broadcast last stage's outputs to every pp rank (replicates over pp)
+    outs = lax.psum(jnp.where(sidx == pp - 1, outs, jnp.zeros_like(outs)), "pp")
+    return outs
+
+
+def _local_forward(cfg: FabricNetConfig, params, x):
+    """Per-rank forward body (inside shard_map). x: (B_local, S_local, d)."""
+    # squeeze this rank's pipeline-stage slice (leading pp dim is size 1 here)
+    sp_params = {
+        "w_in": params["w_in"][0],
+        "w_out": params["w_out"][0],
+        "moe_w1": params["moe_w1"][0],
+        "moe_w2": params["moe_w2"][0],
+        "gate": params["gate"][0],
+    }
+    bl, sl, d = x.shape
+    m = cfg.microbatches
+    xs = x.reshape(m, bl // m, sl, d)
+    outs = _pipeline(sp_params, xs)
+    out = outs.reshape(bl, sl, d)
+    return out @ params["head"]
+
+
+def _local_loss(cfg: FabricNetConfig, params, x, y):
+    out = _local_forward(cfg, params, x)
+    local = jnp.mean(jnp.square(out - y))
+    return lax.pmean(local, ("dp", "ep", "sp", "tp", "pp"))
+
+
+def make_forward_step(cfg: FabricNetConfig, mesh: jax.sharding.Mesh):
+    """Jitted sharded forward: (params, x) -> (B, S, d) output."""
+    x_spec, _ = batch_specs()
+    fwd = jax.shard_map(
+        partial(_local_forward, cfg),
+        mesh=mesh,
+        in_specs=(param_specs(), x_spec),
+        out_specs=x_spec,
+        check_vma=False,
+    )
+    return jax.jit(fwd)
+
+
+def make_train_step(cfg: FabricNetConfig, mesh: jax.sharding.Mesh):
+    """Jitted FULL training step (forward + backward + SGD update) with all
+    five parallelism axes live. Returns (step, init_fn)."""
+    x_spec, y_spec = batch_specs()
+    loss_fn = jax.shard_map(
+        partial(_local_loss, cfg),
+        mesh=mesh,
+        in_specs=(param_specs(), x_spec, y_spec),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    def step(params, x, y):
+        loss, grads = jax.value_and_grad(lambda p: loss_fn(p, x, y))(params)
+        new_params = jax.tree_util.tree_map(lambda p, g: p - cfg.lr * g, params, grads)
+        return new_params, loss
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def make_batch(cfg: FabricNetConfig, mesh: jax.sharding.Mesh, seed: int = 1):
+    """Random (x, y) placed with the fabric batch sharding."""
+    kx, ky = jax.random.split(jax.random.key(seed))
+    x_spec, y_spec = batch_specs()
+    shape = (cfg.batch, cfg.seq, cfg.d_model)
+    x = jax.device_put(jax.random.normal(kx, shape, cfg.dtype), NamedSharding(mesh, x_spec))
+    y = jax.device_put(jax.random.normal(ky, shape, cfg.dtype), NamedSharding(mesh, y_spec))
+    return x, y
+
+
+def validate_config(cfg: FabricNetConfig, mesh: jax.sharding.Mesh) -> None:
+    """Static divisibility checks (all shapes must be static for XLA)."""
+    dp, pp, tp, sp, ep = (mesh.shape[a] for a in ("dp", "pp", "tp", "sp", "ep"))
+    assert cfg.d_ff % tp == 0, "d_ff must divide by tp"
+    assert cfg.batch % (dp * ep) == 0, "batch must divide by dp*ep"
+    bl = cfg.batch // (dp * ep)
+    assert bl % cfg.microbatches == 0, "local batch must divide microbatches"
+    assert cfg.seq % sp == 0, "seq must divide by sp"
+    t = (bl // cfg.microbatches) * (cfg.seq // sp)
+    assert t % ep == 0, "local tokens must divide by ep"
+    assert t % (cfg.experts_per_rank * ep) == 0, "local tokens must divide experts"

@@ -1,0 +1,1 @@
+"""naming — placeholder subpackage; populated per SURVEY.md §7 build order."""

@@ -1,0 +1,32 @@
+"""ops — device-side (jit/pallas) building blocks of the fabric data plane.
+
+Where the reference frames messages on the CPU byte stream
+(src/brpc/policy/baidu_rpc_protocol.cpp header packing, src/butil/iobuf.cpp
+appends), the TPU-native design frames *in HBM with vector ops*: headers are
+uint32 lanes, checksums are vectorized folds, and the frame never leaves the
+device on the hot path.
+"""
+
+from incubator_brpc_tpu.ops.framing import (
+    HEADER_WORDS,
+    MAGIC,
+    FLAG_RESPONSE,
+    FLAG_STREAM,
+    checksum_u32,
+    frame,
+    parse,
+    to_words,
+    from_words,
+)
+
+__all__ = [
+    "HEADER_WORDS",
+    "MAGIC",
+    "FLAG_RESPONSE",
+    "FLAG_STREAM",
+    "checksum_u32",
+    "frame",
+    "parse",
+    "to_words",
+    "from_words",
+]

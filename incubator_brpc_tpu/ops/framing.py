@@ -1,0 +1,146 @@
+"""Device-side message framing — the baidu_std wire format re-expressed for HBM.
+
+Reference wire format (policy/baidu_rpc_protocol.cpp:53-58): 12-byte header
+``"PRPC" | body_size | meta_size`` followed by protobuf meta + body +
+attachment. The TPU-native frame is uint32-lane-aligned so header fields are
+single vector lanes and the whole frame is one contiguous HBM buffer:
+
+    word 0: magic "TPRC" (0x54505243)
+    word 1: payload length in words
+    word 2: flags (bit0 = response, bit1 = stream frame)
+    word 3: correlation id low 32
+    word 4: correlation id high 32
+    word 5: method id
+    word 6: checksum (vectorized fold of payload)
+    word 7: error code on responses (0 on requests)
+
+All functions are jittable with static payload shapes (XLA-friendly: no
+data-dependent shapes; parse returns an ``ok`` predicate instead of raising).
+64-bit ids are carried as uint32 lane pairs — JAX default x64-disabled mode
+never sees a 64-bit dtype.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple, Union
+
+import jax.numpy as jnp
+
+HEADER_WORDS = 8
+MAGIC = 0x54505243  # "TPRC"
+FLAG_RESPONSE = 1
+FLAG_STREAM = 2
+
+CidLike = Union[int, jnp.ndarray, Tuple]
+
+
+def to_words(x: jnp.ndarray) -> jnp.ndarray:
+    """Bit-cast any 32-bit-element array to flat uint32 lanes (the IOBuf
+    'bytes are bytes' contract: framing must not value-convert payloads)."""
+    if x.dtype == jnp.uint32:
+        return x.reshape(-1)
+    if x.dtype.itemsize != 4:
+        raise TypeError(f"payload dtype {x.dtype} is not 32-bit; pack it first")
+    import jax
+
+    return jax.lax.bitcast_convert_type(x, jnp.uint32).reshape(-1)
+
+
+def from_words(words: jnp.ndarray, dtype, shape) -> jnp.ndarray:
+    """Inverse of :func:`to_words`."""
+    import jax
+
+    if jnp.dtype(dtype) == jnp.uint32:
+        return words.reshape(shape)
+    return jax.lax.bitcast_convert_type(words, dtype).reshape(shape)
+
+
+def checksum_u32(payload: jnp.ndarray) -> jnp.ndarray:
+    """Vectorized payload checksum: wrap-around uint32 sum xor length.
+
+    Plays the role of the CRC the reference relies on TCP/RDMA for; a single
+    VPU reduction instead of a serial CRC loop (which would not vectorize).
+    """
+    payload = to_words(payload)
+    return jnp.bitwise_xor(
+        jnp.sum(payload, dtype=jnp.uint32), jnp.uint32(payload.size)
+    )
+
+
+def _split_cid(correlation_id: CidLike):
+    """Normalize a correlation id into (lo32, hi32) uint32 scalars."""
+    if isinstance(correlation_id, tuple):
+        lo, hi = correlation_id
+        return jnp.asarray(lo, jnp.uint32), jnp.asarray(hi, jnp.uint32)
+    if isinstance(correlation_id, int):
+        return jnp.uint32(correlation_id & 0xFFFFFFFF), jnp.uint32(correlation_id >> 32)
+    # traced 32-bit value
+    return jnp.asarray(correlation_id, jnp.uint32), jnp.uint32(0)
+
+
+class Header(NamedTuple):
+    magic: jnp.ndarray
+    body_words: jnp.ndarray
+    flags: jnp.ndarray
+    cid_lo: jnp.ndarray
+    cid_hi: jnp.ndarray
+    method_id: jnp.ndarray
+    checksum: jnp.ndarray
+    error_code: jnp.ndarray
+
+    @property
+    def correlation_id(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        return (self.cid_lo, self.cid_hi)
+
+
+def frame(
+    payload: jnp.ndarray,
+    correlation_id: CidLike,
+    method_id=0,
+    flags=0,
+    error_code=0,
+) -> jnp.ndarray:
+    """Build a framed message: concat(header8, payload_as_u32). Jittable."""
+    payload = to_words(payload)
+    cid_lo, cid_hi = _split_cid(correlation_id)
+    header = jnp.stack(
+        [
+            jnp.uint32(MAGIC),
+            jnp.uint32(payload.size),
+            jnp.asarray(flags, jnp.uint32),
+            cid_lo,
+            cid_hi,
+            jnp.asarray(method_id, jnp.uint32),
+            checksum_u32(payload),
+            jnp.asarray(error_code, jnp.uint32),
+        ]
+    )
+    return jnp.concatenate([header, payload])
+
+
+def parse(framed: jnp.ndarray):
+    """Split a framed buffer into (header, payload, ok).
+
+    ``ok`` is a device predicate (magic+length+checksum verified) — the
+    analog of the reference's ParseRpcMessage returning PARSE_ERROR_TRY_OTHERS
+    (baidu_rpc_protocol.cpp:92-134), kept branch-free for XLA.
+    """
+    framed = to_words(framed)
+    h = framed[:HEADER_WORDS]
+    payload = framed[HEADER_WORDS:]
+    header = Header(
+        magic=h[0],
+        body_words=h[1],
+        flags=h[2],
+        cid_lo=h[3],
+        cid_hi=h[4],
+        method_id=h[5],
+        checksum=h[6],
+        error_code=h[7],
+    )
+    ok = (
+        (h[0] == jnp.uint32(MAGIC))
+        & (h[1] == jnp.uint32(payload.size))
+        & (h[6] == checksum_u32(payload))
+    )
+    return header, payload, ok

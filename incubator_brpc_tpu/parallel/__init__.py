@@ -1,0 +1,36 @@
+"""parallel — mesh + collective lowerings of the reference's combo channels.
+
+SURVEY.md §2.5 maps each reference distribution primitive to its TPU-native
+equivalent; this package implements that column:
+
+| reference primitive            | here                                   |
+|--------------------------------|----------------------------------------|
+| ParallelChannel fan-out/merge  | ``fanout``/``merge`` (all_gather/psum) |
+| PartitionChannel sharding      | ``partition_exchange`` (all_to_all)    |
+| Streaming RPC credit window    | ``ring_stream`` (ppermute ring)        |
+| SelectiveChannel replica sets  | replica groups over mesh sub-axes      |
+"""
+
+from incubator_brpc_tpu.parallel.mesh import (
+    FABRIC_AXES,
+    default_axis_sizes,
+    make_fabric_mesh,
+)
+from incubator_brpc_tpu.parallel.collective import (
+    fanout,
+    merge,
+    partition_exchange,
+    ring_stream,
+    ring_allgather,
+)
+
+__all__ = [
+    "FABRIC_AXES",
+    "default_axis_sizes",
+    "make_fabric_mesh",
+    "fanout",
+    "merge",
+    "partition_exchange",
+    "ring_stream",
+    "ring_allgather",
+]

@@ -1,0 +1,1 @@
+"""rpc — placeholder subpackage; populated per SURVEY.md §7 build order."""

@@ -1,0 +1,1 @@
+"""transport — placeholder subpackage; populated per SURVEY.md §7 build order."""

@@ -1,0 +1,21 @@
+"""utils — base library (the reference's L1 ``src/butil/`` analog).
+
+Python-visible pieces of the base layer: EndPoint (extended with mesh
+coordinates), Status/ErrorCode, the flag registry, and bindings to the native
+C++ base library (IOBuf, pools) once loaded. See SURVEY.md §2.1.
+"""
+
+from incubator_brpc_tpu.utils.endpoint import EndPoint, str2endpoint
+from incubator_brpc_tpu.utils.status import Status, ErrorCode
+from incubator_brpc_tpu.utils.flags import define_flag, get_flag, set_flag, flag_registry
+
+__all__ = [
+    "EndPoint",
+    "str2endpoint",
+    "Status",
+    "ErrorCode",
+    "define_flag",
+    "get_flag",
+    "set_flag",
+    "flag_registry",
+]

@@ -1,0 +1,89 @@
+"""EndPoint — addressing for the TPU fabric.
+
+The reference's ``butil::EndPoint`` (src/butil/endpoint.h) is an ip:port value
+type. The TPU-native design extends it with *mesh coordinates*: an endpoint
+addresses either a host socket (ip:port — used for DCN bootstrap, tests, and
+builtin services) or a device in a ``jax.sharding.Mesh`` (process index +
+local device ordinal + named mesh coords), per SURVEY.md §7 step 1.
+"""
+
+from __future__ import annotations
+
+import re
+import socket as _socket
+from dataclasses import dataclass, field
+from typing import Mapping, Optional, Tuple
+
+IP_ANY = "0.0.0.0"
+
+
+@dataclass(frozen=True)
+class EndPoint:
+    """ip:port plus optional device/mesh coordinates.
+
+    ``device`` is None for plain host endpoints. For device endpoints it is a
+    tuple ``(process_index, local_device_ordinal)`` and ``mesh_coords`` maps
+    mesh axis name -> index (e.g. {'dp': 0, 'tp': 3}).
+    """
+
+    ip: str = IP_ANY
+    port: int = 0
+    device: Optional[Tuple[int, int]] = None
+    mesh_coords: Mapping[str, int] = field(default_factory=dict)
+
+    def is_device(self) -> bool:
+        return self.device is not None
+
+    def __str__(self) -> str:
+        base = f"{self.ip}:{self.port}"
+        if self.device is not None:
+            coords = ",".join(f"{k}={v}" for k, v in sorted(self.mesh_coords.items()))
+            return f"tpu://{base}/d{self.device[0]}.{self.device[1]}[{coords}]"
+        return base
+
+    def __lt__(self, other: "EndPoint") -> bool:
+        return (self.ip, self.port, self.device or (-1, -1)) < (
+            other.ip,
+            other.port,
+            other.device or (-1, -1),
+        )
+
+
+_EP_RE = re.compile(r"^(?:(?P<host>[^:/\[\]]+)|\[(?P<v6>[^\]]+)\])(?::(?P<port>\d+))?$")
+
+
+def str2endpoint(s: str, default_port: int = 0) -> EndPoint:
+    """Parse 'ip:port', 'host:port' or 'tpu://ip:port/dP.O' into an EndPoint.
+
+    Analog of reference str2endpoint/hostname2endpoint
+    (src/butil/endpoint.cpp) — hostname resolution included.
+    """
+    s = s.strip()
+    device = None
+    if s.startswith("tpu://"):
+        rest = s[len("tpu://"):]
+        if "/" in rest:
+            rest, dev = rest.split("/", 1)
+            m = re.match(r"^d(\d+)\.(\d+)", dev)
+            if not m:
+                raise ValueError(f"bad device endpoint: {s}")
+            device = (int(m.group(1)), int(m.group(2)))
+        s = rest
+    m = _EP_RE.match(s)
+    if not m:
+        raise ValueError(f"bad endpoint: {s!r}")
+    host = m.group("host") or m.group("v6")
+    port = int(m.group("port")) if m.group("port") else default_port
+    # numeric literal (v4 or v6) passes through; otherwise resolve the
+    # hostname (reference hostname2endpoint, src/butil/endpoint.cpp)
+    for family in (_socket.AF_INET, _socket.AF_INET6):
+        try:
+            _socket.inet_pton(family, host)
+            return EndPoint(ip=host, port=port, device=device)
+        except OSError:
+            pass
+    try:
+        ip = _socket.gethostbyname(host)
+    except OSError as e:
+        raise ValueError(f"cannot resolve endpoint host {host!r}: {e}") from e
+    return EndPoint(ip=ip, port=port, device=device)

@@ -1,0 +1,88 @@
+"""Flag registry — analog of the reference's gflags + reloadable_flags.
+
+The reference defines ``DEFINE_*`` flags next to every subsystem and allows
+runtime mutation through the ``/flags`` builtin service, gated by validators
+(src/brpc/reloadable_flags.h). Here: a process-global registry of typed
+flags with optional validators; the builtin flags service reads/writes it.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass
+class _Flag:
+    name: str
+    value: Any
+    default: Any
+    help: str
+    type: type
+    validator: Optional[Callable[[Any], bool]] = None
+    reloadable: bool = False
+
+
+class FlagRegistry:
+    def __init__(self) -> None:
+        self._flags: Dict[str, _Flag] = {}
+        self._lock = threading.Lock()
+
+    def define(
+        self,
+        name: str,
+        default: Any,
+        help: str = "",
+        validator: Optional[Callable[[Any], bool]] = None,
+    ) -> None:
+        with self._lock:
+            if name in self._flags:
+                return  # idempotent (module reloads in tests)
+            self._flags[name] = _Flag(
+                name=name,
+                value=default,
+                default=default,
+                help=help,
+                type=type(default),
+                validator=validator,
+                reloadable=validator is not None,
+            )
+
+    def get(self, name: str) -> Any:
+        return self._flags[name].value
+
+    def set(self, name: str, value: Any) -> bool:
+        """Set a flag; reloadable (validator-bearing) flags only, like the
+        reference's /flags service (builtin/flags_service.cpp)."""
+        with self._lock:
+            f = self._flags[name]
+            value = f.type(value)
+            if f.validator is not None and not f.validator(value):
+                return False
+            f.value = value
+            return True
+
+    def set_unchecked(self, name: str, value: Any) -> None:
+        with self._lock:
+            f = self._flags[name]
+            f.value = f.type(value)
+
+    def items(self):
+        return sorted(self._flags.items())
+
+
+flag_registry = FlagRegistry()
+define_flag = flag_registry.define
+get_flag = flag_registry.get
+set_flag = flag_registry.set
+
+
+# Core framework flags (reference: DEFINE_* scattered through src/brpc/)
+define_flag("health_check_interval", 3, "seconds between health-check probes of a failed socket", lambda v: v > 0)
+define_flag("event_dispatcher_num", 1, "number of event dispatchers")
+define_flag("fiber_concurrency", 8, "number of worker threads in the fiber scheduler")
+define_flag("max_body_size", 64 * 1024 * 1024, "maximum message body size", lambda v: v > 0)
+define_flag("socket_max_unwritten_bytes", 64 * 1024 * 1024, "write-queue backpressure threshold (EOVERCROWDED)", lambda v: v > 0)
+define_flag("enable_rpcz", False, "collect rpcz spans", lambda v: True)
+define_flag("rpcz_keep_span_seconds", 1800, "span retention", lambda v: v > 0)

@@ -1,0 +1,111 @@
+"""Error codes and Status — analog of the reference's ``src/brpc/errno.proto``
+and ``butil::Status`` (``src/butil/status.h``).
+
+The numeric values for the RPC-specific codes follow the reference's
+``errno.proto`` so that logs/tools line up; system errno values are taken
+from the host ``errno`` module.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+
+class ErrorCode(enum.IntEnum):
+    """RPC error codes — values mirror reference src/brpc/errno.proto:32-72."""
+
+    OK = 0
+
+    # Errno caused by client
+    ENOSERVICE = 1001  # service not found
+    ENOMETHOD = 1002  # method not found
+    EREQUEST = 1003  # bad request
+    ERPCAUTH = 1004  # unauthorized
+    ETOOMANYFAILS = 1005  # too many sub-channel failures (ParallelChannel)
+    EPCHANFINISH = 1006  # ParallelChannel finished
+    EBACKUPREQUEST = 1007  # sending backup request (internal trigger)
+    ERPCTIMEDOUT = 1008  # RPC call timed out
+    EFAILEDSOCKET = 1009  # broken socket during RPC
+    EHTTP = 1010  # bad http call
+    EOVERCROWDED = 1011  # socket write buffer full (backpressure)
+    ERTMPPUBLISHABLE = 1012
+    ERTMPCREATESTREAM = 1013
+    EEOF = 1014  # got EOF
+    EUNUSED = 1015  # socket never used
+    ESSL = 1016
+
+    # Errno caused by server
+    EINTERNAL = 2001  # server internal error
+    ERESPONSE = 2002  # bad response
+    ELOGOFF = 2003  # server is stopping
+    ELIMIT = 2004  # max_concurrency reached
+
+    # Errno related to RPC framework itself
+    ETERMINATED = 3001
+    EDESTROYED = 3002
+    EINVALIDDATA = 3003
+
+    # Common host errnos reused by the framework
+    EAGAIN = 11
+    EINVAL = 22
+    ENODATA = 61
+    ENOMEM = 12
+    ETIMEDOUT = 110
+
+
+_DESCRIPTIONS = {
+    ErrorCode.OK: "OK",
+    ErrorCode.ENOSERVICE: "The service does not exist",
+    ErrorCode.ENOMETHOD: "The method does not exist",
+    ErrorCode.EREQUEST: "Bad request",
+    ErrorCode.ERPCAUTH: "Unauthorized",
+    ErrorCode.ETOOMANYFAILS: "Too many sub-channel failures",
+    ErrorCode.EBACKUPREQUEST: "Backup request triggered",
+    ErrorCode.ERPCTIMEDOUT: "RPC call timed out",
+    ErrorCode.EFAILEDSOCKET: "Broken socket during RPC",
+    ErrorCode.EOVERCROWDED: "The socket is overcrowded",
+    ErrorCode.EEOF: "Got EOF",
+    ErrorCode.EINTERNAL: "Server internal error",
+    ErrorCode.ERESPONSE: "Bad response",
+    ErrorCode.ELOGOFF: "Server is stopping",
+    ErrorCode.ELIMIT: "Reached server's max_concurrency",
+}
+
+
+def berror(code: int) -> str:
+    """Text for an error code — analog of reference berror() (errno.cpp)."""
+    try:
+        code = ErrorCode(code)
+    except ValueError:
+        import os
+
+        return os.strerror(code)
+    return _DESCRIPTIONS.get(code, code.name)
+
+
+@dataclass
+class Status:
+    """Carries an error code + message; ok() iff code == 0.
+
+    Analog of butil::Status (reference src/butil/status.h) — used as the
+    return of controller-level operations instead of exceptions on hot paths.
+    """
+
+    code: int = 0
+    message: str = ""
+
+    def ok(self) -> bool:
+        return self.code == 0
+
+    @classmethod
+    def OK(cls) -> "Status":
+        return cls(0, "")
+
+    def error_str(self) -> str:
+        if self.ok():
+            return "OK"
+        return self.message or berror(self.code)
+
+    def __bool__(self) -> bool:  # truthiness == ok, matching butil::Status use
+        return self.ok()

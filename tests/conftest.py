@@ -1,0 +1,21 @@
+"""Test harness: force an 8-device virtual CPU mesh (SURVEY.md §4 —
+multi-node behavior is validated in-process, the reference's loopback-test
+shape; here the 'loopback' is xla_force_host_platform_device_count).
+
+Note: this machine's sitecustomize registers the axon TPU plugin and calls
+``jax.config.update("jax_platforms", "axon,cpu")`` at interpreter start, so
+setting JAX_PLATFORMS in the environment is NOT enough — we must override the
+config after importing jax (backends are still uninitialized at that point).
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,95 @@
+"""Framing + tensor-echo tests — analog of the reference's protocol
+conformance suites that call parse/pack handlers directly on hand-built
+buffers (SURVEY.md §4, brpc_*_protocol_unittest pattern)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from incubator_brpc_tpu.ops import framing
+from incubator_brpc_tpu.models.tensor_echo import TensorEchoService, make_echo_step
+
+
+def test_frame_parse_roundtrip():
+    payload = jnp.arange(100, dtype=jnp.uint32)
+    framed = framing.frame(payload, correlation_id=0x1234567890, method_id=7, flags=framing.FLAG_STREAM)
+    header, out, ok = framing.parse(framed)
+    assert bool(ok)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(payload))
+    assert int(header.method_id) == 7
+    assert int(header.flags) == framing.FLAG_STREAM
+    assert int(header.cid_lo) == 0x34567890
+    assert int(header.cid_hi) == 0x12
+    assert int(header.body_words) == 100
+
+
+def test_parse_rejects_corruption():
+    payload = jnp.arange(64, dtype=jnp.uint32)
+    framed = framing.frame(payload, correlation_id=1)
+    corrupt = framed.at[framing.HEADER_WORDS + 3].add(1)  # flip a payload word
+    _, _, ok = framing.parse(corrupt)
+    assert not bool(ok)
+    bad_magic = framed.at[0].set(0)
+    _, _, ok2 = framing.parse(bad_magic)
+    assert not bool(ok2)
+
+
+def test_echo_step_roundtrip():
+    step, request = make_echo_step(payload_words=128)
+    response = step(request)
+    header, payload, ok = framing.parse(response)
+    assert bool(ok)
+    assert int(header.flags) & framing.FLAG_RESPONSE
+    assert int(header.error_code) == 0
+    np.testing.assert_array_equal(
+        np.asarray(payload), np.asarray(request[framing.HEADER_WORDS :])
+    )
+
+
+def test_echo_step_bad_frame_gets_error_response():
+    step, request = make_echo_step(payload_words=128)
+    corrupt = request.at[6].add(1)  # break checksum
+    response = step(corrupt)
+    header, payload, ok = framing.parse(response)
+    assert bool(ok)  # response itself is well-formed
+    assert int(header.error_code) == 1003  # EREQUEST
+    assert int(np.asarray(payload).sum()) == 0
+
+
+def test_multi_method_dispatch():
+    svc = TensorEchoService()
+    svc.add_method(1, lambda p: p + jnp.uint32(1))
+    step = jax.jit(svc.step)
+    payload = jnp.arange(32, dtype=jnp.uint32)
+    req = framing.frame(payload, correlation_id=9, method_id=1)
+    _, out, _ = framing.parse(step(req))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(payload) + 1)
+    with pytest.raises(ValueError):
+        svc.add_method(1, lambda p: p)
+
+
+def test_float_payload_bitcast_roundtrip():
+    x = jnp.array([2.5, -1.0, 0.1, 3e38], jnp.float32)
+    framed = framing.frame(x, correlation_id=2)
+    _, words, ok = framing.parse(framed)
+    assert bool(ok)
+    back = framing.from_words(words, jnp.float32, x.shape)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+
+
+def test_sparse_method_ids_and_enomethod():
+    svc = TensorEchoService()
+    svc.add_method(3, lambda p: p * jnp.uint32(2))
+    svc.add_method(5, lambda p: p + jnp.uint32(10))
+    step = jax.jit(svc.step)
+    payload = jnp.arange(16, dtype=jnp.uint32)
+    # sparse id 3 must hit ITS handler, not an index-3 slot
+    h3, out3, _ = framing.parse(step(framing.frame(payload, 1, method_id=3)))
+    np.testing.assert_array_equal(np.asarray(out3), np.asarray(payload) * 2)
+    h5, out5, _ = framing.parse(step(framing.frame(payload, 1, method_id=5)))
+    np.testing.assert_array_equal(np.asarray(out5), np.asarray(payload) + 10)
+    # unknown id -> ENOMETHOD error frame with zeroed payload
+    h99, out99, _ = framing.parse(step(framing.frame(payload, 1, method_id=99)))
+    assert int(h99.error_code) == 1002
+    assert int(np.asarray(out99).sum()) == 0

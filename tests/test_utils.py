@@ -1,0 +1,47 @@
+"""utils tests: EndPoint parsing, Status, flags."""
+
+import pytest
+
+from incubator_brpc_tpu.utils import EndPoint, str2endpoint, Status, ErrorCode
+from incubator_brpc_tpu.utils.flags import FlagRegistry
+
+
+def test_endpoint_parse_v4():
+    ep = str2endpoint("127.0.0.1:8787")
+    assert (ep.ip, ep.port) == ("127.0.0.1", 8787)
+    assert not ep.is_device()
+
+
+def test_endpoint_parse_v6_literal():
+    ep = str2endpoint("[::1]:80")
+    assert ep.ip == "::1" and ep.port == 80
+
+
+def test_endpoint_parse_device():
+    ep = str2endpoint("tpu://10.0.0.1:9000/d2.3")
+    assert ep.is_device() and ep.device == (2, 3)
+    assert "tpu://" in str(ep)
+
+
+def test_endpoint_unresolvable_raises_valueerror():
+    with pytest.raises(ValueError):
+        str2endpoint("no-such-host-xyz.invalid:1")
+
+
+def test_status_and_berror():
+    s = Status.OK()
+    assert s.ok() and bool(s)
+    f = Status(ErrorCode.ERPCTIMEDOUT)
+    assert not f.ok()
+    assert "timed out" in f.error_str().lower()
+
+
+def test_flags_validator_gate():
+    reg = FlagRegistry()
+    reg.define("x", 5, "test", validator=lambda v: v > 0)
+    reg.define("y", "a", "no validator")
+    assert reg.get("x") == 5
+    assert reg.set("x", 7) and reg.get("x") == 7
+    assert not reg.set("x", -1) and reg.get("x") == 7
+    reg.set_unchecked("y", "b")
+    assert reg.get("y") == "b"
