@@ -1,1 +1,28 @@
-"""protocol — placeholder subpackage; populated per SURVEY.md §7 build order."""
+"""protocol — wire protocols + registry (reference L4, src/brpc/policy/).
+
+The host wire format ("tbus_std") shares its 8×uint32 header layout with the
+device frame (ops/framing.py) so a message can move host↔HBM without
+re-framing — the TPU analog of baidu_std's fixed 12-byte header
+(policy/baidu_rpc_protocol.cpp:53-58).
+"""
+
+from incubator_brpc_tpu.protocol.tbus_std import (
+    HEADER_BYTES,
+    Meta,
+    ParseError,
+    ParsedFrame,
+    pack_frame,
+    try_parse_frame,
+)
+from incubator_brpc_tpu.protocol.registry import Protocol, protocol_registry
+
+__all__ = [
+    "HEADER_BYTES",
+    "Meta",
+    "ParseError",
+    "ParsedFrame",
+    "pack_frame",
+    "try_parse_frame",
+    "Protocol",
+    "protocol_registry",
+]

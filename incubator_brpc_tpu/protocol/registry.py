@@ -1,0 +1,48 @@
+"""Protocol registry (reference src/brpc/protocol.h:64-158 + global.cpp).
+
+A Protocol is a bundle of parse/pack callbacks registered per name; servers
+try registered protocols in order on each connection and remember the first
+that matches (_preferred_index, input_messenger.cpp:60-129).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+
+@dataclass
+class Protocol:
+    name: str
+    # (buf) -> (parsed_or_None, consumed); raises ParseError if not this protocol
+    parse: Callable
+    # client side: (meta, payload, cid, ...) -> bytes
+    pack_request: Optional[Callable] = None
+    # server side: (socket, frame, server) -> None
+    process_request: Optional[Callable] = None
+    # client side: (socket, frame) -> None
+    process_response: Optional[Callable] = None
+
+
+class ProtocolRegistry:
+    def __init__(self) -> None:
+        self._protocols: Dict[str, Protocol] = {}
+        self._order: List[Protocol] = []
+
+    def register(self, proto: Protocol) -> None:
+        if proto.name in self._protocols:
+            raise ValueError(f"protocol {proto.name!r} already registered")
+        self._protocols[proto.name] = proto
+        self._order.append(proto)
+
+    def get(self, name: str) -> Protocol:
+        return self._protocols[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._protocols
+
+    def ordered(self) -> List[Protocol]:
+        return list(self._order)
+
+
+protocol_registry = ProtocolRegistry()

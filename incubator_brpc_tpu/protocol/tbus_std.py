@@ -1,0 +1,162 @@
+"""tbus_std — the canonical host wire protocol.
+
+Layout (little-endian), mirroring the device frame of ops/framing.py so the
+same header parses on both sides of the PCIe/ICI boundary:
+
+    8 × uint32 header:
+        0 magic "TPRC"
+        1 body length in BYTES (meta + payload + attachment)
+        2 flags (bit0 response, bit1 stream, bit2 has-meta)
+        3 correlation id low
+        4 correlation id high
+        5 meta length in bytes
+        6 crc32 of body
+        7 error code (responses)
+    body = meta (JSON, self-describing like baidu_std's RpcMeta proto —
+    policy/baidu_rpc_meta.proto) + payload + attachment.
+
+The reference carries service/method/compress/attachment_size in a protobuf
+RpcMeta; a JSON meta keeps the frame self-describing without a codegen
+dependency (the native C++ runtime will read the same bytes).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+MAGIC = 0x54505243  # "TPRC" — same as ops.framing.MAGIC
+HEADER_BYTES = 32
+_HDR = struct.Struct("<8I")
+
+FLAG_RESPONSE = 1
+FLAG_STREAM = 2
+FLAG_HAS_META = 4
+
+
+@dataclass
+class Meta:
+    """Request/response metadata — the RpcMeta analog
+    (policy/baidu_rpc_meta.proto fields: service/method/compress/attachment/
+    trace ids)."""
+
+    service: str = ""
+    method: str = ""
+    compress: str = ""  # "", "gzip", "snappy" (zlib stands in for snappy)
+    attachment_size: int = 0
+    log_id: int = 0
+    trace_id: int = 0
+    span_id: int = 0
+    parent_span_id: int = 0
+    stream_id: int = 0
+    stream_offset: int = 0
+    stream_close: bool = False
+    error_text: str = ""
+    extra: dict = field(default_factory=dict)
+
+    def to_bytes(self) -> bytes:
+        d = {k: v for k, v in self.__dict__.items() if v not in ("", 0, False, {}, None)}
+        return json.dumps(d, separators=(",", ":")).encode()
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "Meta":
+        m = cls()
+        if b:
+            for k, v in json.loads(b).items():
+                if hasattr(m, k):
+                    setattr(m, k, v)
+        return m
+
+
+def pack_frame(
+    meta: Optional[Meta],
+    payload: bytes,
+    correlation_id: int,
+    flags: int = 0,
+    error_code: int = 0,
+    attachment: bytes = b"",
+) -> bytes:
+    """Serialize one frame. The reference splits this between
+    SerializeRequest and PackRpcRequest (baidu_rpc_protocol.cpp:585-668)."""
+    meta_bytes = b""
+    if meta is not None:
+        if attachment:
+            meta.attachment_size = len(attachment)
+        meta_bytes = meta.to_bytes()
+        flags |= FLAG_HAS_META
+    body = meta_bytes + payload + attachment
+    header = _HDR.pack(
+        MAGIC,
+        len(body),
+        flags,
+        correlation_id & 0xFFFFFFFF,
+        (correlation_id >> 32) & 0xFFFFFFFF,
+        len(meta_bytes),
+        zlib.crc32(body) & 0xFFFFFFFF,
+        error_code,
+    )
+    return header + body
+
+
+@dataclass
+class ParsedFrame:
+    meta: Meta
+    payload: bytes
+    attachment: bytes
+    correlation_id: int
+    flags: int
+    error_code: int
+
+    @property
+    def is_response(self) -> bool:
+        return bool(self.flags & FLAG_RESPONSE)
+
+    @property
+    def is_stream(self) -> bool:
+        return bool(self.flags & FLAG_STREAM)
+
+
+class ParseError(Exception):
+    """Unrecoverable garbage on the wire (magic/crc mismatch) — the
+    reference's PARSE_ERROR_TRY_OTHERS→close path."""
+
+
+def try_parse_frame(buf: bytes) -> Tuple[Optional[ParsedFrame], int]:
+    """Attempt to cut one frame off ``buf``.
+
+    Returns (frame, consumed). (None, 0) means not enough bytes yet — the
+    resumable-parse contract of InputMessenger::CutInputMessage
+    (input_messenger.cpp:60-129). Raises ParseError on corruption.
+    """
+    if len(buf) < HEADER_BYTES:
+        return None, 0
+    magic, body_len, flags, cid_lo, cid_hi, meta_len, crc, err = _HDR.unpack_from(buf)
+    if magic != MAGIC:
+        raise ParseError(f"bad magic {magic:#x}")
+    if meta_len > body_len:
+        raise ParseError("meta longer than body")
+    total = HEADER_BYTES + body_len
+    if len(buf) < total:
+        return None, 0
+    body = bytes(buf[HEADER_BYTES:total])
+    if zlib.crc32(body) & 0xFFFFFFFF != crc:
+        raise ParseError("crc mismatch")
+    meta = Meta.from_bytes(body[:meta_len])
+    rest = body[meta_len:]
+    att = meta.attachment_size
+    if att:
+        payload, attachment = rest[: len(rest) - att], rest[len(rest) - att :]
+    else:
+        payload, attachment = rest, b""
+    frame = ParsedFrame(
+        meta=meta,
+        payload=payload,
+        attachment=attachment,
+        correlation_id=cid_lo | (cid_hi << 32),
+        flags=flags,
+        error_code=err,
+    )
+    return frame, total
