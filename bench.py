@@ -40,7 +40,7 @@ def main() -> None:
     words_large = 2 * 1024 * 1024  # 8 MiB of uint32
     step, request = make_echo_step(payload_words=words_large)
     per_call = _bench_one(step, request, iters=30)
-    bytes_moved = words_large * 4 * 2  # request parsed + response framed
+    bytes_moved = words_large * 4  # one payload per pass (convention: count once)
     gbps = bytes_moved / per_call / 1e9
     results["large_frame_gbps"] = gbps
 
@@ -63,7 +63,7 @@ def main() -> None:
                     "small_frame_us": round(results["small_frame_us"], 2),
                     "small_frame_qps": round(results["small_frame_qps"]),
                     "device": str(jax.devices()[0]),
-                    "baseline": "brpc same-machine >=32KB multi-conn ~2.3 GB/s (docs/cn/benchmark.md:106)",
+                    "baseline": "brpc same-machine >=32KB multi-conn ~2.3 GB/s (docs/cn/benchmark.md:106); NOTE: on-device HBM echo vs the reference's network loopback — not apples-to-apples",
                 },
             }
         )

@@ -6,10 +6,13 @@ Brand-new framework (not a port) re-architected for TPU:
   channels (ParallelChannel / PartitionChannel / SelectiveChannel, see
   reference ``src/brpc/parallel_channel.h``) lower to ICI collectives
   (all_gather / all_to_all / psum) instead of N point-to-point writes;
-- the host/control plane is a native C++ runtime being built bottom-up in
-  ``src/`` (SURVEY.md §7 order): IOBuf zero-copy block chains with pluggable
-  (HBM-registered) allocators, M:N fiber scheduling parked on butexes, a
-  wait-free socket write path — bound into Python via ctypes;
+- the host/control plane follows SURVEY.md §7's order with a split
+  implementation: the L1 buffer core (IOBuf zero-copy block chains with
+  pluggable allocators, Resource/Object pools) targets native C++ under
+  ``src/`` bound via ctypes (check ``src/`` for current build state), while
+  the L2-L5 control plane (scheduler, sockets, channel/server) is
+  Python-on-threads — a stated deviation from SURVEY §2's all-native goal,
+  tracked for native replacement;
 - observability (bvar metrics, rpcz spans, builtin status services) is kept
   intact, as in the reference's L6 (``src/brpc/builtin/``).
 

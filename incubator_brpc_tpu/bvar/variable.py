@@ -79,6 +79,11 @@ class Variable:
             self.expose(name)
 
     def expose(self, name: str) -> bool:
+        # Re-exposing under a new name first drops the old registry entry
+        # (the reference re-registers in Variable::expose_impl); otherwise
+        # the old entry would pin this Variable in the registry forever.
+        if self._exposed_name is not None:
+            self.hide()
         return expose_registry.expose(name, self)
 
     def hide(self) -> bool:

@@ -1,9 +1,10 @@
 """protocol — wire protocols + registry (reference L4, src/brpc/policy/).
 
-The host wire format ("tbus_std") shares its 8×uint32 header layout with the
-device frame (ops/framing.py) so a message can move host↔HBM without
-re-framing — the TPU analog of baidu_std's fixed 12-byte header
-(policy/baidu_rpc_protocol.cpp:53-58).
+The host wire format ("tbus_std") is the TPU analog of baidu_std's fixed
+12-byte header (policy/baidu_rpc_protocol.cpp:53-58). It shares the magic
+and the 8×uint32 header *shape* with the device frame (ops/framing.py), but
+field semantics differ — the device transport re-frames at the host↔HBM
+boundary.
 """
 
 from incubator_brpc_tpu.protocol.tbus_std import (

@@ -1,7 +1,11 @@
 """tbus_std — the canonical host wire protocol.
 
-Layout (little-endian), mirroring the device frame of ops/framing.py so the
-same header parses on both sides of the PCIe/ICI boundary:
+Layout (little-endian). The header shares the magic and the 8×uint32 shape
+with the device frame of ops/framing.py, but field semantics differ (word 1
+is body *bytes* here vs payload *words* there; word 5 is meta length vs
+method id; word 6 is crc32 vs sum-xor) — host frames are re-framed at the
+host↔HBM boundary by the device transport, they do not parse as device
+frames:
 
     8 × uint32 header:
         0 magic "TPRC"
@@ -25,7 +29,7 @@ from __future__ import annotations
 import json
 import struct
 import zlib
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Optional, Tuple
 
 MAGIC = 0x54505243  # "TPRC" — same as ops.framing.MAGIC
@@ -80,11 +84,18 @@ def pack_frame(
     attachment: bytes = b"",
 ) -> bytes:
     """Serialize one frame. The reference splits this between
-    SerializeRequest and PackRpcRequest (baidu_rpc_protocol.cpp:585-668)."""
+    SerializeRequest and PackRpcRequest (baidu_rpc_protocol.cpp:585-668).
+
+    attachment_size is authoritative per frame (as in the reference's
+    RpcMeta): it is always (re)computed here, never inherited from a reused
+    Meta, and the caller's Meta is never mutated. A non-empty attachment
+    requires a Meta to carry its size.
+    """
+    if attachment and meta is None:
+        raise ValueError("non-empty attachment requires a Meta to carry its size")
     meta_bytes = b""
     if meta is not None:
-        if attachment:
-            meta.attachment_size = len(attachment)
+        meta = replace(meta, attachment_size=len(attachment))
         meta_bytes = meta.to_bytes()
         flags |= FLAG_HAS_META
     body = meta_bytes + payload + attachment
@@ -147,6 +158,8 @@ def try_parse_frame(buf: bytes) -> Tuple[Optional[ParsedFrame], int]:
     meta = Meta.from_bytes(body[:meta_len])
     rest = body[meta_len:]
     att = meta.attachment_size
+    if att > len(rest):
+        raise ParseError(f"attachment_size {att} exceeds body remainder {len(rest)}")
     if att:
         payload, attachment = rest[: len(rest) - att], rest[len(rest) - att :]
     else:

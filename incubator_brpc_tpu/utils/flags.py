@@ -54,11 +54,15 @@ class FlagRegistry:
 
     def set(self, name: str, value: Any) -> bool:
         """Set a flag; reloadable (validator-bearing) flags only, like the
-        reference's /flags service (builtin/flags_service.cpp)."""
+        reference's /flags service (builtin/flags_service.cpp): runtime
+        mutation of non-reloadable flags is rejected
+        (src/brpc/reloadable_flags.h)."""
         with self._lock:
             f = self._flags[name]
+            if not f.reloadable:
+                return False
             value = f.type(value)
-            if f.validator is not None and not f.validator(value):
+            if not f.validator(value):
                 return False
             f.value = value
             return True

@@ -40,11 +40,20 @@ class ErrorCode(enum.IntEnum):
     ERESPONSE = 2002  # bad response
     ELOGOFF = 2003  # server is stopping
     ELIMIT = 2004  # max_concurrency reached
+    ECLOSE = 2005  # close socket initiatively
+    EITP = 2006  # failed Itp response
 
-    # Errno related to RPC framework itself
-    ETERMINATED = 3001
-    EDESTROYED = 3002
-    EINVALIDDATA = 3003
+    # Errno related to the device transport (the reference's 3001/3002 are
+    # ERDMA/ERDMACM — RDMA verbs / rdmacm errors; this framework's transport
+    # slot is TPU ICI/DCN, so the same numbers name the transport analog)
+    ETRANSPORT = 3001  # device transport (ICI/DMA) error, analog of ERDMA
+    ETRANSPORTCM = 3002  # mesh/connection-manager error, analog of ERDMACM
+
+    # Errno new in this framework (no reference counterpart; values chosen
+    # outside errno.proto's 1001-3002 range to avoid collision)
+    ETERMINATED = 4001
+    EDESTROYED = 4002
+    EINVALIDDATA = 4003
 
     # Common host errnos reused by the framework
     EAGAIN = 11
@@ -66,6 +75,11 @@ _DESCRIPTIONS = {
     ErrorCode.EFAILEDSOCKET: "Broken socket during RPC",
     ErrorCode.EOVERCROWDED: "The socket is overcrowded",
     ErrorCode.EEOF: "Got EOF",
+    ErrorCode.ETRANSPORT: "Device transport error",
+    ErrorCode.ETRANSPORTCM: "Mesh connection-manager error",
+    ErrorCode.ETERMINATED: "Terminated",
+    ErrorCode.EDESTROYED: "Destroyed",
+    ErrorCode.EINVALIDDATA: "Invalid data",
     ErrorCode.EINTERNAL: "Server internal error",
     ErrorCode.ERESPONSE: "Bad response",
     ErrorCode.ELOGOFF: "Server is stopping",

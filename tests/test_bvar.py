@@ -62,6 +62,15 @@ def test_expose_registry_and_normalize():
     assert a.hide()
 
 
+def test_re_expose_drops_old_registry_entry():
+    a = bvar.Adder(name="test_reexpose_old")
+    assert a.expose("test_reexpose_new")
+    assert bvar.dump_exposed("test_reexpose_old") == {}
+    assert "test_reexpose_new" in bvar.dump_exposed("test_reexpose_new")
+    assert a.hide()
+    assert bvar.dump_exposed("test_reexpose") == {}
+
+
 def test_passive_status():
     x = {"v": 1}
     p = bvar.PassiveStatus(lambda: x["v"] * 2)

@@ -45,3 +45,23 @@ def test_flags_validator_gate():
     assert not reg.set("x", -1) and reg.get("x") == 7
     reg.set_unchecked("y", "b")
     assert reg.get("y") == "b"
+
+
+def test_flags_non_reloadable_rejected():
+    # Runtime set() of a validator-less (non-reloadable) flag is rejected,
+    # matching reference reloadable_flags gating (src/brpc/reloadable_flags.h).
+    reg = FlagRegistry()
+    reg.define("z", 1, "non-reloadable")
+    assert not reg.set("z", 2)
+    assert reg.get("z") == 1
+    reg.set_unchecked("z", 3)  # internal writes stay possible
+    assert reg.get("z") == 3
+
+
+def test_errno_transport_block_mirrors_reference():
+    # 3001/3002 are the transport slot (reference ERDMA/ERDMACM); framework-
+    # only codes live at 4001+.
+    assert ErrorCode.ETRANSPORT == 3001
+    assert ErrorCode.ETRANSPORTCM == 3002
+    assert ErrorCode.ECLOSE == 2005
+    assert ErrorCode.ETERMINATED == 4001
