@@ -1,1 +1,62 @@
-"""runtime — placeholder subpackage; populated per SURVEY.md §7 build order."""
+"""runtime — the concurrency core (reference L2, src/bthread/).
+
+The reference's M:N bthread library maps here to a fiber pool over OS
+threads: under the GIL, user-space context switching buys nothing, so the
+win the reference gets from M:N (cheap blocking) is kept by making every
+blocking point a butex wait and sizing the pool for blocked fibers. The
+new primitive relative to the reference is DeviceCompletionButex: fibers
+park on XLA/PJRT completions the same way they park on socket reads
+(SURVEY.md §7 step 2).
+
+Layer contents (reference counterpart):
+- Butex                 src/bthread/butex.cpp
+- TimerThread           src/bthread/timer_thread.cpp
+- WorkerPool/Fiber      src/bthread/task_control.cpp, task_group.cpp
+- ExecutionQueue        src/bthread/execution_queue.cpp
+- CallIdSpace           src/bthread/id.cpp
+- DeviceCompletionButex src/brpc/rdma/rdma_completion_queue.cpp (analog)
+"""
+
+from incubator_brpc_tpu.runtime.butex import (
+    Butex,
+    ETIMEDOUT,
+    EWOULDBLOCK,
+    WAIT_OK,
+)
+from incubator_brpc_tpu.runtime.correlation_id import CallIdSpace, call_id_space
+from incubator_brpc_tpu.runtime.device_butex import DeviceCompletionButex
+from incubator_brpc_tpu.runtime.execution_queue import (
+    ExecutionQueue,
+    TaskIterator,
+    execution_queue_start,
+)
+from incubator_brpc_tpu.runtime.timer_thread import TimerThread, global_timer_thread
+from incubator_brpc_tpu.runtime.worker_pool import (
+    Fiber,
+    ParkingLot,
+    WorkerPool,
+    WorkStealingQueue,
+    global_worker_pool,
+    spawn,
+)
+
+__all__ = [
+    "Butex",
+    "WAIT_OK",
+    "EWOULDBLOCK",
+    "ETIMEDOUT",
+    "TimerThread",
+    "global_timer_thread",
+    "WorkerPool",
+    "WorkStealingQueue",
+    "ParkingLot",
+    "Fiber",
+    "spawn",
+    "global_worker_pool",
+    "ExecutionQueue",
+    "TaskIterator",
+    "execution_queue_start",
+    "CallIdSpace",
+    "call_id_space",
+    "DeviceCompletionButex",
+]

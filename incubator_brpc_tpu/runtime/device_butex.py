@@ -1,0 +1,144 @@
+"""DeviceCompletionButex — park fibers on device completions (SURVEY.md §7
+step 2's new primitive; the reference analog is RdmaCompletionQueue
+delivering CQ events into the event dispatcher,
+src/brpc/rdma/rdma_completion_queue.{h,cpp}).
+
+XLA dispatch is async: a jitted call returns device arrays whose buffers
+materialize later. A DeviceCompletionButex turns that readiness into a
+butex signal, so RPC fibers block on device work exactly the way they block
+on network reads — without the *caller* spinning in block_until_ready.
+
+Implementation: a small pool of completion-watcher threads (the analog of
+the reference's CQ poller threads, rdma_completion_queue.cpp:39-55) parks
+inside PJRT's ready-event wait (jax.block_until_ready) and then
+bumps/wakes the butex. Callbacks registered via ``on_complete`` run on the
+watcher thread and must be cheap — same contract as the reference's
+HandleCompletion.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional
+
+from incubator_brpc_tpu.runtime.butex import Butex, ETIMEDOUT
+
+
+class _WatcherPool:
+    """Dedicated completion threads (NOT the worker pool: a watcher blocks in
+    the PJRT event wait, which would starve RPC fibers)."""
+
+    def __init__(self, nthreads: int = 2):
+        self._jobs: List = []
+        self._cond = threading.Condition()
+        self._threads = [
+            threading.Thread(target=self._run, name=f"tbrpc-cq-{i}", daemon=True)
+            for i in range(nthreads)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def submit(self, job: Callable[[], None]) -> None:
+        with self._cond:
+            self._jobs.append(job)
+            self._cond.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._jobs:
+                    self._cond.wait()
+                job = self._jobs.pop(0)
+            try:
+                job()
+            except Exception:  # noqa: BLE001
+                import logging
+
+                logging.getLogger(__name__).exception("completion watcher raised")
+
+
+_watchers: Optional[_WatcherPool] = None
+_watchers_lock = threading.Lock()
+
+
+def _watcher_pool() -> _WatcherPool:
+    global _watchers
+    if _watchers is None:
+        with _watchers_lock:
+            if _watchers is None:
+                _watchers = _WatcherPool()
+    return _watchers
+
+
+class DeviceCompletionButex(Butex):
+    """Butex whose value counts settled (completed OR failed) device ops.
+
+    Failures are counted so waiters never hang; they are recorded in
+    ``errors`` and the callback receives the exception (or None) — the
+    reference likewise surfaces failed work requests as flushed-error CQ
+    entries rather than silence (rdma_endpoint CQ error handling).
+    """
+
+    def __init__(self) -> None:
+        super().__init__(0)
+        self._cb_lock = threading.Lock()
+        self._inflight = 0
+        self._errors: List[BaseException] = []
+
+    def watch(
+        self,
+        arrays: Any,
+        on_complete: Optional[Callable[[Any, Optional[BaseException]], None]] = None,
+    ):
+        """Watch a pytree of device arrays; when settled, value += 1 and
+        waiters wake; on_complete(arrays, error_or_None) then runs on the
+        watcher thread (guarded — a raising callback cannot strand waiters,
+        because the bump/wake already happened)."""
+        import jax
+
+        with self._cb_lock:
+            self._inflight += 1
+
+        def job() -> None:
+            error: Optional[BaseException] = None
+            try:
+                jax.block_until_ready(arrays)
+            except BaseException as e:  # noqa: BLE001 — device failure is data here
+                error = e
+            with self._cb_lock:
+                self._inflight -= 1
+                if error is not None:
+                    self._errors.append(error)
+            self.add(1)
+            self.wake_all()
+            if on_complete is not None:
+                try:
+                    on_complete(arrays, error)
+                except Exception:  # noqa: BLE001
+                    import logging
+
+                    logging.getLogger(__name__).exception(
+                        "device completion callback raised"
+                    )
+
+        _watcher_pool().submit(job)
+        return self
+
+    def wait_for(self, completions: int, timeout: Optional[float] = None) -> bool:
+        """Park until at least ``completions`` watched ops completed."""
+        while True:
+            seen = self.load()
+            if seen >= completions:
+                return True
+            if self.wait(seen, timeout=timeout) == ETIMEDOUT:
+                return self.load() >= completions
+
+    @property
+    def inflight(self) -> int:
+        with self._cb_lock:
+            return self._inflight
+
+    @property
+    def errors(self) -> List[BaseException]:
+        with self._cb_lock:
+            return list(self._errors)
