@@ -1,0 +1,187 @@
+"""ctypes bindings for the native runtime (src/tbutil → libtbutil.so).
+
+The data plane of the host runtime is C++ (SURVEY.md §2 rules out Python
+stand-ins for L1): blocks, refcounts, vectored fd IO, regions, and the
+versioned-id resource pool all live in native code; Python holds opaque
+handles. If the shared library is missing it is built on demand with
+`make -C src` (g++ is baked into the image); `NATIVE_AVAILABLE` reports
+whether the native path loaded, and iobuf.py provides a pure-Python
+fallback so the package stays importable on a toolchain-less host.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LIB_PATH = os.path.join(_REPO_ROOT, "src", "build", "libtbutil.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+class _Ref(ctypes.Structure):
+    _fields_ = [("data", ctypes.c_void_p), ("length", ctypes.c_size_t)]
+
+
+RELEASE_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_void_p)
+
+
+def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
+    b = ctypes.c_void_p
+    sigs = {
+        "tb_set_block_size": (None, [ctypes.c_size_t]),
+        "tb_block_size": (ctypes.c_size_t, []),
+        "tb_block_pool_stats": (
+            None,
+            [ctypes.POINTER(ctypes.c_size_t), ctypes.POINTER(ctypes.c_size_t)],
+        ),
+        "tb_iobuf_create": (b, []),
+        "tb_iobuf_destroy": (None, [b]),
+        "tb_iobuf_clear": (None, [b]),
+        "tb_iobuf_size": (ctypes.c_size_t, [b]),
+        "tb_iobuf_block_count": (ctypes.c_size_t, [b]),
+        "tb_iobuf_append": (None, [b, ctypes.c_char_p, ctypes.c_size_t]),
+        "tb_iobuf_append_external": (
+            None,
+            [b, ctypes.c_void_p, ctypes.c_size_t, RELEASE_FN, ctypes.c_void_p],
+        ),
+        "tb_iobuf_append_iobuf": (None, [b, b]),
+        "tb_iobuf_cutn": (ctypes.c_size_t, [b, b, ctypes.c_size_t]),
+        "tb_iobuf_popn": (ctypes.c_size_t, [b, ctypes.c_size_t]),
+        "tb_iobuf_copy_to": (
+            ctypes.c_size_t,
+            [b, ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t],
+        ),
+        "tb_iobuf_refs": (ctypes.c_int, [b, ctypes.POINTER(_Ref), ctypes.c_int]),
+        "tb_iobuf_block_shared_count": (ctypes.c_int, [b, ctypes.c_size_t]),
+        "tb_iobuf_cut_into_fd": (
+            ctypes.c_long,
+            [b, ctypes.c_int, ctypes.c_size_t],
+        ),
+        "tb_iobuf_append_from_fd": (
+            ctypes.c_long,
+            [b, ctypes.c_int, ctypes.c_size_t],
+        ),
+        "tb_region_register": (
+            ctypes.c_int,
+            [ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t],
+        ),
+        "tb_iobuf_append_from_region": (
+            ctypes.c_int,
+            [b, ctypes.c_int, ctypes.c_char_p, ctypes.c_size_t],
+        ),
+        "tb_region_free_blocks": (ctypes.c_size_t, [ctypes.c_int]),
+        "tb_crc32": (
+            ctypes.c_uint32,
+            [ctypes.c_uint32, ctypes.c_char_p, ctypes.c_size_t],
+        ),
+        "tb_fast_rand": (ctypes.c_uint64, []),
+        "tb_fast_rand_less_than": (ctypes.c_uint64, [ctypes.c_uint64]),
+        "tb_monotonic_ns": (ctypes.c_uint64, []),
+        "tb_respool_create": (b, [ctypes.c_size_t]),
+        "tb_respool_destroy": (None, [b]),
+        "tb_respool_get": (b, [b, ctypes.POINTER(ctypes.c_uint64)]),
+        "tb_respool_address": (b, [b, ctypes.c_uint64]),
+        "tb_respool_return": (ctypes.c_int, [b, ctypes.c_uint64]),
+        "tb_respool_live": (ctypes.c_size_t, [b]),
+    }
+    for name, (restype, argtypes) in sigs.items():
+        fn = getattr(lib, name)
+        fn.restype = restype
+        fn.argtypes = argtypes
+    return lib
+
+
+def _build() -> bool:
+    src_dir = os.path.join(_REPO_ROOT, "src")
+    if not os.path.isdir(src_dir):
+        return False
+    try:
+        subprocess.run(
+            ["make", "-C", src_dir],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return False
+    return os.path.exists(_LIB_PATH)
+
+
+def load():
+    """Load (building on demand) and return the declared CDLL, or None."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
+        try:
+            _lib = _declare(ctypes.CDLL(_LIB_PATH))
+        except OSError:
+            return None
+        return _lib
+
+
+LIB = load()
+NATIVE_AVAILABLE = LIB is not None
+
+
+def monotonic_ns() -> int:
+    if LIB is not None:
+        return LIB.tb_monotonic_ns()
+    import time
+
+    return time.monotonic_ns()
+
+
+def fast_rand() -> int:
+    if LIB is not None:
+        return LIB.tb_fast_rand()
+    import random
+
+    return random.getrandbits(64)
+
+
+def crc32(data: bytes, seed: int = 0) -> int:
+    if LIB is not None:
+        return LIB.tb_crc32(seed, data, len(data))
+    import zlib
+
+    return zlib.crc32(data, seed) & 0xFFFFFFFF
+
+
+class ResourcePool:
+    """Versioned-id slab (src/tbutil ResourcePool; reference
+    resource_pool.h:24-83). Ids stay stale-detectable forever."""
+
+    def __init__(self, item_size: int = 8):
+        if LIB is None:
+            raise RuntimeError("native runtime unavailable")
+        self._p = LIB.tb_respool_create(item_size)
+
+    def get(self) -> int:
+        out = ctypes.c_uint64()
+        LIB.tb_respool_get(self._p, ctypes.byref(out))
+        return out.value
+
+    def address(self, rid: int):
+        return LIB.tb_respool_address(self._p, rid)
+
+    def return_(self, rid: int) -> bool:
+        return LIB.tb_respool_return(self._p, rid) == 0
+
+    @property
+    def live(self) -> int:
+        return LIB.tb_respool_live(self._p)
+
+    def __del__(self):
+        p, self._p = getattr(self, "_p", None), None
+        if p and LIB is not None:
+            LIB.tb_respool_destroy(p)

@@ -24,7 +24,6 @@ Design deviations from the reference (butex.cpp:607-690, :261-446):
 from __future__ import annotations
 
 import threading
-import time
 from typing import Any, List, Optional
 
 from incubator_brpc_tpu.runtime.timer_thread import global_timer_thread
@@ -53,12 +52,15 @@ def _timeout_fire(w: _Waiter) -> None:
     while True:
         h = w.home
         if h is None:
-            # in transit between butexes during a requeue: spin until it
-            # lands (the window is two lock acquisitions wide)
-            if w.event.is_set():
-                return
-            time.sleep(0.0002)
-            continue
+            # in transit between butexes during a requeue (the window is two
+            # lock acquisitions wide). Re-arm instead of sleeping: this runs
+            # inline on the single TimerThread, and blocking it would delay
+            # every other timeout in the process.
+            if not w.event.is_set():
+                global_timer_thread().schedule(
+                    lambda: _timeout_fire(w), delay=0.0002
+                )
+            return
         with h._lock:
             if w.home is not h:
                 continue  # requeued between read and lock: chase again

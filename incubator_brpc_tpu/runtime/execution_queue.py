@@ -120,17 +120,23 @@ class ExecutionQueue(Generic[T]):
                     return
             it = TaskIterator(batch, stopped)
             while True:
+                before = len(batch)
                 try:
                     self._consumer(it)
                     break
                 except Exception:  # noqa: BLE001 — consumer bugs must not kill the actor
                     # The raising item was already consumed (at-most-once for
                     # it); re-deliver the batch remainder so ordered items
-                    # behind it are not silently dropped.
+                    # behind it are not silently dropped. If the consumer made
+                    # no progress at all (raised before its first pop), drop
+                    # the head item to guarantee forward progress — otherwise
+                    # a deterministic pre-pop bug livelocks this worker.
                     logger.exception(
                         "execution queue consumer raised (%d items left in batch)",
                         len(batch),
                     )
+                    if batch and len(batch) == before:
+                        batch.popleft()
                     if not batch:
                         break
             if stopped:

@@ -237,6 +237,22 @@ class WorkerPool:
         self._lot.stop()
         for w in self._workers:
             w.thread.join(timeout=5)
+        # Fibers never picked up must still complete their join()/get()
+        # contract: fail them instead of leaving joiners parked forever.
+        orphans: List[Fiber] = []
+        with self._remote_lock:
+            orphans.extend(self._remote)
+            self._remote.clear()
+        for w in self._workers:
+            while True:
+                f = w.rq.pop()
+                if f is None:
+                    break
+                orphans.append(f)
+        for f in orphans:
+            f.exception = RuntimeError("worker pool stopped before fiber ran")
+            f._version_butex.add(1)
+            f._version_butex.wake_all()
 
     def in_worker(self) -> bool:
         w = getattr(_tls, "worker", None)

@@ -1,0 +1,708 @@
+// tbutil implementation — see tbutil.h for the design contract and the
+// reference counterparts each piece mirrors.
+#include "tbutil.h"
+
+#include <errno.h>
+#include <string.h>
+#include <sys/time.h>
+#include <sys/uio.h>
+#include <time.h>
+#include <unistd.h>
+#include <zlib.h>
+
+#include <atomic>
+#include <cstdlib>
+#include <deque>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Blocks
+// ---------------------------------------------------------------------------
+
+enum BlockSource : uint8_t {
+  SRC_POOL = 0,      // header+data in one allocation, cached in the pool
+  SRC_MALLOC = 1,    // same layout but non-default cap: freed, not cached
+  SRC_EXTERNAL = 2,  // data owned by caller; release_cb on last unref
+  SRC_REGION = 3,    // data carved from a registered region slab
+};
+
+struct Block {
+  std::atomic<uint32_t> nshared;
+  std::atomic<uint32_t> size;  // high-water write offset into data
+  uint32_t cap;
+  uint8_t source;
+  int region_id;
+  char* data;
+  tb_release_fn release_cb;
+  void* release_ctx;
+  Block* next;  // freelist link
+};
+
+std::atomic<size_t> g_default_block_size{8192};
+std::atomic<size_t> g_blocks_live{0};
+
+// Global overflow cache behind the TLS caches.
+struct GlobalBlockCache {
+  std::mutex mu;
+  Block* head = nullptr;
+  size_t count = 0;
+  static constexpr size_t kMax = 1024;
+};
+GlobalBlockCache g_block_cache;
+
+// Per-thread cache (reference keeps <=8 blocks/thread, iobuf.cpp:355-430).
+struct TlsBlockCache {
+  static constexpr size_t kMax = 8;
+  Block* head = nullptr;
+  size_t count = 0;
+  ~TlsBlockCache();
+};
+
+void free_block_memory(Block* b) {
+  g_blocks_live.fetch_sub(1, std::memory_order_relaxed);
+  ::free(b);
+}
+
+TlsBlockCache::~TlsBlockCache() {
+  // Thread exit: hand cached blocks to the global cache (or free).
+  std::lock_guard<std::mutex> lk(g_block_cache.mu);
+  while (head) {
+    Block* b = head;
+    head = b->next;
+    if (g_block_cache.count < GlobalBlockCache::kMax) {
+      b->next = g_block_cache.head;
+      g_block_cache.head = b;
+      ++g_block_cache.count;
+    } else {
+      free_block_memory(b);
+    }
+  }
+  count = 0;
+}
+
+thread_local TlsBlockCache tls_block_cache;
+
+Block* alloc_block_raw(size_t cap) {
+  Block* b = static_cast<Block*>(::malloc(sizeof(Block) + cap));
+  if (!b) return nullptr;
+  g_blocks_live.fetch_add(1, std::memory_order_relaxed);
+  b->nshared.store(1, std::memory_order_relaxed);
+  b->size.store(0, std::memory_order_relaxed);
+  b->cap = static_cast<uint32_t>(cap);
+  b->source = cap == g_default_block_size.load(std::memory_order_relaxed)
+                  ? SRC_POOL
+                  : SRC_MALLOC;
+  b->region_id = -1;
+  b->data = reinterpret_cast<char*>(b + 1);
+  b->release_cb = nullptr;
+  b->release_ctx = nullptr;
+  b->next = nullptr;
+  return b;
+}
+
+Block* get_block() {
+  const size_t def = g_default_block_size.load(std::memory_order_relaxed);
+  TlsBlockCache& tls = tls_block_cache;
+  while (tls.head) {
+    Block* b = tls.head;
+    tls.head = b->next;
+    --tls.count;
+    if (b->cap == def) {
+      b->nshared.store(1, std::memory_order_relaxed);
+      b->size.store(0, std::memory_order_relaxed);
+      b->next = nullptr;
+      return b;
+    }
+    free_block_memory(b);  // stale size after tb_set_block_size
+  }
+  {
+    std::lock_guard<std::mutex> lk(g_block_cache.mu);
+    while (g_block_cache.head) {
+      Block* b = g_block_cache.head;
+      g_block_cache.head = b->next;
+      --g_block_cache.count;
+      if (b->cap == def) {
+        b->nshared.store(1, std::memory_order_relaxed);
+        b->size.store(0, std::memory_order_relaxed);
+        b->next = nullptr;
+        return b;
+      }
+      free_block_memory(b);
+    }
+  }
+  return alloc_block_raw(def);
+}
+
+// ---- regions ----
+
+struct Region {
+  char* base = nullptr;
+  size_t block_bytes = 0;
+  std::mutex mu;
+  std::vector<char*> freelist;
+};
+std::mutex g_regions_mu;
+std::deque<Region>* g_regions = nullptr;  // leaked on purpose (never-free)
+
+void region_return(int rid, char* data) {
+  std::lock_guard<std::mutex> lk(g_regions_mu);
+  Region& r = (*g_regions)[static_cast<size_t>(rid)];
+  std::lock_guard<std::mutex> lk2(r.mu);
+  r.freelist.push_back(data);
+}
+
+void dec_ref(Block* b) {
+  if (b->nshared.fetch_sub(1, std::memory_order_acq_rel) != 1) return;
+  switch (b->source) {
+    case SRC_EXTERNAL: {
+      // Last ref dropped: fire the owner's release callback on this thread.
+      // Contract (reference iobuf.cpp:258-306): cb must be cheap/non-
+      // blocking — it may run on a transport completion path.
+      if (b->release_cb) b->release_cb(b->data, b->release_ctx);
+      g_blocks_live.fetch_sub(1, std::memory_order_relaxed);
+      ::free(b);
+      return;
+    }
+    case SRC_REGION: {
+      region_return(b->region_id, b->data);
+      g_blocks_live.fetch_sub(1, std::memory_order_relaxed);
+      ::free(b);
+      return;
+    }
+    case SRC_MALLOC:
+      free_block_memory(b);
+      return;
+    case SRC_POOL:
+    default: {
+      TlsBlockCache& tls = tls_block_cache;
+      if (tls.count < TlsBlockCache::kMax) {
+        b->next = tls.head;
+        tls.head = b;
+        ++tls.count;
+        return;
+      }
+      std::lock_guard<std::mutex> lk(g_block_cache.mu);
+      if (g_block_cache.count < GlobalBlockCache::kMax) {
+        b->next = g_block_cache.head;
+        g_block_cache.head = b;
+        ++g_block_cache.count;
+        return;
+      }
+      free_block_memory(b);
+      return;
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// IOBuf
+// ---------------------------------------------------------------------------
+
+struct BlockRef {
+  Block* block;
+  uint32_t offset;
+  uint32_t length;
+};
+
+}  // namespace
+
+struct tb_iobuf {
+  std::deque<BlockRef> refs;
+  size_t nbytes = 0;
+};
+
+namespace {
+
+// Try to extend the tail ref in place. Safe under sharing: extension is a
+// CAS claiming [expected, expected+m) of the block, so two IOBufs sharing
+// the tail block can never hand out the same bytes twice.
+size_t append_into_tail(tb_iobuf* b, const char* data, size_t n) {
+  if (b->refs.empty()) return 0;
+  BlockRef& r = b->refs.back();
+  Block* blk = r.block;
+  if (blk->source == SRC_EXTERNAL) return 0;
+  uint32_t expected = r.offset + r.length;
+  if (expected >= blk->cap) return 0;
+  uint32_t m = static_cast<uint32_t>(
+      n < static_cast<size_t>(blk->cap - expected) ? n : blk->cap - expected);
+  uint32_t cur = expected;
+  if (!blk->size.compare_exchange_strong(cur, expected + m,
+                                         std::memory_order_acq_rel)) {
+    return 0;  // someone else extended past our view; take a fresh block
+  }
+  memcpy(blk->data + expected, data, m);
+  r.length += m;
+  b->nbytes += m;
+  return m;
+}
+
+void push_ref_shared(tb_iobuf* b, const BlockRef& r) {
+  r.block->nshared.fetch_add(1, std::memory_order_relaxed);
+  b->refs.push_back(r);
+  b->nbytes += r.length;
+}
+
+}  // namespace
+
+extern "C" {
+
+void tb_set_block_size(size_t bytes) {
+  if (bytes < 64) bytes = 64;
+  g_default_block_size.store(bytes, std::memory_order_relaxed);
+}
+
+size_t tb_block_size(void) {
+  return g_default_block_size.load(std::memory_order_relaxed);
+}
+
+void tb_block_pool_stats(size_t* live, size_t* cached) {
+  if (live) *live = g_blocks_live.load(std::memory_order_relaxed);
+  if (cached) {
+    size_t c = tls_block_cache.count;
+    std::lock_guard<std::mutex> lk(g_block_cache.mu);
+    *cached = c + g_block_cache.count;
+  }
+}
+
+tb_iobuf* tb_iobuf_create(void) { return new tb_iobuf(); }
+
+void tb_iobuf_clear(tb_iobuf* b) {
+  for (BlockRef& r : b->refs) dec_ref(r.block);
+  b->refs.clear();
+  b->nbytes = 0;
+}
+
+void tb_iobuf_destroy(tb_iobuf* b) {
+  if (!b) return;
+  tb_iobuf_clear(b);
+  delete b;
+}
+
+size_t tb_iobuf_size(const tb_iobuf* b) { return b->nbytes; }
+
+size_t tb_iobuf_block_count(const tb_iobuf* b) { return b->refs.size(); }
+
+void tb_iobuf_append(tb_iobuf* b, const void* data, size_t n) {
+  const char* p = static_cast<const char*>(data);
+  size_t done = append_into_tail(b, p, n);
+  p += done;
+  n -= done;
+  while (n > 0) {
+    Block* blk = get_block();
+    uint32_t m = static_cast<uint32_t>(n < blk->cap ? n : blk->cap);
+    memcpy(blk->data, p, m);
+    blk->size.store(m, std::memory_order_release);
+    b->refs.push_back(BlockRef{blk, 0, m});
+    b->nbytes += m;
+    p += m;
+    n -= m;
+  }
+}
+
+namespace {
+
+// Shared-release shim for external buffers that exceed one Block's 32-bit
+// length field: each chunk-block decrements; the last one fires the user
+// callback exactly once.
+struct SharedExternal {
+  std::atomic<uint32_t> pending;
+  char* base;
+  tb_release_fn cb;
+  void* ctx;
+};
+
+void shared_external_release(void* data, void* shim_ptr) {
+  (void)data;
+  SharedExternal* s = static_cast<SharedExternal*>(shim_ptr);
+  if (s->pending.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+    if (s->cb) s->cb(s->base, s->ctx);
+    delete s;
+  }
+}
+
+}  // namespace
+
+void tb_iobuf_append_external(tb_iobuf* b, void* data, size_t n,
+                              tb_release_fn cb, void* ctx) {
+  // BlockRef lengths are 32-bit; chunk huge buffers across several
+  // external blocks sharing one release shim so the callback still fires
+  // exactly once, after the last chunk's last ref drops.
+  constexpr size_t kMaxChunk = 0xC0000000u;  // 3 GiB, well under UINT32_MAX
+  const size_t nchunks = n == 0 ? 1 : (n + kMaxChunk - 1) / kMaxChunk;
+  SharedExternal* shim = nullptr;
+  if (nchunks > 1) {
+    shim = new SharedExternal{
+        {static_cast<uint32_t>(nchunks)}, static_cast<char*>(data), cb, ctx};
+  }
+  char* p = static_cast<char*>(data);
+  size_t left = n;
+  for (size_t i = 0; i < nchunks; ++i) {
+    const size_t m = left < kMaxChunk ? left : kMaxChunk;
+    Block* blk = static_cast<Block*>(::malloc(sizeof(Block)));
+    g_blocks_live.fetch_add(1, std::memory_order_relaxed);
+    blk->nshared.store(1, std::memory_order_relaxed);
+    blk->size.store(static_cast<uint32_t>(m), std::memory_order_relaxed);
+    blk->cap = static_cast<uint32_t>(m);
+    blk->source = SRC_EXTERNAL;
+    blk->region_id = -1;
+    blk->data = p;
+    if (shim) {
+      blk->release_cb = shared_external_release;
+      blk->release_ctx = shim;
+    } else {
+      blk->release_cb = cb;
+      blk->release_ctx = ctx;
+    }
+    blk->next = nullptr;
+    b->refs.push_back(BlockRef{blk, 0, static_cast<uint32_t>(m)});
+    b->nbytes += m;
+    p += m;
+    left -= m;
+  }
+}
+
+void tb_iobuf_append_iobuf(tb_iobuf* to, const tb_iobuf* from) {
+  for (const BlockRef& r : from->refs) push_ref_shared(to, r);
+}
+
+size_t tb_iobuf_cutn(tb_iobuf* from, tb_iobuf* to, size_t n) {
+  size_t moved = 0;
+  while (n > 0 && !from->refs.empty()) {
+    BlockRef& r = from->refs.front();
+    if (r.length <= n) {
+      to->refs.push_back(r);  // ref moves wholesale; refcount unchanged
+      to->nbytes += r.length;
+      from->nbytes -= r.length;
+      n -= r.length;
+      moved += r.length;
+      from->refs.pop_front();
+    } else {
+      BlockRef part{r.block, r.offset, static_cast<uint32_t>(n)};
+      push_ref_shared(to, part);
+      r.offset += static_cast<uint32_t>(n);
+      r.length -= static_cast<uint32_t>(n);
+      from->nbytes -= n;
+      moved += n;
+      n = 0;
+    }
+  }
+  return moved;
+}
+
+size_t tb_iobuf_popn(tb_iobuf* from, size_t n) {
+  size_t popped = 0;
+  while (n > 0 && !from->refs.empty()) {
+    BlockRef& r = from->refs.front();
+    if (r.length <= n) {
+      n -= r.length;
+      popped += r.length;
+      from->nbytes -= r.length;
+      dec_ref(r.block);
+      from->refs.pop_front();
+    } else {
+      r.offset += static_cast<uint32_t>(n);
+      r.length -= static_cast<uint32_t>(n);
+      from->nbytes -= n;
+      popped += n;
+      n = 0;
+    }
+  }
+  return popped;
+}
+
+size_t tb_iobuf_copy_to(const tb_iobuf* b, void* out, size_t n, size_t pos) {
+  char* dst = static_cast<char*>(out);
+  size_t copied = 0;
+  for (const BlockRef& r : b->refs) {
+    if (n == 0) break;
+    if (pos >= r.length) {
+      pos -= r.length;
+      continue;
+    }
+    size_t avail = r.length - pos;
+    size_t m = n < avail ? n : avail;
+    memcpy(dst + copied, r.block->data + r.offset + pos, m);
+    copied += m;
+    n -= m;
+    pos = 0;
+  }
+  return copied;
+}
+
+int tb_iobuf_refs(const tb_iobuf* b, tb_ref_view* out, int max) {
+  int i = 0;
+  for (const BlockRef& r : b->refs) {
+    if (i >= max) break;
+    out[i].data = r.block->data + r.offset;
+    out[i].length = r.length;
+    ++i;
+  }
+  return i;
+}
+
+int tb_iobuf_block_shared_count(const tb_iobuf* b, size_t i) {
+  if (i >= b->refs.size()) return -1;
+  return static_cast<int>(
+      b->refs[i].block->nshared.load(std::memory_order_relaxed));
+}
+
+long tb_iobuf_cut_into_fd(tb_iobuf* b, int fd, size_t max_bytes) {
+  constexpr int kMaxIov = 256;
+  struct iovec iov[kMaxIov];
+  int niov = 0;
+  size_t total = 0;
+  for (const BlockRef& r : b->refs) {
+    if (niov >= kMaxIov || total >= max_bytes) break;
+    size_t len = r.length;
+    if (total + len > max_bytes) len = max_bytes - total;
+    iov[niov].iov_base = r.block->data + r.offset;
+    iov[niov].iov_len = len;
+    total += len;
+    ++niov;
+  }
+  if (niov == 0) return 0;
+  ssize_t nw = ::writev(fd, iov, niov);
+  if (nw < 0) return -errno;
+  tb_iobuf_popn(b, static_cast<size_t>(nw));
+  return nw;
+}
+
+long tb_iobuf_append_from_fd(tb_iobuf* b, int fd, size_t max_bytes) {
+  constexpr int kMaxIov = 8;
+  Block* blocks[kMaxIov];
+  struct iovec iov[kMaxIov];
+  int niov = 0;
+  size_t total = 0;
+  while (niov < kMaxIov && total < max_bytes) {
+    Block* blk = get_block();
+    blocks[niov] = blk;
+    size_t want = max_bytes - total;
+    size_t len = want < blk->cap ? want : blk->cap;
+    iov[niov].iov_base = blk->data;
+    iov[niov].iov_len = len;
+    total += len;
+    ++niov;
+  }
+  ssize_t nr = ::readv(fd, iov, niov);
+  if (nr < 0) {
+    int err = errno;
+    for (int i = 0; i < niov; ++i) dec_ref(blocks[i]);
+    return -err;
+  }
+  size_t left = static_cast<size_t>(nr);
+  for (int i = 0; i < niov; ++i) {
+    if (left == 0) {
+      dec_ref(blocks[i]);
+      continue;
+    }
+    uint32_t used = static_cast<uint32_t>(
+        left < iov[i].iov_len ? left : iov[i].iov_len);
+    blocks[i]->size.store(used, std::memory_order_release);
+    b->refs.push_back(BlockRef{blocks[i], 0, used});
+    b->nbytes += used;
+    left -= used;
+  }
+  return nr;
+}
+
+// ---- regions ----
+
+int tb_region_register(void* base, size_t total, size_t block_bytes) {
+  if (!base || block_bytes == 0 || total < block_bytes) return -1;
+  std::lock_guard<std::mutex> lk(g_regions_mu);
+  if (!g_regions) g_regions = new std::deque<Region>();
+  g_regions->emplace_back();
+  Region& r = g_regions->back();
+  r.base = static_cast<char*>(base);
+  r.block_bytes = block_bytes;
+  for (size_t off = 0; off + block_bytes <= total; off += block_bytes) {
+    r.freelist.push_back(r.base + off);
+  }
+  return static_cast<int>(g_regions->size() - 1);
+}
+
+int tb_iobuf_append_from_region(tb_iobuf* b, int rid, const void* data,
+                                size_t n) {
+  Region* reg;
+  {
+    std::lock_guard<std::mutex> lk(g_regions_mu);
+    if (!g_regions || rid < 0 ||
+        static_cast<size_t>(rid) >= g_regions->size()) {
+      return -1;
+    }
+    reg = &(*g_regions)[static_cast<size_t>(rid)];
+  }
+  // Reserve every slab up front so exhaustion mid-copy cannot leave the
+  // IOBuf half-mutated (failure must not consume blocks or append bytes).
+  const size_t nblocks =
+      n == 0 ? 0 : (n + reg->block_bytes - 1) / reg->block_bytes;
+  std::vector<char*> slabs;
+  {
+    std::lock_guard<std::mutex> lk(reg->mu);
+    if (reg->freelist.size() < nblocks) return -1;
+    slabs.assign(reg->freelist.end() - nblocks, reg->freelist.end());
+    reg->freelist.resize(reg->freelist.size() - nblocks);
+  }
+  const char* p = static_cast<const char*>(data);
+  for (char* slab : slabs) {
+    Block* blk = static_cast<Block*>(::malloc(sizeof(Block)));
+    g_blocks_live.fetch_add(1, std::memory_order_relaxed);
+    uint32_t m = static_cast<uint32_t>(
+        n < reg->block_bytes ? n : reg->block_bytes);
+    blk->nshared.store(1, std::memory_order_relaxed);
+    blk->size.store(m, std::memory_order_relaxed);
+    blk->cap = static_cast<uint32_t>(reg->block_bytes);
+    blk->source = SRC_REGION;
+    blk->region_id = rid;
+    blk->data = slab;
+    blk->release_cb = nullptr;
+    blk->release_ctx = nullptr;
+    blk->next = nullptr;
+    memcpy(slab, p, m);
+    b->refs.push_back(BlockRef{blk, 0, m});
+    b->nbytes += m;
+    p += m;
+    n -= m;
+  }
+  return 0;
+}
+
+size_t tb_region_free_blocks(int rid) {
+  std::lock_guard<std::mutex> lk(g_regions_mu);
+  if (!g_regions || rid < 0 || static_cast<size_t>(rid) >= g_regions->size()) {
+    return 0;
+  }
+  Region& r = (*g_regions)[static_cast<size_t>(rid)];
+  std::lock_guard<std::mutex> lk2(r.mu);
+  return r.freelist.size();
+}
+
+// ---- misc ----
+
+uint32_t tb_crc32(uint32_t seed, const void* data, size_t n) {
+  return static_cast<uint32_t>(
+      ::crc32(seed, static_cast<const Bytef*>(data),
+              static_cast<uInt>(n)));
+}
+
+uint64_t tb_fast_rand(void) {
+  // xorshift128+ per thread (reference fast_rand.cpp uses the same family).
+  thread_local uint64_t s0 = 0, s1 = 0;
+  if (s0 == 0 && s1 == 0) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    s0 = static_cast<uint64_t>(ts.tv_nsec) ^
+         (reinterpret_cast<uintptr_t>(&s0) << 16);
+    s1 = static_cast<uint64_t>(ts.tv_sec) * 1000000007ULL ^ 0x9E3779B97F4A7C15ULL;
+    if (s0 == 0 && s1 == 0) s1 = 1;
+  }
+  uint64_t x = s0;
+  const uint64_t y = s1;
+  s0 = y;
+  x ^= x << 23;
+  s1 = x ^ y ^ (x >> 17) ^ (y >> 26);
+  return s1 + y;
+}
+
+uint64_t tb_fast_rand_less_than(uint64_t bound) {
+  if (bound == 0) return 0;
+  return tb_fast_rand() % bound;
+}
+
+uint64_t tb_monotonic_ns(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000000000ULL +
+         static_cast<uint64_t>(ts.tv_nsec);
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// ResourcePool — versioned-id slab, never frees memory (ABA-safe).
+// Versions are odd while live, even while free; id = version<<32 | slot.
+// ---------------------------------------------------------------------------
+
+struct tb_respool {
+  size_t item_size;
+  std::mutex mu;
+  std::vector<char*> chunks;          // each chunk holds kChunkItems items
+  std::vector<uint32_t> versions;     // per slot
+  std::vector<uint32_t> free_slots;
+  size_t nslots = 0;
+  size_t live = 0;
+  static constexpr size_t kChunkItems = 256;
+};
+
+extern "C" {
+
+tb_respool* tb_respool_create(size_t item_size) {
+  tb_respool* p = new tb_respool();
+  p->item_size = item_size ? item_size : 1;
+  return p;
+}
+
+void tb_respool_destroy(tb_respool* p) {
+  if (!p) return;
+  for (char* c : p->chunks) ::free(c);
+  delete p;
+}
+
+static void* respool_slot_ptr(tb_respool* p, uint32_t slot) {
+  return p->chunks[slot / tb_respool::kChunkItems] +
+         (slot % tb_respool::kChunkItems) * p->item_size;
+}
+
+void* tb_respool_get(tb_respool* p, uint64_t* out_id) {
+  std::lock_guard<std::mutex> lk(p->mu);
+  uint32_t slot;
+  if (!p->free_slots.empty()) {
+    slot = p->free_slots.back();
+    p->free_slots.pop_back();
+    p->versions[slot] += 1;  // even -> odd: live again, old ids stale
+  } else {
+    if (p->nslots % tb_respool::kChunkItems == 0) {
+      p->chunks.push_back(static_cast<char*>(
+          ::calloc(tb_respool::kChunkItems, p->item_size)));
+    }
+    slot = static_cast<uint32_t>(p->nslots++);
+    p->versions.push_back(1);
+  }
+  ++p->live;
+  if (out_id) {
+    *out_id = (static_cast<uint64_t>(p->versions[slot]) << 32) | slot;
+  }
+  return respool_slot_ptr(p, slot);
+}
+
+void* tb_respool_address(tb_respool* p, uint64_t id) {
+  const uint32_t slot = static_cast<uint32_t>(id & 0xFFFFFFFFu);
+  const uint32_t version = static_cast<uint32_t>(id >> 32);
+  std::lock_guard<std::mutex> lk(p->mu);
+  if (slot >= p->nslots) return nullptr;
+  if (p->versions[slot] != version || (version & 1) == 0) return nullptr;
+  return respool_slot_ptr(p, slot);
+}
+
+int tb_respool_return(tb_respool* p, uint64_t id) {
+  const uint32_t slot = static_cast<uint32_t>(id & 0xFFFFFFFFu);
+  const uint32_t version = static_cast<uint32_t>(id >> 32);
+  std::lock_guard<std::mutex> lk(p->mu);
+  if (slot >= p->nslots) return -1;
+  if (p->versions[slot] != version || (version & 1) == 0) return -1;
+  p->versions[slot] += 1;  // odd -> even: dead
+  p->free_slots.push_back(slot);
+  --p->live;
+  return 0;
+}
+
+size_t tb_respool_live(const tb_respool* p) {
+  tb_respool* q = const_cast<tb_respool*>(p);
+  std::lock_guard<std::mutex> lk(q->mu);
+  return q->live;
+}
+
+}  // extern "C"

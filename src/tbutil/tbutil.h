@@ -1,0 +1,118 @@
+// tbutil — native L1 base for the TPU-native brpc-class framework.
+//
+// Re-designed counterpart of the reference's butil core
+// (/root/reference/src/butil/iobuf.h:52, iobuf.cpp:221-306,
+//  resource_pool.h:24-83, rdma/block_pool.h:20-66).  NOT a port: the
+// reference interleaves a Chromium base fork; this is a from-scratch,
+// minimal, C-ABI surface designed to be driven from Python via ctypes and
+// from future native transports directly.
+//
+// Key properties kept from the reference design:
+//   * IOBuf = queue of refcounted BlockRef{block, offset, length}; O(1)
+//     cut/append/share; no data copies between IOBufs.
+//   * Blocks come from a TLS-cached pool; refcounts are atomic; an IOBuf
+//     itself is externally synchronized (one owner thread at a time).
+//   * External blocks wrap caller-owned memory (the HBM/registered-memory
+//     hook) and fire a release callback when the last ref drops — the
+//     IOBUF_HUGE_BLOCK / Block::release_cb design (iobuf.cpp:258-306).
+//   * Region allocator: carve fixed blocks out of one registered slab
+//     (modeled on rdma/block_pool) so payloads can live in pinned/device
+//     memory end to end.
+//   * ResourcePool: never-freeing slab of fixed-size items addressed by
+//     versioned 64-bit ids (ABA-safe) — backs socket/stream id tables.
+#ifndef TBUTIL_H
+#define TBUTIL_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct tb_iobuf tb_iobuf;
+typedef void (*tb_release_fn)(void* data, void* ctx);
+
+typedef struct tb_ref_view {
+  const void* data;
+  size_t length;
+} tb_ref_view;
+
+// ---- block pool ----
+// Default block payload size (bytes). Changing it only affects new blocks.
+void tb_set_block_size(size_t bytes);
+size_t tb_block_size(void);
+// blocks currently live (allocated - freed), blocks parked in caches.
+void tb_block_pool_stats(size_t* live, size_t* cached);
+
+// ---- IOBuf ----
+tb_iobuf* tb_iobuf_create(void);
+void tb_iobuf_destroy(tb_iobuf* b);
+void tb_iobuf_clear(tb_iobuf* b);
+size_t tb_iobuf_size(const tb_iobuf* b);
+size_t tb_iobuf_block_count(const tb_iobuf* b);
+// copy n bytes in (fills the tail block first — the portal-append path).
+void tb_iobuf_append(tb_iobuf* b, const void* data, size_t n);
+// zero-copy wrap of caller-owned memory; cb(data, ctx) fires when the last
+// ref drops, on whichever thread drops it (keep cb cheap; see
+// reference iobuf.cpp:258-306 on why release must not block).
+void tb_iobuf_append_external(tb_iobuf* b, void* data, size_t n,
+                              tb_release_fn cb, void* ctx);
+// share `from`'s refs into `to` (refcount bump, no copy).
+void tb_iobuf_append_iobuf(tb_iobuf* to, const tb_iobuf* from);
+// move up to n bytes from the front of `from` to the back of `to`; O(blocks).
+size_t tb_iobuf_cutn(tb_iobuf* from, tb_iobuf* to, size_t n);
+// drop up to n front bytes.
+size_t tb_iobuf_popn(tb_iobuf* from, size_t n);
+// copy out [pos, pos+n) without consuming; returns bytes copied.
+size_t tb_iobuf_copy_to(const tb_iobuf* b, void* out, size_t n, size_t pos);
+// expose up to max {ptr,len} views of the refs (zero-copy read from Python).
+int tb_iobuf_refs(const tb_iobuf* b, tb_ref_view* out, int max);
+// white-box: refcount of the i-th ref's block (tests; reference
+// iobuf.cpp:329 block_shared_count).
+int tb_iobuf_block_shared_count(const tb_iobuf* b, size_t i);
+
+// ---- fd IO (vectored, zero-copy w.r.t. Python) ----
+// writev the first <=max_bytes; pops what was written. Returns bytes
+// written, or -errno.
+long tb_iobuf_cut_into_fd(tb_iobuf* b, int fd, size_t max_bytes);
+// readv up to max_bytes into fresh pool blocks appended to b. Returns bytes
+// read (0 on EOF), or -errno.
+long tb_iobuf_append_from_fd(tb_iobuf* b, int fd, size_t max_bytes);
+
+// ---- region allocator (registered-slab blocks; rdma/block_pool analog) ----
+// Carve `total` into fixed `block_bytes` blocks over caller memory `base`
+// (caller keeps ownership of the slab; must outlive the region's blocks).
+// Returns region id >=0, or -1.
+int tb_region_register(void* base, size_t total, size_t block_bytes);
+// Append n bytes into `b` copied into blocks drawn from region `rid`.
+// Returns 0, or -1 if the region is exhausted.
+int tb_iobuf_append_from_region(tb_iobuf* b, int rid, const void* data,
+                                size_t n);
+// free blocks available in region.
+size_t tb_region_free_blocks(int rid);
+
+// ---- misc ----
+uint32_t tb_crc32(uint32_t seed, const void* data, size_t n);
+uint64_t tb_fast_rand(void);
+uint64_t tb_fast_rand_less_than(uint64_t bound);
+// monotonic ns (CLOCK_MONOTONIC; the cpuwide_time analog).
+uint64_t tb_monotonic_ns(void);
+
+// ---- ResourcePool: versioned-id slab, never frees (ABA-safe ids) ----
+typedef struct tb_respool tb_respool;
+tb_respool* tb_respool_create(size_t item_size);
+void tb_respool_destroy(tb_respool* p);
+// allocate a slot; *out_id = (version<<32)|slot; returns item ptr.
+void* tb_respool_get(tb_respool* p, uint64_t* out_id);
+// resolve id; NULL if the slot's version moved on (the Address-after-
+// SetFailed contract of socket versioned refs).
+void* tb_respool_address(tb_respool* p, uint64_t id);
+// bump version and recycle slot; returns 0 or -1 if id stale.
+int tb_respool_return(tb_respool* p, uint64_t id);
+size_t tb_respool_live(const tb_respool* p);
+
+#ifdef __cplusplus
+}
+#endif
+#endif  // TBUTIL_H
