@@ -7,23 +7,42 @@ field semantics differ — the device transport re-frames at the host↔HBM
 boundary.
 """
 
+from incubator_brpc_tpu.protocol import tbus_std
 from incubator_brpc_tpu.protocol.tbus_std import (
     HEADER_BYTES,
     Meta,
     ParseError,
     ParsedFrame,
     pack_frame,
+    parse_header,
     try_parse_frame,
 )
 from incubator_brpc_tpu.protocol.registry import Protocol, protocol_registry
+
+# The live tbus_std Protocol entry. process_request/process_response are
+# attached by the rpc layer at import (the reference registers everything
+# up front in global.cpp:364-525; here registration is at package import
+# and the rpc hooks bind lazily).
+TBUS_STD = Protocol(
+    name="tbus_std",
+    parse=try_parse_frame,
+    parse_header=parse_header,
+    pack_request=pack_frame,
+)
+
+if "tbus_std" not in protocol_registry:
+    protocol_registry.register(TBUS_STD)
 
 __all__ = [
     "HEADER_BYTES",
     "Meta",
     "ParseError",
     "ParsedFrame",
+    "TBUS_STD",
     "pack_frame",
+    "parse_header",
     "try_parse_frame",
     "Protocol",
     "protocol_registry",
+    "tbus_std",
 ]

@@ -16,9 +16,13 @@ class Protocol:
     name: str
     # (buf) -> (parsed_or_None, consumed); raises ParseError if not this protocol
     parse: Callable
+    # (first header bytes) -> total frame size, or None if more header bytes
+    # are needed; raises ParseError if the bytes are not this protocol.
+    # Lets InputMessenger size the cut without copying the whole buffer.
+    parse_header: Optional[Callable] = None
     # client side: (meta, payload, cid, ...) -> bytes
     pack_request: Optional[Callable] = None
-    # server side: (socket, frame, server) -> None
+    # server side: (socket, frame) -> None
     process_request: Optional[Callable] = None
     # client side: (socket, frame) -> None
     process_response: Optional[Callable] = None

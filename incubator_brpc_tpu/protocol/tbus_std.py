@@ -33,6 +33,7 @@ from dataclasses import dataclass, field, replace
 from typing import Optional, Tuple
 
 MAGIC = 0x54505243  # "TPRC" — same as ops.framing.MAGIC
+MAGIC_BYTES = struct.pack("<I", MAGIC)
 HEADER_BYTES = 32
 _HDR = struct.Struct("<8I")
 
@@ -133,6 +134,24 @@ class ParsedFrame:
 class ParseError(Exception):
     """Unrecoverable garbage on the wire (magic/crc mismatch) — the
     reference's PARSE_ERROR_TRY_OTHERS→close path."""
+
+
+def parse_header(header: bytes) -> Optional[int]:
+    """Total frame size from the fixed header, None if the header itself is
+    still incomplete, ParseError if these bytes are not tbus_std. The
+    InputMessenger sizing hook (input_messenger.cpp:60-129 cuts the same
+    way off baidu_std's 12-byte header)."""
+    if len(header) < 8:
+        if not MAGIC_BYTES.startswith(header[:4]) and len(header) >= 4:
+            raise ParseError("bad magic")
+        return None
+    (magic,) = struct.unpack_from("<I", header)
+    if magic != MAGIC:
+        raise ParseError(f"bad magic {magic:#x}")
+    if len(header) < HEADER_BYTES:
+        return None
+    (body_len,) = struct.unpack_from("<I", header, 4)
+    return HEADER_BYTES + body_len
 
 
 def try_parse_frame(buf: bytes) -> Tuple[Optional[ParsedFrame], int]:

@@ -1,1 +1,44 @@
-"""transport — placeholder subpackage; populated per SURVEY.md §7 build order."""
+"""transport — the I/O core (reference L3: src/brpc/socket.*,
+event_dispatcher.*, acceptor.*, input_messenger.*, socket_map.*).
+
+TCP is the bootstrap/DCN/test transport, exactly as the reference keeps
+TCP beside RDMA; the device transport (transport/device.py) is the
+`transport=tpu` slot modeled on the RDMA endpoint (rdma/rdma_endpoint.h).
+
+Layer contents (reference counterpart):
+- EventDispatcher  event_dispatcher.cpp (epoll reactor, oneshot arming)
+- Socket           socket.cpp (versioned ids, MPSC single-drainer write,
+                   set_failed/health-check/revive, EOVERCROWDED)
+- InputMessenger   input_messenger.cpp (resumable cut, preferred index)
+- Acceptor         acceptor.cpp
+- SocketMap        socket_map.cpp (client connection dedup)
+"""
+
+from incubator_brpc_tpu.transport.acceptor import Acceptor
+from incubator_brpc_tpu.transport.event_dispatcher import (
+    EventDispatcher,
+    global_dispatcher,
+)
+from incubator_brpc_tpu.transport.messenger import InputMessenger
+from incubator_brpc_tpu.transport.sock import (
+    CONNECTED,
+    FAILED,
+    RECYCLED,
+    Socket,
+    address_socket,
+)
+from incubator_brpc_tpu.transport.socket_map import SocketMap, global_socket_map
+
+__all__ = [
+    "Acceptor",
+    "EventDispatcher",
+    "InputMessenger",
+    "Socket",
+    "SocketMap",
+    "address_socket",
+    "global_dispatcher",
+    "global_socket_map",
+    "CONNECTED",
+    "FAILED",
+    "RECYCLED",
+]

@@ -1,0 +1,136 @@
+"""InputMessenger — bytes → protocol messages (reference
+src/brpc/input_messenger.cpp).
+
+Kept semantics:
+- resumable cut loop over the socket's read IOBuf: try the socket's
+  remembered protocol first, then every registered parser
+  (CutInputMessage + _preferred_index, input_messenger.cpp:60-129);
+- a parser that raises ParseError means "not mine — try others"; all
+  parsers rejecting means wire garbage → socket failed with EREQUEST;
+- of N cut messages, the first N-1 are dispatched to fresh fibers and the
+  LAST is processed inline in this fiber (locality optimization,
+  input_messenger.cpp:143-164).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Tuple
+
+from incubator_brpc_tpu.protocol.registry import Protocol, protocol_registry
+from incubator_brpc_tpu.protocol.tbus_std import ParseError
+from incubator_brpc_tpu.runtime.worker_pool import global_worker_pool
+from incubator_brpc_tpu.utils.flags import get_flag
+from incubator_brpc_tpu.utils.status import ErrorCode
+
+logger = logging.getLogger(__name__)
+
+_HEADER_PEEK = 64  # covers every registered protocol's fixed header
+
+
+class InputMessenger:
+    def __init__(self, protocols: Optional[List[Protocol]] = None):
+        self._protocols = protocols  # None -> live registry order
+
+    def _ordered(self, sock) -> List[Protocol]:
+        protos = (
+            self._protocols
+            if self._protocols is not None
+            else protocol_registry.ordered()
+        )
+        pref = sock.preferred_protocol
+        if pref is not None and pref in protos and protos[0] is not pref:
+            protos = [pref] + [p for p in protos if p is not pref]
+        return protos
+
+    def process(self, sock) -> None:
+        """Cut and dispatch every complete message in sock._read_buf."""
+        cut: List[Tuple[Protocol, object]] = []
+        buf = sock._read_buf
+        max_body = int(get_flag("max_body_size"))
+        while True:
+            if len(buf) < 8:
+                break
+            header = buf.to_bytes(_HEADER_PEEK)
+            matched = None
+            total = None
+            for proto in self._ordered(sock):
+                if proto.parse_header is None:
+                    # header-blind protocol: full-parse fallback (copies the
+                    # pending buffer — protocols should provide parse_header)
+                    try:
+                        frame, consumed = proto.parse(buf.to_bytes())
+                    except ParseError:
+                        continue
+                    if frame is None:
+                        matched, total = proto, None  # needs more bytes
+                        break
+                    buf.popn(consumed)
+                    sock.preferred_protocol = proto
+                    cut.append((proto, frame))
+                    matched, total = proto, -1  # -1: already consumed
+                    break
+                try:
+                    total = proto.parse_header(header)
+                except ParseError:
+                    continue
+                matched = proto
+                break
+            if matched is None:
+                self._dispatch(sock, cut)
+                sock.set_failed(ErrorCode.EREQUEST, "unparsable bytes on the wire")
+                return
+            if total == -1:
+                continue  # fallback path already cut one frame
+            sock.preferred_protocol = matched
+            if total is None:
+                break  # header itself incomplete
+            # flag bounds the *body*; allow any registered header on top
+            if total > max_body + _HEADER_PEEK:
+                self._dispatch(sock, cut)
+                sock.set_failed(
+                    ErrorCode.EREQUEST, f"frame of {total} B exceeds max_body_size"
+                )
+                return
+            if len(buf) < total:
+                break
+            raw = buf.to_bytes(total)
+            buf.popn(total)
+            try:
+                frame, consumed = matched.parse(raw)
+            except ParseError as e:
+                self._dispatch(sock, cut)
+                sock.set_failed(ErrorCode.EREQUEST, f"corrupt frame: {e}")
+                return
+            if frame is None or consumed != total:
+                self._dispatch(sock, cut)
+                sock.set_failed(ErrorCode.EREQUEST, "parser/header length mismatch")
+                return
+            cut.append((matched, frame))
+        self._dispatch(sock, cut)
+
+    def _dispatch(self, sock, cut) -> None:
+        if not cut:
+            return
+        pool = global_worker_pool()
+        for proto, frame in cut[:-1]:
+            pool.spawn(self._process_one, sock, proto, frame)
+        proto, frame = cut[-1]
+        self._process_one(sock, proto, frame)  # last message inline
+
+    @staticmethod
+    def _process_one(sock, proto: Protocol, frame) -> None:
+        try:
+            if sock.user_message_handler is not None:
+                sock.user_message_handler(sock, frame, proto)
+            elif getattr(frame, "is_response", False):
+                if proto.process_response is not None:
+                    proto.process_response(sock, frame)
+            elif proto.process_request is not None:
+                proto.process_request(sock, frame)
+            else:
+                logger.warning(
+                    "no handler for %s message on %r", proto.name, sock
+                )
+        except Exception:
+            logger.exception("message handler failed on %r", sock)

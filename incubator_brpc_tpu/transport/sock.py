@@ -1,0 +1,505 @@
+"""Socket — versioned-ref connection object with a single-drainer MPSC
+write path (reference src/brpc/socket.{h,cpp}).
+
+Kept design points (and where they live in the reference):
+- SocketId out of a versioned registry: ``address()`` fails after
+  ``set_failed()`` yet the object stays reachable by holders
+  (socket.h:619-630 versioned refs; never-freed ResourcePool slots).
+- Write path: producers append WriteRequests under the queue lock; the
+  producer that finds no active writer claims drainer-ship, writes once
+  inline, and hands leftovers to a KeepWrite fiber — contenders only
+  enqueue (StartWrite socket.cpp:1591-1686, KeepWrite :1688). At most one
+  thread ever writes the fd.
+- Read path: dispatcher IN event (oneshot) dedupes into one ProcessEvent
+  fiber (StartInputEvent socket.cpp:2113-2158) which drains to EAGAIN
+  into an IOBuf then runs the InputMessenger cut loop.
+- set_failed + health check + revive: a failed client socket probes its
+  remote every ``health_check_interval`` seconds and revives in place
+  (socket.cpp:950-1026); pending writes are failed with callbacks.
+- EOVERCROWDED backpressure when the unwritten backlog passes
+  ``socket_max_unwritten_bytes`` (socket.cpp:1537).
+"""
+
+from __future__ import annotations
+
+import errno as _errno
+import logging
+import socket as _pysocket
+import threading
+from collections import deque
+from typing import Callable, Dict, List, Optional, Union
+
+from incubator_brpc_tpu.bvar import Adder
+from incubator_brpc_tpu.iobuf import IOBuf
+from incubator_brpc_tpu.runtime.butex import Butex, ETIMEDOUT
+from incubator_brpc_tpu.runtime.worker_pool import global_worker_pool
+from incubator_brpc_tpu.transport.event_dispatcher import (
+    EVENT_ERR,
+    EVENT_IN,
+    EVENT_OUT,
+    global_dispatcher,
+)
+from incubator_brpc_tpu.utils.endpoint import EndPoint, str2endpoint
+from incubator_brpc_tpu.utils.flags import get_flag
+from incubator_brpc_tpu.utils.status import ErrorCode
+
+logger = logging.getLogger(__name__)
+
+# states
+CONNECTED = 0
+FAILED = 1
+RECYCLED = 2
+
+in_bytes = Adder(name="socket_in_bytes")
+out_bytes = Adder(name="socket_out_bytes")
+
+
+class _Registry:
+    """SocketId = version<<32 | slot. address() is None once failed or
+    recycled; slots are reused with a bumped version (ABA-safe)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slots: List[Optional["Socket"]] = []
+        self._versions: List[int] = []
+        self._free: List[int] = []
+
+    def insert(self, sock: "Socket") -> int:
+        with self._lock:
+            if self._free:
+                slot = self._free.pop()
+                self._versions[slot] += 1
+                self._slots[slot] = sock
+            else:
+                slot = len(self._slots)
+                self._slots.append(sock)
+                self._versions.append(1)
+            return (self._versions[slot] << 32) | slot
+
+    def address(self, sid: int) -> Optional["Socket"]:
+        slot, version = sid & 0xFFFFFFFF, sid >> 32
+        with self._lock:
+            if slot >= len(self._slots) or self._versions[slot] != version:
+                return None
+            sock = self._slots[slot]
+        if sock is None or sock.state != CONNECTED:
+            return None
+        return sock
+
+    def recycle(self, sid: int) -> None:
+        slot, version = sid & 0xFFFFFFFF, sid >> 32
+        with self._lock:
+            if slot < len(self._slots) and self._versions[slot] == version:
+                self._slots[slot] = None
+                self._versions[slot] += 1
+                self._free.append(slot)
+
+
+_registry = _Registry()
+
+
+def address_socket(sid: int) -> Optional["Socket"]:
+    """Socket::Address analog — None after set_failed/recycle."""
+    return _registry.address(sid)
+
+
+class WriteRequest:
+    __slots__ = ("buf", "on_error")
+
+    def __init__(self, buf: IOBuf, on_error: Optional[Callable[[int, str], None]]):
+        self.buf = buf
+        self.on_error = on_error
+
+
+class Socket:
+    def __init__(
+        self,
+        conn: _pysocket.socket,
+        remote: Optional[EndPoint],
+        messenger=None,
+        is_client: bool = False,
+        health_check_interval: Optional[float] = None,
+        user_message_handler: Optional[Callable] = None,
+    ):
+        conn.setblocking(False)
+        self._conn = conn
+        self.fd = conn.fileno()
+        self.remote = remote
+        self.messenger = messenger  # InputMessenger; may be set post-create
+        self.is_client = is_client
+        self.state = CONNECTED
+        self.error_code = 0
+        self.error_text = ""
+        self.preferred_protocol = None  # remembered by InputMessenger
+        # arbitrary per-connection state for protocols/rpc (auth, streams)
+        self.context: Dict = {}
+        # must be set before the dispatcher registration below: a request
+        # can arrive in the same packet burst as the connect
+        self.user_message_handler = user_message_handler
+        self.on_failed: List[Callable[["Socket"], None]] = []
+        self.on_revived: List[Callable[["Socket"], None]] = []
+
+        self._read_buf = IOBuf()
+        self._wlock = threading.Lock()
+        self._wqueue: deque = deque()
+        self._writing = False
+        # bumped on every set_failed: a drainer from an older epoch exits
+        # without touching _writing, so a post-revive drainer never runs
+        # concurrently with it (single-writer invariant across failures)
+        self._wepoch = 0
+        self._unwritten = 0
+        self._epollout_butex = Butex(0)
+        self._want_out = False
+        self._reading = False
+        self._state_lock = threading.Lock()
+        # fd lifetime: set_failed only shutdown()s; the real close waits
+        # until in-flight I/O fibers release their refs, so a reused fd
+        # number can never be touched by a stale fiber (the reference gets
+        # this from Socket refcounting)
+        self._io_refs = 0
+        self._pending_close: Optional[_pysocket.socket] = None
+        self._hc_stop = Butex(0)
+        if health_check_interval is None:
+            health_check_interval = float(get_flag("health_check_interval"))
+        self.health_check_interval = health_check_interval
+
+        self._dispatcher = global_dispatcher(self.fd)
+        self._pool = global_worker_pool()
+        self.id = _registry.insert(self)
+        self._dispatcher.add_consumer(self.fd, self._on_event, EVENT_IN)
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def connect(
+        cls,
+        remote: Union[str, EndPoint],
+        messenger=None,
+        timeout: float = 5.0,
+        **kwargs,
+    ) -> "Socket":
+        """Client connect (bthread_connect analog: blocking a fiber/thread,
+        never the reactor)."""
+        ep = str2endpoint(remote) if isinstance(remote, str) else remote
+        conn = _pysocket.create_connection((ep.ip, ep.port), timeout=timeout)
+        conn.setsockopt(_pysocket.IPPROTO_TCP, _pysocket.TCP_NODELAY, 1)
+        return cls(conn, ep, messenger=messenger, is_client=True, **kwargs)
+
+    @classmethod
+    def from_accepted(
+        cls, conn: _pysocket.socket, peer, messenger=None, **kwargs
+    ) -> "Socket":
+        try:
+            conn.setsockopt(_pysocket.IPPROTO_TCP, _pysocket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        remote = EndPoint(ip=peer[0], port=peer[1]) if peer else None
+        return cls(conn, remote, messenger=messenger, is_client=False, **kwargs)
+
+    # -- write path ---------------------------------------------------------
+
+    def write(
+        self,
+        data: Union[bytes, IOBuf],
+        on_error: Optional[Callable[[int, str], None]] = None,
+    ) -> int:
+        """Queue data; returns 0 or an ErrorCode. Never blocks the caller
+        beyond one nonblocking writev (the StartWrite inline attempt)."""
+        if self.state != CONNECTED:
+            return ErrorCode.EFAILEDSOCKET
+        if isinstance(data, (bytes, bytearray, memoryview)):
+            buf = IOBuf()
+            buf.append(bytes(data))
+        else:
+            buf = data
+        n = len(buf)
+        if n == 0:
+            return 0  # nothing to send; never enqueue an empty request
+        req = WriteRequest(buf, on_error)
+        with self._wlock:
+            if self._unwritten + n > int(get_flag("socket_max_unwritten_bytes")):
+                return ErrorCode.EOVERCROWDED
+            self._wqueue.append(req)
+            self._unwritten += n
+            if self._writing:
+                return 0  # contender: the active drainer will pick it up
+            self._writing = True
+            epoch = self._wepoch
+        # we are the drainer: one inline nonblocking attempt, then hand off
+        if not self._drain_once(epoch):
+            self._pool.spawn(self._keep_write, epoch)
+        return 0
+
+    # -- fd I/O refs (deferred close) --------------------------------------
+
+    def _acquire_io(self) -> bool:
+        with self._state_lock:
+            if self.state != CONNECTED:
+                return False
+            self._io_refs += 1
+            return True
+
+    def _release_io(self) -> None:
+        conn = None
+        with self._state_lock:
+            self._io_refs -= 1
+            if self._io_refs == 0 and self._pending_close is not None:
+                conn, self._pending_close = self._pending_close, None
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _drain_once(self, epoch: int) -> bool:
+        """One nonblocking drain round. Returns True if drainer-ship was
+        released (queue empty, socket failed, or epoch stale), False if a
+        KeepWrite must continue."""
+        while True:
+            with self._wlock:
+                if self._wepoch != epoch:
+                    return True  # failed since we claimed; new epoch owns _writing
+                if not self._wqueue:
+                    self._writing = False
+                    return True
+                front = self._wqueue[0]
+            if len(front.buf) == 0:
+                with self._wlock:
+                    if self._wepoch == epoch and self._wqueue and self._wqueue[0] is front:
+                        self._wqueue.popleft()
+                continue
+            if not self._acquire_io():
+                return True
+            try:
+                rc = front.buf.cut_into_fd(self.fd, 1 << 20)
+            finally:
+                self._release_io()
+            if rc > 0:
+                out_bytes << rc
+                with self._wlock:
+                    self._unwritten -= rc
+                if len(front.buf) == 0:
+                    with self._wlock:
+                        if self._wepoch == epoch and self._wqueue and self._wqueue[0] is front:
+                            self._wqueue.popleft()
+                continue
+            if rc in (0, -_errno.EAGAIN, -_errno.EWOULDBLOCK):
+                return False  # 0-byte writev == no room: wait for writability
+            if rc == -_errno.EINTR:
+                continue
+            self._fail_from_write(-rc if rc < 0 else _errno.EPIPE)
+            return True  # failed: nothing left to drain
+
+    def _keep_write(self, epoch: int) -> None:
+        """Single-drainer loop (KeepWrite socket.cpp:1688): waits for
+        writability on the epollout butex when the kernel buffer fills."""
+        while True:
+            if self._drain_once(epoch):
+                return
+            seq = self._epollout_butex.load()
+            with self._state_lock:
+                if self.state != CONNECTED:
+                    return
+                self._want_out = True
+            self._arm()
+            self._epollout_butex.wait(seq, timeout=1.0)
+
+    def _fail_from_write(self, err: int) -> None:
+        self.set_failed(
+            ErrorCode.EFAILEDSOCKET, f"write failed: {_errno.errorcode.get(err, err)}"
+        )
+
+    # -- read path ----------------------------------------------------------
+
+    def _on_event(self, revents: int) -> None:
+        # reactor thread: cheap work only
+        if revents & EVENT_ERR:
+            self._pool.spawn(
+                self.set_failed, ErrorCode.EFAILEDSOCKET, "epoll error/hup"
+            )
+            return
+        spawned_reader = False
+        if revents & EVENT_IN:
+            with self._state_lock:
+                if not self._reading and self.state == CONNECTED:
+                    self._reading = True
+                    spawned_reader = True
+            if spawned_reader:
+                self._pool.spawn(self._process_event)
+        if revents & EVENT_OUT:
+            with self._state_lock:
+                self._want_out = False
+            self._epollout_butex.add(1)
+            self._epollout_butex.wake_all()
+        # re-arm whatever interest remains (IN unless a reader fiber owns the
+        # fd; OUT if a KeepWrite re-requested it concurrently)
+        self._arm()
+
+    def _arm(self) -> None:
+        with self._state_lock:
+            if self.state != CONNECTED:
+                return
+            mask = 0
+            if not self._reading:
+                mask |= EVENT_IN
+            if self._want_out:
+                mask |= EVENT_OUT
+        if mask:
+            self._dispatcher.rearm(self.fd, mask)
+
+    def _process_event(self) -> None:
+        """ProcessEvent fiber: drain fd → cut messages → dispatch."""
+        if not self._acquire_io():
+            with self._state_lock:
+                self._reading = False
+            return
+        try:
+            eof = False
+            while True:
+                rc = self._read_buf.append_from_fd(self.fd, 1 << 18)
+                if rc > 0:
+                    in_bytes << rc
+                    if rc < (1 << 18):
+                        break  # short read: kernel buffer drained
+                    continue
+                if rc == 0:
+                    eof = True
+                    break
+                if rc in (-_errno.EAGAIN, -_errno.EWOULDBLOCK):
+                    break
+                if rc == -_errno.EINTR:
+                    continue
+                self.set_failed(
+                    ErrorCode.EFAILEDSOCKET,
+                    f"read failed: {_errno.errorcode.get(-rc, rc)}",
+                )
+                return
+            if self.messenger is not None and len(self._read_buf):
+                self.messenger.process(self)
+            if eof:
+                self.set_failed(ErrorCode.EEOF, "remote closed connection")
+                return
+        finally:
+            self._release_io()
+            with self._state_lock:
+                self._reading = False
+            self._arm()
+
+    # -- failure / revival --------------------------------------------------
+
+    def set_failed(self, code: int = ErrorCode.EFAILEDSOCKET, reason: str = "") -> bool:
+        """Flip to FAILED once; fail pending writes; start health checking
+        for client sockets. Returns False if already failed/recycled."""
+        with self._state_lock:
+            if self.state != CONNECTED:
+                return False
+            self.state = FAILED
+            self.error_code = code
+            self.error_text = reason
+            old_conn = self._conn
+            # close is deferred until in-flight I/O fibers drop their refs —
+            # shutdown() makes their syscalls fail without freeing the fd
+            # number for reuse
+            if self._io_refs > 0:
+                self._pending_close = old_conn
+            else:
+                self._pending_close = None
+        self._dispatcher.remove_consumer(self.fd)
+        try:
+            old_conn.shutdown(_pysocket.SHUT_RDWR)
+        except OSError:
+            pass
+        with self._state_lock:
+            close_now = self._pending_close is None
+        if close_now:
+            try:
+                old_conn.close()
+            except OSError:
+                pass
+        with self._wlock:
+            pending, self._wqueue = list(self._wqueue), deque()
+            self._unwritten = 0
+            self._writing = False
+            self._wepoch += 1  # stale drainers exit; see _drain_once
+        for req in pending:
+            if req.on_error is not None:
+                try:
+                    req.on_error(code, reason)
+                except Exception:
+                    logger.exception("write on_error callback failed")
+        self._epollout_butex.add(1)
+        self._epollout_butex.wake_all()
+        for cb in list(self.on_failed):
+            try:
+                cb(self)
+            except Exception:
+                logger.exception("on_failed callback raised")
+        if (
+            self.is_client
+            and self.remote is not None
+            and self.health_check_interval > 0
+            and code != ErrorCode.ECLOSE
+        ):
+            self._pool.spawn(self._health_check_loop)
+        return True
+
+    def _health_check_loop(self) -> None:
+        """Probe the remote until it answers, then revive in place
+        (HealthCheckThread, socket.cpp:950-1026)."""
+        while True:
+            rc = self._hc_stop.wait(0, timeout=self.health_check_interval)
+            if rc != ETIMEDOUT:
+                return  # recycled: stop probing
+            if self.state != FAILED:
+                return
+            try:
+                conn = _pysocket.create_connection(
+                    (self.remote.ip, self.remote.port), timeout=2.0
+                )
+            except OSError:
+                continue
+            if self._revive(conn):
+                return
+
+    def _revive(self, conn: _pysocket.socket) -> bool:
+        with self._state_lock:
+            if self.state != FAILED:
+                conn.close()
+                return False
+            conn.setblocking(False)
+            try:
+                conn.setsockopt(_pysocket.IPPROTO_TCP, _pysocket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            self._conn = conn
+            self.fd = conn.fileno()
+            self._read_buf = IOBuf()
+            self._reading = False
+            self._want_out = False
+            self.state = CONNECTED
+            self.error_code = 0
+            self.error_text = ""
+        self._dispatcher = global_dispatcher(self.fd)
+        self._dispatcher.add_consumer(self.fd, self._on_event, EVENT_IN)
+        for cb in list(self.on_revived):
+            try:
+                cb(self)
+            except Exception:
+                logger.exception("on_revived callback raised")
+        logger.info("socket to %s revived", self.remote)
+        return True
+
+    def recycle(self) -> None:
+        """Final teardown: no health check, id becomes stale forever."""
+        self.set_failed(ErrorCode.ECLOSE, "recycled")
+        with self._state_lock:
+            self.state = RECYCLED
+        self._hc_stop.add(1)
+        self._hc_stop.wake_all()
+        _registry.recycle(self.id)
+
+    # -- introspection ------------------------------------------------------
+
+    def __repr__(self) -> str:
+        st = {CONNECTED: "up", FAILED: "failed", RECYCLED: "recycled"}[self.state]
+        return f"<Socket id={self.id:#x} fd={self.fd} remote={self.remote} {st}>"

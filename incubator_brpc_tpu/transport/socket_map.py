@@ -1,0 +1,66 @@
+"""SocketMap — client-side connection dedup (reference
+src/brpc/socket_map.{h,cpp}): one main socket per remote endpoint, shared
+by every Channel targeting it; failed sockets stay in the map while their
+health checker probes (socket_map.cpp:35), so revival is transparent."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Union
+
+from incubator_brpc_tpu.transport.sock import RECYCLED, Socket
+from incubator_brpc_tpu.utils.endpoint import EndPoint, str2endpoint
+
+
+class SocketMap:
+    def __init__(self, messenger=None):
+        self._messenger = messenger
+        self._lock = threading.Lock()
+        self._map: Dict[str, Socket] = {}
+
+    def get_or_create(
+        self, remote: Union[str, EndPoint], timeout: float = 5.0, **kwargs
+    ) -> Socket:
+        ep = str2endpoint(remote) if isinstance(remote, str) else remote
+        key = f"{ep.ip}:{ep.port}"
+        with self._lock:
+            sock = self._map.get(key)
+            if sock is not None and sock.state != RECYCLED:
+                return sock  # FAILED sockets stay: health check may revive
+        sock = Socket.connect(ep, messenger=self._messenger, timeout=timeout, **kwargs)
+        with self._lock:
+            cur = self._map.get(key)
+            if cur is not None and cur.state != RECYCLED:
+                sock.recycle()  # lost the race: reuse the established one
+                return cur
+            self._map[key] = sock
+        return sock
+
+    def remove(self, remote: Union[str, EndPoint]) -> Optional[Socket]:
+        ep = str2endpoint(remote) if isinstance(remote, str) else remote
+        key = f"{ep.ip}:{ep.port}"
+        with self._lock:
+            return self._map.pop(key, None)
+
+    def recycle_all(self) -> None:
+        with self._lock:
+            socks, self._map = list(self._map.values()), {}
+        for s in socks:
+            s.recycle()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+
+_global_map: Optional[SocketMap] = None
+_global_lock = threading.Lock()
+
+
+def global_socket_map() -> SocketMap:
+    global _global_map
+    if _global_map is None:
+        with _global_lock:
+            if _global_map is None:
+                _global_map = SocketMap()
+    return _global_map
