@@ -1,0 +1,312 @@
+"""Channel — the client endpoint (reference src/brpc/channel.cpp:285
+CallMethod, controller.cpp:545-676 OnVersionedRPCReturned / 941 IssueRPC).
+
+Call flow (mirrors SURVEY.md §3.1):
+  call_method
+    ├ create ranged call id (2 + max_retry versions, channel.cpp:307)
+    ├ register timeout / backup timers on the TimerThread
+    ├ _issue_rpc: pick socket (single server or LB), pack, Socket.write
+    │   (write failure → CallIdSpace.error → retry arbitration)
+    └ sync: join the call id   (async: done runs when the id is destroyed)
+
+  response path (reader fiber): tbus_std.process_response
+    └ lock call id → _on_rpc_returned: retry / backup-win / end
+      EndRPC: cancel timers, unlock_and_destroy (wakes joiners), run done.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional, Union
+
+from incubator_brpc_tpu import protocol as proto_pkg
+from incubator_brpc_tpu.protocol.tbus_std import (
+    FLAG_RESPONSE,
+    Meta,
+    ParsedFrame,
+    pack_frame,
+)
+from incubator_brpc_tpu.rpc.controller import RETRIABLE, Controller
+from incubator_brpc_tpu.runtime.correlation_id import call_id_space
+from incubator_brpc_tpu.runtime.timer_thread import global_timer_thread
+from incubator_brpc_tpu.runtime.worker_pool import global_worker_pool
+from incubator_brpc_tpu.transport.messenger import InputMessenger
+from incubator_brpc_tpu.transport.socket_map import SocketMap
+from incubator_brpc_tpu.utils.endpoint import EndPoint, str2endpoint
+from incubator_brpc_tpu.utils.status import ErrorCode
+
+logger = logging.getLogger(__name__)
+
+_client_messenger = InputMessenger()
+_client_socket_map = SocketMap(messenger=_client_messenger)
+
+
+def process_response(sock, frame: ParsedFrame) -> None:
+    """tbus_std Protocol.process_response hook: route a response frame to
+    its in-flight RPC via the correlation id (baidu_rpc_protocol.cpp:543)."""
+    cid = frame.correlation_id
+    rc, cntl = call_id_space.lock(cid)
+    if rc != 0 or cntl is None:
+        return  # stale/duplicate response after EndRPC: drop
+    channel = cntl._channel
+    if channel is None:
+        call_id_space.unlock(cid)
+        return
+    channel._on_rpc_returned(cntl, frame, sock)
+
+
+# bind the live hook (registration itself happens at protocol import)
+proto_pkg.TBUS_STD.process_response = process_response
+
+
+class ChannelOptions:
+    def __init__(
+        self,
+        timeout_ms: float = Controller.DEFAULT_TIMEOUT_MS,
+        max_retry: int = Controller.DEFAULT_MAX_RETRY,
+        backup_request_ms: float = -1,
+        connect_timeout: float = 5.0,
+        protocol: str = "tbus_std",
+    ):
+        self.timeout_ms = timeout_ms
+        self.max_retry = max_retry
+        self.backup_request_ms = backup_request_ms
+        self.connect_timeout = connect_timeout
+        self.protocol = protocol
+
+
+class Channel:
+    """Client channel to a single server or (via ``lb`` + naming) a set.
+
+    ``init()`` accepts an "ip:port" / EndPoint for a single server, or a
+    naming url ("list://a:1,b:2", "file://path") plus a load-balancer name
+    — the reference's dual Init (channel.cpp:201-273).
+    """
+
+    def __init__(self):
+        self._options = ChannelOptions()
+        self._single_server: Optional[EndPoint] = None
+        self._lb = None  # LoadBalancerWithNaming (lb/__init__.py), task #5
+        self._socket_map = _client_socket_map
+        self._init_done = False
+
+    def init(
+        self,
+        target: Union[str, EndPoint],
+        lb_name: str = "",
+        options: Optional[ChannelOptions] = None,
+    ) -> bool:
+        if options is not None:
+            self._options = options
+        if isinstance(target, EndPoint):
+            self._single_server = target
+        elif "://" in str(target):
+            from incubator_brpc_tpu.lb import LoadBalancerWithNaming
+
+            self._lb = LoadBalancerWithNaming(str(target), lb_name or "rr")
+            if not self._lb.start():
+                return False
+        else:
+            self._single_server = str2endpoint(str(target))
+        self._init_done = True
+        return True
+
+    # -- public call surface -------------------------------------------------
+
+    def call_method(
+        self,
+        service: str,
+        method: str,
+        request: bytes,
+        cntl: Optional[Controller] = None,
+        done: Optional[Callable[[Controller], None]] = None,
+        attachment: bytes = b"",
+    ) -> Controller:
+        """The CallMethod entry (channel.cpp:285). Synchronous when ``done``
+        is None (joins the call id); asynchronous otherwise."""
+        assert self._init_done, "Channel.init() not called"
+        if cntl is None:
+            cntl = Controller(
+                timeout_ms=self._options.timeout_ms,
+                max_retry=self._options.max_retry,
+                backup_request_ms=self._options.backup_request_ms,
+            )
+        cntl._channel = self
+        cntl._service = service
+        cntl._method = method
+        cntl._request_payload = request
+        cntl.request_attachment = attachment
+        cntl._done = done
+        cntl._mark_start()
+
+        # one id covers the first send + every retry/backup
+        # (bthread_id_create_ranged with 2 + max_retry, channel.cpp:307)
+        cid = call_id_space.create(
+            data=cntl,
+            on_error=self._handle_id_error,
+            version_range=2 + max(0, cntl.max_retry),
+        )
+        cntl.call_id = cid
+
+        from incubator_brpc_tpu.builtin.rpcz import start_client_span
+
+        cntl._span = start_client_span(cntl)
+
+        timer = global_timer_thread()
+        pool = global_worker_pool()
+        if cntl.timeout_ms is not None and cntl.timeout_ms > 0:
+            cntl._timer_ids.append(
+                timer.schedule(
+                    lambda: pool.spawn(
+                        call_id_space.error,
+                        cid,
+                        ErrorCode.ERPCTIMEDOUT,
+                        f"deadline {cntl.timeout_ms} ms exceeded",
+                    ),
+                    delay=cntl.timeout_ms / 1000.0,
+                )
+            )
+        if cntl.backup_request_ms and cntl.backup_request_ms > 0:
+            cntl._timer_ids.append(
+                timer.schedule(
+                    lambda: pool.spawn(
+                        call_id_space.error,
+                        cid,
+                        ErrorCode.EBACKUPREQUEST,
+                        "",
+                    ),
+                    delay=cntl.backup_request_ms / 1000.0,
+                )
+            )
+
+        rc, _ = call_id_space.lock(cid)
+        if rc == 0:
+            self._issue_rpc(cntl)
+            call_id_space.unlock(cid)
+
+        if done is None:
+            call_id_space.join(cid)
+        return cntl
+
+    # convenience alias
+    call = call_method
+
+    # -- issue / return paths (run under the call-id lock) -------------------
+
+    def _pick_socket(self, cntl: Controller):
+        if self._single_server is not None:
+            return self._socket_map.get_or_create(
+                self._single_server, timeout=self._options.connect_timeout
+            )
+        sock = self._lb.select_server(excluded=cntl._excluded_sockets)
+        if sock is None:
+            raise ConnectionError("no available server in load balancer")
+        return sock
+
+    def _issue_rpc(self, cntl: Controller) -> None:
+        """IssueRPC (controller.cpp:941): pick socket, pack, write. Called
+        with the call id locked."""
+        cid = cntl.call_id
+        try:
+            sock = self._pick_socket(cntl)
+        except (OSError, ConnectionError) as e:
+            # connection failed: arbitrate like a socket failure
+            self._arbitrate_error(cntl, ErrorCode.EFAILEDSOCKET, str(e))
+            return
+        cntl.remote_side = sock.remote
+        cntl._sent_sockets.append(sock)
+        meta = Meta(
+            service=cntl._service,
+            method=cntl._method,
+            compress=cntl.compress_type,
+            log_id=cntl.log_id,
+            trace_id=cntl.trace_id,
+            span_id=cntl.span_id,
+        )
+        data = pack_frame(
+            meta,
+            cntl._request_payload,
+            cid,
+            attachment=cntl.request_attachment,
+        )
+        pool = global_worker_pool()
+        rc = sock.write(
+            data,
+            on_error=lambda code, text: pool.spawn(
+                call_id_space.error, cid, code, text
+            ),
+        )
+        if rc != 0:
+            self._arbitrate_error(cntl, rc, f"write to {sock.remote} failed")
+
+    def _handle_id_error(self, cid: int, cntl: Controller, code: int, text: str) -> None:
+        """CallIdSpace on_error: runs with the id locked — the
+        OnVersionedRPCReturned error path (controller.cpp:545)."""
+        self._arbitrate_error(cntl, code, text)
+        # _arbitrate_error either destroyed the id (terminal) or left it
+        # locked after re-issuing; unlock in the latter case.
+        if call_id_space.valid(cid):
+            call_id_space.unlock(cid)
+
+    def _arbitrate_error(self, cntl: Controller, code: int, text: str) -> None:
+        """Retry / backup / fail decision. Id is locked; does NOT unlock
+        (caller decides), but EndRPC destroys."""
+        if code == ErrorCode.EBACKUPREQUEST:
+            # backup timer fired: issue a duplicate, keep the original
+            # in flight (controller.cpp:565-598)
+            if not cntl.has_backup_request:
+                cntl.has_backup_request = True
+                if cntl._sent_sockets:
+                    cntl._excluded_sockets.add(cntl._sent_sockets[-1].id)
+                self._issue_rpc(cntl)
+            return
+        if code in RETRIABLE and cntl.retried_count < cntl.max_retry:
+            cntl.retried_count += 1
+            if cntl._sent_sockets:
+                cntl._excluded_sockets.add(cntl._sent_sockets[-1].id)
+            cntl._reset_for_retry()
+            self._issue_rpc(cntl)
+            return
+        cntl.set_failed(code, text)
+        self._end_rpc(cntl)
+
+    def _on_rpc_returned(self, cntl: Controller, frame: ParsedFrame, sock) -> None:
+        """Response arrived (id locked by process_response)."""
+        if frame.error_code != 0 and frame.error_code in RETRIABLE and (
+            cntl.retried_count < cntl.max_retry
+        ):
+            cntl.retried_count += 1
+            cntl._excluded_sockets.add(sock.id)
+            self._issue_rpc(cntl)
+            call_id_space.unlock(cntl.call_id)
+            return
+        if frame.error_code != 0:
+            cntl.set_failed(
+                frame.error_code,
+                (frame.meta.error_text if frame.meta else "")
+                or f"remote error {frame.error_code}",
+            )
+        else:
+            cntl.response_payload = frame.payload
+            cntl.response_attachment = frame.attachment
+            cntl.response_meta = frame.meta
+        if self._lb is not None:
+            self._lb.feedback(sock, cntl.latency_us, cntl.error_code)
+        self._end_rpc(cntl)
+
+    def _end_rpc(self, cntl: Controller) -> None:
+        """EndRPC: cancel timers, destroy the id (wakes joiners), run done.
+        Called with the id locked; the id is dead afterwards."""
+        timer = global_timer_thread()
+        for tid in cntl._timer_ids:
+            timer.unschedule(tid)
+        cntl._timer_ids.clear()
+        cntl._mark_end()
+        if cntl._span is not None:
+            from incubator_brpc_tpu.builtin.rpcz import end_client_span
+
+            end_client_span(cntl)
+        call_id_space.unlock_and_destroy(cntl.call_id)
+        if cntl._done is not None:
+            global_worker_pool().spawn(cntl._done, cntl)

@@ -1,0 +1,114 @@
+"""Controller — per-RPC state machine and user knob surface (reference
+src/brpc/controller.h:98, controller.cpp).
+
+One Controller accompanies one RPC on either side:
+- client side: carries timeout/retry/backup options in, and the response
+  payload/meta/error out; the retry/backup arbitration of
+  OnVersionedRPCReturned (controller.cpp:545-676) lives in channel.py and
+  mutates this object under the call-id lock.
+- server side: carries the request meta/attachment in and the
+  error-code/attachment out (set_failed → error response).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, List, Optional
+
+from incubator_brpc_tpu.protocol.tbus_std import Meta
+from incubator_brpc_tpu.utils.endpoint import EndPoint
+from incubator_brpc_tpu.utils.status import ErrorCode, berror
+
+
+class Controller:
+    # defaults mirror ChannelOptions (reference channel.h: timeout 500 ms,
+    # max_retry 3, backup off)
+    DEFAULT_TIMEOUT_MS = 500
+    DEFAULT_MAX_RETRY = 3
+
+    def __init__(
+        self,
+        timeout_ms: Optional[float] = None,
+        max_retry: Optional[int] = None,
+        backup_request_ms: float = -1,
+        log_id: int = 0,
+    ):
+        # -- options (client) --
+        self.timeout_ms = (
+            self.DEFAULT_TIMEOUT_MS if timeout_ms is None else timeout_ms
+        )
+        self.max_retry = self.DEFAULT_MAX_RETRY if max_retry is None else max_retry
+        self.backup_request_ms = backup_request_ms
+        self.log_id = log_id
+        self.compress_type: str = ""
+        self.request_attachment: bytes = b""
+
+        # -- in/out state --
+        self.call_id: int = 0
+        self.error_code: int = 0
+        self.error_text: str = ""
+        self.response_payload: bytes = b""
+        self.response_attachment: bytes = b""
+        self.response_meta: Optional[Meta] = None
+        self.request_meta: Optional[Meta] = None  # server side
+        self.remote_side: Optional[EndPoint] = None
+        self.retried_count: int = 0
+        self.has_backup_request: bool = False
+        self.latency_us: float = 0.0
+        self.trace_id: int = 0
+        self.span_id: int = 0
+
+        # -- internals (owned by channel.py / server.py) --
+        self._start_ts: float = 0.0
+        self._deadline: float = 0.0
+        self._done: Optional[Callable[["Controller"], None]] = None
+        self._timer_ids: List[Any] = []
+        self._service: str = ""
+        self._method: str = ""
+        self._request_payload: bytes = b""
+        self._channel = None
+        self._server = None
+        self._excluded_sockets: set = set()  # ExcludedServers retry avoidance
+        self._sent_sockets: List[Any] = []
+        self._span = None
+
+    # -- status surface (reference Controller::Failed/ErrorCode/ErrorText) --
+
+    def failed(self) -> bool:
+        return self.error_code != 0
+
+    def set_failed(self, code: int, text: str = "") -> None:
+        self.error_code = code
+        self.error_text = text or berror(code)
+
+    def ok(self) -> bool:
+        return self.error_code == 0
+
+    # -- internals -----------------------------------------------------------
+
+    def _reset_for_retry(self) -> None:
+        self.error_code = 0
+        self.error_text = ""
+
+    def _mark_start(self) -> None:
+        self._start_ts = time.monotonic()
+        if self.timeout_ms is not None and self.timeout_ms > 0:
+            self._deadline = self._start_ts + self.timeout_ms / 1000.0
+
+    def _mark_end(self) -> None:
+        if self._start_ts:
+            self.latency_us = (time.monotonic() - self._start_ts) * 1e6
+
+    def __repr__(self) -> str:
+        st = "ok" if self.ok() else f"err={self.error_code} {self.error_text!r}"
+        return (
+            f"<Controller {self._service}.{self._method} cid={self.call_id:#x} "
+            f"retried={self.retried_count} {st}>"
+        )
+
+
+# retriable errors (reference default RetryPolicy, retry_policy.cpp: retries
+# connectivity failures, never server-side application errors or timeouts)
+RETRIABLE = frozenset(
+    {ErrorCode.EFAILEDSOCKET, ErrorCode.EEOF, ErrorCode.ECLOSE}
+)
