@@ -1,1 +1,13 @@
-"""builtin — placeholder subpackage; populated per SURVEY.md §7 build order."""
+"""builtin — observability surface (reference L6: src/brpc/builtin/*,
+span.{h,cpp}, rpc_dump.{h,cpp}).
+
+- rpcz:   sampled per-RPC spans (builtin/rpcz_service.cpp analog)
+- portal: process-wide registry of running servers, introspected by the
+  builtin HTTP service (http_portal.py) serving /vars /status /flags
+  /rpcz /health /connections.
+"""
+
+from incubator_brpc_tpu.builtin import portal, rpcz
+from incubator_brpc_tpu.builtin.rpcz import Span, span_store
+
+__all__ = ["portal", "rpcz", "Span", "span_store"]

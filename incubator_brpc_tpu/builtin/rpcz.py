@@ -1,0 +1,204 @@
+"""rpcz — sampled per-RPC spans (reference src/brpc/span.{h,cpp,proto} and
+builtin/rpcz_service.cpp).
+
+Reproduced design points:
+- spans are *sampled*, not always-on: a token-bucket speed limiter caps the
+  collection rate (the reference shares bvar::Collector's sampling-speed
+  limiter, collector.h:38-122, ~COLLECTOR_SAMPLING_BASE samples/s);
+- client spans are created in Channel.call_method (channel.cpp:343), server
+  spans in the protocol's process_request, with trace/span/parent ids
+  carried in the request meta (Dapper-style, baidu_rpc_meta.proto);
+- nested client calls made while serving a request pick up the server
+  span as parent via a thread-local (tls_bls.rpcz_parent_span, span.h:72-75);
+- storage is in-memory ring (the reference persists to LevelDB under
+  rpcz_database_dir; an in-memory ring serves the same /rpcz queries
+  without the on-disk dependency).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from incubator_brpc_tpu.utils.flags import get_flag
+
+SPAN_TYPE_CLIENT = "client"
+SPAN_TYPE_SERVER = "server"
+
+_tls = threading.local()  # .parent_span: active server span on this thread
+
+
+@dataclass
+class Span:
+    trace_id: int = 0
+    span_id: int = 0
+    parent_span_id: int = 0
+    span_type: str = SPAN_TYPE_CLIENT
+    service: str = ""
+    method: str = ""
+    remote_side: str = ""
+    log_id: int = 0
+    error_code: int = 0
+    start_real_us: int = 0
+    latency_us: float = 0.0
+    request_size: int = 0
+    response_size: int = 0
+    # (offset_us_from_start, text) — Span::Annotate analog
+    annotations: List[Tuple[float, str]] = field(default_factory=list)
+
+    def annotate(self, text: str) -> None:
+        now_us = time.time() * 1e6
+        self.annotations.append((now_us - self.start_real_us, text))
+
+
+class _SpeedLimiter:
+    """Token bucket bounding spans collected per second (the reference's
+    Collector sampling-speed share, collector.cpp:35)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._tokens = 0.0
+        self._last = time.monotonic()
+
+    def grab(self) -> bool:
+        rate = float(get_flag("rpcz_samples_per_second"))
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(rate, self._tokens + (now - self._last) * rate)
+            self._last = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+
+class SpanStore:
+    """In-memory ring of finished spans, queryable by trace id / latency."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=int(get_flag("rpcz_max_spans")))
+
+    def submit(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+
+    def recent(self, limit: int = 100) -> List[Span]:
+        with self._lock:
+            return list(self._spans)[-limit:]
+
+    def by_trace(self, trace_id: int) -> List[Span]:
+        with self._lock:
+            return [s for s in self._spans if s.trace_id == trace_id]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+
+span_store = SpanStore()
+_limiter = _SpeedLimiter()
+
+
+def _new_id() -> int:
+    return random.getrandbits(63) | 1
+
+
+def rpcz_enabled() -> bool:
+    return bool(get_flag("enable_rpcz"))
+
+
+# -- client side (channel.cpp:343 Span::CreateClientSpan) --------------------
+
+
+def start_client_span(cntl) -> Optional[Span]:
+    """Create a sampled client span; always propagates trace ids into the
+    controller (so downstream server spans correlate even when this hop
+    doesn't sample)."""
+    parent: Optional[Span] = getattr(_tls, "parent_span", None)
+    if parent is not None:
+        cntl.trace_id = parent.trace_id
+        if not cntl.span_id:
+            cntl.span_id = _new_id()
+    elif not cntl.trace_id:
+        cntl.trace_id = _new_id()
+        cntl.span_id = _new_id()
+    elif not cntl.span_id:
+        cntl.span_id = _new_id()
+    if not rpcz_enabled() or not _limiter.grab():
+        return None
+    return Span(
+        trace_id=cntl.trace_id,
+        span_id=cntl.span_id,
+        parent_span_id=parent.span_id if parent is not None else 0,
+        span_type=SPAN_TYPE_CLIENT,
+        service=cntl._service,
+        method=cntl._method,
+        log_id=cntl.log_id,
+        start_real_us=int(time.time() * 1e6),
+        request_size=len(cntl._request_payload),
+    )
+
+
+def end_client_span(cntl) -> None:
+    span = cntl._span
+    if span is None:
+        return
+    span.latency_us = cntl.latency_us
+    span.error_code = cntl.error_code
+    span.remote_side = str(cntl.remote_side) if cntl.remote_side else ""
+    span.response_size = len(cntl.response_payload)
+    span_store.submit(span)
+    cntl._span = None
+
+
+# -- server side (protocol ProcessRequest, Span::CreateServerSpan) -----------
+
+
+def start_server_span(cntl, meta) -> Optional[Span]:
+    if not rpcz_enabled() or not _limiter.grab():
+        return None
+    span = Span(
+        trace_id=meta.trace_id or _new_id(),
+        span_id=_new_id(),
+        parent_span_id=meta.span_id,
+        span_type=SPAN_TYPE_SERVER,
+        service=meta.service,
+        method=meta.method,
+        log_id=meta.log_id,
+        start_real_us=int(time.time() * 1e6),
+        request_size=len(cntl._request_payload),
+    )
+    _tls.parent_span = span  # nested client calls inherit (span.h:72-75)
+    return span
+
+
+def clear_parent_span(span) -> None:
+    """Called by the server on the *worker thread* when the handler returns
+    (sync or async): the parent-span window is handler execution only, so an
+    async completion on another thread can never leave a stale parent in
+    this worker's TLS."""
+    if span is not None and getattr(_tls, "parent_span", None) is span:
+        _tls.parent_span = None
+
+
+def end_server_span(cntl, response_size: int = 0) -> None:
+    span = cntl._span
+    if span is None:
+        return
+    if getattr(_tls, "parent_span", None) is span:
+        _tls.parent_span = None
+    span.latency_us = cntl.latency_us
+    span.error_code = cntl.error_code
+    span.remote_side = str(cntl.remote_side) if cntl.remote_side else ""
+    span.response_size = response_size
+    span_store.submit(span)
+    cntl._span = None

@@ -1,1 +1,422 @@
-"""lb — placeholder subpackage; populated per SURVEY.md §7 build order."""
+"""lb — load balancers (reference src/brpc/load_balancer.h:33-106 +
+policy/*_load_balancer.cpp, registered in global.cpp:333-339).
+
+Policies: "rr" round-robin, "random", "wrr" weighted round-robin,
+"c_hash" ketama consistent hashing, "la" locality-aware (inverse EWMA
+latency with in-flight penalty — policy/locality_aware_load_balancer.cpp).
+
+All policies read server lists from a DoublyBufferedData snapshot so
+``select`` never blocks ``add_server``/``remove_server`` (the reference's
+wait-free-read property). ``LoadBalancerWithNaming`` glues a naming watcher
+to an LB and resolves the chosen EndPoint to a live Socket.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import logging
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set
+
+from incubator_brpc_tpu.utils.doubly_buffered import DoublyBufferedData
+from incubator_brpc_tpu.utils.endpoint import EndPoint
+from incubator_brpc_tpu.utils.status import ErrorCode
+
+logger = logging.getLogger(__name__)
+
+
+class LoadBalancer:
+    """Base (load_balancer.h:33-106). Servers are EndPoints; ``select``
+    must skip ``excluded`` (the ExcludedServers retry-avoidance set)."""
+
+    name = "base"
+
+    def add_server(self, ep: EndPoint, weight: int = 1) -> bool:
+        raise NotImplementedError
+
+    def remove_server(self, ep: EndPoint) -> bool:
+        raise NotImplementedError
+
+    def select(
+        self,
+        excluded: Optional[Set[EndPoint]] = None,
+        request_code: Optional[int] = None,
+    ) -> Optional[EndPoint]:
+        raise NotImplementedError
+
+    def feedback(self, ep: EndPoint, latency_us: float, error_code: int) -> None:
+        """Called after each RPC completes (Controller Call::OnComplete →
+        LoadBalancer::Feedback). Default: ignore."""
+
+    def servers(self) -> List[EndPoint]:
+        raise NotImplementedError
+
+
+class _SnapshotLB(LoadBalancer):
+    """Shared list-snapshot plumbing over DoublyBufferedData."""
+
+    def __init__(self) -> None:
+        self._dbd: DoublyBufferedData[list] = DoublyBufferedData(list)
+
+    def add_server(self, ep: EndPoint, weight: int = 1) -> bool:
+        added = []
+
+        def _add(lst: list) -> None:
+            if ep not in lst:
+                lst.append(ep)
+                added.append(True)
+
+        self._dbd.modify(_add)
+        return bool(added)
+
+    def remove_server(self, ep: EndPoint) -> bool:
+        removed = []
+
+        def _rm(lst: list) -> None:
+            if ep in lst:
+                lst.remove(ep)
+                removed.append(True)
+
+        self._dbd.modify(_rm)
+        return bool(removed)
+
+    def servers(self) -> List[EndPoint]:
+        with self._dbd.read() as lst:
+            return list(lst)
+
+
+class RoundRobinLB(_SnapshotLB):
+    name = "rr"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._cursor = 0
+        self._cursor_lock = threading.Lock()
+
+    def select(self, excluded=None, request_code=None) -> Optional[EndPoint]:
+        with self._dbd.read() as lst:
+            n = len(lst)
+            if n == 0:
+                return None
+            with self._cursor_lock:
+                start = self._cursor
+                self._cursor = (self._cursor + 1) % n
+            for i in range(n):
+                ep = lst[(start + i) % n]
+                if not excluded or ep not in excluded:
+                    return ep
+            return lst[start % n]  # all excluded: better any than none
+
+
+class RandomLB(_SnapshotLB):
+    name = "random"
+
+    def select(self, excluded=None, request_code=None) -> Optional[EndPoint]:
+        with self._dbd.read() as lst:
+            if not lst:
+                return None
+            cand = [ep for ep in lst if not excluded or ep not in excluded] or lst
+            return random.choice(cand)
+
+
+class WeightedRoundRobinLB(LoadBalancer):
+    """wrr — smooth weighted round robin (policy/weighted_round_robin_\
+load_balancer.cpp; smooth-WRR gives the same proportional schedule)."""
+
+    name = "wrr"
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._weights: Dict[EndPoint, int] = {}
+        self._current: Dict[EndPoint, int] = {}
+
+    def add_server(self, ep: EndPoint, weight: int = 1) -> bool:
+        with self._lock:
+            if ep in self._weights:
+                return False
+            self._weights[ep] = max(1, weight)
+            self._current[ep] = 0
+            return True
+
+    def remove_server(self, ep: EndPoint) -> bool:
+        with self._lock:
+            if ep not in self._weights:
+                return False
+            del self._weights[ep]
+            del self._current[ep]
+            return True
+
+    def select(self, excluded=None, request_code=None) -> Optional[EndPoint]:
+        with self._lock:
+            cand = {
+                ep: w
+                for ep, w in self._weights.items()
+                if not excluded or ep not in excluded
+            } or dict(self._weights)
+            if not cand:
+                return None
+            total = sum(cand.values())
+            best = None
+            for ep, w in cand.items():
+                self._current[ep] += w
+                if best is None or self._current[ep] > self._current[best]:
+                    best = ep
+            self._current[best] -= total
+            return best
+
+    def servers(self) -> List[EndPoint]:
+        with self._lock:
+            return list(self._weights)
+
+
+class ConsistentHashLB(LoadBalancer):
+    """c_hash — ketama ring with virtual nodes
+    (policy/consistent_hashing_load_balancer.cpp: 100+ replicas/server,
+    md5-derived points; requests route by ``request_code``)."""
+
+    name = "c_hash"
+    VIRTUAL_NODES = 100
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._ring: List[int] = []
+        self._owners: Dict[int, EndPoint] = {}
+        self._servers: Set[EndPoint] = set()
+
+    @staticmethod
+    def _points(ep: EndPoint, n: int) -> List[int]:
+        pts = []
+        for i in range(n):
+            h = hashlib.md5(f"{ep.ip}:{ep.port}-{i}".encode()).digest()
+            pts.append(int.from_bytes(h[:8], "little"))
+        return pts
+
+    def add_server(self, ep: EndPoint, weight: int = 1) -> bool:
+        with self._lock:
+            if ep in self._servers:
+                return False
+            self._servers.add(ep)
+            for p in self._points(ep, self.VIRTUAL_NODES * max(1, weight)):
+                if p not in self._owners:
+                    bisect.insort(self._ring, p)
+                    self._owners[p] = ep
+            return True
+
+    def remove_server(self, ep: EndPoint) -> bool:
+        with self._lock:
+            if ep not in self._servers:
+                return False
+            self._servers.discard(ep)
+            dead = [p for p, o in self._owners.items() if o == ep]
+            for p in dead:
+                del self._owners[p]
+                idx = bisect.bisect_left(self._ring, p)
+                if idx < len(self._ring) and self._ring[idx] == p:
+                    self._ring.pop(idx)
+            return True
+
+    def select(self, excluded=None, request_code=None) -> Optional[EndPoint]:
+        if request_code is None:
+            request_code = random.getrandbits(64)
+        key = int.from_bytes(
+            hashlib.md5(request_code.to_bytes(8, "little", signed=False)).digest()[:8],
+            "little",
+        )
+        with self._lock:
+            if not self._ring:
+                return None
+            idx = bisect.bisect(self._ring, key) % len(self._ring)
+            for i in range(len(self._ring)):
+                ep = self._owners[self._ring[(idx + i) % len(self._ring)]]
+                if not excluded or ep not in excluded:
+                    return ep
+            return self._owners[self._ring[idx]]
+
+    def servers(self) -> List[EndPoint]:
+        with self._lock:
+            return list(self._servers)
+
+
+class _LAStat:
+    __slots__ = ("ewma_latency_us", "inflight", "lock")
+
+    def __init__(self) -> None:
+        self.ewma_latency_us = 0.0  # 0 = no sample yet (optimistic)
+        self.inflight = 0
+        self.lock = threading.Lock()
+
+
+class LocalityAwareLB(_SnapshotLB):
+    """la — weight servers by inverse EWMA latency with an in-flight
+    penalty; errors are punished as a large latency sample
+    (policy/locality_aware_load_balancer.{h,cpp}: weight = base/latency,
+    in-flight extrapolation, punish_inflight on timeouts)."""
+
+    name = "la"
+    DECAY = 0.8  # EWMA keep factor per sample
+    PUNISH_FACTOR = 10.0  # error = 10× current average latency sample
+    DEFAULT_LATENCY_US = 1000.0
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._stats: Dict[EndPoint, _LAStat] = {}
+        self._stats_lock = threading.Lock()
+
+    def _stat(self, ep: EndPoint) -> _LAStat:
+        with self._stats_lock:
+            st = self._stats.get(ep)
+            if st is None:
+                st = self._stats[ep] = _LAStat()
+            return st
+
+    def _weight(self, ep: EndPoint) -> float:
+        st = self._stat(ep)
+        with st.lock:
+            lat = st.ewma_latency_us or self.DEFAULT_LATENCY_US
+            return 1e6 / (lat * (1.0 + st.inflight))
+
+    def select(self, excluded=None, request_code=None) -> Optional[EndPoint]:
+        with self._dbd.read() as lst:
+            cand = [ep for ep in lst if not excluded or ep not in excluded] or list(lst)
+        if not cand:
+            return None
+        weights = [self._weight(ep) for ep in cand]
+        total = sum(weights)
+        r = random.random() * total
+        chosen = cand[-1]
+        for ep, w in zip(cand, weights):
+            r -= w
+            if r <= 0:
+                chosen = ep
+                break
+        st = self._stat(chosen)
+        with st.lock:
+            st.inflight += 1
+        return chosen
+
+    def feedback(self, ep: EndPoint, latency_us: float, error_code: int) -> None:
+        st = self._stat(ep)
+        with st.lock:
+            if st.inflight > 0:
+                st.inflight -= 1
+            if error_code != 0:
+                latency_us = max(
+                    latency_us,
+                    (st.ewma_latency_us or self.DEFAULT_LATENCY_US)
+                    * self.PUNISH_FACTOR,
+                )
+            if st.ewma_latency_us == 0.0:
+                st.ewma_latency_us = latency_us
+            else:
+                st.ewma_latency_us = (
+                    self.DECAY * st.ewma_latency_us + (1 - self.DECAY) * latency_us
+                )
+
+    def expected_latency_us(self, ep: EndPoint) -> float:
+        st = self._stat(ep)
+        with st.lock:
+            return st.ewma_latency_us
+
+
+_lb_factories: Dict[str, Callable[[], LoadBalancer]] = {
+    "rr": RoundRobinLB,
+    "random": RandomLB,
+    "wrr": WeightedRoundRobinLB,
+    "c_hash": ConsistentHashLB,
+    "la": LocalityAwareLB,
+}
+
+
+def register_load_balancer(name: str, factory: Callable[[], LoadBalancer]) -> None:
+    _lb_factories[name] = factory
+
+
+def create_load_balancer(name: str) -> LoadBalancer:
+    try:
+        return _lb_factories[name]()
+    except KeyError:
+        raise ValueError(f"unknown load balancer {name!r}") from None
+
+
+class LoadBalancerWithNaming:
+    """Naming-watcher + LB + socket resolution (the reference's
+    LoadBalancerWithNaming in details/load_balancer_with_naming.{h,cpp}).
+
+    ``select_server(excluded)`` takes *socket ids* (what the channel's
+    ExcludedServers carries) and returns a connected Socket."""
+
+    MAX_PICK_ATTEMPTS = 3
+
+    def __init__(self, url: str, lb_name: str = "rr", socket_map=None):
+        from incubator_brpc_tpu.naming import NamingServiceThread
+
+        self.lb = create_load_balancer(lb_name)
+        self.ns_thread = NamingServiceThread(url)
+        if socket_map is None:
+            from incubator_brpc_tpu.transport.socket_map import global_socket_map
+
+            socket_map = global_socket_map()
+        self._socket_map = socket_map
+        self._ep_by_sid: Dict[int, EndPoint] = {}
+        self._map_lock = threading.Lock()
+
+    def start(self) -> bool:
+        if not self.ns_thread.start():
+            return False
+        self.ns_thread.add_observer(self.lb)
+        return True
+
+    def stop(self) -> None:
+        self.ns_thread.stop()
+
+    def select_server(
+        self,
+        excluded: Optional[Set[int]] = None,
+        request_code: Optional[int] = None,
+    ):
+        excluded_eps: Set[EndPoint] = set()
+        if excluded:
+            with self._map_lock:
+                excluded_eps = {
+                    self._ep_by_sid[sid] for sid in excluded if sid in self._ep_by_sid
+                }
+        for _ in range(self.MAX_PICK_ATTEMPTS):
+            ep = self.lb.select(excluded=excluded_eps, request_code=request_code)
+            if ep is None:
+                return None
+            try:
+                sock = self._socket_map.get_or_create(ep)
+            except OSError:
+                # select() already charged this pick (LA in-flight): settle it
+                self.lb.feedback(ep, 0.0, ErrorCode.EFAILEDSOCKET)
+                excluded_eps.add(ep)  # connect refused: try another server
+                continue
+            with self._map_lock:
+                self._ep_by_sid[sock.id] = ep
+            return sock
+        return None
+
+    def feedback(self, sock, latency_us: float, error_code: int) -> None:
+        with self._map_lock:
+            ep = self._ep_by_sid.get(sock.id)
+        if ep is not None:
+            self.lb.feedback(ep, latency_us, error_code)
+
+    def servers(self) -> List[EndPoint]:
+        return self.lb.servers()
+
+
+__all__ = [
+    "LoadBalancer",
+    "RoundRobinLB",
+    "RandomLB",
+    "WeightedRoundRobinLB",
+    "ConsistentHashLB",
+    "LocalityAwareLB",
+    "LoadBalancerWithNaming",
+    "create_load_balancer",
+    "register_load_balancer",
+]

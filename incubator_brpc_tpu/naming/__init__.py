@@ -1,1 +1,196 @@
-"""naming — placeholder subpackage; populated per SURVEY.md §7 build order."""
+"""naming — server-list discovery (reference src/brpc/naming_service.h:49-74,
+policy/*_naming_service.cpp, details/naming_service_thread.{h,cpp}).
+
+Push model kept from the reference: a NamingService runs in its own watcher
+(here a TimerThread-driven poll instead of a dedicated pthread) and pushes
+full server lists into NamingServiceActions; the NamingServiceThread diffs
+consecutive lists into add/remove calls on its observers (load balancers).
+
+Supported urls:
+- ``list://host:port,host:port``  inline list (policy/list_naming_service)
+- ``file://path``                 watched file, one host:port per line
+                                  (policy/file_naming_service)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Callable, Dict, List, Optional
+
+from incubator_brpc_tpu.runtime.timer_thread import global_timer_thread
+from incubator_brpc_tpu.utils.endpoint import EndPoint, str2endpoint
+from incubator_brpc_tpu.utils.flags import get_flag
+
+logger = logging.getLogger(__name__)
+
+
+class NamingService:
+    """Base: subclasses produce full server lists. ``poll_interval_s`` of
+    None means one-shot (list://); otherwise PeriodicNamingService."""
+
+    poll_interval_s: Optional[float] = None
+
+    def __init__(self, service_name: str):
+        self.service_name = service_name
+
+    def get_servers(self) -> Optional[List[EndPoint]]:
+        """Return the current full list, or None if unchanged/unavailable."""
+        raise NotImplementedError
+
+
+class ListNamingService(NamingService):
+    """list://h1:p1,h2:p2 — inline, never changes."""
+
+    def __init__(self, service_name: str):
+        super().__init__(service_name)
+        self._servers = [
+            str2endpoint(part.strip())
+            for part in service_name.split(",")
+            if part.strip()
+        ]
+
+    def get_servers(self) -> Optional[List[EndPoint]]:
+        return list(self._servers)
+
+
+class FileNamingService(NamingService):
+    """file://path — re-read on mtime change (the reference watches with
+    a periodic stat as well)."""
+
+    def __init__(self, service_name: str):
+        super().__init__(service_name)
+        self.path = service_name
+        self.poll_interval_s = float(get_flag("ns_refresh_interval_s"))
+        self._last_mtime: Optional[float] = None
+
+    def get_servers(self) -> Optional[List[EndPoint]]:
+        """None on unchanged file OR any transient error — a failed stat/read
+        must keep the previous server list, never wipe it (the reference
+        keeps serving the last good list across NS hiccups)."""
+        try:
+            mtime = os.stat(self.path).st_mtime_ns
+        except OSError:
+            return None
+        if mtime == self._last_mtime:
+            return None
+        servers: List[EndPoint] = []
+        try:
+            with open(self.path) as f:
+                for line in f:
+                    line = line.split("#", 1)[0].strip()
+                    if line:
+                        servers.append(str2endpoint(line))
+        except (OSError, ValueError):
+            return None  # mtime NOT recorded: retried next tick
+        self._last_mtime = mtime
+        return servers
+
+
+_factories: Dict[str, Callable[[str], NamingService]] = {}
+
+
+def register_naming_service(
+    scheme: str, factory: Callable[[str], NamingService]
+) -> None:
+    _factories[scheme] = factory
+
+
+register_naming_service("list", ListNamingService)
+register_naming_service("file", FileNamingService)
+
+
+def create_naming_service(url: str) -> NamingService:
+    """"scheme://rest" → NamingService (global.cpp:324-330 registry)."""
+    scheme, _, rest = url.partition("://")
+    try:
+        factory = _factories[scheme]
+    except KeyError:
+        raise ValueError(f"unknown naming scheme {scheme!r}") from None
+    return factory(rest)
+
+
+class NamingServiceThread:
+    """Runs one NamingService and diffs its lists into observer callbacks
+    (details/naming_service_thread.cpp — shared per url in the reference;
+    cheap enough here to be per-LB)."""
+
+    def __init__(self, url: str):
+        self.ns = create_naming_service(url)
+        self._observers: List[object] = []  # objects with add_server/remove_server
+        self._current: List[EndPoint] = []
+        self._lock = threading.Lock()
+        self._timer_id = None
+        self._stopped = False
+
+    def start(self) -> bool:
+        self._refresh()
+        if self.ns.poll_interval_s:
+            self._schedule()
+        return True
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._timer_id is not None:
+            global_timer_thread().unschedule(self._timer_id)
+            self._timer_id = None
+
+    def add_observer(self, obs) -> None:
+        with self._lock:
+            self._observers.append(obs)
+            current = list(self._current)
+        for ep in current:
+            obs.add_server(ep)
+
+    def servers(self) -> List[EndPoint]:
+        with self._lock:
+            return list(self._current)
+
+    def _schedule(self) -> None:
+        if self._stopped:
+            return
+        self._timer_id = global_timer_thread().schedule(
+            self._tick, delay=self.ns.poll_interval_s
+        )
+
+    def _tick(self) -> None:
+        # timer callbacks must be cheap in the reference; a file stat+read is
+        # acceptable here, a remote fetch would hand off to the worker pool
+        try:
+            self._refresh()
+        except Exception:
+            logger.exception("naming refresh failed for %s", self.ns.service_name)
+        self._schedule()
+
+    def _refresh(self) -> None:
+        fresh = self.ns.get_servers()
+        if fresh is None:
+            return
+        with self._lock:
+            old = set(self._current)
+            new = set(fresh)
+            added = [ep for ep in fresh if ep not in old]
+            removed = [ep for ep in self._current if ep not in new]
+            self._current = list(dict.fromkeys(fresh))
+            observers = list(self._observers)
+        for obs in observers:
+            for ep in added:
+                obs.add_server(ep)
+            for ep in removed:
+                obs.remove_server(ep)
+        if added or removed:
+            logger.info(
+                "naming %s: +%d -%d → %d servers",
+                self.ns.service_name, len(added), len(removed), len(self._current),
+            )
+
+
+__all__ = [
+    "NamingService",
+    "ListNamingService",
+    "FileNamingService",
+    "NamingServiceThread",
+    "create_naming_service",
+    "register_naming_service",
+]

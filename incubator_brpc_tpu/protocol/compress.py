@@ -1,0 +1,63 @@
+"""Compression codec registry (reference src/brpc/compress.{h,cpp}; handlers
+registered in global.cpp:342-354 for COMPRESS_TYPE_{GZIP,ZLIB,SNAPPY}).
+
+Codecs are named strings carried in Meta.compress; both sides look the name
+up here. A name always identifies exactly one algorithm ("snappy" exists
+only when the real library does; "zlib1" is the built-in cheap/fast codec).
+"""
+
+from __future__ import annotations
+
+import gzip as _gzip
+import zlib as _zlib
+from typing import Callable, Dict, Tuple
+
+_codecs: Dict[str, Tuple[Callable[[bytes], bytes], Callable[[bytes], bytes]]] = {}
+
+
+def register_codec(
+    name: str,
+    compress: Callable[[bytes], bytes],
+    decompress: Callable[[bytes], bytes],
+) -> None:
+    if name in _codecs:
+        raise ValueError(f"codec {name!r} already registered")
+    _codecs[name] = (compress, decompress)
+
+
+def has_codec(name: str) -> bool:
+    return name in _codecs
+
+
+def compress(name: str, data: bytes) -> bytes:
+    if not name:
+        return data
+    try:
+        c, _ = _codecs[name]
+    except KeyError:
+        raise ValueError(f"unknown compression codec {name!r}") from None
+    return c(data)
+
+
+def decompress(name: str, data: bytes) -> bytes:
+    if not name:
+        return data
+    try:
+        _, d = _codecs[name]
+    except KeyError:
+        raise ValueError(f"unknown compression codec {name!r}") from None
+    return d(data)
+
+
+register_codec("gzip", lambda b: _gzip.compress(b, 6), _gzip.decompress)
+register_codec("zlib", lambda b: _zlib.compress(b, 6), _zlib.decompress)
+# "zlib1" fills snappy's cheap-and-fast role. "snappy" itself registers only
+# when the real library exists — a codec name must always identify exactly
+# one algorithm, or two peers with different installs mis-decompress.
+register_codec("zlib1", lambda b: _zlib.compress(b, 1), _zlib.decompress)
+try:
+    import snappy as _snappy  # type: ignore
+
+    register_codec("snappy", _snappy.compress, _snappy.decompress)
+except ImportError:
+    pass

@@ -1,1 +1,24 @@
-"""rpc — placeholder subpackage; populated per SURVEY.md §7 build order."""
+"""rpc — the user-facing API (reference L5: src/brpc/channel.h, server.h,
+controller.h, stream.h and the combo channels).
+
+End-to-end flow is SURVEY.md §3.1/§3.2 re-expressed over the fiber runtime
+and the tbus_std protocol; combo channels additionally lower to XLA
+collectives when all parties share one device mesh (parallel/collective.py).
+"""
+
+from incubator_brpc_tpu.rpc.channel import Channel, ChannelOptions
+from incubator_brpc_tpu.rpc.controller import Controller
+from incubator_brpc_tpu.rpc.server import (
+    MethodStatus,
+    Server,
+    ServerOptions,
+)
+
+__all__ = [
+    "Channel",
+    "ChannelOptions",
+    "Controller",
+    "MethodStatus",
+    "Server",
+    "ServerOptions",
+]

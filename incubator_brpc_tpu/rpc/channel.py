@@ -21,6 +21,7 @@ import threading
 from typing import Callable, Optional, Union
 
 from incubator_brpc_tpu import protocol as proto_pkg
+from incubator_brpc_tpu.protocol import compress as compress_mod
 from incubator_brpc_tpu.protocol.tbus_std import (
     FLAG_RESPONSE,
     Meta,
@@ -104,7 +105,9 @@ class Channel:
         elif "://" in str(target):
             from incubator_brpc_tpu.lb import LoadBalancerWithNaming
 
-            self._lb = LoadBalancerWithNaming(str(target), lb_name or "rr")
+            self._lb = LoadBalancerWithNaming(
+                str(target), lb_name or "rr", socket_map=self._socket_map
+            )
             if not self._lb.start():
                 return False
         else:
@@ -224,12 +227,22 @@ class Channel:
             trace_id=cntl.trace_id,
             span_id=cntl.span_id,
         )
-        data = pack_frame(
-            meta,
-            cntl._request_payload,
-            cid,
-            attachment=cntl.request_attachment,
-        )
+        try:
+            payload = cntl._request_payload
+            if cntl.compress_type:
+                payload = compress_mod.compress(cntl.compress_type, payload)
+            data = pack_frame(
+                meta,
+                payload,
+                cid,
+                attachment=cntl.request_attachment,
+            )
+        except (ValueError, TypeError) as e:
+            # unknown codec / bad frame inputs: fail the RPC, never leak the
+            # locked id out of IssueRPC
+            cntl.set_failed(ErrorCode.EREQUEST, f"pack failed: {e}")
+            self._end_rpc(cntl)
+            return
         pool = global_worker_pool()
         rc = sock.write(
             data,
@@ -288,21 +301,36 @@ class Channel:
                 or f"remote error {frame.error_code}",
             )
         else:
-            cntl.response_payload = frame.payload
+            payload = frame.payload
+            if frame.meta and frame.meta.compress:
+                try:
+                    payload = compress_mod.decompress(frame.meta.compress, payload)
+                except Exception as e:
+                    cntl.set_failed(ErrorCode.ERESPONSE, f"decompress failed: {e}")
+                    self._end_rpc(cntl)
+                    return
+            cntl.response_payload = payload
             cntl.response_attachment = frame.attachment
             cntl.response_meta = frame.meta
-        if self._lb is not None:
-            self._lb.feedback(sock, cntl.latency_us, cntl.error_code)
         self._end_rpc(cntl)
 
     def _end_rpc(self, cntl: Controller) -> None:
         """EndRPC: cancel timers, destroy the id (wakes joiners), run done.
         Called with the id locked; the id is dead afterwards."""
+        cntl._mark_end()
+        if self._lb is not None:
+            # every issued attempt (retries, backup duplicates) was a
+            # select() — feed each back exactly once so LA's in-flight
+            # accounting balances (Call::OnComplete does per-call Feedback,
+            # controller.cpp:698-777)
+            last = cntl._sent_sockets[-1] if cntl._sent_sockets else None
+            for sock in cntl._sent_sockets:
+                code = cntl.error_code if sock is last else ErrorCode.EFAILEDSOCKET
+                self._lb.feedback(sock, cntl.latency_us, code)
         timer = global_timer_thread()
         for tid in cntl._timer_ids:
             timer.unschedule(tid)
         cntl._timer_ids.clear()
-        cntl._mark_end()
         if cntl._span is not None:
             from incubator_brpc_tpu.builtin.rpcz import end_client_span
 

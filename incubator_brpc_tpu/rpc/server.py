@@ -1,0 +1,357 @@
+"""Server — service registry + lifecycle + admission (reference
+src/brpc/server.{h,cpp}: StartInternal server.cpp:690, method map
+server.cpp:1209, MethodStatus admission details/method_status.h:90-97).
+
+Request flow (mirrors SURVEY.md §3.2):
+  Acceptor IN event → Socket reader fiber → InputMessenger cut
+    → tbus_std.process_request (bound below)
+      ├ look up server via sock.context (the reference reaches it through
+      │ the Socket's user object)
+      ├ find MethodProperty; ENOSERVICE/ENOMETHOD on miss
+      ├ MethodStatus.on_requested — ELIMIT admission, ELOGOFF when stopping
+      ├ decompress, build server Controller, rpcz server span
+      └ run handler; done → _send_response (compress, pack, Socket.write,
+        MethodStatus.on_responded latency bvars)
+
+A handler is ``handler(cntl, request: bytes) -> Optional[bytes]``:
+  - return bytes: the response payload (sync style);
+  - return None after calling ``cntl.set_async()``: the handler owns the
+    response and must call ``cntl.send_response(payload)`` later — the
+    reference's done-closure style (baidu_rpc_protocol.cpp:490-503).
+Errors: ``cntl.set_failed(code, text)`` → an error frame, payload dropped.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, Optional, Union
+
+from incubator_brpc_tpu import protocol as proto_pkg
+from incubator_brpc_tpu.bvar import Adder, LatencyRecorder
+from incubator_brpc_tpu.protocol import compress as compress_mod
+from incubator_brpc_tpu.protocol.tbus_std import (
+    FLAG_RESPONSE,
+    Meta,
+    ParsedFrame,
+    pack_frame,
+)
+from incubator_brpc_tpu.rpc.controller import Controller
+from incubator_brpc_tpu.transport.acceptor import Acceptor
+from incubator_brpc_tpu.transport.messenger import InputMessenger
+from incubator_brpc_tpu.utils.endpoint import EndPoint, str2endpoint
+from incubator_brpc_tpu.utils.status import ErrorCode, berror
+
+logger = logging.getLogger(__name__)
+
+
+class MethodStatus:
+    """Per-method concurrency gate + latency stats
+    (details/method_status.h:28,90-97: _nprocessing fetch_add vs
+    _max_concurrency; latency bvars fed in OnResponded)."""
+
+    def __init__(self, full_name: str, max_concurrency: int = 0):
+        self.full_name = full_name
+        self.max_concurrency = max_concurrency  # 0 = unlimited
+        self._nprocessing = 0
+        self._lock = threading.Lock()
+        self.latency = LatencyRecorder(name=f"method_{full_name}_latency")
+        self.nerror = Adder(name=f"method_{full_name}_error")
+
+    @property
+    def processing(self) -> int:
+        return self._nprocessing
+
+    def on_requested(self) -> bool:
+        with self._lock:
+            if self.max_concurrency and self._nprocessing >= self.max_concurrency:
+                return False
+            self._nprocessing += 1
+            return True
+
+    def on_responded(self, error_code: int, latency_us: float) -> None:
+        with self._lock:
+            self._nprocessing -= 1
+        if error_code == 0:
+            self.latency << latency_us
+        else:
+            self.nerror << 1
+
+
+class MethodProperty:
+    __slots__ = ("handler", "status")
+
+    def __init__(self, handler: Callable, status: MethodStatus):
+        self.handler = handler
+        self.status = status
+
+
+class ServerOptions:
+    """Subset of reference ServerOptions (server.h:55-239) that applies here."""
+
+    def __init__(
+        self,
+        max_concurrency: int = 0,
+        method_max_concurrency: int = 0,
+        idle_timeout_s: float = -1,
+        has_builtin_services: bool = True,
+    ):
+        self.max_concurrency = max_concurrency
+        self.method_max_concurrency = method_max_concurrency
+        self.idle_timeout_s = idle_timeout_s
+        self.has_builtin_services = has_builtin_services
+
+
+class Server:
+    def __init__(self, options: Optional[ServerOptions] = None):
+        self.options = options or ServerOptions()
+        self._methods: Dict[str, MethodProperty] = {}
+        self._acceptor: Optional[Acceptor] = None
+        self._messenger = InputMessenger()
+        self._stopping = False
+        self._started = False
+        self._lock = threading.Lock()
+        self._nprocessing = 0  # server-level concurrency
+        self._quiescent = threading.Condition(self._lock)
+        self.nrequest = Adder(name=None)
+        self.nerror = Adder(name=None)
+        self.listen_endpoint: Optional[EndPoint] = None
+
+    # -- registration --------------------------------------------------------
+
+    def add_service(
+        self,
+        name: str,
+        handlers: Dict[str, Callable],
+        max_concurrency: Optional[int] = None,
+    ) -> None:
+        """Register ``name.method → handler`` rows (Server::AddService builds
+        the same flat _method_map, server.cpp:1209)."""
+        if self._started:
+            raise RuntimeError("add_service after start")
+        for method, handler in handlers.items():
+            full = f"{name}.{method}"
+            if full in self._methods:
+                raise ValueError(f"method {full} already registered")
+            mc = (
+                max_concurrency
+                if max_concurrency is not None
+                else self.options.method_max_concurrency
+            )
+            self._methods[full] = MethodProperty(handler, MethodStatus(full, mc))
+
+    def method_status(self, service: str, method: str) -> Optional[MethodStatus]:
+        prop = self._methods.get(f"{service}.{method}")
+        return prop.status if prop else None
+
+    def methods(self) -> Dict[str, MethodProperty]:
+        return dict(self._methods)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, listen: Union[int, str, EndPoint] = 0) -> bool:
+        """StartInternal (server.cpp:690): build the acceptor and listen.
+        ``listen`` may be a port (0 = ephemeral), "ip:port", or EndPoint."""
+        if self._started:
+            return False
+        if isinstance(listen, int):
+            ep = EndPoint(ip="127.0.0.1", port=listen)
+        elif isinstance(listen, str):
+            ep = str2endpoint(listen)
+        else:
+            ep = listen
+        self._acceptor = Acceptor(
+            ep, messenger=self._messenger, conn_context={"server": self}
+        )
+        self.listen_endpoint = self._acceptor.endpoint
+        self._stopping = False
+        self._started = True
+        if self.options.has_builtin_services:
+            from incubator_brpc_tpu.builtin import portal
+
+            portal.register_server(self)
+        logger.info("server started on %s", self.listen_endpoint)
+        return True
+
+    def stop(self) -> None:
+        """Stop accepting + fail connections; in-flight handlers finish
+        (Server::Stop then Join, server.cpp)."""
+        if not self._started:
+            return
+        self._stopping = True
+        if self._acceptor is not None:
+            self._acceptor.stop()
+        if self.options.has_builtin_services:
+            from incubator_brpc_tpu.builtin import portal
+
+            portal.unregister_server(self)
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Wait until every in-flight request finished."""
+        with self._quiescent:
+            return self._quiescent.wait_for(
+                lambda: self._nprocessing == 0, timeout=timeout
+            )
+
+    @property
+    def port(self) -> int:
+        return self.listen_endpoint.port if self.listen_endpoint else 0
+
+    @property
+    def running(self) -> bool:
+        return self._started and not self._stopping
+
+    def connection_count(self) -> int:
+        return self._acceptor.connection_count() if self._acceptor else 0
+
+    # -- request path --------------------------------------------------------
+
+    def process_request(self, sock, frame: ParsedFrame) -> None:
+        """The tbus_std process_request body (baidu_rpc_protocol.cpp:307)."""
+        self.nrequest << 1
+        meta = frame.meta
+        cntl = Controller()
+        cntl.request_meta = meta
+        cntl.remote_side = sock.remote
+        cntl.log_id = meta.log_id
+        cntl.trace_id = meta.trace_id
+        cntl.span_id = meta.span_id
+        cntl.call_id = frame.correlation_id
+        cntl.compress_type = meta.compress
+        cntl.request_attachment = frame.attachment
+        cntl._server = self
+        cntl._service = meta.service
+        cntl._method = meta.method
+        cntl._mark_start()
+
+        if self._stopping:
+            cntl.set_failed(ErrorCode.ELOGOFF, berror(ErrorCode.ELOGOFF))
+            self._send_response(sock, cntl, b"")
+            return
+        prop = self._methods.get(f"{meta.service}.{meta.method}")
+        if prop is None:
+            code = (
+                ErrorCode.ENOMETHOD
+                if any(k.startswith(meta.service + ".") for k in self._methods)
+                else ErrorCode.ENOSERVICE
+            )
+            cntl.set_failed(code, f"unknown {meta.service}.{meta.method}")
+            self._send_response(sock, cntl, b"")
+            return
+        status = prop.status
+        # server-level then per-method admission (method_status.h:90-97)
+        with self._lock:
+            admitted_server = not (
+                self.options.max_concurrency
+                and self._nprocessing >= self.options.max_concurrency
+            )
+            if admitted_server:
+                self._nprocessing += 1
+        if not (admitted_server and status.on_requested()):
+            if admitted_server:  # method gate refused: undo the server add
+                with self._lock:
+                    self._nprocessing -= 1
+                    if self._nprocessing == 0:
+                        self._quiescent.notify_all()
+            cntl.set_failed(ErrorCode.ELIMIT, berror(ErrorCode.ELIMIT))
+            self.nerror << 1
+            self._send_response(sock, cntl, b"")
+            return
+
+        try:
+            payload = frame.payload
+            if meta.compress:
+                payload = compress_mod.decompress(meta.compress, payload)
+        except Exception as e:
+            cntl.set_failed(ErrorCode.EREQUEST, f"decompress failed: {e}")
+            self._finish(sock, cntl, b"", status)
+            return
+        cntl._request_payload = payload
+
+        from incubator_brpc_tpu.builtin.rpcz import start_server_span
+
+        cntl._span = start_server_span(cntl, meta)
+        if cntl._span is not None:
+            cntl._span.annotate("processing")
+
+        # wire the async-response closure before running user code
+        cntl._async = False
+        cntl.set_async = lambda: setattr(cntl, "_async", True)
+        cntl.send_response = lambda response=b"": self._finish(
+            sock, cntl, response, status
+        )
+        try:
+            response = prop.handler(cntl, payload)
+        except Exception as e:
+            logger.exception("handler %s.%s raised", meta.service, meta.method)
+            cntl.set_failed(ErrorCode.EINTERNAL, f"handler raised: {e!r}")
+            response = b""
+        finally:
+            # the parent-span window is handler execution on THIS thread;
+            # an async completion elsewhere must not leave stale TLS here
+            from incubator_brpc_tpu.builtin.rpcz import clear_parent_span
+
+            clear_parent_span(cntl._span)
+        if cntl._async and not cntl.failed():
+            return  # handler owns the response now
+        self._finish(sock, cntl, response or b"", status)
+
+    def _finish(
+        self, sock, cntl: Controller, response: bytes, status: Optional[MethodStatus]
+    ) -> None:
+        self._send_response(sock, cntl, response)
+        cntl._mark_end()
+        if status is not None:
+            status.on_responded(cntl.error_code, cntl.latency_us)
+            with self._lock:
+                self._nprocessing -= 1
+                if self._nprocessing == 0:
+                    self._quiescent.notify_all()
+        if cntl.failed():
+            self.nerror << 1
+        if cntl._span is not None:
+            from incubator_brpc_tpu.builtin.rpcz import end_server_span
+
+            end_server_span(cntl, response_size=len(response))
+
+    def _send_response(self, sock, cntl: Controller, response: bytes) -> None:
+        """SendRpcResponse (baidu_rpc_protocol.cpp:136): serialize+compress,
+        append attachment, write."""
+        meta = Meta(
+            service=cntl._service,
+            method=cntl._method,
+            error_text=cntl.error_text if cntl.failed() else "",
+            trace_id=cntl.trace_id,
+            span_id=cntl.span_id,
+        )
+        payload = b"" if cntl.failed() else response
+        if payload and cntl.compress_type:
+            meta.compress = cntl.compress_type
+            payload = compress_mod.compress(cntl.compress_type, payload)
+        data = pack_frame(
+            meta,
+            payload,
+            cntl.call_id,
+            flags=FLAG_RESPONSE,
+            error_code=cntl.error_code,
+            attachment=b"" if cntl.failed() else cntl.response_attachment,
+        )
+        rc = sock.write(data)
+        if rc != 0:
+            logger.warning(
+                "response write to %s failed: %s", sock.remote, berror(rc)
+            )
+
+
+def process_request(sock, frame: ParsedFrame) -> None:
+    """Global tbus_std Protocol.process_request hook: route to the server
+    that accepted this connection (the reference reaches the Server through
+    the Socket's user field)."""
+    server: Optional[Server] = sock.context.get("server")
+    if server is None:
+        logger.warning("request frame on %r with no owning server", sock)
+        return
+    server.process_request(sock, frame)
+
+
+proto_pkg.TBUS_STD.process_request = process_request

@@ -134,7 +134,23 @@ class Butex:
             w.timer_id = global_timer_thread().schedule(
                 lambda: _timeout_fire(w), delay=timeout
             )
-        w.event.wait()
+        # Tell the worker pool this worker is BLOCKED (not merely busy) so
+        # elastic growth can keep `concurrency` runnable workers — the
+        # replacement for the reference's M:N descheduling of the caller.
+        from incubator_brpc_tpu.runtime import worker_pool as _wp
+
+        worker = getattr(_wp._tls, "worker", None)
+        if worker is not None and not w.event.is_set():
+            pool = worker.pool
+            with pool._grow_lock:
+                pool._nblocked += 1
+            try:
+                w.event.wait()
+            finally:
+                with pool._grow_lock:
+                    pool._nblocked -= 1
+        else:
+            w.event.wait()
         if w.timer_id is not None and not w.timed_out:
             global_timer_thread().unschedule(w.timer_id)
         return ETIMEDOUT if w.timed_out else WAIT_OK

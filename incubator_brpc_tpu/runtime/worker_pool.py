@@ -150,7 +150,13 @@ class _Worker:
                 state = pool._lot.state()
                 if self._peek_any():
                     continue
-                pool._lot.wait(state)
+                with pool._grow_lock:
+                    pool._nidle += 1
+                try:
+                    pool._lot.wait(state)
+                finally:
+                    with pool._grow_lock:
+                        pool._nidle -= 1
                 continue
             pool.nfibers_run << 1
             fiber._run()
@@ -187,12 +193,21 @@ class _Worker:
 class WorkerPool:
     """TaskControl analog: owns the workers, the remote queue, the lot."""
 
-    def __init__(self, concurrency: Optional[int] = None, name: str = "pool"):
+    def __init__(
+        self,
+        concurrency: Optional[int] = None,
+        max_concurrency: Optional[int] = None,
+        name: str = "pool",
+    ):
         self._concurrency = concurrency or get_flag("fiber_concurrency")
+        self._max_concurrency = max_concurrency or get_flag("fiber_concurrency_max")
         self._remote: deque = deque()
         self._remote_lock = threading.Lock()
         self._lot = ParkingLot()
         self._stopped = False
+        self._nidle = 0
+        self._nblocked = 0  # workers parked in a butex wait mid-fiber
+        self._grow_lock = threading.Lock()
         self.nfibers_run = Adder(name=f"{name}_fibers_run")
         self._workers: List[_Worker] = [
             _Worker(self, i) for i in range(self._concurrency)
@@ -218,6 +233,23 @@ class WorkerPool:
                     self._remote.append(fiber)
         # capped wake: 1 waiter per spawn (task_control.cpp:361-391 caps at 2)
         self._lot.signal(1)
+        # elastic growth (task_control.cpp:382-390 grows from
+        # bthread_min_concurrency): fibers here block their worker 1:1, so
+        # the pool maintains ~`concurrency` RUNNABLE workers — it grows only
+        # when butex-blocked workers eat into that budget (a busy-but-running
+        # worker will drain the queue by itself; growing on mere busyness
+        # would add one thread per spawn in a burst).
+        if self._nidle == 0:
+            with self._grow_lock:
+                if (
+                    self._nidle == 0
+                    and len(self._workers) - self._nblocked < self._concurrency
+                    and len(self._workers) < self._max_concurrency
+                    and not self._stopped
+                ):
+                    w = _Worker(self, len(self._workers))
+                    self._workers.append(w)
+                    w.thread.start()
         return fiber
 
     def _pop_remote(self) -> Optional[Fiber]:

@@ -29,11 +29,15 @@ class Acceptor:
         messenger=None,
         user_message_handler: Optional[Callable] = None,
         on_connection: Optional[Callable[[Socket], None]] = None,
+        conn_context: Optional[dict] = None,
         backlog: int = 128,
     ):
         self._messenger = messenger
         self._user_message_handler = user_message_handler
         self._on_connection = on_connection
+        # seeded into every accepted Socket BEFORE it goes live (a request
+        # can arrive in the same burst as the accept)
+        self._conn_context = conn_context
         self._connections: Dict[int, Socket] = {}
         self._conn_lock = threading.Lock()
         self._accepting = False
@@ -85,6 +89,7 @@ class Acceptor:
                     peer,
                     messenger=self._messenger,
                     user_message_handler=self._user_message_handler,
+                    context=self._conn_context,
                 )
                 with self._conn_lock:
                     self._connections[sock.id] = sock

@@ -31,7 +31,7 @@ from typing import Callable, Dict, List, Optional, Union
 
 from incubator_brpc_tpu.bvar import Adder
 from incubator_brpc_tpu.iobuf import IOBuf
-from incubator_brpc_tpu.runtime.butex import Butex, ETIMEDOUT
+from incubator_brpc_tpu.runtime.butex import Butex
 from incubator_brpc_tpu.runtime.worker_pool import global_worker_pool
 from incubator_brpc_tpu.transport.event_dispatcher import (
     EVENT_ERR,
@@ -120,6 +120,7 @@ class Socket:
         is_client: bool = False,
         health_check_interval: Optional[float] = None,
         user_message_handler: Optional[Callable] = None,
+        context: Optional[Dict] = None,
     ):
         conn.setblocking(False)
         self._conn = conn
@@ -131,8 +132,13 @@ class Socket:
         self.error_code = 0
         self.error_text = ""
         self.preferred_protocol = None  # remembered by InputMessenger
-        # arbitrary per-connection state for protocols/rpc (auth, streams)
-        self.context: Dict = {}
+        # arbitrary per-connection state for protocols/rpc (auth, streams).
+        # Must be seeded via the constructor when a frame could arrive in the
+        # same packet burst as the connect: the dispatcher registration at
+        # the bottom of __init__ makes the socket live immediately, so a
+        # post-construction stamp (e.g. the owning server) can lose the race
+        # with the first request.
+        self.context: Dict = dict(context) if context else {}
         # must be set before the dispatcher registration below: a request
         # can arrive in the same packet burst as the connect
         self.user_message_handler = user_message_handler
@@ -158,7 +164,6 @@ class Socket:
         # this from Socket refcounting)
         self._io_refs = 0
         self._pending_close: Optional[_pysocket.socket] = None
-        self._hc_stop = Butex(0)
         if health_check_interval is None:
             health_check_interval = float(get_flag("health_check_interval"))
         self.health_check_interval = health_check_interval
@@ -440,26 +445,34 @@ class Socket:
             and self.health_check_interval > 0
             and code != ErrorCode.ECLOSE
         ):
-            self._pool.spawn(self._health_check_loop)
+            self._schedule_health_check()
         return True
 
-    def _health_check_loop(self) -> None:
-        """Probe the remote until it answers, then revive in place
-        (HealthCheckThread, socket.cpp:950-1026)."""
-        while True:
-            rc = self._hc_stop.wait(0, timeout=self.health_check_interval)
-            if rc != ETIMEDOUT:
-                return  # recycled: stop probing
-            if self.state != FAILED:
-                return
-            try:
-                conn = _pysocket.create_connection(
-                    (self.remote.ip, self.remote.port), timeout=2.0
-                )
-            except OSError:
-                continue
-            if self._revive(conn):
-                return
+    def _schedule_health_check(self) -> None:
+        """Timer-driven probing (HealthCheckThread, socket.cpp:950-1026).
+        The reference parks a bthread between probes — free under M:N; here
+        a parked fiber would pin a worker for the (possibly unbounded) life
+        of a dead remote, so the wait lives on the TimerThread and only the
+        short connect probe occupies a fiber."""
+        from incubator_brpc_tpu.runtime.timer_thread import global_timer_thread
+
+        global_timer_thread().schedule(
+            lambda: self._pool.spawn(self._health_probe),
+            delay=self.health_check_interval,
+        )
+
+    def _health_probe(self) -> None:
+        if self.state != FAILED:
+            return  # recycled or already revived: stop probing
+        try:
+            conn = _pysocket.create_connection(
+                (self.remote.ip, self.remote.port), timeout=2.0
+            )
+        except OSError:
+            self._schedule_health_check()
+            return
+        if not self._revive(conn):
+            self._schedule_health_check()
 
     def _revive(self, conn: _pysocket.socket) -> bool:
         with self._state_lock:
@@ -494,8 +507,6 @@ class Socket:
         self.set_failed(ErrorCode.ECLOSE, "recycled")
         with self._state_lock:
             self.state = RECYCLED
-        self._hc_stop.add(1)
-        self._hc_stop.wake_all()
         _registry.recycle(self.id)
 
     # -- introspection ------------------------------------------------------

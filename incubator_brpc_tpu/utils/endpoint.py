@@ -34,6 +34,18 @@ class EndPoint:
     def is_device(self) -> bool:
         return self.device is not None
 
+    def __hash__(self) -> int:
+        # mesh_coords is descriptive, not identity: two endpoints naming the
+        # same ip:port/device are the same server (LBs key sets by EndPoint)
+        return hash((self.ip, self.port, self.device))
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, EndPoint):
+            return NotImplemented
+        return (self.ip, self.port, self.device) == (
+            other.ip, other.port, other.device,
+        )
+
     def __str__(self) -> str:
         base = f"{self.ip}:{self.port}"
         if self.device is not None:

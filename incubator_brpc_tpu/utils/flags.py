@@ -80,13 +80,34 @@ flag_registry = FlagRegistry()
 define_flag = flag_registry.define
 get_flag = flag_registry.get
 set_flag = flag_registry.set
+set_flag_unchecked = flag_registry.set_unchecked
 
 
 # Core framework flags (reference: DEFINE_* scattered through src/brpc/)
 define_flag("health_check_interval", 3, "seconds between health-check probes of a failed socket", lambda v: v > 0)
 define_flag("event_dispatcher_num", 1, "number of event dispatchers")
 define_flag("fiber_concurrency", 8, "number of worker threads in the fiber scheduler")
+define_flag(
+    "fiber_concurrency_max",
+    256,
+    "elastic ceiling of the fiber scheduler: blocking fibers occupy a worker "
+    "1:1, so the pool grows while none is idle (reference elastic growth from "
+    "bthread_min_concurrency, task_control.cpp:382-390)",
+)
 define_flag("max_body_size", 64 * 1024 * 1024, "maximum message body size", lambda v: v > 0)
 define_flag("socket_max_unwritten_bytes", 64 * 1024 * 1024, "write-queue backpressure threshold (EOVERCROWDED)", lambda v: v > 0)
 define_flag("enable_rpcz", False, "collect rpcz spans", lambda v: True)
 define_flag("rpcz_keep_span_seconds", 1800, "span retention", lambda v: v > 0)
+define_flag("rpcz_max_spans", 10000, "max spans retained in memory", lambda v: v > 0)
+define_flag(
+    "rpcz_samples_per_second",
+    1000,
+    "span sampling speed limit (reference bvar::Collector COLLECTOR_SAMPLING_BASE)",
+    lambda v: v > 0,
+)
+define_flag(
+    "ns_refresh_interval_s",
+    1.0,
+    "polling period of periodic naming services (reference -ns_access_interval)",
+    lambda v: v > 0,
+)
