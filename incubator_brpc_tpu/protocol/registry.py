@@ -26,6 +26,10 @@ class Protocol:
     process_request: Optional[Callable] = None
     # client side: (socket, frame) -> None
     process_response: Optional[Callable] = None
+    # either side: (socket, frame) -> None for FLAG_STREAM frames
+    # (the reference registers streaming_rpc as its own Protocol; here the
+    # stream frames share tbus_std's header so they share its row)
+    process_stream: Optional[Callable] = None
 
 
 class ProtocolRegistry:

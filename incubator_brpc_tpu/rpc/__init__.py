@@ -13,6 +13,13 @@ from incubator_brpc_tpu.rpc.server import (
     Server,
     ServerOptions,
 )
+from incubator_brpc_tpu.rpc.stream import (
+    Stream,
+    StreamHandler,
+    StreamOptions,
+    stream_accept,
+    stream_create,
+)
 
 __all__ = [
     "Channel",
@@ -21,4 +28,9 @@ __all__ = [
     "MethodStatus",
     "Server",
     "ServerOptions",
+    "Stream",
+    "StreamHandler",
+    "StreamOptions",
+    "stream_accept",
+    "stream_create",
 ]

@@ -125,6 +125,7 @@ class Channel:
         cntl: Optional[Controller] = None,
         done: Optional[Callable[[Controller], None]] = None,
         attachment: bytes = b"",
+        request_stream=None,
     ) -> Controller:
         """The CallMethod entry (channel.cpp:285). Synchronous when ``done``
         is None (joins the call id); asynchronous otherwise."""
@@ -141,6 +142,8 @@ class Channel:
         cntl._request_payload = request
         cntl.request_attachment = attachment
         cntl._done = done
+        if request_stream is not None:
+            cntl._request_stream = request_stream
         cntl._mark_start()
 
         # one id covers the first send + every retry/backup
@@ -226,6 +229,9 @@ class Channel:
             log_id=cntl.log_id,
             trace_id=cntl.trace_id,
             span_id=cntl.span_id,
+            stream_id=(
+                cntl._request_stream.id if cntl._request_stream is not None else 0
+            ),
         )
         try:
             payload = cntl._request_payload
@@ -312,6 +318,13 @@ class Channel:
             cntl.response_payload = payload
             cntl.response_attachment = frame.attachment
             cntl.response_meta = frame.meta
+            if (
+                cntl._request_stream is not None
+                and frame.meta is not None
+                and frame.meta.stream_id
+            ):
+                # handshake complete: the server's stream id arrived
+                cntl._request_stream._connect(sock, frame.meta.stream_id)
         self._end_rpc(cntl)
 
     def _end_rpc(self, cntl: Controller) -> None:
@@ -335,6 +348,16 @@ class Channel:
             from incubator_brpc_tpu.builtin.rpcz import end_client_span
 
             end_client_span(cntl)
+        if cntl._request_stream is not None:
+            from incubator_brpc_tpu.rpc import stream as stream_mod
+
+            if cntl._request_stream.state == stream_mod.CONNECTING:
+                # RPC ended without the server accepting: kill the half-open
+                # stream so writers don't block forever
+                cntl._request_stream._fail(
+                    cntl.error_code or ErrorCode.EREQUEST,
+                    cntl.error_text or "stream not accepted",
+                )
         call_id_space.unlock_and_destroy(cntl.call_id)
         if cntl._done is not None:
             global_worker_pool().spawn(cntl._done, cntl)

@@ -71,6 +71,11 @@ class Controller:
         self._excluded_sockets: set = set()  # ExcludedServers retry avoidance
         self._sent_sockets: List[Any] = []
         self._span = None
+        # streaming handshake (rpc/stream.py): client's half-open stream out,
+        # server's accepted id back (request_stream in RpcMeta, stream.cpp)
+        self._request_stream = None
+        self._accepted_stream_id: int = 0
+        self._sock = None  # server side: the connection the request came on
 
     # -- status surface (reference Controller::Failed/ErrorCode/ErrorText) --
 

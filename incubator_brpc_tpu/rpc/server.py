@@ -222,6 +222,7 @@ class Server:
         cntl._server = self
         cntl._service = meta.service
         cntl._method = meta.method
+        cntl._sock = sock  # stream_accept needs the connection
         cntl._mark_start()
 
         if self._stopping:
@@ -299,6 +300,14 @@ class Server:
     def _finish(
         self, sock, cntl: Controller, response: bytes, status: Optional[MethodStatus]
     ) -> None:
+        if cntl.failed() and cntl._accepted_stream_id:
+            # handler accepted a stream then failed: the response will carry
+            # stream_id=0, so the client kills its half — kill ours too
+            from incubator_brpc_tpu.rpc.stream import get_stream
+
+            s = get_stream(cntl._accepted_stream_id)
+            if s is not None:
+                s._fail(cntl.error_code, "rpc failed after stream_accept")
         self._send_response(sock, cntl, response)
         cntl._mark_end()
         if status is not None:
@@ -323,6 +332,7 @@ class Server:
             error_text=cntl.error_text if cntl.failed() else "",
             trace_id=cntl.trace_id,
             span_id=cntl.span_id,
+            stream_id=0 if cntl.failed() else cntl._accepted_stream_id,
         )
         payload = b"" if cntl.failed() else response
         if payload and cntl.compress_type:

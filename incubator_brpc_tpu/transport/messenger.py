@@ -112,16 +112,35 @@ class InputMessenger:
     def _dispatch(self, sock, cut) -> None:
         if not cut:
             return
+        # Stream frames must reach their per-stream ExecutionQueue in wire
+        # order, so they are routed inline here (the push is cheap and
+        # nonblocking; ordered consumption happens on the queue's fiber —
+        # the reference keeps order the same way by routing streaming
+        # messages during the parse phase, SURVEY §3.4). Everything else
+        # gets the N-1-fibers + last-inline treatment.
+        rest = []
+        for proto, frame in cut:
+            if getattr(frame, "is_stream", False) and proto.process_stream is not None:
+                self._process_one(sock, proto, frame)
+            else:
+                rest.append((proto, frame))
+        if not rest:
+            return
         pool = global_worker_pool()
-        for proto, frame in cut[:-1]:
+        for proto, frame in rest[:-1]:
             pool.spawn(self._process_one, sock, proto, frame)
-        proto, frame = cut[-1]
+        proto, frame = rest[-1]
         self._process_one(sock, proto, frame)  # last message inline
 
     @staticmethod
     def _process_one(sock, proto: Protocol, frame) -> None:
         try:
-            if sock.user_message_handler is not None:
+            if (
+                getattr(frame, "is_stream", False)
+                and proto.process_stream is not None
+            ):
+                proto.process_stream(sock, frame)
+            elif sock.user_message_handler is not None:
                 sock.user_message_handler(sock, frame, proto)
             elif getattr(frame, "is_response", False):
                 if proto.process_response is not None:

@@ -1,0 +1,223 @@
+"""Streaming RPC tests (reference test/brpc_streaming_rpc_unittest.cpp:
+handshake, ordered delivery, credit-window flow control, close)."""
+
+import threading
+import time
+
+import pytest
+
+from incubator_brpc_tpu.rpc import (
+    Channel,
+    Server,
+    StreamHandler,
+    StreamOptions,
+    stream_accept,
+    stream_create,
+)
+from incubator_brpc_tpu.rpc import stream as stream_mod
+from incubator_brpc_tpu.utils.status import ErrorCode
+
+
+class Recorder(StreamHandler):
+    def __init__(self, delay=0.0):
+        self.messages = []
+        self.closed = threading.Event()
+        self.failed = threading.Event()
+        self.delay = delay
+
+    def on_received_messages(self, stream, messages):
+        if self.delay:
+            time.sleep(self.delay)
+        self.messages.extend(messages)
+
+    def on_closed(self, stream):
+        self.closed.set()
+
+    def on_failed(self, stream, code, reason):
+        self.failed.set()
+        self.closed.set()
+
+
+@pytest.fixture
+def echo_server():
+    server = Server()
+    accepted = {}
+
+    def open_stream(cntl, request):
+        opts = StreamOptions(handler=accepted.get("handler") or Recorder())
+        s = stream_accept(cntl, opts)
+        assert s is not None
+        accepted["stream"] = s
+        return b"accepted"
+
+    def plain(cntl, request):
+        return request
+
+    server.add_service("test", {"open_stream": open_stream, "plain": plain})
+    assert server.start(0)
+    yield server, accepted
+    server.stop()
+    server.join(timeout=5)
+
+
+def _connect(server, accepted, handler=None, client_opts=None):
+    ch = Channel()
+    assert ch.init(f"127.0.0.1:{server.port}")
+    accepted["handler"] = handler
+    s = stream_create(client_opts or StreamOptions(handler=Recorder()))
+    cntl = ch.call_method("test", "open_stream", b"", request_stream=s)
+    assert cntl.ok(), cntl.error_text
+    assert s.wait_connected(timeout=5)
+    return ch, s
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+class TestHandshake:
+    def test_accept_connects_both_sides(self, echo_server):
+        server, accepted = echo_server
+        _, s = _connect(server, accepted, handler=Recorder())
+        srv_stream = accepted["stream"]
+        assert s.state == stream_mod.CONNECTED
+        assert srv_stream.state == stream_mod.CONNECTED
+        assert s.remote_id == srv_stream.id
+        assert srv_stream.remote_id == s.id
+        s.close()
+
+    def test_unaccepted_stream_fails(self, echo_server):
+        server, accepted = echo_server
+        ch = Channel()
+        assert ch.init(f"127.0.0.1:{server.port}")
+        s = stream_create(StreamOptions(handler=Recorder()))
+        # "plain" never calls stream_accept → response meta has no stream id
+        cntl = ch.call_method("test", "plain", b"x", request_stream=s)
+        assert cntl.ok()
+        assert _wait(lambda: s.state == stream_mod.CLOSED)
+        assert s.write(b"data") == ErrorCode.EINVAL
+
+    def test_failed_rpc_kills_stream(self, echo_server):
+        server, accepted = echo_server
+        ch = Channel()
+        assert ch.init(f"127.0.0.1:{server.port}")
+        s = stream_create(StreamOptions(handler=Recorder()))
+        cntl = ch.call_method("test", "nosuch", b"", request_stream=s)
+        assert cntl.failed()
+        assert _wait(lambda: s.state == stream_mod.CLOSED)
+
+
+class TestDataPath:
+    def test_ordered_delivery_client_to_server(self, echo_server):
+        server, accepted = echo_server
+        rec = Recorder()
+        _, s = _connect(server, accepted, handler=rec)
+        msgs = [f"msg-{i}".encode() for i in range(50)]
+        for m in msgs:
+            assert s.write(m) == 0
+        assert _wait(lambda: len(rec.messages) == 50)
+        assert rec.messages == msgs
+        s.close()
+
+    def test_bidirectional(self, echo_server):
+        server, accepted = echo_server
+        client_rec = Recorder()
+        _, s = _connect(
+            server,
+            accepted,
+            handler=Recorder(),
+            client_opts=StreamOptions(handler=client_rec),
+        )
+        srv_stream = accepted["stream"]
+        assert srv_stream.write(b"from-server") == 0
+        assert _wait(lambda: client_rec.messages == [b"from-server"])
+        s.close()
+
+    def test_large_messages(self, echo_server):
+        server, accepted = echo_server
+        rec = Recorder()
+        _, s = _connect(server, accepted, handler=rec)
+        big = bytes(range(256)) * 4096  # 1 MiB
+        assert s.write(big, timeout=10) == 0
+        assert _wait(lambda: rec.messages == [big])
+        s.close()
+
+
+class TestFlowControl:
+    def test_window_blocks_writer_and_feedback_resumes(self, echo_server):
+        """The core credit-window property (stream.cpp:263-300): a slow
+        consumer stalls the writer at max_buf_size; its feedback un-stalls."""
+        server, accepted = echo_server
+        rec = Recorder(delay=0.15)  # slow consumer
+        _, s = _connect(server, accepted, handler=rec)
+        s.options.max_buf_size = 4096
+        chunk = b"x" * 2048
+
+        # two chunks fill the window; the third must hit EAGAIN immediately
+        assert s.write(chunk) == 0
+        assert s.write(chunk) == 0
+        assert s.write(chunk, timeout=0) == ErrorCode.EAGAIN
+        assert s.unconsumed_bytes == 4096
+
+        # blocking write parks until the consumer's feedback lifts the window
+        t0 = time.monotonic()
+        assert s.write(chunk, timeout=10) == 0
+        waited = time.monotonic() - t0
+        assert waited > 0.05  # it actually blocked on the butex
+        assert _wait(lambda: len(rec.messages) == 3)
+        s.close()
+
+    def test_unlimited_window_never_blocks(self, echo_server):
+        server, accepted = echo_server
+        rec = Recorder()
+        _, s = _connect(
+            server, accepted, handler=rec,
+        )
+        s.options.max_buf_size = 0
+        for _ in range(20):
+            assert s.write(b"y" * 1024, timeout=0) == 0
+        assert _wait(lambda: len(rec.messages) == 20)
+        s.close()
+
+
+class TestClose:
+    def test_close_notifies_peer_after_data(self, echo_server):
+        server, accepted = echo_server
+        rec = Recorder()
+        _, s = _connect(server, accepted, handler=rec)
+        s.write(b"last-words")
+        s.close()
+        assert rec.closed.wait(timeout=5)
+        assert rec.messages == [b"last-words"]  # data seen before close
+        assert s.state == stream_mod.CLOSED
+        assert s.write(b"after") == ErrorCode.EINVAL
+
+    def test_registry_cleanup(self, echo_server):
+        server, accepted = echo_server
+        rec = Recorder()
+        _, s = _connect(server, accepted, handler=rec)
+        sid, srv_sid = s.id, accepted["stream"].id
+        assert stream_mod.get_stream(sid) is not None
+        s.close()
+        assert rec.closed.wait(timeout=5)
+        assert stream_mod.get_stream(sid) is None
+        assert _wait(lambda: stream_mod.get_stream(srv_sid) is None)
+
+    def test_socket_failure_fails_stream(self, echo_server):
+        server, accepted = echo_server
+        rec = Recorder()
+        ch, s = _connect(server, accepted, handler=Recorder())
+        # fail the client's underlying socket out from under the stream
+        client_rec = Recorder()
+        s2 = stream_create(StreamOptions(handler=client_rec))
+        cntl = ch.call_method("test", "open_stream", b"", request_stream=s2)
+        assert cntl.ok()
+        assert s2.wait_connected(timeout=5)
+        s2._sock.set_failed(ErrorCode.EFAILEDSOCKET, "injected")
+        assert client_rec.failed.wait(timeout=5)
+        assert s2.write(b"z") == ErrorCode.EINVAL
