@@ -350,11 +350,28 @@ class LoadBalancerWithNaming:
 
     MAX_PICK_ATTEMPTS = 3
 
-    def __init__(self, url: str, lb_name: str = "rr", socket_map=None):
-        from incubator_brpc_tpu.naming import NamingServiceThread
-
+    def __init__(
+        self,
+        url: str = "",
+        lb_name: str = "rr",
+        socket_map=None,
+        ns_thread=None,
+        server_filter=None,
+    ):
+        """Either ``url`` (owns a fresh NamingServiceThread) or ``ns_thread``
+        (shared, not stopped by us — how PartitionChannel feeds N filtered
+        views off one watcher). ``server_filter(ep) -> bool`` limits which
+        naming entries reach the LB (the reference's ns_filter seam)."""
         self.lb = create_load_balancer(lb_name)
-        self.ns_thread = NamingServiceThread(url)
+        if ns_thread is not None:
+            self.ns_thread = ns_thread
+            self._owns_ns = False
+        else:
+            from incubator_brpc_tpu.naming import NamingServiceThread
+
+            self.ns_thread = NamingServiceThread(url)
+            self._owns_ns = True
+        self._server_filter = server_filter
         if socket_map is None:
             from incubator_brpc_tpu.transport.socket_map import global_socket_map
 
@@ -364,13 +381,23 @@ class LoadBalancerWithNaming:
         self._map_lock = threading.Lock()
 
     def start(self) -> bool:
-        if not self.ns_thread.start():
+        if self._owns_ns and not self.ns_thread.start():
             return False
-        self.ns_thread.add_observer(self.lb)
+        self.ns_thread.add_observer(self)
         return True
 
     def stop(self) -> None:
-        self.ns_thread.stop()
+        if self._owns_ns:
+            self.ns_thread.stop()
+
+    # NamingServiceThread observer surface (filtered pass-through to the LB)
+    def add_server(self, ep: EndPoint) -> None:
+        if self._server_filter is None or self._server_filter(ep):
+            self.lb.add_server(ep)
+
+    def remove_server(self, ep: EndPoint) -> None:
+        if self._server_filter is None or self._server_filter(ep):
+            self.lb.remove_server(ep)
 
     def select_server(
         self,

@@ -40,13 +40,25 @@ class NamingService:
         raise NotImplementedError
 
 
+def _parse_node(s: str) -> EndPoint:
+    """'host:port[ tag]' → EndPoint; the optional whitespace-separated tag
+    (reference ServerNode.tag — PartitionChannel reads "N/M" out of it)."""
+    import dataclasses
+
+    parts = s.split(None, 1)
+    ep = str2endpoint(parts[0])
+    if len(parts) > 1 and parts[1].strip():
+        ep = dataclasses.replace(ep, tag=parts[1].strip())
+    return ep
+
+
 class ListNamingService(NamingService):
-    """list://h1:p1,h2:p2 — inline, never changes."""
+    """list://h1:p1[ tag],h2:p2[ tag] — inline, never changes."""
 
     def __init__(self, service_name: str):
         super().__init__(service_name)
         self._servers = [
-            str2endpoint(part.strip())
+            _parse_node(part.strip())
             for part in service_name.split(",")
             if part.strip()
         ]
@@ -81,7 +93,7 @@ class FileNamingService(NamingService):
                 for line in f:
                     line = line.split("#", 1)[0].strip()
                     if line:
-                        servers.append(str2endpoint(line))
+                        servers.append(_parse_node(line))
         except (OSError, ValueError):
             return None  # mtime NOT recorded: retried next tick
         self._last_mtime = mtime
@@ -168,17 +180,26 @@ class NamingServiceThread:
         if fresh is None:
             return
         with self._lock:
-            old = set(self._current)
-            new = set(fresh)
-            added = [ep for ep in fresh if ep not in old]
-            removed = [ep for ep in self._current if ep not in new]
-            self._current = list(dict.fromkeys(fresh))
+            # diff on (endpoint, tag): EndPoint identity ignores the tag, but
+            # a server whose tag changed (e.g. moved partitions) must be seen
+            # as remove+add by observers (reference ServerNode compares tags).
+            # Dedup keeps the tag too: one address may publish several tags.
+            old = {(ep, ep.tag) for ep in self._current}
+            new = {(ep, ep.tag) for ep in fresh}
+            added = [ep for ep in fresh if (ep, ep.tag) not in old]
+            removed = [ep for ep in self._current if (ep, ep.tag) not in new]
+            self._current = list(
+                {(ep, ep.tag): ep for ep in fresh}.values()
+            )
             observers = list(self._observers)
         for obs in observers:
-            for ep in added:
-                obs.add_server(ep)
+            # removes BEFORE adds: on a tag-only change the two lists hold
+            # eq-equal EndPoints, and a tag-blind LB doing add-first would
+            # no-op the add then delete the server on the remove
             for ep in removed:
                 obs.remove_server(ep)
+            for ep in added:
+                obs.add_server(ep)
         if added or removed:
             logger.info(
                 "naming %s: +%d -%d → %d servers",

@@ -13,6 +13,15 @@ from incubator_brpc_tpu.rpc.server import (
     Server,
     ServerOptions,
 )
+from incubator_brpc_tpu.rpc.combo import (
+    CallMapper,
+    ParallelChannel,
+    PartitionChannel,
+    PartitionParser,
+    ResponseMerger,
+    SelectiveChannel,
+    SubCall,
+)
 from incubator_brpc_tpu.rpc.stream import (
     Stream,
     StreamHandler,
@@ -22,9 +31,16 @@ from incubator_brpc_tpu.rpc.stream import (
 )
 
 __all__ = [
+    "CallMapper",
     "Channel",
     "ChannelOptions",
     "Controller",
+    "ParallelChannel",
+    "PartitionChannel",
+    "PartitionParser",
+    "ResponseMerger",
+    "SelectiveChannel",
+    "SubCall",
     "MethodStatus",
     "Server",
     "ServerOptions",

@@ -115,6 +115,19 @@ class Channel:
         self._init_done = True
         return True
 
+    def init_with_lb(self, lb, options: Optional[ChannelOptions] = None) -> bool:
+        """Init with a pre-built LoadBalancerWithNaming-compatible object
+        (select_server/feedback/start/stop) — the seam PartitionChannel uses
+        to feed each sub-channel a filtered server view
+        (partition_channel.cpp builds sub-channels the same way)."""
+        if options is not None:
+            self._options = options
+        if not lb.start():
+            return False
+        self._lb = lb
+        self._init_done = True
+        return True
+
     # -- public call surface -------------------------------------------------
 
     def call_method(

@@ -1,0 +1,413 @@
+"""Combo channels — ParallelChannel / SelectiveChannel / PartitionChannel
+(reference src/brpc/parallel_channel.{h,cpp}, selective_channel.{h,cpp},
+partition_channel.{h,cpp}).
+
+These compose ordinary Channels on the host RPC plane. When every party
+sits on one device mesh, the same fan-out/merge and partition-exchange
+semantics lower to XLA collectives instead (parallel/collective.py — the
+SURVEY §2.5 ICI fast path); the classes here are the general
+point-to-point form.
+
+Kept semantics:
+- ParallelChannel: CallMapper maps (channel_index, request) → SubCall
+  (broadcast / rewritten / skipped, parallel_channel.h:36-101); sub-calls
+  run concurrently; the parent fails once ``nfailed >= fail_limit``
+  (default: all non-skipped must fail, parallel_channel.cpp:625-627);
+  successful responses merge in channel-index order via ResponseMerger.
+- SelectiveChannel: sub-channels are schedulable units behind an internal
+  LB; retries go to *different* sub-channels (selective_channel.cpp, the
+  `_sender` hook controller.cpp:956-964).
+- PartitionChannel: one naming service splits into per-partition
+  sub-channels via a PartitionParser reading "N/M" server tags
+  (partition_channel.h:44-50); the call fans out like ParallelChannel.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, List, Optional, Tuple
+
+from incubator_brpc_tpu.rpc.channel import Channel, ChannelOptions
+from incubator_brpc_tpu.rpc.controller import RETRIABLE, Controller
+from incubator_brpc_tpu.utils.status import ErrorCode, berror
+
+logger = logging.getLogger(__name__)
+
+
+# -- ParallelChannel ---------------------------------------------------------
+
+
+class SubCall:
+    """What a CallMapper returns per sub-channel (parallel_channel.h:36)."""
+
+    __slots__ = ("service", "method", "request", "skipped")
+
+    def __init__(
+        self,
+        service: Optional[str] = None,
+        method: Optional[str] = None,
+        request: Optional[bytes] = None,
+        skipped: bool = False,
+    ):
+        self.service = service
+        self.method = method
+        self.request = request
+        self.skipped = skipped
+
+    @classmethod
+    def skip(cls) -> "SubCall":
+        return cls(skipped=True)
+
+
+class CallMapper:
+    """Default: broadcast the original request to every sub-channel."""
+
+    def map(
+        self, channel_index: int, nchannels: int, service: str, method: str,
+        request: bytes,
+    ) -> SubCall:
+        return SubCall()
+
+
+class ResponseMerger:
+    """Incremental merge in channel-index order (parallel_channel.h:103).
+    Default: concatenate payload bytes."""
+
+    def merge(self, merged: bytes, sub_response: bytes) -> bytes:
+        return merged + sub_response
+
+
+class ParallelChannel:
+    """Scatter/gather across sub-channels (parallel_channel.cpp)."""
+
+    def __init__(self, fail_limit: int = -1):
+        self.fail_limit = fail_limit
+        self._subs: List[Tuple[Channel, CallMapper, ResponseMerger]] = []
+
+    def add_channel(
+        self,
+        channel: Channel,
+        call_mapper: Optional[CallMapper] = None,
+        response_merger: Optional[ResponseMerger] = None,
+    ) -> None:
+        self._subs.append(
+            (channel, call_mapper or CallMapper(), response_merger or ResponseMerger())
+        )
+
+    @property
+    def channel_count(self) -> int:
+        return len(self._subs)
+
+    def call_method(
+        self,
+        service: str,
+        method: str,
+        request: bytes,
+        cntl: Optional[Controller] = None,
+        done: Optional[Callable[[Controller], None]] = None,
+    ) -> Controller:
+        if cntl is None:
+            cntl = Controller()
+        nchan = len(self._subs)
+        if nchan == 0:
+            cntl.set_failed(ErrorCode.EINVAL, "ParallelChannel has no sub channels")
+            if done:
+                done(cntl)
+            return cntl
+
+        plan: List[Optional[Tuple[Channel, ResponseMerger, SubCall]]] = []
+        for i, (ch, mapper, merger) in enumerate(self._subs):
+            sub = mapper.map(i, nchan, service, method, request)
+            plan.append(None if sub.skipped else (ch, merger, sub))
+        ndone = sum(1 for p in plan if p is not None)
+        if ndone == 0:
+            cntl.set_failed(ErrorCode.EREQUEST, "all sub calls skipped")
+            if done:
+                done(cntl)
+            return cntl
+        # 1 <= fail_limit <= ndone (parallel_channel.cpp:625-637)
+        fail_limit = self.fail_limit
+        if fail_limit < 0:
+            fail_limit = ndone
+        fail_limit = max(1, min(fail_limit, ndone))
+
+        state = {
+            "remaining": ndone,
+            "nfailed": 0,
+            "first_error": (0, ""),
+            "finished": False,
+        }
+        lock = threading.Lock()
+        all_done = threading.Event()
+        sub_cntls: List[Optional[Controller]] = [None] * nchan
+
+        def finish() -> None:
+            if state["nfailed"] >= fail_limit:
+                code, text = state["first_error"]
+                cntl.set_failed(
+                    code or ErrorCode.EINTERNAL,
+                    f"{state['nfailed']}/{ndone} sub calls failed "
+                    f"(fail_limit={fail_limit}): {text}",
+                )
+            else:
+                merged = b""
+                for i, p in enumerate(plan):
+                    if p is None:
+                        continue
+                    sc = sub_cntls[i]
+                    if sc is not None and sc.ok():
+                        merged = p[1].merge(merged, sc.response_payload)
+                cntl.response_payload = merged
+            all_done.set()
+            if done is not None:
+                done(cntl)
+
+        def sub_done(i: int, sc: Controller) -> None:
+            with lock:
+                sub_cntls[i] = sc
+                if sc.failed():
+                    state["nfailed"] += 1
+                    if state["first_error"][0] == 0:
+                        state["first_error"] = (sc.error_code, sc.error_text)
+                state["remaining"] -= 1
+                # early finish once the verdict is decided either way
+                # (parallel_channel.cpp:221-224 cancels the rest; our
+                # remaining sub-calls just complete into a dead closure)
+                decided = (
+                    state["remaining"] == 0 or state["nfailed"] >= fail_limit
+                )
+                if not decided or state["finished"]:
+                    return
+                state["finished"] = True
+            finish()
+
+        for i, p in enumerate(plan):
+            if p is None:
+                continue
+            ch, _, sub = p
+            sc = Controller(
+                timeout_ms=cntl.timeout_ms,
+                max_retry=cntl.max_retry,
+                backup_request_ms=cntl.backup_request_ms,
+            )
+            sc.compress_type = cntl.compress_type
+            sc.log_id = cntl.log_id
+            ch.call_method(
+                sub.service or service,
+                sub.method or method,
+                request if sub.request is None else sub.request,
+                cntl=sc,
+                done=(lambda c, _i=i: sub_done(_i, c)),
+            )
+        if done is None:
+            all_done.wait()
+        return cntl
+
+    call = call_method
+
+
+# -- SelectiveChannel --------------------------------------------------------
+
+
+class SelectiveChannel:
+    """Replica-set chooser: each sub-channel is a schedulable unit; retries
+    move to a different sub-channel (selective_channel.cpp). The internal
+    scheduler here is round-robin with failure feedback — the reference
+    embeds a full LB over fake SocketIds; per-sub-channel health (a failure
+    skips the unit for one rotation) covers the same failover contract."""
+
+    def __init__(self, max_retry: int = 3):
+        self.max_retry = max_retry
+        self._subs: List[Channel] = []
+        self._next = 0
+        self._lock = threading.Lock()
+
+    def add_channel(self, channel: Channel) -> int:
+        with self._lock:
+            self._subs.append(channel)
+            return len(self._subs) - 1
+
+    @property
+    def channel_count(self) -> int:
+        return len(self._subs)
+
+    def _pick(self, excluded: set) -> Optional[int]:
+        with self._lock:
+            n = len(self._subs)
+            for _ in range(n):
+                i = self._next % n
+                self._next += 1
+                if i not in excluded:
+                    return i
+        return None
+
+    def call_method(
+        self,
+        service: str,
+        method: str,
+        request: bytes,
+        cntl: Optional[Controller] = None,
+        done: Optional[Callable[[Controller], None]] = None,
+    ) -> Controller:
+        if cntl is None:
+            cntl = Controller(max_retry=self.max_retry)
+        if not self._subs:
+            cntl.set_failed(ErrorCode.EINVAL, "SelectiveChannel has no sub channels")
+            if done:
+                done(cntl)
+            return cntl
+        if done is not None:
+            # honor the async contract: the retry loop joins sub-calls, so it
+            # runs on a worker fiber and the caller returns immediately
+            from incubator_brpc_tpu.runtime.worker_pool import global_worker_pool
+
+            global_worker_pool().spawn(
+                self._call_blocking, service, method, request, cntl, done
+            )
+            return cntl
+        return self._call_blocking(service, method, request, cntl, None)
+
+    def _call_blocking(
+        self,
+        service: str,
+        method: str,
+        request: bytes,
+        cntl: Controller,
+        done: Optional[Callable[[Controller], None]],
+    ) -> Controller:
+        import time as _time
+
+        excluded: set = set()
+        # the per-call retry knob wins (Controller.max_retry, as Channel
+        # honors it); the whole call shares ONE deadline — each attempt gets
+        # the remaining budget, not a fresh timeout (controller.cpp deadline)
+        attempts = 1 + max(0, cntl.max_retry)
+        deadline = None
+        if cntl.timeout_ms is not None and cntl.timeout_ms > 0:
+            deadline = _time.monotonic() + cntl.timeout_ms / 1000.0
+        last: Optional[Controller] = None
+        for _ in range(attempts):
+            remaining_ms = cntl.timeout_ms
+            if deadline is not None:
+                remaining_ms = (deadline - _time.monotonic()) * 1000.0
+                if remaining_ms <= 0:
+                    if last is None:
+                        cntl.set_failed(
+                            ErrorCode.ERPCTIMEDOUT, berror(ErrorCode.ERPCTIMEDOUT)
+                        )
+                        if done:
+                            done(cntl)
+                        return cntl
+                    break
+            i = self._pick(excluded)
+            if i is None:
+                break
+            sub = self._subs[i]
+            sc = Controller(
+                timeout_ms=remaining_ms,
+                max_retry=0,  # retry here moves channels, not servers
+                backup_request_ms=cntl.backup_request_ms,
+            )
+            sc.compress_type = cntl.compress_type
+            sc.log_id = cntl.log_id
+            sub.call_method(service, method, request, cntl=sc)
+            last = sc
+            if sc.ok():
+                cntl.response_payload = sc.response_payload
+                cntl.response_attachment = sc.response_attachment
+                cntl.remote_side = sc.remote_side
+                if done:
+                    done(cntl)
+                return cntl
+            excluded.add(i)
+            if sc.error_code not in RETRIABLE and sc.error_code != ErrorCode.ERPCTIMEDOUT:
+                break  # application error: switching replicas won't help
+        if last is not None:
+            cntl.set_failed(last.error_code, f"all replicas failed: {last.error_text}")
+        else:
+            cntl.set_failed(ErrorCode.EINTERNAL, "no selectable sub channel")
+        if done:
+            done(cntl)
+        return cntl
+
+    call = call_method
+
+
+# -- PartitionChannel --------------------------------------------------------
+
+
+class PartitionParser:
+    """Parse a server tag into (partition_index, partition_count) or None if
+    the tag doesn't belong to this scheme (partition_channel.h:44-50 parses
+    "N/M")."""
+
+    def parse(self, tag: str) -> Optional[Tuple[int, int]]:
+        try:
+            n, m = tag.split("/", 1)
+            idx, cnt = int(n), int(m)
+        except (ValueError, AttributeError):
+            return None
+        if 0 <= idx < cnt:
+            return idx, cnt
+        return None
+
+
+class PartitionChannel(ParallelChannel):
+    """One naming service, M partitions, one sub-channel per partition
+    (partition_channel.cpp). Servers publish tags ("0/3", "1/3", ...) next
+    to their address in the naming source; each sub-channel only sees its
+    partition's servers."""
+
+    def __init__(self, fail_limit: int = -1):
+        super().__init__(fail_limit=fail_limit)
+        self.partition_count = 0
+        self._ns_thread = None
+
+    def init(
+        self,
+        naming_url: str,
+        partition_count: int,
+        lb_name: str = "rr",
+        parser: Optional[PartitionParser] = None,
+        options: Optional[ChannelOptions] = None,
+        call_mapper: Optional[CallMapper] = None,
+        response_merger: Optional[ResponseMerger] = None,
+    ) -> bool:
+        from incubator_brpc_tpu.naming import NamingServiceThread
+
+        parser = parser or PartitionParser()
+        self.partition_count = partition_count
+        self._ns_thread = NamingServiceThread(naming_url)
+        if not self._ns_thread.start():
+            return False
+        from incubator_brpc_tpu.lb import LoadBalancerWithNaming
+        from incubator_brpc_tpu.rpc.channel import _client_socket_map
+
+        for part in range(partition_count):
+            # each partition = a filtered view over the ONE shared naming
+            # watcher (partition_channel.cpp builds sub-channels the same
+            # way); the client socket map carries the response messenger
+            def _filter(ep, _part=part):
+                return parser.parse(getattr(ep, "tag", "") or "") == (
+                    _part,
+                    partition_count,
+                )
+
+            lb = LoadBalancerWithNaming(
+                lb_name=lb_name,
+                socket_map=_client_socket_map,
+                ns_thread=self._ns_thread,
+                server_filter=_filter,
+            )
+            ch = Channel()
+            if not ch.init_with_lb(lb, options=options):
+                return False
+            self.add_channel(ch, call_mapper, response_merger)
+        return True
+
+    def stop(self) -> None:
+        if self._ns_thread is not None:
+            self._ns_thread.stop()
+
+

@@ -30,6 +30,10 @@ class EndPoint:
     port: int = 0
     device: Optional[Tuple[int, int]] = None
     mesh_coords: Mapping[str, int] = field(default_factory=dict)
+    # naming-source tag (reference ServerNode.tag, naming_service.h:38):
+    # descriptive like mesh_coords — excluded from hash/eq. PartitionChannel
+    # parses "N/M" partition tags out of it.
+    tag: str = ""
 
     def is_device(self) -> bool:
         return self.device is not None

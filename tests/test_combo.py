@@ -1,0 +1,332 @@
+"""Combo channel tests (reference test/brpc_parallel_channel_unittest.cpp,
+brpc_selective_channel_unittest.cpp, brpc_partition_channel_unittest.cpp —
+the in-process many-local-servers shape of SURVEY §4)."""
+
+import threading
+import time
+
+import pytest
+
+from incubator_brpc_tpu.rpc import (
+    CallMapper,
+    Channel,
+    ParallelChannel,
+    PartitionChannel,
+    PartitionParser,
+    ResponseMerger,
+    SelectiveChannel,
+    Server,
+    SubCall,
+)
+from incubator_brpc_tpu.utils.status import ErrorCode
+
+
+def make_server(name: bytes):
+    """Echo server that prefixes responses with its name."""
+    server = Server()
+
+    def echo(cntl, request):
+        return name + b":" + request
+
+    def fail(cntl, request):
+        cntl.set_failed(ErrorCode.EINTERNAL, "injected failure")
+        return b""
+
+    def slow(cntl, request):
+        time.sleep(0.3)
+        return name + b":slow"
+
+    server.add_service("svc", {"echo": echo, "fail": fail, "slow": slow})
+    assert server.start(0)
+    return server
+
+
+@pytest.fixture
+def three_servers():
+    servers = [make_server(b"s%d" % i) for i in range(3)]
+    yield servers
+    for s in servers:
+        s.stop()
+    for s in servers:
+        s.join(timeout=5)
+
+
+def sub_channel(server):
+    ch = Channel()
+    assert ch.init(f"127.0.0.1:{server.port}")
+    return ch
+
+
+class TestParallelChannel:
+    def test_broadcast_and_merge_in_index_order(self, three_servers):
+        pc = ParallelChannel()
+        for s in three_servers:
+            pc.add_channel(sub_channel(s))
+        cntl = pc.call_method("svc", "echo", b"hi")
+        assert cntl.ok(), cntl.error_text
+        assert cntl.response_payload == b"s0:his1:his2:hi"
+
+    def test_call_mapper_rewrites_and_skips(self, three_servers):
+        class Mapper(CallMapper):
+            def map(self, i, n, service, method, request):
+                if i == 1:
+                    return SubCall.skip()
+                return SubCall(request=b"%d" % i)
+
+        pc = ParallelChannel()
+        for s in three_servers:
+            pc.add_channel(sub_channel(s), call_mapper=Mapper())
+        cntl = pc.call_method("svc", "echo", b"ignored")
+        assert cntl.ok()
+        assert cntl.response_payload == b"s0:0s2:2"
+
+    def test_default_fail_limit_tolerates_partial_failure(self, three_servers):
+        """Unset fail_limit = ndone: parent succeeds unless ALL fail
+        (parallel_channel.cpp:625-627)."""
+        class Mapper(CallMapper):
+            def map(self, i, n, service, method, request):
+                return SubCall(method="fail" if i == 0 else "echo")
+
+        pc = ParallelChannel()
+        for s in three_servers:
+            pc.add_channel(sub_channel(s), call_mapper=Mapper())
+        cntl = pc.call_method("svc", "echo", b"x")
+        assert cntl.ok()
+        assert cntl.response_payload == b"s1:xs2:x"  # failed sub not merged
+
+    def test_fail_limit_one_fails_fast(self, three_servers):
+        class Mapper(CallMapper):
+            def map(self, i, n, service, method, request):
+                return SubCall(method="fail" if i == 2 else "echo")
+
+        pc = ParallelChannel(fail_limit=1)
+        for s in three_servers:
+            pc.add_channel(sub_channel(s), call_mapper=Mapper())
+        cntl = pc.call_method("svc", "echo", b"x")
+        assert cntl.failed()
+        assert cntl.error_code == ErrorCode.EINTERNAL
+
+    def test_all_failed_fails_parent(self, three_servers):
+        pc = ParallelChannel()
+        for s in three_servers:
+            pc.add_channel(sub_channel(s))
+        cntl = pc.call_method("svc", "fail", b"x")
+        assert cntl.failed()
+
+    def test_custom_merger(self, three_servers):
+        class Longest(ResponseMerger):
+            def merge(self, merged, sub):
+                return sub if len(sub) > len(merged) else merged
+
+        pc = ParallelChannel()
+        names = [b"a", b"bb", b"c"]
+        for s, n in zip(three_servers, names):
+            pc.add_channel(sub_channel(s), response_merger=Longest())
+        cntl = pc.call_method("svc", "echo", b"zz")
+        assert cntl.ok()
+        # all three responses are 5 bytes ("sN:zz"); merge keeps the first
+        # (index order) since later ones aren't strictly longer
+        assert cntl.response_payload == b"s0:zz"
+
+    def test_async_done(self, three_servers):
+        pc = ParallelChannel()
+        for s in three_servers:
+            pc.add_channel(sub_channel(s))
+        ev = threading.Event()
+        out = {}
+
+        def done(c):
+            out["payload"] = c.response_payload
+            ev.set()
+
+        pc.call_method("svc", "echo", b"a", done=done)
+        assert ev.wait(timeout=5)
+        assert out["payload"] == b"s0:as1:as2:a"
+
+
+class TestSelectiveChannel:
+    def test_round_robins_across_channels(self, three_servers):
+        sc = SelectiveChannel()
+        for s in three_servers:
+            sc.add_channel(sub_channel(s))
+        seen = set()
+        for _ in range(6):
+            cntl = sc.call_method("svc", "echo", b"q")
+            assert cntl.ok()
+            seen.add(cntl.response_payload)
+        assert seen == {b"s0:q", b"s1:q", b"s2:q"}
+
+    def test_failover_to_other_replica(self, three_servers):
+        """A dead replica is skipped: retries go to different sub-channels
+        (selective_channel.cpp retry contract)."""
+        dead = Channel()
+        # unused port: connect will fail → retriable EFAILEDSOCKET
+        assert dead.init("127.0.0.1:1")
+        sc = SelectiveChannel(max_retry=2)
+        sc.add_channel(dead)
+        sc.add_channel(sub_channel(three_servers[0]))
+        for _ in range(4):
+            cntl = sc.call_method("svc", "echo", b"f")
+            assert cntl.ok(), cntl.error_text
+            assert cntl.response_payload == b"s0:f"
+
+    def test_application_error_does_not_failover(self, three_servers):
+        sc = SelectiveChannel(max_retry=2)
+        for s in three_servers:
+            sc.add_channel(sub_channel(s))
+        cntl = sc.call_method("svc", "fail", b"x")
+        assert cntl.failed()
+        assert cntl.error_code == ErrorCode.EINTERNAL
+
+    def test_async_done_does_not_block(self, three_servers):
+        sc = SelectiveChannel()
+        for s in three_servers:
+            sc.add_channel(sub_channel(s))
+        ev = threading.Event()
+        out = {}
+
+        def done(c):
+            out["p"] = c.response_payload
+            ev.set()
+
+        t0 = time.monotonic()
+        sc.call_method("svc", "slow", b"x", done=done)
+        assert time.monotonic() - t0 < 0.2  # returned before the 0.3s handler
+        assert ev.wait(timeout=5)
+        assert out["p"].endswith(b":slow")
+
+    def test_per_call_deadline_covers_all_retries(self):
+        """The caller's timeout bounds the WHOLE call, not each attempt
+        (controller deadline semantics)."""
+        from incubator_brpc_tpu.rpc import Controller
+
+        sc = SelectiveChannel(max_retry=5)
+        for port in (1, 2, 3):
+            ch = Channel()
+            assert ch.init(f"127.0.0.1:{port}")
+            sc.add_channel(ch)
+        cntl = Controller(timeout_ms=400, max_retry=5)
+        t0 = time.monotonic()
+        sc.call_method("svc", "echo", b"x", cntl=cntl)
+        assert cntl.failed()
+        assert time.monotonic() - t0 < 2.0  # not 6 x timeout
+
+    def test_all_dead_fails(self):
+        sc = SelectiveChannel(max_retry=3)
+        for port in (1, 2):
+            ch = Channel()
+            assert ch.init(f"127.0.0.1:{port}")
+            sc.add_channel(ch)
+        cntl = sc.call_method("svc", "echo", b"x")
+        assert cntl.failed()
+
+
+class TestNamingTagDiff:
+    def test_tag_change_is_remove_then_add(self, tmp_path):
+        """A tag-only change must reach observers as remove-then-add so
+        tag-blind LBs keep the server (reference ServerNode tag compare)."""
+        from incubator_brpc_tpu.naming import NamingServiceThread
+
+        f = tmp_path / "servers"
+        f.write_text("127.0.0.1:7001 0/2\n")
+        nst = NamingServiceThread(f"file://{f}")
+        nst.stop()  # no timer; we drive _refresh by hand
+        events = []
+
+        class Obs:
+            def add_server(self, ep):
+                events.append(("add", ep.port, ep.tag))
+
+            def remove_server(self, ep):
+                events.append(("rm", ep.port, ep.tag))
+
+        nst._refresh()
+        nst.add_observer(Obs())
+        f.write_text("127.0.0.1:7001 1/2\n")
+        nst._refresh()
+        assert events == [
+            ("add", 7001, "0/2"),  # add_observer replay
+            ("rm", 7001, "0/2"),
+            ("add", 7001, "1/2"),
+        ]
+
+    def test_one_address_two_tags_both_tracked(self, tmp_path):
+        from incubator_brpc_tpu.naming import NamingServiceThread
+
+        f = tmp_path / "servers"
+        f.write_text("127.0.0.1:7002 0/2\n127.0.0.1:7002 1/2\n")
+        nst = NamingServiceThread(f"file://{f}")
+        nst.stop()
+        nst._refresh()
+        assert {(ep.port, ep.tag) for ep in nst.servers()} == {
+            (7002, "0/2"),
+            (7002, "1/2"),
+        }
+        removed = []
+
+        class Obs:
+            def add_server(self, ep):
+                pass
+
+            def remove_server(self, ep):
+                removed.append(ep.tag)
+
+        nst.add_observer(Obs())
+        f.write_text("\n")
+        nst._refresh()
+        assert sorted(removed) == ["0/2", "1/2"]
+
+
+class TestPartitionChannel:
+    def test_parser(self):
+        p = PartitionParser()
+        assert p.parse("0/3") == (0, 3)
+        assert p.parse("2/3") == (2, 3)
+        assert p.parse("3/3") is None
+        assert p.parse("junk") is None
+        assert p.parse("") is None
+
+    def test_fanout_across_partitions(self, three_servers):
+        """Each partition's sub-channel only sees its tagged servers; the
+        call fans out across partitions and merges."""
+        url = "list://" + ",".join(
+            f"127.0.0.1:{s.port} {i}/3" for i, s in enumerate(three_servers)
+        )
+        pc = PartitionChannel()
+        assert pc.init(url, partition_count=3)
+        cntl = pc.call_method("svc", "echo", b"p")
+        assert cntl.ok(), cntl.error_text
+        assert cntl.response_payload == b"s0:ps1:ps2:p"
+        pc.stop()
+
+    def test_untagged_servers_excluded(self, three_servers):
+        # only partitions 0 and 1 are tagged; server 2 has a foreign tag
+        url = "list://" + ",".join(
+            [
+                f"127.0.0.1:{three_servers[0].port} 0/2",
+                f"127.0.0.1:{three_servers[1].port} 1/2",
+                f"127.0.0.1:{three_servers[2].port} other",
+            ]
+        )
+        pc = PartitionChannel()
+        assert pc.init(url, partition_count=2)
+        cntl = pc.call_method("svc", "echo", b"u")
+        assert cntl.ok()
+        assert cntl.response_payload == b"s0:us1:u"
+        pc.stop()
+
+    def test_empty_partition_fails_sub_call(self, three_servers):
+        """A partition with no servers fails its sub-call; default
+        fail_limit still lets the others succeed."""
+        url = "list://" + ",".join(
+            [
+                f"127.0.0.1:{three_servers[0].port} 0/2",
+                # partition 1 is empty
+            ]
+        )
+        pc = PartitionChannel()
+        assert pc.init(url, partition_count=2)
+        cntl = pc.call_method("svc", "echo", b"e")
+        assert cntl.ok(), cntl.error_text
+        assert cntl.response_payload == b"s0:e"
+        pc.stop()
