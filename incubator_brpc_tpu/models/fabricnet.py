@@ -82,7 +82,11 @@ def init_params(cfg: FabricNetConfig, mesh: jax.sharding.Mesh, seed: int = 0):
     specs = param_specs()
 
     def mk(key, shape, spec, scale):
-        arr = jax.random.normal(key, shape, cfg.dtype) * scale
+        # scale is a numpy float64 scalar — multiply in the target dtype or
+        # promotion silently upcasts bfloat16 params to float32
+        arr = jax.random.normal(key, shape, cfg.dtype) * jnp.asarray(
+            scale, cfg.dtype
+        )
         return jax.device_put(arr, NamedSharding(mesh, spec))
 
     return {
@@ -99,10 +103,17 @@ def _rms_norm(x: jnp.ndarray) -> jnp.ndarray:
     return x * lax.rsqrt(jnp.mean(jnp.square(x), axis=-1, keepdims=True) + 1e-6)
 
 
+def _gelu(x: jnp.ndarray) -> jnp.ndarray:
+    """dtype-preserving gelu: jax.nn.gelu's float32 internals promote
+    bfloat16 activations, which would poison every downstream matmul (and
+    break the pipeline scan whose carry must keep the model dtype)."""
+    return jax.nn.gelu(x).astype(x.dtype)
+
+
 def _mlp_tp(w_in_l, w_out_l, x):
     """Megatron MLP: hidden sharded over 'tp', reply merged with psum —
     the PartitionChannel request/merge path on ICI."""
-    h = jax.nn.gelu(jnp.einsum("bsd,df->bsf", x, w_in_l))
+    h = _gelu(jnp.einsum("bsd,df->bsf", x, w_in_l))
     y = jnp.einsum("bsf,fd->bsd", h, w_out_l)
     return lax.psum(y, "tp")
 
@@ -139,7 +150,7 @@ def _moe(moe_w1, moe_w2, gate_w, x):
     routed = lax.all_to_all(grouped, "ep", split_axis=0, concat_axis=0, tiled=True)
     # rank-local expert apply: token r -> local expert r % e_local (static)
     xr = routed.reshape(t // e_local, e_local, d).swapaxes(0, 1)  # (e_local, t/e_local, d)
-    h = jax.nn.gelu(jnp.einsum("etd,edf->etf", xr, moe_w1))
+    h = _gelu(jnp.einsum("etd,edf->etf", xr, moe_w1))
     yr = jnp.einsum("etf,efd->etd", h, moe_w2)
     routed_out = yr.swapaxes(0, 1).reshape(t, d)
     back = lax.all_to_all(routed_out, "ep", split_axis=0, concat_axis=0, tiled=True)
