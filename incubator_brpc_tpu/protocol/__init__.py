@@ -33,6 +33,10 @@ TBUS_STD = Protocol(
 if "tbus_std" not in protocol_registry:
     protocol_registry.register(TBUS_STD)
 
+# http registers itself on import (after tbus_std so the binary protocol
+# keeps first-try priority in the InputMessenger loop)
+from incubator_brpc_tpu.protocol import http as _http  # noqa: E402,F401
+
 __all__ = [
     "HEADER_BYTES",
     "Meta",

@@ -1,0 +1,271 @@
+"""HTTP/1.x protocol — the second wire protocol on the shared port
+(reference src/brpc/policy/http_rpc_protocol.{h,cpp} + details/http_parser;
+the server tries registered protocols per connection and remembers the
+match, exactly as InputMessenger does here).
+
+Server side: parses requests off the socket byte stream (resumable — an
+incomplete request returns (None, 0)), routes them through the builtin
+portal pages plus any handlers the owning Server registered with
+``add_http_handler``, and writes an HTTP/1.1 keep-alive response.
+
+Client side: ``http_call`` issues one request over a plain blocking socket
+(tests and tools; the reference's full async http client rides the same
+Socket machinery as everything else — ours can once needed).
+
+Not implemented (reference parity gaps, deliberate): chunked
+transfer-encoding, HTTP/2 (the reference fork has HPACK tables but no h2
+framing either — SURVEY §2.4).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket as _pysocket
+from typing import Dict, Optional, Tuple
+from urllib.parse import parse_qsl, urlsplit
+
+from incubator_brpc_tpu.protocol.registry import Protocol, protocol_registry
+from incubator_brpc_tpu.protocol.tbus_std import ParseError
+
+logger = logging.getLogger(__name__)
+
+_METHODS = (b"GET ", b"POST ", b"PUT ", b"DELETE ", b"HEAD ", b"OPTIONS ", b"PATCH ")
+_MAX_HEADER_BYTES = 64 * 1024
+
+
+class HttpFrame:
+    """One parsed request (HttpMessage analog)."""
+
+    is_response = False  # server-side frames only
+    is_stream = False
+    # HTTP/1.1 has no correlation ids: responses MUST go out in request
+    # order, so the messenger processes these inline on the reader fiber
+    # instead of fanning out to concurrent fibers
+    process_inline = True
+
+    def __init__(
+        self,
+        method: str,
+        path: str,
+        query: Dict[str, str],
+        headers: Dict[str, str],
+        body: bytes,
+    ):
+        self.method = method
+        self.path = path
+        self.query = query
+        self.headers = headers  # keys lower-cased (CaseIgnoredFlatMap analog)
+        self.body = body
+
+    def __repr__(self) -> str:
+        return f"<HttpFrame {self.method} {self.path} {len(self.body)}B>"
+
+
+def looks_like_http(buf: bytes) -> bool:
+    head = buf[:8]
+    return any(head.startswith(m[: len(head)]) for m in _METHODS)
+
+
+def _content_length(headers_blob: str) -> int:
+    """Extract+validate Content-Length from a raw header block. ParseError
+    on malformed or negative values (the InputMessenger contract: anything
+    other than ParseError would escape the cut loop and wedge the
+    connection)."""
+    for line in headers_blob.split("\r\n"):
+        k, _, v = line.partition(":")
+        if k.strip().lower() == "content-length":
+            v = v.strip()
+            if not v.isdigit():  # rejects negatives and garbage
+                raise ParseError(f"bad Content-Length {v!r}")
+            return int(v)
+    return 0
+
+
+def parse_header(header: bytes) -> Optional[int]:
+    """Total frame size once the header block is visible (the sizing hook —
+    lets the messenger cut without copying the whole pending buffer, and
+    puts HTTP bodies under the same max_body_size guard as tbus_std).
+    None = header block incomplete (the messenger re-peeks deeper)."""
+    if not looks_like_http(header):
+        raise ParseError("not http")
+    head_end = header.find(b"\r\n\r\n")
+    if head_end < 0:
+        if len(header) >= _MAX_HEADER_BYTES:
+            raise ParseError("http header block too large")
+        return None
+    blob = header[:head_end].decode("latin-1", errors="replace")
+    if "chunked" in blob.lower() and "transfer-encoding" in blob.lower():
+        raise ParseError("chunked request bodies not supported")
+    return head_end + 4 + _content_length(blob)
+
+
+def parse(buf: bytes) -> Tuple[Optional[HttpFrame], int]:
+    """Cut one request off ``buf``. (None, 0) = incomplete; ParseError =
+    not HTTP (try other protocols / fail the connection)."""
+    if not looks_like_http(buf):
+        raise ParseError("not http")
+    head_end = buf.find(b"\r\n\r\n")
+    if head_end < 0:
+        if len(buf) > _MAX_HEADER_BYTES:
+            raise ParseError("http header block too large")
+        return None, 0
+    head = buf[:head_end].decode("latin-1")
+    lines = head.split("\r\n")
+    try:
+        method, target, version = lines[0].split(" ", 2)
+    except ValueError:
+        raise ParseError(f"bad request line {lines[0]!r}") from None
+    if not version.startswith("HTTP/1."):
+        raise ParseError(f"unsupported version {version!r}")
+    headers: Dict[str, str] = {}
+    for line in lines[1:]:
+        if ":" in line:
+            k, v = line.split(":", 1)
+            headers[k.strip().lower()] = v.strip()
+    if "chunked" in headers.get("transfer-encoding", ""):
+        raise ParseError("chunked request bodies not supported")
+    raw_len = headers.get("content-length", "0") or "0"
+    if not raw_len.isdigit():
+        raise ParseError(f"bad Content-Length {raw_len!r}")
+    body_len = int(raw_len)
+    total = head_end + 4 + body_len
+    if len(buf) < total:
+        return None, 0
+    body = bytes(buf[head_end + 4 : total])
+    parts = urlsplit(target)
+    query = dict(parse_qsl(parts.query, keep_blank_values=True))
+    frame = HttpFrame(method.upper(), parts.path or "/", query, headers, body)
+    return frame, total
+
+
+def build_response(
+    status: int = 200,
+    body: bytes = b"",
+    content_type: str = "text/plain",
+    extra_headers: Optional[Dict[str, str]] = None,
+    keep_alive: bool = True,
+) -> bytes:
+    reason = {
+        200: "OK",
+        302: "Found",
+        400: "Bad Request",
+        403: "Forbidden",
+        404: "Not Found",
+        405: "Method Not Allowed",
+        500: "Internal Server Error",
+        503: "Service Unavailable",
+    }.get(status, "OK")
+    lines = [
+        f"HTTP/1.1 {status} {reason}",
+        f"Content-Length: {len(body)}",
+        f"Content-Type: {content_type}",
+        "Connection: " + ("keep-alive" if keep_alive else "close"),
+    ]
+    for k, v in (extra_headers or {}).items():
+        lines.append(f"{k}: {v}")
+    return ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1") + body
+
+
+def process_request(sock, frame: HttpFrame) -> None:
+    """Route a request through the owning server's portal (the reference
+    wires builtin services into every server, server.cpp:433)."""
+    from incubator_brpc_tpu.builtin import pages
+
+    server = sock.context.get("server")
+    try:
+        status, ctype, body = pages.handle(server, frame)
+    except Exception as e:
+        logger.exception("http handler failed for %s", frame.path)
+        status, ctype, body = 500, "text/plain", f"error: {e!r}".encode()
+    close = frame.headers.get("connection", "").lower() == "close"
+    if frame.method == "HEAD":
+        # RFC 9110: Content-Length reflects what GET would return, body
+        # omitted — sending it would desync the keep-alive byte stream
+        head_only = build_response(
+            status,
+            body,
+            content_type=ctype,
+            keep_alive=not close,
+        )
+        head_only = head_only[: len(head_only) - len(body)]
+        sock.write(head_only)
+    else:
+        sock.write(
+            build_response(status, body, content_type=ctype, keep_alive=not close)
+        )
+    if close:
+        # half-close from our side once the response drains; the client
+        # reads to EOF. A hard set_failed here could cut the queued write.
+        from incubator_brpc_tpu.runtime.timer_thread import global_timer_thread
+        from incubator_brpc_tpu.utils.status import ErrorCode
+
+        def _close_when_drained(attempt: int = 0) -> None:
+            with sock._wlock:
+                drained = not sock._wqueue
+            if drained or attempt > 100:
+                sock.set_failed(ErrorCode.ECLOSE, "http connection: close")
+            else:
+                global_timer_thread().schedule(
+                    lambda: _close_when_drained(attempt + 1), delay=0.01
+                )
+
+        _close_when_drained()
+
+
+HTTP = Protocol(
+    name="http",
+    parse=parse,
+    parse_header=parse_header,
+    process_request=process_request,
+)
+
+if "http" not in protocol_registry:
+    protocol_registry.register(HTTP)
+
+
+# -- minimal client (tools/tests; reference uses the full Channel stack) -----
+
+
+def http_call(
+    host: str,
+    port: int,
+    path: str,
+    method: str = "GET",
+    body: bytes = b"",
+    timeout: float = 5.0,
+) -> Tuple[int, Dict[str, str], bytes]:
+    """One blocking request → (status, headers, body)."""
+    req = (
+        f"{method} {path} HTTP/1.1\r\n"
+        f"Host: {host}:{port}\r\n"
+        f"Content-Length: {len(body)}\r\n"
+        f"Connection: close\r\n\r\n"
+    ).encode("latin-1") + body
+    with _pysocket.create_connection((host, port), timeout=timeout) as conn:
+        conn.sendall(req)
+        raw = b""
+        head_end = -1
+        while head_end < 0:
+            data = conn.recv(65536)
+            if not data:
+                break
+            raw += data
+            head_end = raw.find(b"\r\n\r\n")
+        if head_end < 0:
+            raise ConnectionError("connection closed before response headers")
+        head = raw[:head_end].decode("latin-1")
+        lines = head.split("\r\n")
+        status = int(lines[0].split(" ", 2)[1])
+        headers: Dict[str, str] = {}
+        for line in lines[1:]:
+            if ":" in line:
+                k, v = line.split(":", 1)
+                headers[k.strip().lower()] = v.strip()
+        body_len = int(headers.get("content-length", "0") or "0")
+        rest = raw[head_end + 4 :]
+        while len(rest) < body_len:
+            data = conn.recv(65536)
+            if not data:
+                break
+            rest += data
+    return status, headers, rest[:body_len]

@@ -106,6 +106,7 @@ class Server:
     def __init__(self, options: Optional[ServerOptions] = None):
         self.options = options or ServerOptions()
         self._methods: Dict[str, MethodProperty] = {}
+        self._http_handlers: Dict[str, Callable] = {}
         self._acceptor: Optional[Acceptor] = None
         self._messenger = InputMessenger()
         self._stopping = False
@@ -139,6 +140,24 @@ class Server:
                 else self.options.method_max_concurrency
             )
             self._methods[full] = MethodProperty(handler, MethodStatus(full, mc))
+
+    def add_http_handler(self, path: str, handler: Callable) -> None:
+        """Register an HTTP handler ``fn(HttpFrame) -> (status, content_type,
+        body_bytes)`` at an exact path or a prefix ending in '/'. Builtin
+        portal pages win on conflicts (the reference forbids shadowing
+        builtins too, server.cpp AddBuiltinServices)."""
+        if self._started:
+            raise RuntimeError("add_http_handler after start")
+        self._http_handlers[path] = handler
+
+    def find_http_handler(self, path: str) -> Optional[Callable]:
+        h = self._http_handlers.get(path)
+        if h is not None:
+            return h
+        for prefix, handler in self._http_handlers.items():
+            if prefix.endswith("/") and path.startswith(prefix):
+                return handler
+        return None
 
     def method_status(self, service: str, method: str) -> Optional[MethodStatus]:
         prop = self._methods.get(f"{service}.{method}")

@@ -26,6 +26,9 @@ from incubator_brpc_tpu.utils.status import ErrorCode
 logger = logging.getLogger(__name__)
 
 _HEADER_PEEK = 64  # covers every registered protocol's fixed header
+# variable-length headers (HTTP) may need a deeper look before they can
+# size the frame; bounded so a hostile peer can't make us copy the world
+_MAX_HEADER_PEEK = 64 * 1024
 
 
 class InputMessenger:
@@ -72,6 +75,12 @@ class InputMessenger:
                     break
                 try:
                     total = proto.parse_header(header)
+                    if total is None and len(buf) > len(header):
+                        # header block longer than the fast peek: re-peek
+                        # deeper before concluding "incomplete"
+                        deeper = buf.to_bytes(min(len(buf), _MAX_HEADER_PEEK))
+                        if len(deeper) > len(header):
+                            total = proto.parse_header(deeper)
                 except ParseError:
                     continue
                 matched = proto
@@ -86,7 +95,7 @@ class InputMessenger:
             if total is None:
                 break  # header itself incomplete
             # flag bounds the *body*; allow any registered header on top
-            if total > max_body + _HEADER_PEEK:
+            if total > max_body + _MAX_HEADER_PEEK:
                 self._dispatch(sock, cut)
                 sock.set_failed(
                     ErrorCode.EREQUEST, f"frame of {total} B exceeds max_body_size"
@@ -112,15 +121,21 @@ class InputMessenger:
     def _dispatch(self, sock, cut) -> None:
         if not cut:
             return
-        # Stream frames must reach their per-stream ExecutionQueue in wire
-        # order, so they are routed inline here (the push is cheap and
-        # nonblocking; ordered consumption happens on the queue's fiber —
-        # the reference keeps order the same way by routing streaming
-        # messages during the parse phase, SURVEY §3.4). Everything else
-        # gets the N-1-fibers + last-inline treatment.
+        # Two classes of frame must be handled inline, in wire order, on
+        # this (single-per-socket) reader fiber:
+        # - stream frames: their per-stream ExecutionQueue push must happen
+        #   in order (the reference routes streaming messages during the
+        #   parse phase for the same reason, SURVEY §3.4);
+        # - frames whose protocol has no correlation ids (HTTP): responses
+        #   must be written in request order.
+        # Everything else gets the N-1-fibers + last-inline treatment.
         rest = []
         for proto, frame in cut:
-            if getattr(frame, "is_stream", False) and proto.process_stream is not None:
+            inline = getattr(frame, "process_inline", False) or (
+                getattr(frame, "is_stream", False)
+                and proto.process_stream is not None
+            )
+            if inline:
                 self._process_one(sock, proto, frame)
             else:
                 rest.append((proto, frame))

@@ -260,7 +260,9 @@ def test_health_check_revives_against_restarted_server():
 def test_garbage_input_fails_connection(echo_server):
     raw = pysocket.create_connection((LOOP, echo_server.port))
     try:
-        raw.sendall(b"GET / HTTP/1.1\r\n\r\n")  # not tbus_std
+        # matches no registered protocol (tbus_std magic is "TPRC"; not an
+        # HTTP method line either)
+        raw.sendall(b"\x00\xffGARBAGE-ON-THE-WIRE\r\n\r\n")
         # server must drop us: recv sees EOF
         raw.settimeout(5)
         assert raw.recv(4096) == b""
