@@ -88,6 +88,25 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
         "tb_respool_address": (b, [b, ctypes.c_uint64]),
         "tb_respool_return": (ctypes.c_int, [b, ctypes.c_uint64]),
         "tb_respool_live": (ctypes.c_size_t, [b]),
+        "tb_objpool_create": (b, [ctypes.c_size_t]),
+        "tb_objpool_destroy": (None, [b]),
+        "tb_objpool_get": (b, [b]),
+        "tb_objpool_return": (None, [b, ctypes.c_void_p]),
+        "tb_objpool_live": (ctypes.c_size_t, [b]),
+        "tb_objpool_free_count": (ctypes.c_size_t, [b]),
+        "tb_flatmap_create": (b, [ctypes.c_size_t]),
+        "tb_flatmap_destroy": (None, [b]),
+        "tb_flatmap_insert": (
+            ctypes.c_int,
+            [b, ctypes.c_uint64, ctypes.c_uint64],
+        ),
+        "tb_flatmap_get": (
+            ctypes.c_int,
+            [b, ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64)],
+        ),
+        "tb_flatmap_erase": (ctypes.c_int, [b, ctypes.c_uint64]),
+        "tb_flatmap_size": (ctypes.c_size_t, [b]),
+        "tb_flatmap_capacity": (ctypes.c_size_t, [b]),
     }
     for name, (restype, argtypes) in sigs.items():
         fn = getattr(lib, name)
@@ -185,3 +204,76 @@ class ResourcePool:
         p, self._p = getattr(self, "_p", None), None
         if p and LIB is not None:
             LIB.tb_respool_destroy(p)
+
+
+class ObjectPool:
+    """Pointer-addressed fixed-size object slab (src/tbutil ObjectPool;
+    reference object_pool.h). Memory never returns to the OS."""
+
+    def __init__(self, item_size: int = 8):
+        if LIB is None:
+            raise RuntimeError("native runtime unavailable")
+        self._p = LIB.tb_objpool_create(item_size)
+
+    def get(self) -> int:
+        return LIB.tb_objpool_get(self._p) or 0
+
+    def return_(self, item: int) -> None:
+        LIB.tb_objpool_return(self._p, item)
+
+    @property
+    def live(self) -> int:
+        return LIB.tb_objpool_live(self._p)
+
+    @property
+    def free_count(self) -> int:
+        return LIB.tb_objpool_free_count(self._p)
+
+    def __del__(self):
+        p, self._p = getattr(self, "_p", None), None
+        if p and LIB is not None:
+            LIB.tb_objpool_destroy(p)
+
+
+class FlatMap:
+    """Native open-addressing u64→u64 map (src/tbutil FlatMap; reference
+    containers/flat_map.h) — the hot-path id table for native transports."""
+
+    def __init__(self, initial_capacity: int = 16):
+        if LIB is None:
+            raise RuntimeError("native runtime unavailable")
+        self._m = LIB.tb_flatmap_create(initial_capacity)
+
+    def __setitem__(self, key: int, value: int) -> None:
+        LIB.tb_flatmap_insert(self._m, key, value)
+
+    def get(self, key: int, default=None):
+        out = ctypes.c_uint64()
+        if LIB.tb_flatmap_get(self._m, key, ctypes.byref(out)):
+            return out.value
+        return default
+
+    def __getitem__(self, key: int) -> int:
+        v = self.get(key)
+        if v is None:
+            raise KeyError(key)
+        return v
+
+    def __contains__(self, key: int) -> bool:
+        return LIB.tb_flatmap_get(self._m, key, None) == 1
+
+    def __delitem__(self, key: int) -> None:
+        if not LIB.tb_flatmap_erase(self._m, key):
+            raise KeyError(key)
+
+    def __len__(self) -> int:
+        return LIB.tb_flatmap_size(self._m)
+
+    @property
+    def capacity(self) -> int:
+        return LIB.tb_flatmap_capacity(self._m)
+
+    def __del__(self):
+        m, self._m = getattr(self, "_m", None), None
+        if m and LIB is not None:
+            LIB.tb_flatmap_destroy(m)

@@ -30,6 +30,13 @@ from incubator_brpc_tpu.runtime.execution_queue import (
     TaskIterator,
     execution_queue_start,
 )
+from incubator_brpc_tpu.runtime.mutex import (
+    CountdownEvent,
+    FiberCond,
+    FiberMutex,
+    contention_profile,
+    reset_contention_profile,
+)
 from incubator_brpc_tpu.runtime.timer_thread import TimerThread, global_timer_thread
 from incubator_brpc_tpu.runtime.worker_pool import (
     Fiber,
@@ -59,4 +66,9 @@ __all__ = [
     "CallIdSpace",
     "call_id_space",
     "DeviceCompletionButex",
+    "FiberMutex",
+    "FiberCond",
+    "CountdownEvent",
+    "contention_profile",
+    "reset_contention_profile",
 ]

@@ -665,8 +665,10 @@ void* tb_respool_get(tb_respool* p, uint64_t* out_id) {
     p->versions[slot] += 1;  // even -> odd: live again, old ids stale
   } else {
     if (p->nslots % tb_respool::kChunkItems == 0) {
-      p->chunks.push_back(static_cast<char*>(
-          ::calloc(tb_respool::kChunkItems, p->item_size)));
+      char* chunk = static_cast<char*>(
+          ::calloc(tb_respool::kChunkItems, p->item_size));
+      if (!chunk) return nullptr;
+      p->chunks.push_back(chunk);
     }
     slot = static_cast<uint32_t>(p->nslots++);
     p->versions.push_back(1);
@@ -703,6 +705,222 @@ size_t tb_respool_live(const tb_respool* p) {
   tb_respool* q = const_cast<tb_respool*>(p);
   std::lock_guard<std::mutex> lk(q->mu);
   return q->live;
+}
+
+// ---------------------------------------------------------------------------
+// ObjectPool (reference src/butil/object_pool.h: pointer-addressed slab,
+// free list, memory never returned to the OS so a stale pointer is at worst
+// a recycled object, never a wild read)
+// ---------------------------------------------------------------------------
+
+struct tb_objpool {
+  static constexpr size_t kChunkItems = 256;
+  std::mutex mu;
+  size_t item_size = 0;
+  std::vector<char*> chunks;
+  std::vector<void*> free_list;
+  size_t nitems = 0;  // slots ever carved
+  size_t live = 0;
+};
+
+tb_objpool* tb_objpool_create(size_t item_size) {
+  tb_objpool* p = new tb_objpool();
+  p->item_size = item_size < 8 ? 8 : item_size;
+  return p;
+}
+
+void tb_objpool_destroy(tb_objpool* p) {
+  if (!p) return;
+  for (char* c : p->chunks) ::free(c);
+  delete p;
+}
+
+void* tb_objpool_get(tb_objpool* p) {
+  std::lock_guard<std::mutex> lk(p->mu);
+  void* item;
+  if (!p->free_list.empty()) {
+    item = p->free_list.back();
+    p->free_list.pop_back();
+  } else {
+    if (p->nitems % tb_objpool::kChunkItems == 0) {
+      char* chunk =
+          static_cast<char*>(::calloc(tb_objpool::kChunkItems, p->item_size));
+      if (!chunk) return nullptr;
+      p->chunks.push_back(chunk);
+    }
+    item = p->chunks.back() +
+           (p->nitems % tb_objpool::kChunkItems) * p->item_size;
+    ++p->nitems;
+  }
+  ++p->live;
+  return item;
+}
+
+void tb_objpool_return(tb_objpool* p, void* item) {
+  if (!item) return;
+  std::lock_guard<std::mutex> lk(p->mu);
+  p->free_list.push_back(item);
+  --p->live;
+}
+
+size_t tb_objpool_live(const tb_objpool* p) {
+  tb_objpool* q = const_cast<tb_objpool*>(p);
+  std::lock_guard<std::mutex> lk(q->mu);
+  return q->live;
+}
+
+size_t tb_objpool_free_count(const tb_objpool* p) {
+  tb_objpool* q = const_cast<tb_objpool*>(p);
+  std::lock_guard<std::mutex> lk(q->mu);
+  return q->free_list.size();
+}
+
+// ---------------------------------------------------------------------------
+// FlatMap (reference src/butil/containers/flat_map.h re-expressed as the
+// typed u64->u64 open-addressing table hot paths need; linear probing,
+// tombstones, grow at 70% occupancy)
+// ---------------------------------------------------------------------------
+
+struct tb_flatmap {
+  enum : uint8_t { EMPTY = 0, FULL = 1, TOMB = 2 };
+  // internally locked: ctypes drops the GIL per call, so Python threads
+  // hit this concurrently (ObjectPool/ResourcePool get the same treatment)
+  mutable std::mutex mu;
+  std::vector<uint64_t> keys;
+  std::vector<uint64_t> vals;
+  std::vector<uint8_t> states;
+  size_t nfull = 0;
+  size_t noccupied = 0;  // FULL + TOMB (drives rehash)
+};
+
+static inline uint64_t fm_hash(uint64_t x) {
+  // splitmix64 finalizer — cheap and well distributed
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
+
+static size_t fm_round_up_pow2(size_t n) {
+  size_t c = 16;
+  while (c < n) c <<= 1;
+  return c;
+}
+
+static void fm_rehash(tb_flatmap* m, size_t new_cap);
+
+static void fm_insert_nogrow(tb_flatmap* m, uint64_t key, uint64_t value) {
+  const size_t mask = m->keys.size() - 1;
+  size_t i = fm_hash(key) & mask;
+  while (m->states[i] == tb_flatmap::FULL) i = (i + 1) & mask;
+  if (m->states[i] == tb_flatmap::EMPTY) ++m->noccupied;
+  m->states[i] = tb_flatmap::FULL;
+  m->keys[i] = key;
+  m->vals[i] = value;
+  ++m->nfull;
+}
+
+static void fm_rehash(tb_flatmap* m, size_t new_cap) {
+  std::vector<uint64_t> keys(new_cap), vals(new_cap);
+  std::vector<uint8_t> states(new_cap, tb_flatmap::EMPTY);
+  keys.swap(m->keys);
+  vals.swap(m->vals);
+  states.swap(m->states);
+  const size_t old_full = m->nfull;
+  m->nfull = 0;
+  m->noccupied = 0;
+  for (size_t i = 0; i < keys.size(); ++i) {
+    if (states[i] == tb_flatmap::FULL) fm_insert_nogrow(m, keys[i], vals[i]);
+  }
+  (void)old_full;
+}
+
+tb_flatmap* tb_flatmap_create(size_t initial_capacity) {
+  tb_flatmap* m = new tb_flatmap();
+  const size_t cap = fm_round_up_pow2(initial_capacity ? initial_capacity : 16);
+  m->keys.assign(cap, 0);
+  m->vals.assign(cap, 0);
+  m->states.assign(cap, tb_flatmap::EMPTY);
+  return m;
+}
+
+void tb_flatmap_destroy(tb_flatmap* m) { delete m; }
+
+int tb_flatmap_insert(tb_flatmap* m, uint64_t key, uint64_t value) {
+  std::lock_guard<std::mutex> lk(m->mu);
+  const size_t mask = m->keys.size() - 1;
+  size_t i = fm_hash(key) & mask;
+  long first_tomb = -1;
+  while (m->states[i] != tb_flatmap::EMPTY) {
+    if (m->states[i] == tb_flatmap::FULL && m->keys[i] == key) {
+      m->vals[i] = value;
+      return 1;
+    }
+    if (m->states[i] == tb_flatmap::TOMB && first_tomb < 0) {
+      first_tomb = static_cast<long>(i);
+    }
+    i = (i + 1) & mask;
+  }
+  // the scan already found the landing slot: reuse the first tombstone on
+  // the chain, else the terminating EMPTY — no second probe
+  if (first_tomb >= 0) {
+    i = static_cast<size_t>(first_tomb);
+  } else {
+    ++m->noccupied;
+  }
+  m->states[i] = tb_flatmap::FULL;
+  m->keys[i] = key;
+  m->vals[i] = value;
+  ++m->nfull;
+  if (m->noccupied * 10 >= m->keys.size() * 7) {
+    // size from live entries, not old capacity: tombstone churn rehashes
+    // in place (clearing tombs) instead of growing without bound
+    size_t want = fm_round_up_pow2(m->nfull * 4 < 16 ? 16 : m->nfull * 4);
+    try {
+      fm_rehash(m, want);
+    } catch (const std::bad_alloc&) {
+      return -1;  // documented OOM contract; never let the throw cross ctypes
+    }
+  }
+  return 0;
+}
+
+int tb_flatmap_get(const tb_flatmap* m, uint64_t key, uint64_t* out) {
+  std::lock_guard<std::mutex> lk(m->mu);
+  const size_t mask = m->keys.size() - 1;
+  size_t i = fm_hash(key) & mask;
+  while (m->states[i] != tb_flatmap::EMPTY) {
+    if (m->states[i] == tb_flatmap::FULL && m->keys[i] == key) {
+      if (out) *out = m->vals[i];
+      return 1;
+    }
+    i = (i + 1) & mask;
+  }
+  return 0;
+}
+
+int tb_flatmap_erase(tb_flatmap* m, uint64_t key) {
+  std::lock_guard<std::mutex> lk(m->mu);
+  const size_t mask = m->keys.size() - 1;
+  size_t i = fm_hash(key) & mask;
+  while (m->states[i] != tb_flatmap::EMPTY) {
+    if (m->states[i] == tb_flatmap::FULL && m->keys[i] == key) {
+      m->states[i] = tb_flatmap::TOMB;
+      --m->nfull;
+      return 1;
+    }
+    i = (i + 1) & mask;
+  }
+  return 0;
+}
+
+size_t tb_flatmap_size(const tb_flatmap* m) {
+  std::lock_guard<std::mutex> lk(m->mu);
+  return m->nfull;
+}
+size_t tb_flatmap_capacity(const tb_flatmap* m) {
+  std::lock_guard<std::mutex> lk(m->mu);
+  return m->keys.size();
 }
 
 }  // extern "C"

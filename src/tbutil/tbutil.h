@@ -112,6 +112,32 @@ void* tb_respool_address(tb_respool* p, uint64_t id);
 int tb_respool_return(tb_respool* p, uint64_t id);
 size_t tb_respool_live(const tb_respool* p);
 
+// ---- ObjectPool: fixed-size objects addressed by pointer, free-listed,
+// never returned to the OS (reference src/butil/object_pool.h) ----
+typedef struct tb_objpool tb_objpool;
+tb_objpool* tb_objpool_create(size_t item_size);
+void tb_objpool_destroy(tb_objpool* p);
+void* tb_objpool_get(tb_objpool* p);
+// return an item obtained from this pool; it becomes reusable immediately.
+void tb_objpool_return(tb_objpool* p, void* item);
+size_t tb_objpool_live(const tb_objpool* p);
+size_t tb_objpool_free_count(const tb_objpool* p);
+
+// ---- FlatMap: open-addressing u64->u64 hash map for hot-path id lookups
+// (reference src/butil/containers/flat_map.h; this is the narrow typed
+// variant native transports need — socket ids, stream ids, cids) ----
+typedef struct tb_flatmap tb_flatmap;
+tb_flatmap* tb_flatmap_create(size_t initial_capacity);
+void tb_flatmap_destroy(tb_flatmap* m);
+// 0 = inserted new, 1 = replaced existing, -1 = OOM on grow.
+int tb_flatmap_insert(tb_flatmap* m, uint64_t key, uint64_t value);
+// 1 = found (*out filled), 0 = absent.
+int tb_flatmap_get(const tb_flatmap* m, uint64_t key, uint64_t* out);
+// 1 = erased, 0 = absent.
+int tb_flatmap_erase(tb_flatmap* m, uint64_t key);
+size_t tb_flatmap_size(const tb_flatmap* m);
+size_t tb_flatmap_capacity(const tb_flatmap* m);
+
 #ifdef __cplusplus
 }
 #endif
