@@ -243,9 +243,12 @@ class FlatMap:
         if LIB is None:
             raise RuntimeError("native runtime unavailable")
         self._m = LIB.tb_flatmap_create(initial_capacity)
+        if not self._m:
+            raise MemoryError("tb_flatmap_create failed")
 
     def __setitem__(self, key: int, value: int) -> None:
-        LIB.tb_flatmap_insert(self._m, key, value)
+        if LIB.tb_flatmap_insert(self._m, key, value) < 0:
+            raise MemoryError("flatmap grow failed")
 
     def get(self, key: int, default=None):
         out = ctypes.c_uint64()

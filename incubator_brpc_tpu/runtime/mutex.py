@@ -128,12 +128,9 @@ class FiberMutex:
         return True
 
     def release(self) -> None:
-        # atomic exchange-to-0 via CAS loop: a plain load+store would race
-        # with a waiter upgrading 1→2 in between and lose its wakeup
-        while True:
-            old = self._b.load()
-            if self._b.compare_exchange(old, 0):
-                break
+        # atomic exchange: a plain load+store would race with a waiter
+        # upgrading 1→2 in between and lose its wakeup
+        old = self._b.exchange(0)
         # the unlock side pays the wake (the reference's contention profiler
         # hooks here; our timing happens on the waiter side instead)
         if old == 2:

@@ -802,6 +802,10 @@ static inline uint64_t fm_hash(uint64_t x) {
 }
 
 static size_t fm_round_up_pow2(size_t n) {
+  // clamp: anything past 2^32 entries is a caller bug, and an unbounded
+  // shift would overflow to 0 and spin forever
+  const size_t kMaxCap = size_t(1) << 32;
+  if (n > kMaxCap) n = kMaxCap;
   size_t c = 16;
   while (c < n) c <<= 1;
   return c;
@@ -836,12 +840,19 @@ static void fm_rehash(tb_flatmap* m, size_t new_cap) {
 }
 
 tb_flatmap* tb_flatmap_create(size_t initial_capacity) {
-  tb_flatmap* m = new tb_flatmap();
-  const size_t cap = fm_round_up_pow2(initial_capacity ? initial_capacity : 16);
-  m->keys.assign(cap, 0);
-  m->vals.assign(cap, 0);
-  m->states.assign(cap, tb_flatmap::EMPTY);
-  return m;
+  tb_flatmap* m = nullptr;
+  try {
+    m = new tb_flatmap();
+    const size_t cap =
+        fm_round_up_pow2(initial_capacity ? initial_capacity : 16);
+    m->keys.assign(cap, 0);
+    m->vals.assign(cap, 0);
+    m->states.assign(cap, tb_flatmap::EMPTY);
+    return m;
+  } catch (const std::bad_alloc&) {
+    delete m;
+    return nullptr;  // never let the throw cross the C ABI into ctypes
+  }
 }
 
 void tb_flatmap_destroy(tb_flatmap* m) { delete m; }

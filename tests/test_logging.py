@@ -1,0 +1,90 @@
+"""LogSink + rate-limited logging tests (reference
+test/logging_unittest.cc LogSink cases)."""
+
+import logging
+
+from incubator_brpc_tpu.utils import logging as tblog
+
+
+class CapturingSink(tblog.LogSink):
+    def __init__(self, consume=True):
+        self.records = []
+        self.consume = consume
+
+    def on_log_message(self, record):
+        self.records.append(record)
+        return self.consume
+
+
+def test_sink_sees_framework_records():
+    sink = CapturingSink()
+    old = tblog.set_log_sink(sink)
+    try:
+        logging.getLogger("incubator_brpc_tpu.test").warning("hello %s", "sink")
+    finally:
+        tblog.set_log_sink(old)
+    assert any(r.getMessage() == "hello sink" for r in sink.records)
+
+
+def test_sink_swap_returns_old_and_restores():
+    a, b = CapturingSink(), CapturingSink()
+    old0 = tblog.set_log_sink(a)
+    try:
+        assert tblog.set_log_sink(b) is a
+        logging.getLogger("incubator_brpc_tpu.test").error("to-b")
+        assert any(r.getMessage() == "to-b" for r in b.records)
+        assert not any(r.getMessage() == "to-b" for r in a.records)
+    finally:
+        tblog.set_log_sink(old0)
+
+
+def test_propagation_disabled_while_sink_active():
+    pkg = logging.getLogger("incubator_brpc_tpu")
+    assert pkg.propagate is True
+    sink = CapturingSink()
+    old = tblog.set_log_sink(sink)
+    try:
+        assert pkg.propagate is False
+    finally:
+        tblog.set_log_sink(old)
+    assert pkg.propagate is True
+
+
+def test_sink_sees_info_and_debug():
+    """The package logger opens to DEBUG while a sink is installed —
+    otherwise root's WARNING default would drop these before any handler."""
+    sink = CapturingSink()
+    old = tblog.set_log_sink(sink)
+    try:
+        logging.getLogger("incubator_brpc_tpu.lvl").info("info-rec")
+        logging.getLogger("incubator_brpc_tpu.lvl").debug("debug-rec")
+    finally:
+        tblog.set_log_sink(old)
+    msgs = [r.getMessage() for r in sink.records]
+    assert "info-rec" in msgs and "debug-rec" in msgs
+
+
+def test_level_counters_advance():
+    before = tblog.log_counts[logging.WARNING].get_value()
+    logging.getLogger("incubator_brpc_tpu.counting").warning("count me")
+    assert tblog.log_counts[logging.WARNING].get_value() == before + 1
+
+
+def test_log_every_n_and_first_n():
+    logger = logging.getLogger("incubator_brpc_tpu.rl")
+    sink = CapturingSink()
+    old = tblog.set_log_sink(sink)
+    try:
+        emitted = [tblog.log_every_n(logger, logging.INFO, 3, "n") for _ in range(9)]
+        assert emitted == [True, False, False] * 3
+        emitted = [tblog.log_first_n(logger, logging.INFO, 2, "f") for _ in range(5)]
+        assert emitted == [True, True, False, False, False]
+    finally:
+        tblog.set_log_sink(old)
+
+
+def test_log_every_second():
+    logger = logging.getLogger("incubator_brpc_tpu.rl2")
+    # same call site (one line in a loop): only the first emits
+    emitted = [tblog.log_every_second(logger, logging.INFO, "s") for _ in range(3)]
+    assert emitted == [True, False, False]
