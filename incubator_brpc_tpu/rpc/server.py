@@ -23,6 +23,7 @@ Errors: ``cntl.set_failed(code, text)`` → an error frame, payload dropped.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import threading
 from typing import Callable, Dict, Optional, Union
@@ -37,6 +38,10 @@ from incubator_brpc_tpu.protocol.tbus_std import (
     pack_frame,
 )
 from incubator_brpc_tpu.rpc.controller import Controller
+
+# imported at module scope so the rpc_dump* flags exist (and show in
+# /flags) before the first request arrives
+from incubator_brpc_tpu.rpc.dump import maybe_dump_request
 from incubator_brpc_tpu.transport.acceptor import Acceptor
 from incubator_brpc_tpu.transport.messenger import InputMessenger
 from incubator_brpc_tpu.utils.endpoint import EndPoint, str2endpoint
@@ -287,6 +292,13 @@ class Server:
             self._finish(sock, cntl, b"", status)
             return
         cntl._request_payload = payload
+
+        # dumped AFTER decompression, so the sampled frame carries the
+        # plaintext payload with compress cleared — self-consistent for
+        # replay (replaying the original compressed bytes through
+        # call_method would double-wrap them)
+        maybe_dump_request(dataclasses.replace(meta, compress=""), payload,
+                           frame.attachment)
 
         from incubator_brpc_tpu.builtin.rpcz import start_server_span
 

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""rpc_press — load generator for tbus_std servers (reference
+tools/rpc_press: drives a method at a target qps/concurrency and reports
+qps + latency percentiles).
+
+Usage:
+    python tools/rpc_press.py --server 127.0.0.1:8000 \
+        --method demo.echo --payload-bytes 64 --threads 8 --duration 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+import time
+
+
+def run_press(
+    server: str,
+    service: str,
+    method: str,
+    payload: bytes,
+    threads: int = 4,
+    duration: float = 5.0,
+    timeout_ms: float = 1000,
+) -> dict:
+    from incubator_brpc_tpu.bvar import LatencyRecorder
+    from incubator_brpc_tpu.rpc import Channel, ChannelOptions
+
+    ch = Channel()
+    if not ch.init(server, options=ChannelOptions(timeout_ms=timeout_ms)):
+        raise SystemExit(f"cannot init channel to {server}")
+
+    latency = LatencyRecorder(name=None)
+    stop_at = time.monotonic() + duration
+    counts = {"ok": 0, "fail": 0}
+    lock = threading.Lock()
+
+    def worker():
+        ok = fail = 0
+        while time.monotonic() < stop_at:
+            t0 = time.perf_counter()
+            cntl = ch.call_method(service, method, payload)
+            if cntl.ok():
+                ok += 1
+                latency << (time.perf_counter() - t0) * 1e6
+            else:
+                fail += 1
+        with lock:
+            counts["ok"] += ok
+            counts["fail"] += fail
+
+    ts = [threading.Thread(target=worker) for _ in range(threads)]
+    t0 = time.monotonic()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = time.monotonic() - t0
+    return {
+        "qps": counts["ok"] / wall if wall else 0.0,
+        "ok": counts["ok"],
+        "fail": counts["fail"],
+        "latency_us_avg": latency.latency(),
+        "latency_us_p50": latency.latency_percentile(0.5),
+        "latency_us_p99": latency.latency_percentile(0.99),
+        "latency_us_max": latency.max_latency(),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--server", required=True, help="ip:port or naming url")
+    p.add_argument("--method", required=True, help="service.method")
+    p.add_argument("--payload-bytes", type=int, default=64)
+    p.add_argument("--payload-file", help="read request payload from a file")
+    p.add_argument("--threads", type=int, default=4)
+    p.add_argument("--duration", type=float, default=5.0, help="seconds")
+    p.add_argument("--timeout-ms", type=float, default=1000)
+    args = p.parse_args(argv)
+
+    service, _, method = args.method.rpartition(".")
+    if not service:
+        p.error("--method must be service.method")
+    if args.payload_file:
+        with open(args.payload_file, "rb") as f:
+            payload = f.read()
+    else:
+        payload = b"x" * args.payload_bytes
+
+    stats = run_press(
+        args.server,
+        service,
+        method,
+        payload,
+        threads=args.threads,
+        duration=args.duration,
+        timeout_ms=args.timeout_ms,
+    )
+    print(
+        f"qps={stats['qps']:.0f} ok={stats['ok']} fail={stats['fail']} "
+        f"avg={stats['latency_us_avg']:.0f}us p50={stats['latency_us_p50']:.0f}us "
+        f"p99={stats['latency_us_p99']:.0f}us max={stats['latency_us_max']:.0f}us"
+    )
+    return 0 if stats["fail"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, ".")
+    raise SystemExit(main())

@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""rpc_view — print the contents of rpc_dump sample files (reference
+tools/rpc_view).
+
+Usage:
+    python tools/rpc_view.py ./rpc_dump/requests.1234.0000
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("paths", nargs="+", help="dump files")
+    p.add_argument("--max-payload", type=int, default=64, help="bytes shown")
+    args = p.parse_args(argv)
+
+    from incubator_brpc_tpu.rpc.dump import load_dump_file
+
+    n = 0
+    for path in args.paths:
+        for meta, payload, attachment in load_dump_file(path):
+            preview = payload[: args.max_payload]
+            print(
+                f"[{n}] {meta.service}.{meta.method} "
+                f"payload={len(payload)}B attachment={len(attachment)}B "
+                f"compress={meta.compress or '-'} log_id={meta.log_id} "
+                f"| {preview!r}"
+            )
+            n += 1
+    print(f"{n} samples")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, ".")
+    raise SystemExit(main())
