@@ -193,4 +193,11 @@ def handle(server, frame) -> Resp:
         handler = server.find_http_handler(frame.path)
         if handler is not None:
             return handler(frame)
+        # http→rpc gateway: /<service>/<method> reaches the same method map
+        # as the binary protocol (http_rpc_protocol.cpp's pb-over-http)
+        parts = frame.path.strip("/").split("/")
+        if len(parts) == 2 and server.has_method(f"{parts[0]}.{parts[1]}"):
+            return server.invoke_for_http(
+                parts[0], parts[1], frame.body, sock=getattr(frame, "sock", None)
+            )
     return 404, "text/plain", f"no handler for {frame.path}\n".encode()

@@ -172,6 +172,7 @@ def process_request(sock, frame: HttpFrame) -> None:
     from incubator_brpc_tpu.builtin import pages
 
     server = sock.context.get("server")
+    frame.sock = sock  # the rpc gateway threads the connection through
     try:
         status, ctype, body = pages.handle(server, frame)
     except Exception as e:

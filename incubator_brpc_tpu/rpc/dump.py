@@ -136,9 +136,16 @@ def reset_global_dumper() -> None:
 
 
 def maybe_dump_request(meta: Meta, payload: bytes, attachment: bytes = b"") -> None:
-    """The server-side hook (ProcessRpcRequest's sampling site)."""
+    """The server-side hook (ProcessRpcRequest's sampling site). The caller
+    passes the DECOMPRESSED payload, so compress is cleared here (after the
+    flag check — the off path must stay allocation-free) to keep dumped
+    frames self-consistent for replay."""
     if get_flag("rpc_dump"):
-        global_dumper().sample(meta, payload, attachment)
+        import dataclasses
+
+        global_dumper().sample(
+            dataclasses.replace(meta, compress=""), payload, attachment
+        )
 
 
 def load_dump_file(path: str):

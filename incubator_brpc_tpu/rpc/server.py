@@ -23,7 +23,6 @@ Errors: ``cntl.set_failed(code, text)`` → an error frame, payload dropped.
 
 from __future__ import annotations
 
-import dataclasses
 import logging
 import threading
 from typing import Callable, Dict, Optional, Union
@@ -293,12 +292,7 @@ class Server:
             return
         cntl._request_payload = payload
 
-        # dumped AFTER decompression, so the sampled frame carries the
-        # plaintext payload with compress cleared — self-consistent for
-        # replay (replaying the original compressed bytes through
-        # call_method would double-wrap them)
-        maybe_dump_request(dataclasses.replace(meta, compress=""), payload,
-                           frame.attachment)
+        maybe_dump_request(meta, payload, frame.attachment)
 
         from incubator_brpc_tpu.builtin.rpcz import start_server_span
 
@@ -353,6 +347,87 @@ class Server:
             from incubator_brpc_tpu.builtin.rpcz import end_server_span
 
             end_server_span(cntl, response_size=len(response))
+
+    def has_method(self, full_name: str) -> bool:
+        """Cheap membership check (the gateway route test — methods() copies
+        the whole map)."""
+        return full_name in self._methods
+
+    def invoke_for_http(self, service: str, method: str, body: bytes, sock=None):
+        """The http→rpc gateway body (the reference serves every pb service
+        over HTTP at /ServiceName/MethodName via json2pb transcoding,
+        http_rpc_protocol.cpp): same method map, same admission gates, the
+        request body as payload. Returns (status, content_type, bytes).
+
+        Async handlers are waited for up to the reloadable
+        ``http_gateway_async_timeout_s`` flag — the wait pins this
+        connection's reader fiber (HTTP responses must go out in request
+        order), so slow async methods belong on the binary protocol."""
+        prop = self._methods.get(f"{service}.{method}")
+        if prop is None:
+            return 404, "text/plain", f"no method {service}.{method}\n".encode()
+        if self._stopping:
+            return 503, "text/plain", b"server stopping\n"
+        status = prop.status
+        with self._lock:
+            admitted_server = not (
+                self.options.max_concurrency
+                and self._nprocessing >= self.options.max_concurrency
+            )
+            if admitted_server:
+                self._nprocessing += 1
+        if not (admitted_server and status.on_requested()):
+            if admitted_server:
+                with self._lock:
+                    self._nprocessing -= 1
+                    if self._nprocessing == 0:
+                        self._quiescent.notify_all()
+            return 503, "text/plain", b"concurrency limit reached\n"
+
+        self.nrequest << 1
+        cntl = Controller()
+        cntl._server = self
+        cntl._service = service
+        cntl._method = method
+        cntl._request_payload = body
+        # populate the same request context the binary path provides so
+        # handlers behave identically over both protocols
+        cntl.request_meta = Meta(service=service, method=method)
+        cntl._sock = sock
+        cntl.remote_side = sock.remote if sock is not None else None
+        cntl._mark_start()
+        done = threading.Event()
+        holder = {"response": b""}
+        cntl._async = False
+        cntl.set_async = lambda: setattr(cntl, "_async", True)
+
+        def send_response(response=b""):
+            holder["response"] = response or b""
+            done.set()
+
+        cntl.send_response = send_response
+        try:
+            response = prop.handler(cntl, body)
+        except Exception as e:
+            logger.exception("handler %s.%s raised (http)", service, method)
+            cntl.set_failed(ErrorCode.EINTERNAL, f"handler raised: {e!r}")
+            response = b""
+        if cntl._async and not cntl.failed():
+            from incubator_brpc_tpu.utils.flags import get_flag
+
+            if not done.wait(timeout=float(get_flag("http_gateway_async_timeout_s"))):
+                cntl.set_failed(ErrorCode.ERPCTIMEDOUT, "async handler timed out")
+            response = holder["response"]
+        cntl._mark_end()
+        status.on_responded(cntl.error_code, cntl.latency_us)
+        with self._lock:
+            self._nprocessing -= 1
+            if self._nprocessing == 0:
+                self._quiescent.notify_all()
+        if cntl.failed():
+            self.nerror << 1
+            return 500, "text/plain", f"{cntl.error_text}\n".encode()
+        return 200, "application/octet-stream", response or b""
 
     def _send_response(self, sock, cntl: Controller, response: bytes) -> None:
         """SendRpcResponse (baidu_rpc_protocol.cpp:136): serialize+compress,

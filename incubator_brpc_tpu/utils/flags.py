@@ -97,6 +97,12 @@ define_flag(
 define_flag("max_body_size", 64 * 1024 * 1024, "maximum message body size", lambda v: v > 0)
 define_flag("socket_max_unwritten_bytes", 64 * 1024 * 1024, "write-queue backpressure threshold (EOVERCROWDED)", lambda v: v > 0)
 define_flag("enable_rpcz", False, "collect rpcz spans", lambda v: True)
+define_flag(
+    "http_gateway_async_timeout_s",
+    30,
+    "how long the http->rpc gateway waits for an async handler",
+    lambda v: v > 0,
+)
 define_flag("rpcz_keep_span_seconds", 1800, "span retention", lambda v: v > 0)
 define_flag("rpcz_max_spans", 10000, "max spans retained in memory", lambda v: v > 0)
 define_flag(

@@ -1,0 +1,156 @@
+"""RESP client/pipelining + http→rpc gateway tests (reference
+test/brpc_redis_unittest.cpp command/reply cases, and the pb-over-http
+behavior of http_rpc_protocol.cpp)."""
+
+import threading
+
+import pytest
+
+from incubator_brpc_tpu.protocol import resp
+from incubator_brpc_tpu.protocol.http import http_call
+from incubator_brpc_tpu.rpc import Channel, Server
+
+
+class TestRespCodec:
+    def test_pack_command(self):
+        assert (
+            resp.pack_command("SET", "k", "v")
+            == b"*3\r\n$3\r\nSET\r\n$1\r\nk\r\n$1\r\nv\r\n"
+        )
+        assert resp.pack_command("INCRBY", "k", 5) == (
+            b"*3\r\n$6\r\nINCRBY\r\n$1\r\nk\r\n$1\r\n5\r\n"
+        )
+
+    def test_parse_simple_types(self):
+        assert resp.parse_reply(b"+OK\r\n") == ("OK", 5)
+        assert resp.parse_reply(b":42\r\n") == (42, 5)
+        r, off = resp.parse_reply(b"$5\r\nhello\r\n")
+        assert (r, off) == (b"hello", 11)
+        r, off = resp.parse_reply(b"$-1\r\n")
+        assert r is None and off == 5
+        err, _ = resp.parse_reply(b"-ERR nope\r\n")
+        assert isinstance(err, resp.RespError)
+
+    def test_parse_array_and_nested(self):
+        buf = b"*2\r\n$1\r\na\r\n*2\r\n:1\r\n:2\r\n"
+        r, off = resp.parse_reply(buf)
+        assert r == [b"a", [1, 2]]
+        assert off == len(buf)
+
+    def test_incomplete_returns_sentinel(self):
+        for partial in (b"", b"$5\r\nhel", b"*2\r\n:1\r\n", b"+OK"):
+            r, off = resp.parse_reply(partial)
+            assert off == -1
+
+
+@pytest.fixture
+def redis_pair():
+    server = resp.MockRedisServer()
+    assert server.start()
+    client = resp.RedisClient(f"127.0.0.1:{server.port}")
+    yield server, client
+    client.close()
+    server.stop()
+
+
+class TestRedisClient:
+    def test_basic_commands(self, redis_pair):
+        _, c = redis_pair
+        assert c.ping() == "PONG"
+        assert c.set("k", "v1") == "OK"
+        assert c.get("k") == b"v1"
+        assert c.get("missing") is None
+        assert c.incr("n") == 1
+        assert c.incr("n") == 2
+        assert c.delete("k", "missing") == 1
+
+    def test_pipeline_order(self, redis_pair):
+        _, c = redis_pair
+        replies = c.pipeline(
+            [("SET", "a", "1"), ("INCR", "a"), ("GET", "a"), ("MGET", "a", "nope")]
+        )
+        assert replies == ["OK", 2, b"2", [b"2", None]]
+
+    def test_error_reply_raises(self, redis_pair):
+        _, c = redis_pair
+        with pytest.raises(resp.RespError):
+            c.execute("NOSUCHCMD")
+
+    def test_concurrent_pipelines(self, redis_pair):
+        _, c = redis_pair
+        errs = []
+
+        def worker(i):
+            try:
+                for j in range(50):
+                    key = f"t{i}"
+                    c.execute("SET", key, f"{j}")
+                    assert c.get(key) is not None
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+
+
+@pytest.fixture
+def dual_server():
+    server = Server()
+
+    def upper(cntl, request):
+        return request.upper()
+
+    def fail(cntl, request):
+        from incubator_brpc_tpu.utils.status import ErrorCode
+
+        cntl.set_failed(ErrorCode.EINTERNAL, "nope")
+        return b""
+
+    def later(cntl, request):
+        cntl.set_async()
+        threading.Timer(0.05, lambda: cntl.send_response(b"async:" + request)).start()
+        return None
+
+    server.add_service("svc", {"upper": upper, "fail": fail, "later": later})
+    assert server.start(0)
+    yield server
+    server.stop()
+    server.join(timeout=5)
+
+
+class TestHttpGateway:
+    def test_same_method_over_both_protocols(self, dual_server):
+        ch = Channel()
+        assert ch.init(f"127.0.0.1:{dual_server.port}")
+        assert ch.call_method("svc", "upper", b"abc").response_payload == b"ABC"
+        status, _, body = http_call(
+            "127.0.0.1", dual_server.port, "/svc/upper", method="POST", body=b"abc"
+        )
+        assert status == 200 and body == b"ABC"
+
+    def test_gateway_counts_in_method_stats(self, dual_server):
+        http_call("127.0.0.1", dual_server.port, "/svc/upper", method="POST", body=b"x")
+        st = dual_server.method_status("svc", "upper")
+        assert st.latency.count() >= 1
+
+    def test_gateway_errors_map_to_500(self, dual_server):
+        status, _, body = http_call(
+            "127.0.0.1", dual_server.port, "/svc/fail", method="POST", body=b""
+        )
+        assert status == 500 and b"nope" in body
+
+    def test_gateway_unknown_is_404(self, dual_server):
+        status, _, _ = http_call(
+            "127.0.0.1", dual_server.port, "/svc/zzz", method="POST", body=b""
+        )
+        assert status == 404
+
+    def test_gateway_async_handler(self, dual_server):
+        status, _, body = http_call(
+            "127.0.0.1", dual_server.port, "/svc/later", method="POST", body=b"hi"
+        )
+        assert status == 200 and body == b"async:hi"
