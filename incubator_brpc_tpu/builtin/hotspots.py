@@ -1,0 +1,92 @@
+"""Hotspots — on-demand sampling CPU profiler + contention dump
+(reference src/brpc/builtin/hotspots_service.cpp: /hotspots/cpu via
+gperftools sampling, /hotspots/contention via the bthread mutex
+collector).
+
+The CPU profiler here samples ``sys._current_frames()`` at a fixed rate
+for a bounded window — a wall-clock stack sampler over every thread in
+the process (fibers run on pool threads, so fiber work is attributed to
+its code naturally). Results aggregate identical stacks and sort by
+sample count; leaf-function totals give the flat view.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from collections import Counter
+from typing import Dict, List, Tuple
+
+_profile_lock = threading.Lock()  # one profiling run at a time
+
+
+def sample_cpu(seconds: float = 1.0, hz: int = 100) -> Dict[str, object]:
+    """Sample all threads' stacks for ``seconds`` at ``hz``. Returns
+    {samples, stacks: [(count, stack_text)], flat: [(count, leaf)]}."""
+    if not _profile_lock.acquire(blocking=False):
+        raise RuntimeError("another profiling run is in progress")
+    try:
+        me = threading.get_ident()
+        interval = 1.0 / max(1, hz)
+        stacks: Counter = Counter()
+        flat: Counter = Counter()
+        deadline = time.monotonic() + max(0.01, seconds)
+        nsamples = 0
+        while time.monotonic() < deadline:
+            for tid, frame in sys._current_frames().items():
+                if tid == me:
+                    continue
+                stack = traceback.extract_stack(frame, limit=24)
+                if not stack:
+                    continue
+                key = "\n".join(
+                    f"  {f.filename}:{f.lineno} {f.name}" for f in stack
+                )
+                stacks[key] += 1
+                leaf = stack[-1]
+                flat[f"{leaf.filename}:{leaf.lineno} {leaf.name}"] += 1
+                nsamples += 1
+            time.sleep(interval)
+        return {
+            "samples": nsamples,
+            "stacks": stacks.most_common(),
+            "flat": flat.most_common(),
+        }
+    finally:
+        _profile_lock.release()
+
+
+def render_cpu_text(result: Dict[str, object], top: int = 30) -> str:
+    lines = [f"samples: {result['samples']}", "", "--- flat (leaf) ---"]
+    for leaf, count in list(result["flat"])[:top]:
+        lines.append(f"{count:8d}  {leaf}")
+    lines.append("")
+    lines.append("--- stacks ---")
+    for stack, count in list(result["stacks"])[:top]:
+        lines.append(f"{count:8d} samples:")
+        lines.append(stack)
+        lines.append("")
+    return "\n".join(lines)
+
+
+def render_contention_text(top: int = 30) -> str:
+    from incubator_brpc_tpu.runtime.mutex import (
+        contended_acquires,
+        contention_profile,
+        contention_wait,
+    )
+
+    rows: List[Tuple[str, int, float]] = contention_profile()
+    lines = [
+        f"contended acquires: {contended_acquires.get_value()}",
+        f"wait stats: {contention_wait.get_value()}",
+        "",
+        "--- by call site (total wait us) ---",
+    ]
+    for stack, count, wait_us in rows[:top]:
+        lines.append(f"{wait_us:12.0f}us over {count} acquisitions at:")
+        lines.append(stack.rstrip())
+        lines.append("")
+    return "\n".join(lines)

@@ -146,6 +146,24 @@ def _rpcz(server, frame) -> Resp:
     return 200, "text/plain", ("\n".join(lines) + "\n").encode()
 
 
+def _hotspots(server, frame) -> Resp:
+    """hotspots_service.cpp: /hotspots (cpu sampling, bounded window) and
+    /hotspots/contention (mutex contention by call site)."""
+    from incubator_brpc_tpu.builtin import hotspots
+
+    if frame.path.rstrip("/").endswith("/contention"):
+        return 200, "text/plain", hotspots.render_contention_text().encode()
+    try:
+        seconds = min(10.0, float(frame.query.get("seconds", "1")))
+    except ValueError:
+        return 400, "text/plain", b"bad seconds\n"
+    try:
+        result = hotspots.sample_cpu(seconds=seconds)
+    except RuntimeError as e:
+        return 503, "text/plain", f"{e}\n".encode()
+    return 200, "text/plain", hotspots.render_cpu_text(result).encode()
+
+
 def _connections(server, frame) -> Resp:
     from incubator_brpc_tpu.builtin.portal import running_servers
 
@@ -175,6 +193,8 @@ _PAGES: Dict[str, object] = {
     "/flags": _flags,
     "/rpcz": _rpcz,
     "/connections": _connections,
+    "/hotspots": _hotspots,
+    "/hotspots/contention": _hotspots,
 }
 
 

@@ -130,20 +130,23 @@ class RedisClient:
         data = sock._read_buf.to_bytes()
         sock._read_buf.popn(len(data))
         self._rbuf += data
+        off = 0  # running offset: slice the buffer ONCE per burst, not per reply
         while True:
             try:
-                reply, nxt = parse_reply(self._rbuf)
+                reply, nxt = parse_reply(self._rbuf, off)
             except ValueError:
                 self._fail_all(RespError("protocol desync"))
                 sock.set_failed()
                 return
             if nxt == -1:
-                return  # incomplete: wait for more bytes
-            self._rbuf = self._rbuf[nxt:]
+                break  # incomplete: wait for more bytes
+            off = nxt
             with self._plock:
                 pending = self._pending.pop(0) if self._pending else None
             if pending is not None:
                 pending.set(reply)
+        if off:
+            self._rbuf = self._rbuf[off:]
 
     def _on_socket_failed(self, sock) -> None:
         self._fail_all(RespError(f"connection lost: {sock.error_text}"))
@@ -175,8 +178,15 @@ class RedisClient:
         with self._plock:
             self._pending.extend(pendings)
             rc = self._sock.write(payload)
+            if rc != 0:
+                # nothing of THIS call reached the wire: drop only our
+                # pendings (failing the whole FIFO would desync replies
+                # still in flight for earlier, successfully-written calls)
+                del self._pending[len(self._pending) - len(pendings):]
         if rc != 0:
-            self._fail_all(RespError(f"write failed ({rc})"))
+            err = RespError(f"write failed ({rc})")
+            for p in pendings:
+                p.set(err)
         out: List[Reply] = []
         for p in pendings:
             if not p.wait(timeout):

@@ -263,20 +263,7 @@ class Server:
             self._send_response(sock, cntl, b"")
             return
         status = prop.status
-        # server-level then per-method admission (method_status.h:90-97)
-        with self._lock:
-            admitted_server = not (
-                self.options.max_concurrency
-                and self._nprocessing >= self.options.max_concurrency
-            )
-            if admitted_server:
-                self._nprocessing += 1
-        if not (admitted_server and status.on_requested()):
-            if admitted_server:  # method gate refused: undo the server add
-                with self._lock:
-                    self._nprocessing -= 1
-                    if self._nprocessing == 0:
-                        self._quiescent.notify_all()
+        if not self._admit(status):
             cntl.set_failed(ErrorCode.ELIMIT, berror(ErrorCode.ELIMIT))
             self.nerror << 1
             self._send_response(sock, cntl, b"")
@@ -336,17 +323,42 @@ class Server:
         self._send_response(sock, cntl, response)
         cntl._mark_end()
         if status is not None:
-            status.on_responded(cntl.error_code, cntl.latency_us)
-            with self._lock:
-                self._nprocessing -= 1
-                if self._nprocessing == 0:
-                    self._quiescent.notify_all()
+            self._release(status, cntl)
         if cntl.failed():
             self.nerror << 1
         if cntl._span is not None:
             from incubator_brpc_tpu.builtin.rpcz import end_server_span
 
             end_server_span(cntl, response_size=len(response))
+
+    # -- shared admission/teardown (method_status.h:90-97; used by the
+    # binary path and the http gateway so the two cannot drift) -----------
+
+    def _admit(self, status: MethodStatus) -> bool:
+        """Server-level then per-method gate; True = admitted (caller MUST
+        pair with _release)."""
+        with self._lock:
+            admitted_server = not (
+                self.options.max_concurrency
+                and self._nprocessing >= self.options.max_concurrency
+            )
+            if admitted_server:
+                self._nprocessing += 1
+        if admitted_server and status.on_requested():
+            return True
+        if admitted_server:  # method gate refused: undo the server add
+            with self._lock:
+                self._nprocessing -= 1
+                if self._nprocessing == 0:
+                    self._quiescent.notify_all()
+        return False
+
+    def _release(self, status: MethodStatus, cntl: Controller) -> None:
+        status.on_responded(cntl.error_code, cntl.latency_us)
+        with self._lock:
+            self._nprocessing -= 1
+            if self._nprocessing == 0:
+                self._quiescent.notify_all()
 
     def has_method(self, full_name: str) -> bool:
         """Cheap membership check (the gateway route test — methods() copies
@@ -363,28 +375,16 @@ class Server:
         ``http_gateway_async_timeout_s`` flag — the wait pins this
         connection's reader fiber (HTTP responses must go out in request
         order), so slow async methods belong on the binary protocol."""
+        self.nrequest << 1  # counted before admission, like the binary path
         prop = self._methods.get(f"{service}.{method}")
         if prop is None:
             return 404, "text/plain", f"no method {service}.{method}\n".encode()
         if self._stopping:
             return 503, "text/plain", b"server stopping\n"
         status = prop.status
-        with self._lock:
-            admitted_server = not (
-                self.options.max_concurrency
-                and self._nprocessing >= self.options.max_concurrency
-            )
-            if admitted_server:
-                self._nprocessing += 1
-        if not (admitted_server and status.on_requested()):
-            if admitted_server:
-                with self._lock:
-                    self._nprocessing -= 1
-                    if self._nprocessing == 0:
-                        self._quiescent.notify_all()
+        if not self._admit(status):
             return 503, "text/plain", b"concurrency limit reached\n"
 
-        self.nrequest << 1
         cntl = Controller()
         cntl._server = self
         cntl._service = service
@@ -392,10 +392,22 @@ class Server:
         cntl._request_payload = body
         # populate the same request context the binary path provides so
         # handlers behave identically over both protocols
-        cntl.request_meta = Meta(service=service, method=method)
+        meta = Meta(service=service, method=method)
+        cntl.request_meta = meta
         cntl._sock = sock
         cntl.remote_side = sock.remote if sock is not None else None
         cntl._mark_start()
+
+        # same observability hooks as the binary path
+        maybe_dump_request(meta, body)
+        from incubator_brpc_tpu.builtin.rpcz import (
+            clear_parent_span,
+            end_server_span,
+            start_server_span,
+        )
+
+        cntl._span = start_server_span(cntl, meta)
+
         done = threading.Event()
         holder = {"response": b""}
         cntl._async = False
@@ -412,6 +424,8 @@ class Server:
             logger.exception("handler %s.%s raised (http)", service, method)
             cntl.set_failed(ErrorCode.EINTERNAL, f"handler raised: {e!r}")
             response = b""
+        finally:
+            clear_parent_span(cntl._span)
         if cntl._async and not cntl.failed():
             from incubator_brpc_tpu.utils.flags import get_flag
 
@@ -419,11 +433,9 @@ class Server:
                 cntl.set_failed(ErrorCode.ERPCTIMEDOUT, "async handler timed out")
             response = holder["response"]
         cntl._mark_end()
-        status.on_responded(cntl.error_code, cntl.latency_us)
-        with self._lock:
-            self._nprocessing -= 1
-            if self._nprocessing == 0:
-                self._quiescent.notify_all()
+        self._release(status, cntl)
+        if cntl._span is not None:
+            end_server_span(cntl, response_size=len(response or b""))
         if cntl.failed():
             self.nerror << 1
             return 500, "text/plain", f"{cntl.error_text}\n".encode()

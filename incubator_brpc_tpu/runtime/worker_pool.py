@@ -40,7 +40,7 @@ class Fiber:
     """Handle to a spawned task; join() parks on the version butex."""
 
     __slots__ = ("_fn", "_args", "_kwargs", "_version_butex", "result",
-                 "exception", "urgent")
+                 "exception", "urgent", "keytable")
 
     def __init__(self, fn, args, kwargs, urgent: bool):
         self._fn = fn
@@ -50,6 +50,7 @@ class Fiber:
         self.result: Any = None
         self.exception: Optional[BaseException] = None
         self.urgent = urgent
+        self.keytable = None  # lazily built by runtime.keys
 
     @property
     def done(self) -> bool:
@@ -72,11 +73,21 @@ class Fiber:
         return self.result
 
     def _run(self) -> None:
+        prev_fiber = getattr(_tls, "fiber", None)
+        _tls.fiber = self  # fiber-local storage context (runtime.keys)
         try:
             self.result = self._fn(*self._args, **self._kwargs)
         except BaseException as e:  # noqa: BLE001 — stored, re-raised in get()
             self.exception = e
         finally:
+            if self.keytable is not None:
+                # run key destructors on fiber exit (key.cpp KeyTable dtor)
+                # BEFORE restoring _tls.fiber: a destructor reading or
+                # writing other keys must still see THIS fiber's table
+                from incubator_brpc_tpu.runtime import keys as _keys
+
+                _keys.run_destructors(self.keytable)
+            _tls.fiber = prev_fiber
             # exit path: bump version, wake joiners (task_group.cpp:327-347)
             self._version_butex.add(1)
             self._version_butex.wake_all()

@@ -1,0 +1,136 @@
+"""Fiber-local storage + hotspots profiler tests (reference
+test/bthread_key_unittest.cpp, hotspots_service coverage in
+brpc_builtin_service_unittest.cpp)."""
+
+import threading
+import time
+
+from incubator_brpc_tpu.builtin import hotspots
+from incubator_brpc_tpu.runtime import spawn
+from incubator_brpc_tpu.runtime.keys import (
+    fiber_getspecific,
+    fiber_key_create,
+    fiber_key_delete,
+    fiber_setspecific,
+)
+
+
+class TestFiberKeys:
+    def test_set_get_on_plain_thread(self):
+        k = fiber_key_create()
+        assert fiber_getspecific(k) is None
+        assert fiber_setspecific(k, "value")
+        assert fiber_getspecific(k) == "value"
+
+    def test_isolation_between_fibers(self):
+        k = fiber_key_create()
+        out = {}
+
+        def fib(name):
+            assert fiber_getspecific(k) is None  # fresh per fiber
+            fiber_setspecific(k, name)
+            time.sleep(0.01)
+            out[name] = fiber_getspecific(k)
+
+        fibers = [spawn(fib, f"f{i}") for i in range(4)]
+        for f in fibers:
+            assert f.join(timeout=5)
+        assert out == {f"f{i}": f"f{i}" for i in range(4)}
+
+    def test_isolation_between_threads(self):
+        k = fiber_key_create()
+        out = {}
+
+        def th(name):
+            fiber_setspecific(k, name)
+            time.sleep(0.01)
+            out[name] = fiber_getspecific(k)
+
+        ts = [threading.Thread(target=th, args=(f"t{i}",)) for i in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert out == {f"t{i}": f"t{i}" for i in range(3)}
+
+    def test_destructor_runs_on_fiber_exit(self):
+        destroyed = []
+        k = fiber_key_create(destructor=destroyed.append)
+
+        def fib():
+            fiber_setspecific(k, "resource")
+
+        assert spawn(fib).join(timeout=5)
+        assert destroyed == ["resource"]
+
+    def test_deleted_key_reads_none_and_skips_destructor(self):
+        destroyed = []
+        k = fiber_key_create(destructor=destroyed.append)
+
+        def fib():
+            fiber_setspecific(k, "gone")
+            assert fiber_key_delete(k)
+            assert fiber_getspecific(k) is None
+
+        assert spawn(fib).join(timeout=5)
+        assert destroyed == []
+
+    def test_key_version_prevents_stale_reads(self):
+        k1 = fiber_key_create()
+        fiber_setspecific(k1, "old")
+        assert fiber_key_delete(k1)
+        k2 = fiber_key_create()  # may reuse the index
+        if k2[0] == k1[0]:
+            assert fiber_getspecific(k2) is None  # versioned: no bleed
+        assert fiber_getspecific(k1) is None
+
+
+class TestHotspots:
+    def test_cpu_sampler_catches_a_busy_thread(self):
+        stop = threading.Event()
+
+        def burn():
+            while not stop.is_set():
+                sum(i * i for i in range(1000))
+
+        t = threading.Thread(target=burn, name="burner")
+        t.start()
+        try:
+            result = hotspots.sample_cpu(seconds=0.3, hz=200)
+        finally:
+            stop.set()
+            t.join()
+        assert result["samples"] > 10
+        text = hotspots.render_cpu_text(result)
+        assert "burn" in text
+
+    def test_single_run_at_a_time(self):
+        import pytest
+
+        t = threading.Thread(
+            target=lambda: hotspots.sample_cpu(seconds=0.3)
+        )
+        t.start()
+        time.sleep(0.05)
+        with pytest.raises(RuntimeError):
+            hotspots.sample_cpu(seconds=0.1)
+        t.join()
+
+    def test_portal_pages(self):
+        from incubator_brpc_tpu.protocol.http import http_call
+        from incubator_brpc_tpu.rpc import Server
+
+        s = Server()
+        s.add_service("h", {"m": lambda c, r: r})
+        assert s.start(0)
+        try:
+            status, _, body = http_call(
+                "127.0.0.1", s.port, "/hotspots?seconds=0.2", timeout=10
+            )
+            assert status == 200
+            assert b"samples:" in body
+            status, _, body = http_call("127.0.0.1", s.port, "/hotspots/contention")
+            assert status == 200
+            assert b"contended acquires" in body
+        finally:
+            s.stop()
