@@ -357,6 +357,7 @@ class LoadBalancerWithNaming:
         socket_map=None,
         ns_thread=None,
         server_filter=None,
+        key_tag: str = "",
     ):
         """Either ``url`` (owns a fresh NamingServiceThread) or ``ns_thread``
         (shared, not stopped by us — how PartitionChannel feeds N filtered
@@ -372,6 +373,7 @@ class LoadBalancerWithNaming:
             self.ns_thread = NamingServiceThread(url)
             self._owns_ns = True
         self._server_filter = server_filter
+        self._key_tag = key_tag
         if socket_map is None:
             from incubator_brpc_tpu.transport.socket_map import global_socket_map
 
@@ -415,7 +417,7 @@ class LoadBalancerWithNaming:
             if ep is None:
                 return None
             try:
-                sock = self._socket_map.get_or_create(ep)
+                sock = self._socket_map.get_or_create(ep, key_tag=self._key_tag)
             except OSError:
                 # select() already charged this pick (LA in-flight): settle it
                 self.lb.feedback(ep, 0.0, ErrorCode.EFAILEDSOCKET)

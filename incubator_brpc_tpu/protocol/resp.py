@@ -149,7 +149,13 @@ class RedisClient:
             self._rbuf = self._rbuf[off:]
 
     def _on_socket_failed(self, sock) -> None:
-        self._fail_all(RespError(f"connection lost: {sock.error_text}"))
+        # deferred to a pool fiber: this callback can fire synchronously
+        # from sock.write() while pipeline() holds _plock — running
+        # _fail_all inline would self-deadlock on the non-reentrant lock
+        from incubator_brpc_tpu.runtime.worker_pool import global_worker_pool
+
+        err = RespError(f"connection lost: {sock.error_text}")
+        global_worker_pool().spawn(self._fail_all, err)
 
     def _fail_all(self, err: RespError) -> None:
         with self._plock:

@@ -13,8 +13,13 @@ from incubator_brpc_tpu.rpc.server import (
     Server,
     ServerOptions,
 )
+from incubator_brpc_tpu.rpc.auth import (
+    Authenticator,
+    SharedSecretAuthenticator,
+)
 from incubator_brpc_tpu.rpc.combo import (
     CallMapper,
+    DynamicPartitionChannel,
     ParallelChannel,
     PartitionChannel,
     PartitionParser,
@@ -31,8 +36,11 @@ from incubator_brpc_tpu.rpc.stream import (
 )
 
 __all__ = [
+    "Authenticator",
     "CallMapper",
     "Channel",
+    "DynamicPartitionChannel",
+    "SharedSecretAuthenticator",
     "ChannelOptions",
     "Controller",
     "ParallelChannel",

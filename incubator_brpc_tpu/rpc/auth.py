@@ -1,0 +1,83 @@
+"""Authenticator — per-connection credential fight (reference
+src/brpc/authenticator.h: GenerateCredential on the client's first request
+per connection, VerifyCredential once on the server side; impls like
+policy/giano_authenticator).
+
+Kept contract:
+- the credential rides only the first request(s) on a connection (frames
+  sent before the first response may all carry it — the reference's
+  FightAuthentication lets concurrent first-writers race, one wins);
+- the server verifies once and marks the connection authenticated;
+  unauthenticated frames without a credential are rejected with ERPCAUTH.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+from typing import Optional
+
+
+class Authenticator:
+    """Subclass both sides (authenticator.h:30-52)."""
+
+    def generate_credential(self) -> str:
+        """Client: the auth string for a connection's first request."""
+        raise NotImplementedError
+
+    def verify_credential(self, auth_str: str, remote_side) -> bool:
+        """Server: accept or reject a connection's credential."""
+        raise NotImplementedError
+
+
+class SharedSecretAuthenticator(Authenticator):
+    """HMAC over a shared secret — a usable default (the reference ships
+    ALL of its real authenticators as org-internal stubs)."""
+
+    def __init__(self, secret: str, identity: str = "client"):
+        self._secret = secret.encode()
+        self.identity = identity
+
+    def generate_credential(self) -> str:
+        mac = hmac.new(self._secret, self.identity.encode(), hashlib.sha256)
+        return f"{self.identity}:{mac.hexdigest()}"
+
+    def verify_credential(self, auth_str: str, remote_side) -> bool:
+        identity, _, digest = (auth_str or "").partition(":")
+        if not identity or not digest:
+            return False
+        want = hmac.new(self._secret, identity.encode(), hashlib.sha256)
+        return hmac.compare_digest(want.hexdigest(), digest)
+
+
+def _clear_on_revive(sock) -> None:
+    # a revived Socket is a NEW connection: the server side has no
+    # 'authenticated' mark, so the credential must be fought again
+    sock.context.pop("auth_done", None)
+
+
+def attach_credential(meta, sock, auth: Optional[Authenticator]) -> None:
+    """Client side: add the credential while the connection is unproven."""
+    if auth is None:
+        return
+    if not sock.context.get("auth_revive_hooked"):
+        sock.context["auth_revive_hooked"] = True
+        sock.on_revived.append(_clear_on_revive)
+    if sock.context.get("auth_done"):
+        return
+    meta.extra["auth"] = auth.generate_credential()
+
+
+def mark_authenticated(sock) -> None:
+    sock.context["auth_done"] = True
+
+
+def server_check(meta, sock, auth: Optional[Authenticator]) -> bool:
+    """Server side: verify once per connection; True = let the request in."""
+    if auth is None or sock.context.get("authenticated"):
+        return True
+    cred = meta.extra.get("auth", "")
+    if auth.verify_credential(cred, sock.remote):
+        sock.context["authenticated"] = True
+        return True
+    return False

@@ -69,12 +69,14 @@ class ChannelOptions:
         backup_request_ms: float = -1,
         connect_timeout: float = 5.0,
         protocol: str = "tbus_std",
+        auth=None,
     ):
         self.timeout_ms = timeout_ms
         self.max_retry = max_retry
         self.backup_request_ms = backup_request_ms
         self.connect_timeout = connect_timeout
         self.protocol = protocol
+        self.auth = auth  # Authenticator (rpc/auth.py)
 
 
 class Channel:
@@ -106,7 +108,10 @@ class Channel:
             from incubator_brpc_tpu.lb import LoadBalancerWithNaming
 
             self._lb = LoadBalancerWithNaming(
-                str(target), lb_name or "rr", socket_map=self._socket_map
+                str(target),
+                lb_name or "rr",
+                socket_map=self._socket_map,
+                key_tag=self._auth_key_tag(),
             )
             if not self._lb.start():
                 return False
@@ -213,10 +218,25 @@ class Channel:
 
     # -- issue / return paths (run under the call-id lock) -------------------
 
+    def _auth_key_tag(self) -> str:
+        """Connection-pool partition for this channel's credentials — the
+        reference's SocketMapKey carries the Authenticator for the same
+        reason (socket_map.h:35)."""
+        a = self._options.auth
+        if a is None:
+            return ""
+        tag = getattr(a, "_smap_tag", None)
+        if tag is None:
+            tag = f"auth-{id(a):x}"
+            a._smap_tag = tag
+        return tag
+
     def _pick_socket(self, cntl: Controller):
         if self._single_server is not None:
             return self._socket_map.get_or_create(
-                self._single_server, timeout=self._options.connect_timeout
+                self._single_server,
+                timeout=self._options.connect_timeout,
+                key_tag=self._auth_key_tag(),
             )
         sock = self._lb.select_server(excluded=cntl._excluded_sockets)
         if sock is None:
@@ -246,6 +266,10 @@ class Channel:
                 cntl._request_stream.id if cntl._request_stream is not None else 0
             ),
         )
+        if self._options.auth is not None:
+            from incubator_brpc_tpu.rpc.auth import attach_credential
+
+            attach_credential(meta, sock, self._options.auth)
         try:
             payload = cntl._request_payload
             if cntl.compress_type:
@@ -331,6 +355,12 @@ class Channel:
             cntl.response_payload = payload
             cntl.response_attachment = frame.attachment
             cntl.response_meta = frame.meta
+            if self._options.auth is not None:
+                # a successful response proves the connection: stop sending
+                # credentials on it (FightAuthentication settled)
+                from incubator_brpc_tpu.rpc.auth import mark_authenticated
+
+                mark_authenticated(sock)
             if (
                 cntl._request_stream is not None
                 and frame.meta is not None

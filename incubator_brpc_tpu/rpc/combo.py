@@ -353,6 +353,44 @@ class PartitionParser:
         return None
 
 
+def _build_partition_channels(
+    ns_thread,
+    parser: "PartitionParser",
+    partition_count: int,
+    lb_name: str,
+    options: Optional[ChannelOptions],
+):
+    """Per-partition filtered LB views over ONE shared naming watcher
+    (partition_channel.cpp builds sub-channels the same way) — shared by
+    PartitionChannel and DynamicPartitionChannel so the construction (and
+    its error handling) cannot drift. Returns (channels, lbs) or None if a
+    sub-channel failed to init. The client socket map carries the response
+    messenger."""
+    from incubator_brpc_tpu.lb import LoadBalancerWithNaming
+    from incubator_brpc_tpu.rpc.channel import _client_socket_map
+
+    channels, lbs = [], []
+    for part in range(partition_count):
+        def _filter(ep, _part=part):
+            return parser.parse(getattr(ep, "tag", "") or "") == (
+                _part,
+                partition_count,
+            )
+
+        lb = LoadBalancerWithNaming(
+            lb_name=lb_name,
+            socket_map=_client_socket_map,
+            ns_thread=ns_thread,
+            server_filter=_filter,
+        )
+        ch = Channel()
+        if not ch.init_with_lb(lb, options=options):
+            return None
+        channels.append(ch)
+        lbs.append(lb)
+    return channels, lbs
+
+
 class PartitionChannel(ParallelChannel):
     """One naming service, M partitions, one sub-channel per partition
     (partition_channel.cpp). Servers publish tags ("0/3", "1/3", ...) next
@@ -381,28 +419,12 @@ class PartitionChannel(ParallelChannel):
         self._ns_thread = NamingServiceThread(naming_url)
         if not self._ns_thread.start():
             return False
-        from incubator_brpc_tpu.lb import LoadBalancerWithNaming
-        from incubator_brpc_tpu.rpc.channel import _client_socket_map
-
-        for part in range(partition_count):
-            # each partition = a filtered view over the ONE shared naming
-            # watcher (partition_channel.cpp builds sub-channels the same
-            # way); the client socket map carries the response messenger
-            def _filter(ep, _part=part):
-                return parser.parse(getattr(ep, "tag", "") or "") == (
-                    _part,
-                    partition_count,
-                )
-
-            lb = LoadBalancerWithNaming(
-                lb_name=lb_name,
-                socket_map=_client_socket_map,
-                ns_thread=self._ns_thread,
-                server_filter=_filter,
-            )
-            ch = Channel()
-            if not ch.init_with_lb(lb, options=options):
-                return False
+        built = _build_partition_channels(
+            self._ns_thread, parser, partition_count, lb_name, options
+        )
+        if built is None:
+            return False
+        for ch in built[0]:
             self.add_channel(ch, call_mapper, response_merger)
         return True
 
@@ -411,3 +433,119 @@ class PartitionChannel(ParallelChannel):
             self._ns_thread.stop()
 
 
+
+
+class DynamicPartitionChannel:
+    """Mixed partitioning schemes behind one naming service, traffic
+    weighted by per-scheme capacity (reference partition_channel.h:134 +
+    policy/dynpart_load_balancer.cpp: servers tagged "0/3" and "0/4"
+    coexist while a fleet re-partitions; each call picks ONE scheme with
+    probability proportional to live-servers/partition-count — full replica
+    sets attract more traffic — then fans out across that scheme's
+    partitions like an ordinary PartitionChannel)."""
+
+    def __init__(self, fail_limit: int = -1):
+        self.fail_limit = fail_limit
+        self._ns_thread = None
+        self._parser: Optional[PartitionParser] = None
+        self._lb_name = "rr"
+        self._options: Optional[ChannelOptions] = None
+        self._lock = threading.Lock()
+        # scheme M -> (ParallelChannel, [per-partition LBs for weighting])
+        self._schemes = {}
+        self._rng_state = 0x9E3779B97F4A7C15
+
+    def init(
+        self,
+        naming_url: str,
+        lb_name: str = "rr",
+        parser: Optional[PartitionParser] = None,
+        options: Optional[ChannelOptions] = None,
+    ) -> bool:
+        from incubator_brpc_tpu.naming import NamingServiceThread
+
+        self._parser = parser or PartitionParser()
+        self._lb_name = lb_name
+        self._options = options
+        self._ns_thread = NamingServiceThread(naming_url)
+        if not self._ns_thread.start():
+            return False
+        # observe to DISCOVER schemes; the per-partition filtered LBs do
+        # their own add/remove through the same thread
+        self._ns_thread.add_observer(self)
+        return True
+
+    def stop(self) -> None:
+        if self._ns_thread is not None:
+            self._ns_thread.stop()
+
+    # NamingServiceThread observer: build a scheme on first sighting
+    def add_server(self, ep) -> None:
+        parsed = self._parser.parse(getattr(ep, "tag", "") or "")
+        if parsed is None:
+            return
+        _, count = parsed
+        # the whole check+build is under the lock: two concurrent observer
+        # callbacks discovering the same scheme must not both build it (the
+        # loser's LBs would stay registered on the naming thread forever).
+        # No inversion risk: the naming thread never holds its own lock
+        # while calling observers.
+        with self._lock:
+            if count in self._schemes:
+                return
+            built = _build_partition_channels(
+                self._ns_thread, self._parser, count, self._lb_name, self._options
+            )
+            if built is None:
+                logger.warning("scheme /%d failed to build; skipped", count)
+                return
+            channels, lbs = built
+            pc = ParallelChannel(fail_limit=self.fail_limit)
+            for ch in channels:
+                pc.add_channel(ch)
+            self._schemes[count] = (pc, lbs)
+
+    def remove_server(self, ep) -> None:
+        pass  # the filtered LBs see the removal themselves
+
+    def _pick_scheme(self):
+        with self._lock:
+            schemes = list(self._schemes.values())
+        weighted = []
+        for pc, lbs in schemes:
+            nservers = sum(len(lb.servers()) for lb in lbs)
+            if nservers > 0:
+                weighted.append((nservers / pc.channel_count, pc))
+        if not weighted:
+            return None
+        # xorshift-weighted pick (no global random state)
+        self._rng_state ^= (self._rng_state << 13) & 0xFFFFFFFFFFFFFFFF
+        self._rng_state ^= self._rng_state >> 7
+        self._rng_state ^= (self._rng_state << 17) & 0xFFFFFFFFFFFFFFFF
+        total = sum(w for w, _ in weighted)
+        x = (self._rng_state / 2**64) * total
+        for w, pc in weighted:
+            x -= w
+            if x <= 0:
+                return pc
+        return weighted[-1][1]
+
+    def call_method(
+        self,
+        service: str,
+        method: str,
+        request: bytes,
+        cntl: Optional[Controller] = None,
+        done: Optional[Callable[[Controller], None]] = None,
+    ) -> Controller:
+        pc = self._pick_scheme()
+        if pc is None:
+            if cntl is None:
+                cntl = Controller()
+            cntl.set_failed(ErrorCode.EINTERNAL, "no partitioning scheme has servers")
+            if done:
+                done(cntl)
+            return cntl
+        return pc.call_method(service, method, request, cntl=cntl, done=done)
+
+    call = call_method

@@ -99,11 +99,13 @@ class ServerOptions:
         method_max_concurrency: int = 0,
         idle_timeout_s: float = -1,
         has_builtin_services: bool = True,
+        auth=None,
     ):
         self.max_concurrency = max_concurrency
         self.method_max_concurrency = method_max_concurrency
         self.idle_timeout_s = idle_timeout_s
         self.has_builtin_services = has_builtin_services
+        self.auth = auth  # Authenticator (rpc/auth.py)
 
 
 class Server:
@@ -252,6 +254,14 @@ class Server:
             cntl.set_failed(ErrorCode.ELOGOFF, berror(ErrorCode.ELOGOFF))
             self._send_response(sock, cntl, b"")
             return
+        if self.options.auth is not None:
+            from incubator_brpc_tpu.rpc.auth import server_check
+
+            if not server_check(meta, sock, self.options.auth):
+                cntl.set_failed(ErrorCode.ERPCAUTH, berror(ErrorCode.ERPCAUTH))
+                self.nerror << 1
+                self._send_response(sock, cntl, b"")
+                return
         prop = self._methods.get(f"{meta.service}.{meta.method}")
         if prop is None:
             code = (

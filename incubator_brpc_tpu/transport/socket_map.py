@@ -19,10 +19,18 @@ class SocketMap:
         self._map: Dict[str, Socket] = {}
 
     def get_or_create(
-        self, remote: Union[str, EndPoint], timeout: float = 5.0, **kwargs
+        self,
+        remote: Union[str, EndPoint],
+        timeout: float = 5.0,
+        key_tag: str = "",
+        **kwargs,
     ) -> Socket:
+        """``key_tag`` partitions connections the way the reference's
+        SocketMapKey{EndPoint, auth, ssl, ...} does (socket_map.h:35): a
+        channel with credentials must NOT share a connection with one
+        without — the shared socket would be authenticated for both."""
         ep = str2endpoint(remote) if isinstance(remote, str) else remote
-        key = f"{ep.ip}:{ep.port}"
+        key = f"{ep.ip}:{ep.port}|{key_tag}"
         with self._lock:
             sock = self._map.get(key)
             if sock is not None and sock.state != RECYCLED:
@@ -36,9 +44,9 @@ class SocketMap:
             self._map[key] = sock
         return sock
 
-    def remove(self, remote: Union[str, EndPoint]) -> Optional[Socket]:
+    def remove(self, remote: Union[str, EndPoint], key_tag: str = "") -> Optional[Socket]:
         ep = str2endpoint(remote) if isinstance(remote, str) else remote
-        key = f"{ep.ip}:{ep.port}"
+        key = f"{ep.ip}:{ep.port}|{key_tag}"
         with self._lock:
             return self._map.pop(key, None)
 

@@ -1,0 +1,201 @@
+"""Authenticator + DynamicPartitionChannel + memcache client tests
+(reference authenticator.h contract, brpc_partition_channel_unittest.cpp
+DynamicPartitionChannel cases, brpc_memcache_unittest.cpp)."""
+
+import threading
+
+import pytest
+
+from incubator_brpc_tpu.protocol import memcache
+from incubator_brpc_tpu.rpc import (
+    Channel,
+    ChannelOptions,
+    DynamicPartitionChannel,
+    Server,
+    ServerOptions,
+    SharedSecretAuthenticator,
+)
+from incubator_brpc_tpu.utils.status import ErrorCode
+
+
+class TestAuth:
+    def _server(self, auth):
+        s = Server(options=ServerOptions(auth=auth))
+        s.add_service("a", {"echo": lambda c, r: r})
+        assert s.start(0)
+        return s
+
+    def test_valid_credential_accepted_once_per_connection(self):
+        auth = SharedSecretAuthenticator("s3cret")
+        s = self._server(auth)
+        try:
+            ch = Channel()
+            assert ch.init(
+                f"127.0.0.1:{s.port}", options=ChannelOptions(auth=auth)
+            )
+            for i in range(3):  # later calls ride the authenticated conn
+                cntl = ch.call_method("a", "echo", b"x%d" % i)
+                assert cntl.ok(), cntl.error_text
+        finally:
+            s.stop()
+
+    def test_auth_channels_do_not_share_connections(self):
+        """SocketMapKey carries auth (socket_map.h:35): an unauthenticated
+        channel to the same endpoint must not ride an authenticated
+        connection."""
+        auth = SharedSecretAuthenticator("s3cret")
+        s = self._server(auth)
+        try:
+            good = Channel()
+            assert good.init(
+                f"127.0.0.1:{s.port}", options=ChannelOptions(auth=auth)
+            )
+            assert good.call_method("a", "echo", b"1").ok()
+            bad = Channel()
+            assert bad.init(f"127.0.0.1:{s.port}")  # no credentials
+            cntl = bad.call_method("a", "echo", b"2")
+            assert cntl.failed()
+            assert cntl.error_code == ErrorCode.ERPCAUTH
+            # the authenticated channel is unaffected
+            assert good.call_method("a", "echo", b"3").ok()
+        finally:
+            s.stop()
+
+    def test_missing_credential_rejected(self):
+        s = self._server(SharedSecretAuthenticator("s3cret"))
+        try:
+            ch = Channel()
+            assert ch.init(f"127.0.0.1:{s.port}")  # no auth configured
+            cntl = ch.call_method("a", "echo", b"x")
+            assert cntl.failed()
+            assert cntl.error_code == ErrorCode.ERPCAUTH
+        finally:
+            s.stop()
+
+    def test_wrong_secret_rejected(self):
+        s = self._server(SharedSecretAuthenticator("right"))
+        try:
+            ch = Channel()
+            assert ch.init(
+                f"127.0.0.1:{s.port}",
+                options=ChannelOptions(auth=SharedSecretAuthenticator("wrong")),
+            )
+            cntl = ch.call_method("a", "echo", b"x")
+            assert cntl.failed()
+            assert cntl.error_code == ErrorCode.ERPCAUTH
+        finally:
+            s.stop()
+
+
+class TestMemcache:
+    @pytest.fixture
+    def pair(self):
+        server = memcache.MockMemcacheServer()
+        assert server.start()
+        client = memcache.MemcacheClient(f"127.0.0.1:{server.port}")
+        yield server, client
+        client.close()
+        server.stop()
+
+    def test_store_and_retrieve(self, pair):
+        _, c = pair
+        assert c.set("k", b"v1", flags=7)
+        assert c.get("k") == b"v1"
+        assert c.get("missing") is None
+        assert not c.add("k", b"v2")  # exists
+        assert c.replace("k", b"v2")
+        assert c.get("k") == b"v2"
+        assert c.delete("k")
+        assert not c.delete("k")
+
+    def test_incr_decr(self, pair):
+        _, c = pair
+        assert c.set("n", b"10")
+        assert c.incr("n", 5) == 15
+        assert c.decr("n", 3) == 12
+        assert c.incr("missing") == "NOT_FOUND"
+
+    def test_get_multi_and_version(self, pair):
+        _, c = pair
+        c.set("a", b"1")
+        c.set("b", b"2")
+        assert c.get_multi("a", "b", "zz") == {"a": b"1", "b": b"2"}
+        assert "VERSION" in c.version()
+
+    def test_concurrent_clients(self, pair):
+        _, c = pair
+        errs = []
+
+        def worker(i):
+            try:
+                for j in range(30):
+                    key = f"w{i}"
+                    assert c.set(key, b"%d" % j)
+                    assert c.get(key) is not None
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+
+
+def make_named_server(name: bytes):
+    s = Server()
+    s.add_service("svc", {"echo": (lambda c, r, _n=name: _n + b":" + r)})
+    assert s.start(0)
+    return s
+
+
+class TestDynamicPartitionChannel:
+    def test_mixed_schemes_both_serve(self):
+        # scheme /1 (one whole server) and scheme /2 (two half servers)
+        servers = [make_named_server(b"s%d" % i) for i in range(3)]
+        try:
+            url = "list://" + ",".join(
+                [
+                    f"127.0.0.1:{servers[0].port} 0/1",
+                    f"127.0.0.1:{servers[1].port} 0/2",
+                    f"127.0.0.1:{servers[2].port} 1/2",
+                ]
+            )
+            dpc = DynamicPartitionChannel()
+            assert dpc.init(url)
+            got = set()
+            for _ in range(40):
+                cntl = dpc.call_method("svc", "echo", b"q")
+                assert cntl.ok(), cntl.error_text
+                got.add(cntl.response_payload)
+            # both schemes must have been picked across 40 weighted draws
+            assert b"s0:q" in got  # scheme /1
+            assert b"s1:qs2:q" in got  # scheme /2 fan-out, merged in order
+            dpc.stop()
+        finally:
+            for s in servers:
+                s.stop()
+
+    def test_single_scheme_behaves_like_partition_channel(self):
+        servers = [make_named_server(b"p%d" % i) for i in range(2)]
+        try:
+            url = "list://" + ",".join(
+                f"127.0.0.1:{s.port} {i}/2" for i, s in enumerate(servers)
+            )
+            dpc = DynamicPartitionChannel()
+            assert dpc.init(url)
+            cntl = dpc.call_method("svc", "echo", b"z")
+            assert cntl.ok()
+            assert cntl.response_payload == b"p0:zp1:z"
+            dpc.stop()
+        finally:
+            for s in servers:
+                s.stop()
+
+    def test_no_tagged_servers_fails(self):
+        dpc = DynamicPartitionChannel()
+        assert dpc.init("list://127.0.0.1:1 junktag")
+        cntl = dpc.call_method("svc", "echo", b"x")
+        assert cntl.failed()
+        dpc.stop()
