@@ -100,6 +100,39 @@ class FileNamingService(NamingService):
         return servers
 
 
+class DnsNamingService(NamingService):
+    """dns://host:port — every A record becomes a server, re-resolved each
+    refresh tick (the reference's http:// DomainNamingService,
+    policy/domain_naming_service.cpp). Also registered as http://."""
+
+    def __init__(self, service_name: str):
+        import socket as _pysocket
+
+        super().__init__(service_name)
+        self.poll_interval_s = float(get_flag("ns_refresh_interval_s"))
+        # strip any URL path: "host:port/svc" and "host/svc" are valid
+        # channel targets (the reference's DomainNamingService does the same)
+        authority = service_name.split("/", 1)[0]
+        host, _, port = authority.partition(":")
+        self._host = host
+        self._port = int(port) if port else 80
+        self._pysocket = _pysocket
+
+    def get_servers(self) -> Optional[List[EndPoint]]:
+        try:
+            infos = self._pysocket.getaddrinfo(
+                self._host, self._port, proto=self._pysocket.IPPROTO_TCP
+            )
+        except OSError:
+            return None  # keep the previous list across DNS hiccups
+        seen = []
+        for _, _, _, _, sockaddr in infos:
+            ep = EndPoint(ip=sockaddr[0], port=self._port)
+            if ep not in seen:
+                seen.append(ep)
+        return seen
+
+
 _factories: Dict[str, Callable[[str], NamingService]] = {}
 
 
@@ -111,6 +144,8 @@ def register_naming_service(
 
 register_naming_service("list", ListNamingService)
 register_naming_service("file", FileNamingService)
+register_naming_service("dns", DnsNamingService)
+register_naming_service("http", DnsNamingService)
 
 
 def create_naming_service(url: str) -> NamingService:
@@ -211,6 +246,7 @@ __all__ = [
     "NamingService",
     "ListNamingService",
     "FileNamingService",
+    "DnsNamingService",
     "NamingServiceThread",
     "create_naming_service",
     "register_naming_service",

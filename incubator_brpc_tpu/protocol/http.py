@@ -12,9 +12,12 @@ Client side: ``http_call`` issues one request over a plain blocking socket
 (tests and tools; the reference's full async http client rides the same
 Socket machinery as everything else — ours can once needed).
 
-Not implemented (reference parity gaps, deliberate): chunked
-transfer-encoding, HTTP/2 (the reference fork has HPACK tables but no h2
-framing either — SURVEY §2.4).
+Progressive responses: a handler returning an iterator of byte chunks
+streams Transfer-Encoding: chunked (the ProgressiveAttachment /
+ProgressiveReader analog, progressive_attachment.{h,cpp}); the client
+decoder in ``http_call`` understands chunked bodies. Chunked *request*
+bodies and HTTP/2 remain out of scope (the reference fork has HPACK
+tables but no h2 framing either — SURVEY §2.4).
 """
 
 from __future__ import annotations
@@ -138,6 +141,18 @@ def parse(buf: bytes) -> Tuple[Optional[HttpFrame], int]:
     return frame, total
 
 
+_REASONS = {
+    200: "OK",
+    302: "Found",
+    400: "Bad Request",
+    403: "Forbidden",
+    404: "Not Found",
+    405: "Method Not Allowed",
+    500: "Internal Server Error",
+    503: "Service Unavailable",
+}
+
+
 def build_response(
     status: int = 200,
     body: bytes = b"",
@@ -145,16 +160,7 @@ def build_response(
     extra_headers: Optional[Dict[str, str]] = None,
     keep_alive: bool = True,
 ) -> bytes:
-    reason = {
-        200: "OK",
-        302: "Found",
-        400: "Bad Request",
-        403: "Forbidden",
-        404: "Not Found",
-        405: "Method Not Allowed",
-        500: "Internal Server Error",
-        503: "Service Unavailable",
-    }.get(status, "OK")
+    reason = _REASONS.get(status, "OK")
     lines = [
         f"HTTP/1.1 {status} {reason}",
         f"Content-Length: {len(body)}",
@@ -164,6 +170,66 @@ def build_response(
     for k, v in (extra_headers or {}).items():
         lines.append(f"{k}: {v}")
     return ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1") + body
+
+
+def build_chunked_head(
+    status: int, content_type: str, keep_alive: bool = True
+) -> bytes:
+    reason = _REASONS.get(status, "OK")
+    return (
+        f"HTTP/1.1 {status} {reason}\r\n"
+        f"Content-Type: {content_type}\r\n"
+        "Transfer-Encoding: chunked\r\n"
+        "Connection: " + ("keep-alive" if keep_alive else "close") + "\r\n\r\n"
+    ).encode("latin-1")
+
+
+def build_chunk(data: bytes) -> bytes:
+    return b"%x\r\n%s\r\n" % (len(data), data)
+
+
+CHUNK_END = b"0\r\n\r\n"
+
+
+def _send_progressive(sock, status: int, ctype: str, body_iter, close: bool) -> None:
+    """ProgressiveAttachment analog (reference progressive_attachment.{h,cpp}
+    + ProgressiveReader): headers go out now, chunks stream as the producer
+    yields them — unbounded bodies without buffering. The producer runs on
+    its own fiber so a slow source never pins the reader fiber; the
+    ``_http_stream_done`` gate in sock.context keeps a later pipelined
+    response from interleaving with the stream (HTTP in-order contract)."""
+    import threading as _threading
+
+    from incubator_brpc_tpu.runtime.worker_pool import global_worker_pool
+
+    done = _threading.Event()
+    sock.context["_http_stream_done"] = done
+    if sock.write(build_chunked_head(status, ctype, keep_alive=not close)) != 0:
+        # can't even start the response: the stream is unrecoverable
+        done.set()
+        sock.set_failed()
+        return
+
+    def drain():
+        try:
+            try:
+                for chunk in body_iter:
+                    if chunk:
+                        if sock.write(build_chunk(bytes(chunk))) != 0:
+                            return  # connection gone: stop producing
+            except Exception:
+                logger.exception("progressive body producer raised")
+                sock.set_failed()  # can't signal mid-stream errors in HTTP/1.1
+                return
+            if sock.write(CHUNK_END) != 0:
+                sock.set_failed()  # client must not wait forever for the 0-chunk
+                return
+            if close:
+                _close_when_drained(sock)
+        finally:
+            done.set()
+
+    global_worker_pool().spawn(drain)
 
 
 def process_request(sock, frame: HttpFrame) -> None:
@@ -179,6 +245,32 @@ def process_request(sock, frame: HttpFrame) -> None:
         logger.exception("http handler failed for %s", frame.path)
         status, ctype, body = 500, "text/plain", f"error: {e!r}".encode()
     close = frame.headers.get("connection", "").lower() == "close"
+    # a still-streaming earlier response owns the connection: wait (we run
+    # on the per-socket reader fiber, so blocking preserves wire order)
+    prior = sock.context.get("_http_stream_done")
+    if prior is not None and not prior.wait(timeout=60):
+        sock.set_failed()
+        return
+    if isinstance(body, str):
+        body = body.encode()
+    if (
+        not isinstance(body, (bytes, bytearray, memoryview))
+        and hasattr(body, "__iter__")
+        and not isinstance(body, dict)
+    ):
+        if frame.method == "HEAD":
+            # HEAD responses carry no body: headers only, iterator dropped
+            sock.write(build_chunked_head(status, ctype, keep_alive=not close))
+            if close:
+                _close_when_drained(sock)
+            return
+        # a handler returned an iterator: stream it chunked (progressive)
+        _send_progressive(sock, status, ctype, iter(body), close)
+        return
+    if not isinstance(body, (bytes, bytearray, memoryview)):
+        status, ctype, body = 500, "text/plain", (
+            f"handler returned non-bytes body {type(body).__name__}\n".encode()
+        )
     if frame.method == "HEAD":
         # RFC 9110: Content-Length reflects what GET would return, body
         # omitted — sending it would desync the keep-alive byte stream
@@ -195,22 +287,23 @@ def process_request(sock, frame: HttpFrame) -> None:
             build_response(status, body, content_type=ctype, keep_alive=not close)
         )
     if close:
-        # half-close from our side once the response drains; the client
-        # reads to EOF. A hard set_failed here could cut the queued write.
-        from incubator_brpc_tpu.runtime.timer_thread import global_timer_thread
-        from incubator_brpc_tpu.utils.status import ErrorCode
+        _close_when_drained(sock)
 
-        def _close_when_drained(attempt: int = 0) -> None:
-            with sock._wlock:
-                drained = not sock._wqueue
-            if drained or attempt > 100:
-                sock.set_failed(ErrorCode.ECLOSE, "http connection: close")
-            else:
-                global_timer_thread().schedule(
-                    lambda: _close_when_drained(attempt + 1), delay=0.01
-                )
 
-        _close_when_drained()
+def _close_when_drained(sock, attempt: int = 0) -> None:
+    """Half-close once the response drains; the client reads to EOF. A hard
+    set_failed here could cut the queued write."""
+    from incubator_brpc_tpu.runtime.timer_thread import global_timer_thread
+    from incubator_brpc_tpu.utils.status import ErrorCode
+
+    with sock._wlock:
+        drained = not sock._wqueue
+    if drained or attempt > 100:
+        sock.set_failed(ErrorCode.ECLOSE, "http connection: close")
+    else:
+        global_timer_thread().schedule(
+            lambda: _close_when_drained(sock, attempt + 1), delay=0.01
+        )
 
 
 HTTP = Protocol(
@@ -262,8 +355,30 @@ def http_call(
             if ":" in line:
                 k, v = line.split(":", 1)
                 headers[k.strip().lower()] = v.strip()
-        body_len = int(headers.get("content-length", "0") or "0")
         rest = raw[head_end + 4 :]
+        if "chunked" in headers.get("transfer-encoding", "").lower():
+            # progressive body: read + decode until the 0-length chunk
+            body = b""
+            while True:
+                nl = rest.find(b"\r\n")
+                while nl < 0:
+                    data = conn.recv(65536)
+                    if not data:
+                        return status, headers, body
+                    rest += data
+                    nl = rest.find(b"\r\n")
+                size = int(rest[:nl], 16)
+                need = nl + 2 + size + 2
+                while len(rest) < need:
+                    data = conn.recv(65536)
+                    if not data:
+                        return status, headers, body
+                    rest += data
+                if size == 0:
+                    return status, headers, body
+                body += rest[nl + 2 : nl + 2 + size]
+                rest = rest[need:]
+        body_len = int(headers.get("content-length", "0") or "0")
         while len(rest) < body_len:
             data = conn.recv(65536)
             if not data:

@@ -33,9 +33,14 @@ from __future__ import annotations
 import threading
 from typing import Optional, Tuple
 
+import time as _time
+
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from incubator_brpc_tpu.bvar import Adder, LatencyRecorder
+from incubator_brpc_tpu.ops import framing
 from incubator_brpc_tpu.runtime.butex import Butex, ETIMEDOUT
 from incubator_brpc_tpu.runtime.device_butex import DeviceCompletionButex
 from incubator_brpc_tpu.utils.status import ErrorCode
@@ -85,13 +90,7 @@ class DeviceEndpoint:
         device=None,
         window_size: int = 8,
     ):
-        import jax
-
         from incubator_brpc_tpu.models.tensor_echo import TensorEchoService
-
-        import jax.numpy as jnp
-
-        from incubator_brpc_tpu.ops import framing
 
         self.service = service or TensorEchoService()
         self.device = device if device is not None else jax.devices()[0]
@@ -112,8 +111,6 @@ class DeviceEndpoint:
     # -- credit window (rdma_endpoint.h:176-195) ----------------------------
 
     def _acquire_credit(self, timeout: Optional[float]) -> bool:
-        import time as _time
-
         deadline = None if timeout is None else _time.monotonic() + timeout
         while True:
             c = self._credits.load()
@@ -148,12 +145,6 @@ class DeviceEndpoint:
         """Async: frame → HBM → dispatch fused step → watch completion.
         Returns a _PendingCall the caller can wait on; the credit is held
         until the response settles (the per-WR ack discipline)."""
-        import jax
-        import jax.numpy as jnp
-        import time as _time
-
-        from incubator_brpc_tpu.ops import framing
-
         pending = _PendingCall()
         if not self._acquire_credit(timeout):
             pending.error_code = ErrorCode.EOVERCROWDED
@@ -209,8 +200,6 @@ class DeviceEndpoint:
     ) -> Tuple[int, bytes]:
         """Sync byte adapter: pad to words, run, trim the response to the
         request's byte length (handlers are shape-preserving)."""
-        import time as _time
-
         nbytes = len(payload)
         pad = (-nbytes) % 4
         words = np.frombuffer(payload + b"\x00" * pad, dtype=np.uint32)
@@ -252,8 +241,6 @@ def _parse_response(host_frame: np.ndarray):
     """Host-side parse of a device response frame (the 8-word header layout
     of ops/framing.py, read with numpy — no second device round-trip).
     Word 7 is the error code on responses."""
-    from incubator_brpc_tpu.ops import framing
-
     header = host_frame[: framing.HEADER_WORDS]
     payload = host_frame[framing.HEADER_WORDS :]
     return header, payload, header[7]

@@ -157,6 +157,108 @@ class TestPortal:
         assert status == 200
         assert str(portal_server.port).encode() in body
 
+    def test_progressive_chunked_response(self, portal_server):
+        """ProgressiveAttachment analog: a handler returning an iterator
+        streams chunks; the client sees data before the producer finishes
+        (progressive_attachment.{h,cpp})."""
+        import socket as pysocket
+        import threading
+        import time
+
+        gate = threading.Event()
+
+        def body():
+            yield b"first-chunk"
+            gate.wait(timeout=5)  # hold the stream open until released
+            yield b"second-chunk"
+
+        srv = Server()
+        srv.add_http_handler(
+            "/streamed", lambda frame: (200, "text/plain", body())
+        )
+        assert srv.start(0)
+        try:
+            with pysocket.create_connection(("127.0.0.1", srv.port)) as conn:
+                conn.sendall(b"GET /streamed HTTP/1.1\r\n\r\n")
+                conn.settimeout(5)
+                got = b""
+                while b"first-chunk" not in got:
+                    got += conn.recv(65536)
+                # first chunk arrived while the producer is still blocked
+                assert b"second-chunk" not in got
+                assert b"Transfer-Encoding: chunked" in got
+                gate.set()
+                while b"0\r\n\r\n" not in got:
+                    got += conn.recv(65536)
+                assert b"second-chunk" in got
+        finally:
+            srv.stop()
+
+    def test_pipelined_request_waits_for_stream(self, portal_server):
+        """A pipelined request behind a progressive response must not have
+        its response interleave with the chunks (in-order contract)."""
+        import socket as pysocket
+        import threading
+
+        gate = threading.Event()
+
+        def body():
+            yield b"AAA"
+            gate.wait(timeout=5)
+            yield b"BBB"
+
+        srv = Server()
+        srv.add_http_handler("/s", lambda frame: (200, "text/plain", body()))
+        assert srv.start(0)
+        try:
+            with pysocket.create_connection(("127.0.0.1", srv.port)) as conn:
+                conn.sendall(
+                    b"GET /s HTTP/1.1\r\n\r\nGET /health HTTP/1.1\r\n"
+                    b"Connection: close\r\n\r\n"
+                )
+                conn.settimeout(5)
+                got = b""
+                while b"AAA" not in got:
+                    got += conn.recv(65536)
+                # second response must NOT have arrived mid-stream
+                assert b"OK" not in got.split(b"AAA")[-1]
+                gate.set()
+                while b'HTTP/1.1 200 OK\r\nContent-Length: 2' not in got:
+                    data = conn.recv(65536)
+                    if not data:
+                        break
+                    got += data
+                # stream terminator precedes the second response
+                term = got.find(b"0\r\n\r\n")
+                second = got.find(b"Content-Length: 2")
+                assert 0 < term < second
+        finally:
+            srv.stop()
+
+    def test_str_body_is_coerced(self, portal_server):
+        srv = Server()
+        srv.add_http_handler("/str", lambda frame: (200, "text/plain", "plain-str"))
+        assert srv.start(0)
+        try:
+            status, _, body = http_mod.http_call("127.0.0.1", srv.port, "/str")
+            assert (status, body) == (200, b"plain-str")
+        finally:
+            srv.stop()
+
+    def test_http_call_decodes_chunked(self, portal_server):
+        srv = Server()
+        srv.add_http_handler(
+            "/gen",
+            lambda frame: (200, "text/plain", (b"x%d|" % i for i in range(5))),
+        )
+        assert srv.start(0)
+        try:
+            status, headers, body = http_mod.http_call("127.0.0.1", srv.port, "/gen")
+            assert status == 200
+            assert body == b"x0|x1|x2|x3|x4|"
+        finally:
+            srv.stop()
+
     def test_head_has_no_body(self, portal_server):
         import socket as pysocket
 
