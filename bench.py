@@ -161,6 +161,63 @@ def bench_rpc_echo(results: dict) -> None:
     server.stop()
 
 
+def bench_device_rpc(results: dict) -> None:
+    """The transport=tpu path end to end: RPC over loopback whose handler
+    runs the fused device step (DeviceEndpoint.server_handler)."""
+    from incubator_brpc_tpu.rpc import Channel, Controller, Server
+    from incubator_brpc_tpu.transport.device import DeviceEndpoint
+
+    ep = DeviceEndpoint(window_size=8)
+    server = Server()
+    server.add_service("tensor", {"echo": ep.server_handler()})
+    started = server.start(0)
+    assert started
+    ch = Channel()
+    inited = ch.init(f"127.0.0.1:{server.port}")
+    assert inited
+    payload = b"d" * 256
+    # warm (first call compiles the device program)
+    c = ch.call_method(
+        "tensor", "echo", payload, cntl=Controller(timeout_ms=120000)
+    )
+    assert c.ok(), c.error_text
+
+    # sequential latency
+    n = 20
+    t0 = time.perf_counter()
+    for _ in range(n):
+        c = ch.call_method(
+            "tensor", "echo", payload, cntl=Controller(timeout_ms=30000)
+        )
+        assert c.ok(), c.error_text
+    results["device_rpc_us"] = (time.perf_counter() - t0) / n * 1e6
+
+    # pipelined throughput: 8 callers keep the credit window full so
+    # dispatches and readbacks overlap (the per-WR pipelining the window
+    # exists for)
+    nthreads, per = 8, 10
+    errs = []
+
+    def worker():
+        for _ in range(per):
+            c = ch.call_method(
+                "tensor", "echo", payload, cntl=Controller(timeout_ms=60000)
+            )
+            if c.failed():
+                errs.append(c.error_code)
+
+    threads = [threading.Thread(target=worker) for _ in range(nthreads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    assert not errs, f"{len(errs)} pipelined device RPCs failed"
+    results["device_rpc_qps"] = nthreads * per / dt
+    server.stop()
+
+
 def bench_fabricnet(results: dict) -> None:
     """Flagship train step on the real chip at a bench-scale config."""
     from incubator_brpc_tpu.models import fabricnet
@@ -217,6 +274,7 @@ def main() -> None:
     results: dict = {}
     bench_device_echo(results)
     bench_rpc_echo(results)
+    bench_device_rpc(results)
     bench_fabricnet(results)
 
     gbps = results["large_frame_gbps"]
@@ -235,6 +293,8 @@ def main() -> None:
                     "rpc_echo_us": round(results["rpc_echo_us"], 1),
                     "rpc_echo_qps": round(results["rpc_echo_qps"]),
                     "stream_gbps": round(results["stream_gbps"], 3),
+                    "device_rpc_us": round(results["device_rpc_us"], 1),
+                    "device_rpc_qps": round(results["device_rpc_qps"]),
                     "fabricnet_step_ms": round(results["fabricnet_step_ms"], 2),
                     # null (not 0) when cost analysis was unavailable
                     "fabricnet_tflops": (

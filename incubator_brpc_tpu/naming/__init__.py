@@ -28,9 +28,13 @@ logger = logging.getLogger(__name__)
 
 class NamingService:
     """Base: subclasses produce full server lists. ``poll_interval_s`` of
-    None means one-shot (list://); otherwise PeriodicNamingService."""
+    None means one-shot (list://); otherwise PeriodicNamingService.
+    ``offload_refresh`` = True moves ``get_servers`` off the TimerThread
+    onto a worker fiber (required for anything that does network I/O —
+    a blocked TimerThread stalls every timer in the process)."""
 
     poll_interval_s: Optional[float] = None
+    offload_refresh: bool = False
 
     def __init__(self, service_name: str):
         self.service_name = service_name
@@ -104,6 +108,8 @@ class DnsNamingService(NamingService):
     """dns://host:port — every A record becomes a server, re-resolved each
     refresh tick (the reference's http:// DomainNamingService,
     policy/domain_naming_service.cpp). Also registered as http://."""
+
+    offload_refresh = True  # getaddrinfo can block for seconds
 
     def __init__(self, service_name: str):
         import socket as _pysocket
@@ -202,8 +208,17 @@ class NamingServiceThread:
         )
 
     def _tick(self) -> None:
-        # timer callbacks must be cheap in the reference; a file stat+read is
-        # acceptable here, a remote fetch would hand off to the worker pool
+        # timer callbacks must be cheap; a file stat+read runs inline, a
+        # remote fetch (DNS) hands off to the worker pool and reschedules
+        # only after it finishes (so a slow resolver can't pile up fibers)
+        if self.ns.offload_refresh:
+            from incubator_brpc_tpu.runtime.worker_pool import global_worker_pool
+
+            global_worker_pool().spawn(self._refresh_and_reschedule)
+            return
+        self._refresh_and_reschedule()
+
+    def _refresh_and_reschedule(self) -> None:
         try:
             self._refresh()
         except Exception:

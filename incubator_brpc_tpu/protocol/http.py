@@ -198,15 +198,23 @@ def _send_progressive(sock, status: int, ctype: str, body_iter, close: bool) -> 
     its own fiber so a slow source never pins the reader fiber; the
     ``_http_stream_done`` gate in sock.context keeps a later pipelined
     response from interleaving with the stream (HTTP in-order contract)."""
-    import threading as _threading
-
     from incubator_brpc_tpu.runtime.worker_pool import global_worker_pool
 
-    done = _threading.Event()
+    from incubator_brpc_tpu.runtime.butex import Butex
+
+    # a Butex, not a threading.Event: waiters must count as BLOCKED so the
+    # worker pool grows past them (N stalled streams + N pipelined requests
+    # would otherwise deadlock every carrier thread)
+    done = Butex(0)
     sock.context["_http_stream_done"] = done
+
+    def finish_gate():
+        done.store(1)
+        done.wake_all()
+
     if sock.write(build_chunked_head(status, ctype, keep_alive=not close)) != 0:
         # can't even start the response: the stream is unrecoverable
-        done.set()
+        finish_gate()
         sock.set_failed()
         return
 
@@ -216,7 +224,12 @@ def _send_progressive(sock, status: int, ctype: str, body_iter, close: bool) -> 
                 for chunk in body_iter:
                     if chunk:
                         if sock.write(build_chunk(bytes(chunk))) != 0:
-                            return  # connection gone: stop producing
+                            # EVERY mid-stream write failure (including
+                            # transient EOVERCROWDED) kills the connection:
+                            # a truncated chunk stream on a live socket
+                            # would desync everything after it
+                            sock.set_failed()
+                            return
             except Exception:
                 logger.exception("progressive body producer raised")
                 sock.set_failed()  # can't signal mid-stream errors in HTTP/1.1
@@ -227,7 +240,7 @@ def _send_progressive(sock, status: int, ctype: str, body_iter, close: bool) -> 
             if close:
                 _close_when_drained(sock)
         finally:
-            done.set()
+            finish_gate()
 
     global_worker_pool().spawn(drain)
 
@@ -246,11 +259,15 @@ def process_request(sock, frame: HttpFrame) -> None:
         status, ctype, body = 500, "text/plain", f"error: {e!r}".encode()
     close = frame.headers.get("connection", "").lower() == "close"
     # a still-streaming earlier response owns the connection: wait (we run
-    # on the per-socket reader fiber, so blocking preserves wire order)
+    # on the per-socket reader fiber, so blocking preserves wire order;
+    # the butex wait counts as blocked → the pool grows a replacement)
     prior = sock.context.get("_http_stream_done")
-    if prior is not None and not prior.wait(timeout=60):
-        sock.set_failed()
-        return
+    if prior is not None and prior.load() == 0:
+        from incubator_brpc_tpu.runtime.butex import ETIMEDOUT as _ETIMEDOUT
+
+        if prior.wait(0, timeout=60) == _ETIMEDOUT and prior.load() == 0:
+            sock.set_failed()
+            return
     if isinstance(body, str):
         body = body.encode()
     if (
@@ -367,7 +384,7 @@ def http_call(
                         return status, headers, body
                     rest += data
                     nl = rest.find(b"\r\n")
-                size = int(rest[:nl], 16)
+                size = int(rest[:nl].split(b";")[0], 16)  # tolerate extensions
                 need = nl + 2 + size + 2
                 while len(rest) < need:
                     data = conn.recv(65536)

@@ -66,7 +66,15 @@ def _watcher_pool() -> _WatcherPool:
     if _watchers is None:
         with _watchers_lock:
             if _watchers is None:
-                _watchers = _WatcherPool()
+                # sized by flag (the reference's rdma_cq_num, CQ poller
+                # count rdma_completion_queue.cpp:39-55): completion
+                # handlers do the host readback, so this bounds how many
+                # device→host fetches overlap
+                from incubator_brpc_tpu.utils.flags import get_flag
+
+                _watchers = _WatcherPool(
+                    max(1, int(get_flag("device_cq_threads")))
+                )
     return _watchers
 
 
