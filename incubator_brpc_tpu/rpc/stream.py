@@ -37,6 +37,7 @@ from incubator_brpc_tpu.protocol.tbus_std import (
     Meta,
     ParsedFrame,
     pack_frame,
+    pack_frame_iobuf,
 )
 from incubator_brpc_tpu.runtime.butex import Butex, ETIMEDOUT
 from incubator_brpc_tpu.runtime.execution_queue import ExecutionQueue, TaskIterator
@@ -167,7 +168,8 @@ class Stream:
             if blocked and self._wbutex.wait(seq, timeout=remaining) == ETIMEDOUT:
                 return ErrorCode.EAGAIN
         meta = Meta(stream_id=rid, extra={"ft": FT_DATA, "from": self.id})
-        rc = sock.write(pack_frame(meta, data, 0, flags=FLAG_STREAM))
+        # IOBuf pack: no body/frame concat copies on the data hot path
+        rc = sock.write(pack_frame_iobuf(meta, data, 0, flags=FLAG_STREAM))
         if rc == ErrorCode.EOVERCROWDED:
             # transient socket backpressure (socket.cpp:1537): surface it,
             # don't kill the stream

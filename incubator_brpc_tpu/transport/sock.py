@@ -123,6 +123,9 @@ class Socket:
         context: Optional[Dict] = None,
     ):
         conn.setblocking(False)
+        # NOTE: no explicit SO_RCVBUF/SO_SNDBUF — setting them disables
+        # kernel autotuning and is silently clamped to rmem_max/wmem_max,
+        # which SHRINKS effective buffers on stock kernels (measured)
         self._conn = conn
         self.fd = conn.fileno()
         self.remote = remote
@@ -276,7 +279,7 @@ class Socket:
             if not self._acquire_io():
                 return True
             try:
-                rc = front.buf.cut_into_fd(self.fd, 1 << 20)
+                rc = front.buf.cut_into_fd(self.fd, 4 << 20)
             finally:
                 self._release_io()
             if rc > 0:
@@ -360,11 +363,15 @@ class Socket:
             return
         try:
             eof = False
+            # must equal what one native readv can actually deliver
+            # (kMaxIov x default block size, tbutil.cc): a larger ask would
+            # make every full read look "short" and kill the drain loop
+            read_chunk = 64 * 8192
             while True:
-                rc = self._read_buf.append_from_fd(self.fd, 1 << 18)
+                rc = self._read_buf.append_from_fd(self.fd, read_chunk)
                 if rc > 0:
                     in_bytes << rc
-                    if rc < (1 << 18):
+                    if rc < read_chunk:
                         break  # short read: kernel buffer drained
                     continue
                 if rc == 0:

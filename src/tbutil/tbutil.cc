@@ -55,7 +55,10 @@ GlobalBlockCache g_block_cache;
 
 // Per-thread cache (reference keeps <=8 blocks/thread, iobuf.cpp:355-430).
 struct TlsBlockCache {
-  static constexpr size_t kMax = 8;
+  // sized to one full readv burst so the read loop recycles blocks
+  // through the TLS cache instead of malloc (reference keeps 8/thread;
+  // our reader frees a whole burst at once after the cut)
+  static constexpr size_t kMax = 64;
   Block* head = nullptr;
   size_t count = 0;
   ~TlsBlockCache();
@@ -471,7 +474,10 @@ long tb_iobuf_cut_into_fd(tb_iobuf* b, int fd, size_t max_bytes) {
 }
 
 long tb_iobuf_append_from_fd(tb_iobuf* b, int fd, size_t max_bytes) {
-  constexpr int kMaxIov = 8;
+  // 64 iovecs of default (8KB) blocks = 512KB per readv: the bytes-per-
+  // event ceiling of the reader loop (the reference's IOPortal reads with
+  // a comparable iovec budget; 8 iovecs capped loopback at ~64KB/event)
+  constexpr int kMaxIov = 64;
   Block* blocks[kMaxIov];
   struct iovec iov[kMaxIov];
   int niov = 0;
