@@ -319,6 +319,13 @@ def block_size() -> int:
     return LIB.tb_block_size() if LIB is not None else 8192
 
 
+def read_burst_bytes() -> int:
+    """Bytes one append_from_fd readv can deliver (native iovec budget ×
+    current block size) — read loops must size asks and short-read tests
+    from this, not a magic constant."""
+    return LIB.tb_iobuf_read_burst() if LIB is not None else 1 << 16
+
+
 def block_pool_stats() -> dict:
     if LIB is None:
         return {"live": -1, "cached": -1}

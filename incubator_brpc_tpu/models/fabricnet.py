@@ -14,8 +14,11 @@ training step over the fabric mesh (SURVEY.md §2.5):
 - **pp pipeline** — chained streaming RPC: GPipe microbatch schedule whose
   stage handoff is a ppermute ring over 'pp' (the credit-window stream of
   stream.cpp with window=1 frame in flight per neighbor).
-- **sp sequence ring** — ring exchange over 'sp' built on
-  parallel.collective.ring_stream (ring-attention-style context pass).
+- **sp sequence ring** — with ``heads > 0`` (the default), EXACT causal
+  ring attention over 'sp' (models/ring_attention.py: KV blocks rotate the
+  ring, online-softmax accumulation — the long-context slot); with
+  ``heads == 0`` the lighter ring-mean context pass built on
+  parallel.collective.ring_stream.
 - **ep expert exchange** — DynamicPartitionChannel
   (partition_channel.h:134): static round-robin token routing via all_to_all
   over 'ep'.
@@ -49,13 +52,14 @@ class FabricNetConfig:
     batch: int = 8  # global; must divide by dp*ep*microbatches
     seq: int = 16  # global; must divide by sp
     microbatches: int = 2
+    heads: int = 2  # ring-attention heads; 0 = ring-mean context instead
     lr: float = 1e-2
     dtype: jnp.dtype = jnp.float32
 
 
-def param_specs() -> Dict[str, P]:
+def param_specs(heads: int) -> Dict[str, P]:
     """PartitionSpecs for the param pytree (leading 'pp' = pipeline stage)."""
-    return {
+    specs = {
         "w_in": P("pp", None, None, "tp"),
         "w_out": P("pp", None, "tp", None),
         "moe_w1": P("pp", "ep", None, None),
@@ -63,6 +67,11 @@ def param_specs() -> Dict[str, P]:
         "gate": P("pp", None, None),
         "head": P(),
     }
+    if heads:
+        # attention projections replicated across tp (sp is their axis)
+        specs["wqkv"] = P("pp", None, None, None)
+        specs["wo"] = P("pp", None, None)
+    return specs
 
 
 def batch_specs() -> Tuple[P, P]:
@@ -78,8 +87,8 @@ def init_params(cfg: FabricNetConfig, mesh: jax.sharding.Mesh, seed: int = 0):
     d, f, fe = cfg.d_model, cfg.d_ff, cfg.d_expert
     L = cfg.layers_per_stage
     E = cfg.experts_per_rank * ep
-    keys = jax.random.split(jax.random.key(seed), 6)
-    specs = param_specs()
+    keys = jax.random.split(jax.random.key(seed), 8)
+    specs = param_specs(cfg.heads)
 
     def mk(key, shape, spec, scale):
         # scale is a numpy float64 scalar — multiply in the target dtype or
@@ -89,7 +98,7 @@ def init_params(cfg: FabricNetConfig, mesh: jax.sharding.Mesh, seed: int = 0):
         )
         return jax.device_put(arr, NamedSharding(mesh, spec))
 
-    return {
+    params = {
         "w_in": mk(keys[0], (pp, L, d, f), specs["w_in"], 1.0 / np.sqrt(d)),
         "w_out": mk(keys[1], (pp, L, f, d), specs["w_out"], 1.0 / np.sqrt(f)),
         "moe_w1": mk(keys[2], (pp, E, d, fe), specs["moe_w1"], 1.0 / np.sqrt(d)),
@@ -97,6 +106,12 @@ def init_params(cfg: FabricNetConfig, mesh: jax.sharding.Mesh, seed: int = 0):
         "gate": mk(keys[4], (pp, d, 1), specs["gate"], 1.0 / np.sqrt(d)),
         "head": mk(keys[5], (d, d), specs["head"], 1.0 / np.sqrt(d)),
     }
+    if cfg.heads:
+        params["wqkv"] = mk(
+            keys[6], (pp, 3, d, d), specs["wqkv"], 1.0 / np.sqrt(d)
+        )
+        params["wo"] = mk(keys[7], (pp, d, d), specs["wo"], 1.0 / np.sqrt(d))
+    return params
 
 
 def _rms_norm(x: jnp.ndarray) -> jnp.ndarray:
@@ -158,18 +173,38 @@ def _moe(moe_w1, moe_w2, gate_w, x):
     return (ungrouped * g).reshape(mb, sl, d)
 
 
-def _stage_fn(sp_params, x):
-    """One pipeline stage: L residual [tp-MLP] layers + sp ring context +
-    ep MoE block."""
+def _ring_attn_block(wqkv, wo, heads, x):
+    """Causal ring attention over 'sp' (models/ring_attention.py) with
+    per-stage projections — the long-context sequence-parallel block."""
+    from incubator_brpc_tpu.models.ring_attention import ring_attention
+
+    mb, sl, d = x.shape
+    q = (x @ wqkv[0]).reshape(mb, sl, heads, d // heads)
+    k = (x @ wqkv[1]).reshape(mb, sl, heads, d // heads)
+    v = (x @ wqkv[2]).reshape(mb, sl, heads, d // heads)
+    out = ring_attention(q, k, v, axis="sp", causal=True)
+    return out.reshape(mb, sl, d) @ wo
+
+
+def _stage_fn(sp_params, heads, x):
+    """One pipeline stage: L residual [tp-MLP] layers + sp sequence block
+    (ring attention, or ring-mean context when heads=0) + ep MoE block.
+    ``heads`` is static config, threaded via partial — never through the
+    (traced-array) param pytree."""
     L = sp_params["w_in"].shape[0]
     for l in range(L):
         x = x + _mlp_tp(sp_params["w_in"][l], sp_params["w_out"][l], _rms_norm(x))
-    x = x + _ring_context(x)
+    if heads:
+        x = x + _ring_attn_block(
+            sp_params["wqkv"], sp_params["wo"], heads, _rms_norm(x)
+        )
+    else:
+        x = x + _ring_context(x)
     x = x + _moe(sp_params["moe_w1"], sp_params["moe_w2"], sp_params["gate"], _rms_norm(x))
     return x
 
 
-def _pipeline(sp_params, xs):
+def _pipeline(stage, xs):
     """GPipe over 'pp': scan of M + pp - 1 ticks; stage handoff is a
     ppermute ring (streaming-RPC frame to the right neighbor each tick)."""
     pp = lax.axis_size("pp")
@@ -182,7 +217,7 @@ def _pipeline(sp_params, xs):
     def tick(carry, t):
         buf, outs = carry
         inp = jnp.where(sidx == 0, xs[jnp.clip(t, 0, m - 1)], buf)
-        out = _stage_fn(sp_params, inp)
+        out = stage(inp)
         ot = t - (pp - 1)
         valid = (ot >= 0) & (ot < m) & (sidx == pp - 1)
         outs = jnp.where(valid, outs.at[jnp.clip(ot, 0, m - 1)].set(out), outs)
@@ -205,10 +240,13 @@ def _local_forward(cfg: FabricNetConfig, params, x):
         "moe_w2": params["moe_w2"][0],
         "gate": params["gate"][0],
     }
+    if cfg.heads:
+        sp_params["wqkv"] = params["wqkv"][0]
+        sp_params["wo"] = params["wo"][0]
     bl, sl, d = x.shape
     m = cfg.microbatches
     xs = x.reshape(m, bl // m, sl, d)
-    outs = _pipeline(sp_params, xs)
+    outs = _pipeline(partial(_stage_fn, sp_params, cfg.heads), xs)
     out = outs.reshape(bl, sl, d)
     return out @ params["head"]
 
@@ -225,7 +263,7 @@ def make_forward_step(cfg: FabricNetConfig, mesh: jax.sharding.Mesh):
     fwd = jax.shard_map(
         partial(_local_forward, cfg),
         mesh=mesh,
-        in_specs=(param_specs(), x_spec),
+        in_specs=(param_specs(cfg.heads), x_spec),
         out_specs=x_spec,
         check_vma=False,
     )
@@ -239,7 +277,7 @@ def make_train_step(cfg: FabricNetConfig, mesh: jax.sharding.Mesh):
     loss_fn = jax.shard_map(
         partial(_local_loss, cfg),
         mesh=mesh,
-        in_specs=(param_specs(), x_spec, y_spec),
+        in_specs=(param_specs(cfg.heads), x_spec, y_spec),
         out_specs=P(),
         check_vma=False,
     )
@@ -270,6 +308,8 @@ def validate_config(cfg: FabricNetConfig, mesh: jax.sharding.Mesh) -> None:
     bl = cfg.batch // (dp * ep)
     assert bl % cfg.microbatches == 0, "local batch must divide microbatches"
     assert cfg.seq % sp == 0, "seq must divide by sp"
+    if cfg.heads:
+        assert cfg.d_model % cfg.heads == 0, "d_model must divide by heads"
     t = (bl // cfg.microbatches) * (cfg.seq // sp)
     assert t % ep == 0, "local tokens must divide by ep"
     assert t % (cfg.experts_per_rank * ep) == 0, "local tokens must divide experts"

@@ -39,6 +39,7 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
             None,
             [ctypes.POINTER(ctypes.c_size_t), ctypes.POINTER(ctypes.c_size_t)],
         ),
+        "tb_iobuf_read_burst": (ctypes.c_size_t, []),
         "tb_iobuf_create": (b, []),
         "tb_iobuf_destroy": (None, [b]),
         "tb_iobuf_clear": (None, [b]),

@@ -363,10 +363,12 @@ class Socket:
             return
         try:
             eof = False
-            # must equal what one native readv can actually deliver
-            # (kMaxIov x default block size, tbutil.cc): a larger ask would
-            # make every full read look "short" and kill the drain loop
-            read_chunk = 64 * 8192
+            # must equal what one native readv can actually deliver: a
+            # larger ask would make every full read look "short" and kill
+            # the drain loop
+            from incubator_brpc_tpu.iobuf import read_burst_bytes
+
+            read_chunk = read_burst_bytes()
             while True:
                 rc = self._read_buf.append_from_fd(self.fd, read_chunk)
                 if rc > 0:

@@ -473,11 +473,17 @@ long tb_iobuf_cut_into_fd(tb_iobuf* b, int fd, size_t max_bytes) {
   return nw;
 }
 
+// iovec budget per readv: 64 default blocks = 512KB/burst — the bytes-
+// per-event ceiling of the reader loop (the reference's IOPortal reads
+// with a comparable budget; 8 iovecs capped loopback at ~64KB/event)
+constexpr int kReadIovBudget = 64;
+
+size_t tb_iobuf_read_burst(void) {
+  return kReadIovBudget * g_default_block_size.load(std::memory_order_relaxed);
+}
+
 long tb_iobuf_append_from_fd(tb_iobuf* b, int fd, size_t max_bytes) {
-  // 64 iovecs of default (8KB) blocks = 512KB per readv: the bytes-per-
-  // event ceiling of the reader loop (the reference's IOPortal reads with
-  // a comparable iovec budget; 8 iovecs capped loopback at ~64KB/event)
-  constexpr int kMaxIov = 64;
+  constexpr int kMaxIov = kReadIovBudget;
   Block* blocks[kMaxIov];
   struct iovec iov[kMaxIov];
   int niov = 0;

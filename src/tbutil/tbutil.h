@@ -44,6 +44,10 @@ void tb_set_block_size(size_t bytes);
 size_t tb_block_size(void);
 // blocks currently live (allocated - freed), blocks parked in caches.
 void tb_block_pool_stats(size_t* live, size_t* cached);
+// bytes one tb_iobuf_append_from_fd readv can deliver (iovec budget x
+// current block size) — read loops size their asks and short-read tests
+// from this so the contract lives in ONE place.
+size_t tb_iobuf_read_burst(void);
 
 // ---- IOBuf ----
 tb_iobuf* tb_iobuf_create(void);

@@ -61,7 +61,7 @@ def test_sharded_forward_matches_single_device():
     # move identical params/batch onto the 8-device mesh shardings
     from jax.sharding import NamedSharding
 
-    specs = fabricnet.param_specs()
+    specs = fabricnet.param_specs(cfg.heads)
     params8 = {
         k: jax.device_put(np.asarray(v), NamedSharding(mesh8, specs[k]))
         for k, v in params1.items()
