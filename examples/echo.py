@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""echo — the canonical client/server pair (reference example/echo_c++:
+EchoService::Echo returns the request, client prints the round trip).
+
+Run server:  python examples/echo.py server [port]
+Run client:  python examples/echo.py client <port> [message]
+Or demo both in one process:  python examples/echo.py demo
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+from incubator_brpc_tpu.rpc import Channel, Server  # noqa: E402
+
+
+def make_server(port: int = 0) -> Server:
+    server = Server()
+
+    def echo(cntl, request: bytes) -> bytes:
+        # attachment flows back untouched, like the reference example
+        cntl.response_attachment = cntl.request_attachment
+        return request
+
+    server.add_service("EchoService", {"Echo": echo})
+    assert server.start(port)
+    print(f"EchoServer listening on {server.listen_endpoint} "
+          f"(portal: http://127.0.0.1:{server.port}/status)")
+    return server
+
+
+def run_client(port: int, message: str = "hello world") -> None:
+    ch = Channel()
+    assert ch.init(f"127.0.0.1:{port}")
+    cntl = ch.call_method(
+        "EchoService", "Echo", message.encode(), attachment=b"piggyback"
+    )
+    if cntl.failed():
+        raise SystemExit(f"RPC failed: {cntl.error_text}")
+    print(f"response={cntl.response_payload!r} "
+          f"attachment={cntl.response_attachment!r} "
+          f"latency={cntl.latency_us:.0f}us")
+
+
+def main() -> None:
+    mode = sys.argv[1] if len(sys.argv) > 1 else "demo"
+    if mode == "server":
+        server = make_server(int(sys.argv[2]) if len(sys.argv) > 2 else 8000)
+        try:
+            import time
+
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            server.stop()
+    elif mode == "client":
+        run_client(int(sys.argv[2]), *(sys.argv[3:4] or []))
+    else:
+        server = make_server(0)
+        run_client(server.port)
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+#!/usr/bin/env python
+"""parallel_echo — scatter/gather over a ParallelChannel (reference
+example/parallel_echo_c++): one call fans out to N sub-channels, responses
+merge in channel order. Run: python examples/parallel_echo.py
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+from incubator_brpc_tpu.rpc import Channel, ParallelChannel, Server  # noqa: E402
+
+
+def main() -> None:
+    servers = []
+    for i in range(3):
+        s = Server()
+        s.add_service(
+            "EchoService", {"Echo": (lambda c, req, _i=i: b"[replica%d]%s" % (_i, req))}
+        )
+        assert s.start(0)
+        servers.append(s)
+
+    pc = ParallelChannel()  # default fail_limit: succeeds unless ALL fail
+    for s in servers:
+        ch = Channel()
+        assert ch.init(f"127.0.0.1:{s.port}")
+        pc.add_channel(ch)
+
+    cntl = pc.call_method("EchoService", "Echo", b"fanout")
+    assert cntl.ok(), cntl.error_text
+    print(f"merged response: {cntl.response_payload!r}")
+    for s in servers:
+        s.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -144,8 +144,16 @@ def load():
             return None
         try:
             _lib = _declare(ctypes.CDLL(_LIB_PATH))
-        except OSError:
-            return None
+        except (OSError, AttributeError):
+            # AttributeError = stale prebuilt .so missing a newer symbol:
+            # rebuild once and retry before giving up (the pure-Python
+            # fallback must win over an import-time crash)
+            if not _build():
+                return None
+            try:
+                _lib = _declare(ctypes.CDLL(_LIB_PATH))
+            except (OSError, AttributeError):
+                return None
         return _lib
 
 

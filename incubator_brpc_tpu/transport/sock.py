@@ -30,7 +30,7 @@ from collections import deque
 from typing import Callable, Dict, List, Optional, Union
 
 from incubator_brpc_tpu.bvar import Adder
-from incubator_brpc_tpu.iobuf import IOBuf
+from incubator_brpc_tpu.iobuf import IOBuf, read_burst_bytes
 from incubator_brpc_tpu.runtime.butex import Butex
 from incubator_brpc_tpu.runtime.worker_pool import global_worker_pool
 from incubator_brpc_tpu.transport.event_dispatcher import (
@@ -366,8 +366,6 @@ class Socket:
             # must equal what one native readv can actually deliver: a
             # larger ask would make every full read look "short" and kill
             # the drain loop
-            from incubator_brpc_tpu.iobuf import read_burst_bytes
-
             read_chunk = read_burst_bytes()
             while True:
                 rc = self._read_buf.append_from_fd(self.fd, read_chunk)

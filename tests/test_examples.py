@@ -1,0 +1,25 @@
+"""Every example pair must keep running (the reference treats example/
+as living documentation; SURVEY §1 L7). Each runs in-process on the
+virtual mesh — tensor_echo_tpu is exercised via its own module path in
+test_device_transport, so only the host-plane examples run here."""
+
+import runpy
+import sys
+
+import pytest
+
+EXAMPLES = [
+    "examples/echo.py",
+    "examples/parallel_echo.py",
+    "examples/streaming_echo.py",
+    "examples/partition_echo.py",
+    "examples/backup_request.py",
+]
+
+
+@pytest.mark.parametrize("path", EXAMPLES)
+def test_example_runs(path, monkeypatch, capsys):
+    monkeypatch.setattr(sys, "argv", [path])
+    runpy.run_path(path, run_name="__main__")
+    out = capsys.readouterr().out
+    assert out.strip()  # every example prints its result

@@ -71,6 +71,28 @@ def test_sharded_forward_matches_single_device():
     np.testing.assert_allclose(np.asarray(out1), np.asarray(out8), rtol=2e-4, atol=2e-5)
 
 
+def test_heads_zero_ring_mean_path():
+    """The heads=0 fallback (ring-mean context instead of ring attention)
+    must keep training — otherwise the branch rots untested."""
+    import jax
+
+    from incubator_brpc_tpu.parallel.mesh import make_fabric_mesh
+
+    mesh = make_fabric_mesh(
+        8, axis_sizes={"dp": 2, "pp": 1, "tp": 2, "sp": 2, "ep": 1}
+    )
+    cfg = fabricnet.FabricNetConfig(heads=0)
+    fabricnet.validate_config(cfg, mesh)
+    params = fabricnet.init_params(cfg, mesh)
+    assert "wqkv" not in params
+    x, y = fabricnet.make_batch(cfg, mesh)
+    step = fabricnet.make_train_step(cfg, mesh)
+    params, l0 = step(params, x, y)
+    for _ in range(5):
+        params, loss = step(params, x, y)
+    assert float(loss) < float(l0)
+
+
 def test_graft_entry_dryrun():
     import __graft_entry__ as ge
 
