@@ -144,16 +144,22 @@ def load():
             return None
         try:
             _lib = _declare(ctypes.CDLL(_LIB_PATH))
-        except (OSError, AttributeError):
-            # AttributeError = stale prebuilt .so missing a newer symbol:
-            # rebuild once and retry before giving up (the pure-Python
-            # fallback must win over an import-time crash)
-            if not _build():
-                return None
-            try:
-                _lib = _declare(ctypes.CDLL(_LIB_PATH))
-            except (OSError, AttributeError):
-                return None
+        except OSError:
+            return None
+        except AttributeError:
+            # Stale prebuilt .so missing a newer symbol. The library is
+            # already dlopen'd into THIS process (ctypes never dlcloses and
+            # dlopen dedupes by path), so a rebuild cannot help until the
+            # next interpreter: rebuild for that one, fall back to pure
+            # Python now instead of crashing package import.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "libtbutil.so is stale (missing symbol); rebuilding for the "
+                "next process and using the pure-Python fallback in this one"
+            )
+            _build()
+            return None
         return _lib
 
 

@@ -104,6 +104,8 @@ class Channel:
             self._options = options
         if isinstance(target, EndPoint):
             self._single_server = target
+        elif str(target).startswith("unix://"):
+            self._single_server = str2endpoint(str(target))
         elif "://" in str(target):
             from incubator_brpc_tpu.lb import LoadBalancerWithNaming
 

@@ -182,7 +182,7 @@ class Server:
         if isinstance(listen, int):
             ep = EndPoint(ip="127.0.0.1", port=listen)
         elif isinstance(listen, str):
-            ep = str2endpoint(listen)
+            ep = str2endpoint(listen)  # "ip:port" or "unix:///path"
         else:
             ep = listen
         self._acceptor = Acceptor(

@@ -43,13 +43,42 @@ class Acceptor:
         self._accepting = False
         self._stopped = False
 
-        lsock = _pysocket.socket(_pysocket.AF_INET, _pysocket.SOCK_STREAM)
-        lsock.setsockopt(_pysocket.SOL_SOCKET, _pysocket.SO_REUSEADDR, 1)
-        lsock.bind((endpoint.ip, endpoint.port))
+        self._unix_path: Optional[str] = None
+        if endpoint.ip.startswith("unix://"):
+            import os as _os
+
+            path = endpoint.ip[len("unix://"):]
+            if _os.path.exists(path):
+                # only a DEAD socket file may be unlinked: hijacking a live
+                # listener would silently black-hole its traffic (the TCP
+                # branch gets this from EADDRINUSE)
+                probe = _pysocket.socket(_pysocket.AF_UNIX, _pysocket.SOCK_STREAM)
+                try:
+                    probe.settimeout(0.2)
+                    probe.connect(path)
+                    probe.close()
+                    raise OSError(f"unix socket {path} has a live listener")
+                except (ConnectionRefusedError, FileNotFoundError, TimeoutError):
+                    probe.close()
+                    try:
+                        _os.unlink(path)
+                    except OSError:
+                        pass
+            lsock = _pysocket.socket(_pysocket.AF_UNIX, _pysocket.SOCK_STREAM)
+            lsock.bind(path)
+            self._unix_path = path
+            resolved = endpoint
+        else:
+            lsock = _pysocket.socket(_pysocket.AF_INET, _pysocket.SOCK_STREAM)
+            lsock.setsockopt(_pysocket.SOL_SOCKET, _pysocket.SO_REUSEADDR, 1)
+            lsock.bind((endpoint.ip, endpoint.port))
+            resolved = None  # filled after listen (ephemeral port)
         lsock.listen(backlog)
         lsock.setblocking(False)
         self._lsock = lsock
-        self.endpoint = EndPoint(ip=endpoint.ip, port=lsock.getsockname()[1])
+        self.endpoint = resolved or EndPoint(
+            ip=endpoint.ip, port=lsock.getsockname()[1]
+        )
         self._dispatcher = global_dispatcher(lsock.fileno())
         self._pool = global_worker_pool()
         self._dispatcher.add_consumer(lsock.fileno(), self._on_event, EVENT_IN)
@@ -118,6 +147,13 @@ class Acceptor:
             self._lsock.close()
         except OSError:
             pass
+        if self._unix_path is not None:
+            import os as _os
+
+            try:
+                _os.unlink(self._unix_path)  # no stale socket file left behind
+            except OSError:
+                pass
         if close_connections:
             for sock in self.connections():
                 sock.set_failed(ErrorCode.ECLOSE, "acceptor stopped")

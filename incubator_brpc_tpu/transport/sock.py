@@ -189,8 +189,13 @@ class Socket:
         """Client connect (bthread_connect analog: blocking a fiber/thread,
         never the reactor)."""
         ep = str2endpoint(remote) if isinstance(remote, str) else remote
-        conn = _pysocket.create_connection((ep.ip, ep.port), timeout=timeout)
-        conn.setsockopt(_pysocket.IPPROTO_TCP, _pysocket.TCP_NODELAY, 1)
+        if ep.ip.startswith("unix://"):
+            conn = _pysocket.socket(_pysocket.AF_UNIX, _pysocket.SOCK_STREAM)
+            conn.settimeout(timeout)
+            conn.connect(ep.ip[len("unix://"):])
+        else:
+            conn = _pysocket.create_connection((ep.ip, ep.port), timeout=timeout)
+            conn.setsockopt(_pysocket.IPPROTO_TCP, _pysocket.TCP_NODELAY, 1)
         return cls(conn, ep, messenger=messenger, is_client=True, **kwargs)
 
     @classmethod
@@ -472,9 +477,16 @@ class Socket:
         if self.state != FAILED:
             return  # recycled or already revived: stop probing
         try:
-            conn = _pysocket.create_connection(
-                (self.remote.ip, self.remote.port), timeout=2.0
-            )
+            if self.remote.ip.startswith("unix://"):
+                conn = _pysocket.socket(
+                    _pysocket.AF_UNIX, _pysocket.SOCK_STREAM
+                )
+                conn.settimeout(2.0)
+                conn.connect(self.remote.ip[len("unix://"):])
+            else:
+                conn = _pysocket.create_connection(
+                    (self.remote.ip, self.remote.port), timeout=2.0
+                )
         except OSError:
             self._schedule_health_check()
             return

@@ -76,6 +76,11 @@ def str2endpoint(s: str, default_port: int = 0) -> EndPoint:
     """
     s = s.strip()
     device = None
+    if s.startswith("unix://"):
+        # unix domain sockets (reference butil/unix_socket.cpp): the whole
+        # "unix://<path>" travels in ip with port 0 — every consumer
+        # (Socket, Acceptor, SocketMap keys) branches on the prefix
+        return EndPoint(ip=s, port=0)
     if s.startswith("tpu://"):
         rest = s[len("tpu://"):]
         if "/" in rest:

@@ -270,6 +270,65 @@ def test_garbage_input_fails_connection(echo_server):
         raw.close()
 
 
+def test_unix_domain_socket_rpc(tmp_path):
+    """unix:// endpoints work end to end (reference butil/unix_socket.cpp
+    + Server listening on a unix path)."""
+    from incubator_brpc_tpu.rpc import Channel, Server
+
+    path = str(tmp_path / "echo.sock")
+    server = Server()
+    server.add_service("u", {"echo": lambda c, r: r[::-1]})
+    assert server.start(f"unix://{path}")
+    try:
+        ch = Channel()
+        assert ch.init(f"unix://{path}")
+        cntl = ch.call_method("u", "echo", b"abcdef")
+        assert cntl.ok(), cntl.error_text
+        assert cntl.response_payload == b"fedcba"
+    finally:
+        server.stop()
+        server.join(timeout=5)
+
+
+def test_unix_socket_lifecycle(tmp_path):
+    """stop() unlinks the path; a live listener can't be hijacked; a stale
+    file from a dead server is cleaned and rebound."""
+    import os
+
+    from incubator_brpc_tpu.rpc import Channel, Server
+
+    path = str(tmp_path / "life.sock")
+    a = Server()
+    a.add_service("u", {"e": lambda c, r: r})
+    assert a.start(f"unix://{path}")
+    # a second bind on a LIVE path must fail loudly, not hijack
+    b = Server()
+    b.add_service("u", {"e": lambda c, r: r})
+    with pytest.raises(OSError):
+        b.start(f"unix://{path}")
+    a.stop()
+    a.join(timeout=5)
+    assert not os.path.exists(path)  # clean shutdown removed the file
+    # a stale file from a crashed server is unlinked and rebound
+    open(path, "w").close()  # not even a socket: bind must still work? no —
+    os.unlink(path)  # (plain files are not probe-able sockets; keep it real)
+    import socket as pysock
+
+    dead = pysock.socket(pysock.AF_UNIX, pysock.SOCK_STREAM)
+    dead.bind(path)
+    dead.close()  # bound then closed WITHOUT unlink: classic stale file
+    c = Server()
+    c.add_service("u", {"e": lambda cx, r: r + r})
+    assert c.start(f"unix://{path}")
+    try:
+        ch = Channel()
+        assert ch.init(f"unix://{path}")
+        assert ch.call_method("u", "e", b"xy").response_payload == b"xyxy"
+    finally:
+        c.stop()
+        c.join(timeout=5)
+
+
 def test_fragmented_frame_reassembles(echo_server):
     """Resumable cut: a frame dribbled in 7-byte chunks still parses."""
     raw = pysocket.create_connection((LOOP, echo_server.port))
