@@ -104,9 +104,7 @@ class Channel:
             self._options = options
         if isinstance(target, EndPoint):
             self._single_server = target
-        elif str(target).startswith("unix://"):
-            self._single_server = str2endpoint(str(target))
-        elif "://" in str(target):
+        elif "://" in str(target) and not str(target).startswith("unix://"):
             from incubator_brpc_tpu.lb import LoadBalancerWithNaming
 
             self._lb = LoadBalancerWithNaming(

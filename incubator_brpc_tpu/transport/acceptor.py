@@ -55,10 +55,13 @@ class Acceptor:
                 probe = _pysocket.socket(_pysocket.AF_UNIX, _pysocket.SOCK_STREAM)
                 try:
                     probe.settimeout(0.2)
+                    # a connect TIMEOUT is ambiguous (live listener with a
+                    # full backlog) and must refuse, not unlink — only a
+                    # clean refusal proves the file is stale
                     probe.connect(path)
                     probe.close()
                     raise OSError(f"unix socket {path} has a live listener")
-                except (ConnectionRefusedError, FileNotFoundError, TimeoutError):
+                except (ConnectionRefusedError, FileNotFoundError):
                     probe.close()
                     try:
                         _os.unlink(path)
@@ -143,17 +146,20 @@ class Acceptor:
     def stop(self, close_connections: bool = True) -> None:
         self._stopped = True
         self._dispatcher.remove_consumer(self._lsock.fileno())
+        if self._unix_path is not None:
+            import os as _os
+
+            # unlink BEFORE close: while we still own the listener, a
+            # successor's liveness probe connects (live → refuses to bind),
+            # so we can never delete a successor's fresh socket file
+            try:
+                _os.unlink(self._unix_path)
+            except OSError:
+                pass
         try:
             self._lsock.close()
         except OSError:
             pass
-        if self._unix_path is not None:
-            import os as _os
-
-            try:
-                _os.unlink(self._unix_path)  # no stale socket file left behind
-            except OSError:
-                pass
         if close_connections:
             for sock in self.connections():
                 sock.set_failed(ErrorCode.ECLOSE, "acceptor stopped")

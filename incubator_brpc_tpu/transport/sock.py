@@ -111,6 +111,19 @@ class WriteRequest:
         self.on_error = on_error
 
 
+def _dial(ep: EndPoint, timeout: float) -> _pysocket.socket:
+    """Open a client connection to a TCP or unix:// endpoint (the ONE
+    place that knows how to dial — used by connect and health probing)."""
+    if ep.ip.startswith("unix://"):
+        conn = _pysocket.socket(_pysocket.AF_UNIX, _pysocket.SOCK_STREAM)
+        conn.settimeout(timeout)
+        conn.connect(ep.ip[len("unix://"):])
+        return conn
+    conn = _pysocket.create_connection((ep.ip, ep.port), timeout=timeout)
+    conn.setsockopt(_pysocket.IPPROTO_TCP, _pysocket.TCP_NODELAY, 1)
+    return conn
+
+
 class Socket:
     def __init__(
         self,
@@ -189,13 +202,7 @@ class Socket:
         """Client connect (bthread_connect analog: blocking a fiber/thread,
         never the reactor)."""
         ep = str2endpoint(remote) if isinstance(remote, str) else remote
-        if ep.ip.startswith("unix://"):
-            conn = _pysocket.socket(_pysocket.AF_UNIX, _pysocket.SOCK_STREAM)
-            conn.settimeout(timeout)
-            conn.connect(ep.ip[len("unix://"):])
-        else:
-            conn = _pysocket.create_connection((ep.ip, ep.port), timeout=timeout)
-            conn.setsockopt(_pysocket.IPPROTO_TCP, _pysocket.TCP_NODELAY, 1)
+        conn = _dial(ep, timeout)
         return cls(conn, ep, messenger=messenger, is_client=True, **kwargs)
 
     @classmethod
@@ -477,16 +484,7 @@ class Socket:
         if self.state != FAILED:
             return  # recycled or already revived: stop probing
         try:
-            if self.remote.ip.startswith("unix://"):
-                conn = _pysocket.socket(
-                    _pysocket.AF_UNIX, _pysocket.SOCK_STREAM
-                )
-                conn.settimeout(2.0)
-                conn.connect(self.remote.ip[len("unix://"):])
-            else:
-                conn = _pysocket.create_connection(
-                    (self.remote.ip, self.remote.port), timeout=2.0
-                )
+            conn = _dial(self.remote, timeout=2.0)
         except OSError:
             self._schedule_health_check()
             return

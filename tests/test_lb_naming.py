@@ -1,0 +1,167 @@
+"""Load-balancer + naming integration tests (the VERDICT round-1 matrix:
+multi-server loopback with add/remove mid-traffic, LA punishing an
+injected-slow server — reference test/brpc_load_balancer_unittest.cpp and
+the File:// naming shape of brpc_channel_unittest.cpp:149-260)."""
+
+import collections
+import time
+
+import pytest
+
+from incubator_brpc_tpu.lb import (
+    ConsistentHashLB,
+    LocalityAwareLB,
+    RoundRobinLB,
+    WeightedRoundRobinLB,
+)
+from incubator_brpc_tpu.rpc import Channel, ChannelOptions, Server
+from incubator_brpc_tpu.utils.endpoint import EndPoint
+
+
+def ep(port):
+    return EndPoint(ip="127.0.0.1", port=port)
+
+
+class TestLbUnits:
+    def test_rr_cycles_evenly(self):
+        lb = RoundRobinLB()
+        for p in (1, 2, 3):
+            lb.add_server(ep(p))
+        picks = [lb.select().port for _ in range(9)]
+        assert collections.Counter(picks) == {1: 3, 2: 3, 3: 3}
+
+    def test_wrr_respects_weights(self):
+        lb = WeightedRoundRobinLB()
+        lb.add_server(ep(1), weight=3)
+        lb.add_server(ep(2), weight=1)
+        picks = collections.Counter(lb.select().port for _ in range(80))
+        assert picks[1] > picks[2] * 2
+
+    def test_consistent_hash_stability(self):
+        lb = ConsistentHashLB()
+        for p in (1, 2, 3, 4):
+            lb.add_server(ep(p))
+        owner = {code: lb.select(request_code=code).port for code in range(200)}
+        # same code -> same server, deterministically
+        for code in range(200):
+            assert lb.select(request_code=code).port == owner[code]
+        # removing one server remaps ONLY its keys (ketama property)
+        lb.remove_server(ep(3))
+        moved = sum(
+            1
+            for code in range(200)
+            if lb.select(request_code=code).port != owner[code]
+        )
+        lost = sum(1 for code in range(200) if owner[code] == 3)
+        assert moved == lost
+
+    def test_la_prefers_fast_server(self):
+        lb = LocalityAwareLB()
+        fast, slow = ep(1), ep(2)
+        lb.add_server(fast)
+        lb.add_server(slow)
+        for _ in range(50):
+            chosen = lb.select()
+            lb.feedback(chosen, 100.0 if chosen == fast else 50_000.0, 0)
+        picks = collections.Counter(lb.select().port for _ in range(200))
+        # select() charges in-flight; settle them so the counter is honest
+        assert picks[1] > picks[2] * 5
+
+    def test_la_punishes_errors(self):
+        lb = LocalityAwareLB()
+        good, bad = ep(1), ep(2)
+        lb.add_server(good)
+        lb.add_server(bad)
+        for _ in range(50):
+            chosen = lb.select()
+            lb.feedback(chosen, 200.0, 0 if chosen == good else 1014)
+        assert lb.expected_latency_us(bad) > lb.expected_latency_us(good) * 3
+
+
+def named_server(name: bytes, delay: float = 0.0):
+    s = Server()
+
+    def echo(cntl, req):
+        if delay:
+            time.sleep(delay)
+        return name
+
+    s.add_service("svc", {"echo": echo})
+    assert s.start(0)
+    return s
+
+
+class TestNamingMidTraffic:
+    def test_add_remove_servers_mid_traffic(self, tmp_path):
+        """The File:// naming shape: servers join and leave a live channel
+        by editing the file (brpc_channel_unittest.cpp:162)."""
+        s1 = named_server(b"one")
+        s2 = named_server(b"two")
+        f = tmp_path / "servers"
+        f.write_text(f"127.0.0.1:{s1.port}\n")
+        try:
+            ch = Channel()
+            assert ch.init(f"file://{f}", "rr")
+            for _ in range(4):
+                assert ch.call_method("svc", "echo", b"").response_payload == b"one"
+            # add s2 and push the refresh (tests drive it directly instead
+            # of waiting out the poll interval)
+            f.write_text(f"127.0.0.1:{s1.port}\n127.0.0.1:{s2.port}\n")
+            ch._lb.ns_thread._refresh()
+            seen = {
+                ch.call_method("svc", "echo", b"").response_payload
+                for _ in range(10)
+            }
+            assert seen == {b"one", b"two"}
+            # remove s1: traffic must drain to s2 only, no failures
+            f.write_text(f"127.0.0.1:{s2.port}\n")
+            ch._lb.ns_thread._refresh()
+            for _ in range(6):
+                cntl = ch.call_method("svc", "echo", b"")
+                assert cntl.ok(), cntl.error_text
+                assert cntl.response_payload == b"two"
+        finally:
+            s1.stop()
+            s2.stop()
+
+    def test_la_avoids_injected_slow_server_e2e(self):
+        """The full stack: list:// naming + la LB; a server with injected
+        latency ends up with a small share of live traffic."""
+        fast1 = named_server(b"f1")
+        fast2 = named_server(b"f2")
+        slow = named_server(b"slow", delay=0.08)
+        try:
+            ch = Channel()
+            assert ch.init(
+                f"list://127.0.0.1:{fast1.port},127.0.0.1:{fast2.port},"
+                f"127.0.0.1:{slow.port}",
+                "la",
+                options=ChannelOptions(timeout_ms=5000),
+            )
+            counts = collections.Counter()
+            for _ in range(60):
+                cntl = ch.call_method("svc", "echo", b"")
+                assert cntl.ok(), cntl.error_text
+                counts[cntl.response_payload] += 1
+            # the slow server must get markedly less than a fair third
+            assert counts[b"slow"] < 60 / 3 / 2, counts
+            assert counts[b"f1"] > 0 and counts[b"f2"] > 0
+        finally:
+            fast1.stop()
+            fast2.stop()
+            slow.stop()
+
+    def test_all_servers_removed_fails_cleanly(self, tmp_path):
+        s1 = named_server(b"solo")
+        f = tmp_path / "servers"
+        f.write_text(f"127.0.0.1:{s1.port}\n")
+        try:
+            ch = Channel()
+            assert ch.init(f"file://{f}", "rr")
+            assert ch.call_method("svc", "echo", b"").ok()
+            f.write_text("\n")
+            ch._lb.ns_thread._refresh()
+            cntl = ch.call_method("svc", "echo", b"")
+            assert cntl.failed()  # no server: fails, doesn't hang
+        finally:
+            s1.stop()
