@@ -70,6 +70,7 @@ class ChannelOptions:
         connect_timeout: float = 5.0,
         protocol: str = "tbus_std",
         auth=None,
+        connection_type: str = "single",
     ):
         self.timeout_ms = timeout_ms
         self.max_retry = max_retry
@@ -77,6 +78,12 @@ class ChannelOptions:
         self.connect_timeout = connect_timeout
         self.protocol = protocol
         self.auth = auth  # Authenticator (rpc/auth.py)
+        # "single" (shared main socket), "pooled" (exclusive connection per
+        # in-flight call, parked for reuse), "short" (fresh connection,
+        # closed after the call) — reference AdaptiveConnectionType
+        if connection_type not in ("single", "pooled", "short"):
+            raise ValueError(f"unknown connection_type {connection_type!r}")
+        self.connection_type = connection_type
 
 
 class Channel:
@@ -105,6 +112,15 @@ class Channel:
         if isinstance(target, EndPoint):
             self._single_server = target
         elif "://" in str(target) and not str(target).startswith("unix://"):
+            if self._options.connection_type != "single":
+                # visible error, not a silent downgrade: LB targets ride
+                # the shared main sockets (the reference hangs secondaries
+                # off the main socket; not implemented here)
+                raise ValueError(
+                    "connection_type "
+                    f"{self._options.connection_type!r} requires a "
+                    "single-server target"
+                )
             from incubator_brpc_tpu.lb import LoadBalancerWithNaming
 
             self._lb = LoadBalancerWithNaming(
@@ -231,13 +247,43 @@ class Channel:
             a._smap_tag = tag
         return tag
 
-    def _pick_socket(self, cntl: Controller):
-        if self._single_server is not None:
-            return self._socket_map.get_or_create(
-                self._single_server,
-                timeout=self._options.connect_timeout,
-                key_tag=self._auth_key_tag(),
+    def _dispose_attempt_sock(self, kind: str, sock) -> None:
+        """One attempt's connection settles (Call::OnComplete disposition,
+        controller.cpp:698): pooled returns to the pool (broken ones are
+        recycled there), short closes."""
+        if kind == "pooled":
+            self._socket_map.return_pooled(
+                self._single_server, sock, key_tag=self._auth_key_tag()
             )
+        else:
+            sock.recycle()
+
+    def _pick_socket(self, cntl: Controller):
+        ctype = self._options.connection_type
+        if self._single_server is not None:
+            if ctype == "single":
+                return self._socket_map.get_or_create(
+                    self._single_server,
+                    timeout=self._options.connect_timeout,
+                    key_tag=self._auth_key_tag(),
+                )
+            if ctype == "pooled":
+                sock = self._socket_map.get_pooled(
+                    self._single_server,
+                    timeout=self._options.connect_timeout,
+                    key_tag=self._auth_key_tag(),
+                )
+            else:  # short: fresh connection, closed at EndRPC
+                sock = self._socket_map.get_short(
+                    self._single_server, timeout=self._options.connect_timeout
+                )
+            # disposed together at EndRPC — a backup request keeps the
+            # previous attempt's connection in flight, so NOTHING may be
+            # settled mid-call
+            cntl._call_socks.append((ctype, sock))
+            return sock
+        # LB targets use the shared main sockets (the reference hangs
+        # pooled/short secondaries off the main socket)
         sock = self._lb.select_server(excluded=cntl._excluded_sockets)
         if sock is None:
             raise ConnectionError("no available server in load balancer")
@@ -391,6 +437,19 @@ class Channel:
             from incubator_brpc_tpu.builtin.rpcz import end_client_span
 
             end_client_span(cntl)
+        # settle every attempt's pooled/short connection now — except one a
+        # live stream is bound to, which is released when the stream ends
+        stream_sock = (
+            cntl._request_stream._sock if cntl._request_stream is not None else None
+        )
+        for kind, sock in cntl._call_socks:
+            if sock is stream_sock:
+                sock.context["_stream_dispose"] = (
+                    lambda _k=kind, _s=sock: self._dispose_attempt_sock(_k, _s)
+                )
+                continue
+            self._dispose_attempt_sock(kind, sock)
+        cntl._call_socks.clear()
         if cntl._request_stream is not None:
             from incubator_brpc_tpu.rpc import stream as stream_mod
 

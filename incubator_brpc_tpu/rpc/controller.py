@@ -76,6 +76,10 @@ class Controller:
         self._request_stream = None
         self._accepted_stream_id: int = 0
         self._sock = None  # server side: the connection the request came on
+        # (kind, socket) per attempt for pooled/short connection types —
+        # disposed together at EndRPC (never mid-call: a backup request
+        # keeps the original attempt's connection in flight)
+        self._call_socks: List[Any] = []
 
     # -- status surface (reference Controller::Failed/ErrorCode/ErrorText) --
 

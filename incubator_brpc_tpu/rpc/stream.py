@@ -270,13 +270,20 @@ class Stream:
 
     def _unhook_socket(self) -> None:
         """Drop our on_failed hook so closed streams don't accumulate on a
-        long-lived connection."""
+        long-lived connection, and release a pooled/short connection the
+        channel deferred to us (the stream pinned it past EndRPC)."""
         sock = self._sock
         if sock is not None:
             try:
                 sock.on_failed.remove(self._on_socket_failed)
             except ValueError:
                 pass
+            dispose = sock.context.pop("_stream_dispose", None)
+            if dispose is not None:
+                try:
+                    dispose()
+                except Exception:
+                    logger.exception("stream connection disposal raised")
 
     def _fail(self, code: int, reason: str) -> None:
         with self._lock:

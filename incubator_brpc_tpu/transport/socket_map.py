@@ -8,7 +8,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional, Union
 
-from incubator_brpc_tpu.transport.sock import RECYCLED, Socket
+from incubator_brpc_tpu.transport.sock import CONNECTED, RECYCLED, Socket
 from incubator_brpc_tpu.utils.endpoint import EndPoint, str2endpoint
 
 
@@ -17,6 +17,7 @@ class SocketMap:
         self._messenger = messenger
         self._lock = threading.Lock()
         self._map: Dict[str, Socket] = {}
+        self._pooled: Dict[str, list] = {}  # key -> idle pooled sockets
 
     def get_or_create(
         self,
@@ -50,9 +51,89 @@ class SocketMap:
         with self._lock:
             return self._map.pop(key, None)
 
+    # -- pooled secondary sockets (reference Socket::GetPooledSocket:
+    # an exclusive connection per in-flight call, parked for reuse) -------
+
+    def get_pooled(
+        self,
+        remote: Union[str, EndPoint],
+        timeout: float = 5.0,
+        key_tag: str = "",
+        **kwargs,
+    ) -> Socket:
+        """Pop an idle pooled connection or dial a fresh one. The caller
+        owns it exclusively until return_pooled()."""
+        ep = str2endpoint(remote) if isinstance(remote, str) else remote
+        key = f"{ep.ip}:{ep.port}|{key_tag}"
+        dead = []
+        with self._lock:
+            idle = self._pooled.get(key)
+            sock = None
+            while idle:
+                cand = idle.pop()
+                if cand.state == CONNECTED:
+                    sock = cand
+                    break
+                dead.append(cand)
+        for d in dead:
+            d.recycle()  # free the registry slot, don't just drop the ref
+        if sock is not None:
+            return sock
+        # no health checking: a dead pooled connection is simply discarded
+        # at the next pop (the pool replaces, it never revives)
+        return Socket.connect(
+            ep,
+            messenger=self._messenger,
+            timeout=timeout,
+            health_check_interval=0,
+            **kwargs,
+        )
+
+    def return_pooled(
+        self,
+        remote: Union[str, EndPoint],
+        sock: Socket,
+        key_tag: str = "",
+        max_idle: int = 32,
+    ) -> None:
+        """Park a healthy connection for reuse; broken or surplus ones are
+        recycled (the reference caps pooled idle connections too)."""
+        if sock.state != CONNECTED:
+            sock.recycle()  # free the registry slot
+            return
+        ep = str2endpoint(remote) if isinstance(remote, str) else remote
+        key = f"{ep.ip}:{ep.port}|{key_tag}"
+        with self._lock:
+            idle = self._pooled.setdefault(key, [])
+            if len(idle) < max_idle:
+                idle.append(sock)
+                return
+        sock.recycle()
+
+    def get_short(
+        self,
+        remote: Union[str, EndPoint],
+        timeout: float = 5.0,
+        **kwargs,
+    ) -> Socket:
+        """A fresh connection the caller closes after one call (reference
+        Socket::GetShortSocket) — dialed with THIS map's messenger so
+        short-connection traffic parses like everything else."""
+        ep = str2endpoint(remote) if isinstance(remote, str) else remote
+        return Socket.connect(
+            ep,
+            messenger=self._messenger,
+            timeout=timeout,
+            health_check_interval=0,
+            **kwargs,
+        )
+
     def recycle_all(self) -> None:
         with self._lock:
             socks, self._map = list(self._map.values()), {}
+            for idle in self._pooled.values():
+                socks.extend(idle)
+            self._pooled = {}
         for s in socks:
             s.recycle()
 

@@ -1,0 +1,144 @@
+"""Connection-type tests: single / pooled / short (reference
+ChannelOptions.connection_type; Socket::GetPooledSocket/GetShortSocket,
+test coverage shape of brpc_socket_unittest.cpp)."""
+
+import threading
+import time
+
+import pytest
+
+from incubator_brpc_tpu.rpc import Channel, ChannelOptions, Server
+
+
+@pytest.fixture
+def server():
+    s = Server()
+    inflight = {"now": 0, "max": 0}
+    lock = threading.Lock()
+
+    def echo(cntl, req):
+        with lock:
+            inflight["now"] += 1
+            inflight["max"] = max(inflight["max"], inflight["now"])
+        time.sleep(0.02)
+        with lock:
+            inflight["now"] -= 1
+        return req
+
+    s.add_service("ct", {"echo": echo})
+    assert s.start(0)
+    yield s
+    s.stop()
+    s.join(timeout=5)
+
+
+def _wait_conns(server, want, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if server.connection_count() == want:
+            return True
+        time.sleep(0.02)
+    return server.connection_count() == want
+
+
+class TestConnectionTypes:
+    def test_single_shares_one_connection(self, server):
+        ch = Channel()
+        assert ch.init(
+            f"127.0.0.1:{server.port}",
+            options=ChannelOptions(connection_type="single"),
+        )
+        for _ in range(5):
+            assert ch.call_method("ct", "echo", b"x").ok()
+        assert server.connection_count() == 1
+
+    def test_short_closes_after_each_call(self, server):
+        ch = Channel()
+        assert ch.init(
+            f"127.0.0.1:{server.port}",
+            options=ChannelOptions(connection_type="short"),
+        )
+        for _ in range(3):
+            assert ch.call_method("ct", "echo", b"x").ok()
+            assert _wait_conns(server, 0)  # connection gone after the call
+
+    def test_pooled_reuses_sequentially(self, server):
+        ch = Channel()
+        assert ch.init(
+            f"127.0.0.1:{server.port}",
+            options=ChannelOptions(connection_type="pooled"),
+        )
+        for _ in range(5):
+            assert ch.call_method("ct", "echo", b"x").ok()
+        # sequential calls reuse ONE pooled connection
+        assert server.connection_count() == 1
+
+    def test_lb_target_rejects_non_single(self, server):
+        ch = Channel()
+        with pytest.raises(ValueError):
+            ch.init(
+                f"list://127.0.0.1:{server.port}",
+                "rr",
+                options=ChannelOptions(connection_type="short"),
+            )
+
+    def test_backup_request_keeps_original_connection(self):
+        """A backup attempt must NOT settle the original attempt's
+        connection mid-call (the original response may still win)."""
+        s = Server()
+
+        def slow_echo(cntl, req):
+            time.sleep(0.3)
+            return b"original"
+
+        s.add_service("ct", {"echo": slow_echo})
+        assert s.start(0)
+        try:
+            ch = Channel()
+            assert ch.init(
+                f"127.0.0.1:{s.port}",
+                options=ChannelOptions(
+                    connection_type="short",
+                    timeout_ms=5000,
+                    backup_request_ms=50,
+                    max_retry=1,
+                ),
+            )
+            cntl = ch.call_method("ct", "echo", b"x")
+            assert cntl.ok(), cntl.error_text
+            assert cntl.response_payload == b"original"
+            assert _wait_conns(s, 0)  # both attempts' connections settled
+        finally:
+            s.stop()
+            s.join(timeout=5)
+
+    def test_pooled_concurrent_calls_use_distinct_connections(self, server):
+        ch = Channel()
+        assert ch.init(
+            f"127.0.0.1:{server.port}",
+            options=ChannelOptions(connection_type="pooled", timeout_ms=5000),
+        )
+        n = 4
+        errs = []
+
+        def worker():
+            c = ch.call_method("ct", "echo", b"y")
+            if c.failed():
+                errs.append(c.error_text)
+
+        ts = [threading.Thread(target=worker) for _ in range(n)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+        # each in-flight call held its own connection; all parked now
+        assert server.connection_count() == n
+        # and they are reused, not re-dialed, by the next wave
+        ts = [threading.Thread(target=worker) for _ in range(n)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+        assert server.connection_count() == n
