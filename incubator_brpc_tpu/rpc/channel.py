@@ -43,6 +43,19 @@ _client_messenger = InputMessenger()
 _client_socket_map = SocketMap(messenger=_client_messenger)
 
 
+def _recycle_when_drained(sock, attempt: int = 0) -> None:
+    """Close once queued writes flushed: recycling immediately would drop
+    frames still on the MPSC queue (e.g. a stream's CLOSE)."""
+    with sock._wlock:
+        drained = not sock._wqueue
+    if drained or attempt > 200:
+        sock.recycle()
+    else:
+        global_timer_thread().schedule(
+            lambda: _recycle_when_drained(sock, attempt + 1), delay=0.01
+        )
+
+
 def process_response(sock, frame: ParsedFrame) -> None:
     """tbus_std Protocol.process_response hook: route a response frame to
     its in-flight RPC via the correlation id (baidu_rpc_protocol.cpp:543)."""
@@ -143,6 +156,13 @@ class Channel:
         (partition_channel.cpp builds sub-channels the same way)."""
         if options is not None:
             self._options = options
+        if self._options.connection_type != "single":
+            # same visible rejection as init(): LB targets ride the shared
+            # main sockets, never a silent downgrade
+            raise ValueError(
+                f"connection_type {self._options.connection_type!r} "
+                "requires a single-server target"
+            )
         if not lb.start():
             return False
         self._lb = lb
@@ -247,16 +267,19 @@ class Channel:
             a._smap_tag = tag
         return tag
 
-    def _dispose_attempt_sock(self, kind: str, sock) -> None:
+    def _dispose_attempt_sock(self, kind: str, sock, reusable: bool = True) -> None:
         """One attempt's connection settles (Call::OnComplete disposition,
-        controller.cpp:698): pooled returns to the pool (broken ones are
-        recycled there), short closes."""
-        if kind == "pooled":
+        controller.cpp:698): pooled returns to the pool ONLY when the call
+        finished cleanly — a timed-out or superseded attempt may still have
+        a request in flight, and parking it would head-of-line-block the
+        next caller (the reference closes non-single connections on error
+        for the same reason). Short connections drain then close."""
+        if kind == "pooled" and reusable:
             self._socket_map.return_pooled(
                 self._single_server, sock, key_tag=self._auth_key_tag()
             )
         else:
-            sock.recycle()
+            _recycle_when_drained(sock)
 
     def _pick_socket(self, cntl: Controller):
         ctype = self._options.connection_type
@@ -438,17 +461,30 @@ class Channel:
 
             end_client_span(cntl)
         # settle every attempt's pooled/short connection now — except one a
-        # live stream is bound to, which is released when the stream ends
+        # live stream is bound to, which is released when the stream ends.
+        # A pooled socket is only reusable when this was a clean,
+        # single-attempt success (a timed-out or superseded attempt may
+        # still carry an in-flight request).
+        reusable = cntl.ok() and len(cntl._call_socks) <= 1
         stream_sock = (
             cntl._request_stream._sock if cntl._request_stream is not None else None
         )
         for kind, sock in cntl._call_socks:
             if sock is stream_sock:
-                sock.context["_stream_dispose"] = (
-                    lambda _k=kind, _s=sock: self._dispose_attempt_sock(_k, _s)
+                cb = lambda _k=kind, _s=sock, _r=reusable: (  # noqa: E731
+                    self._dispose_attempt_sock(_k, _s, _r)
                 )
+                sock.context["_stream_dispose"] = cb
+                from incubator_brpc_tpu.rpc import stream as stream_mod
+
+                if cntl._request_stream.state == stream_mod.CLOSED:
+                    # the stream raced us and already ran _unhook_socket:
+                    # whoever pops the callback runs it (dict.pop is atomic)
+                    late = sock.context.pop("_stream_dispose", None)
+                    if late is not None:
+                        late()
                 continue
-            self._dispose_attempt_sock(kind, sock)
+            self._dispose_attempt_sock(kind, sock, reusable)
         cntl._call_socks.clear()
         if cntl._request_stream is not None:
             from incubator_brpc_tpu.rpc import stream as stream_mod

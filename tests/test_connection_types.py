@@ -112,33 +112,48 @@ class TestConnectionTypes:
             s.stop()
             s.join(timeout=5)
 
-    def test_pooled_concurrent_calls_use_distinct_connections(self, server):
-        ch = Channel()
-        assert ch.init(
-            f"127.0.0.1:{server.port}",
-            options=ChannelOptions(connection_type="pooled", timeout_ms=5000),
-        )
+    def test_pooled_concurrent_calls_use_distinct_connections(self):
+        # barrier-gated handler: all n calls are PROVABLY in flight at
+        # once, so exactly n distinct pooled connections must exist
         n = 4
-        errs = []
+        barrier = threading.Barrier(n)
+        s = Server()
 
-        def worker():
-            c = ch.call_method("ct", "echo", b"y")
-            if c.failed():
-                errs.append(c.error_text)
+        def gated_echo(cntl, req):
+            barrier.wait(timeout=10)
+            return req
 
-        ts = [threading.Thread(target=worker) for _ in range(n)]
-        for t in ts:
-            t.start()
-        for t in ts:
-            t.join()
-        assert not errs
-        # each in-flight call held its own connection; all parked now
-        assert server.connection_count() == n
-        # and they are reused, not re-dialed, by the next wave
-        ts = [threading.Thread(target=worker) for _ in range(n)]
-        for t in ts:
-            t.start()
-        for t in ts:
-            t.join()
-        assert not errs
-        assert server.connection_count() == n
+        s.add_service("ct", {"echo": gated_echo})
+        assert s.start(0)
+        try:
+            ch = Channel()
+            assert ch.init(
+                f"127.0.0.1:{s.port}",
+                options=ChannelOptions(connection_type="pooled", timeout_ms=10000),
+            )
+            errs = []
+
+            def worker():
+                c = ch.call_method("ct", "echo", b"y")
+                if c.failed():
+                    errs.append(c.error_text)
+
+            ts = [threading.Thread(target=worker) for _ in range(n)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert not errs
+            # each in-flight call held its own connection; all parked now
+            assert s.connection_count() == n
+            # and they are reused, not re-dialed, by the next wave
+            ts = [threading.Thread(target=worker) for _ in range(n)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert not errs
+            assert s.connection_count() == n
+        finally:
+            s.stop()
+            s.join(timeout=5)
