@@ -307,20 +307,13 @@ def process_request(sock, frame: HttpFrame) -> None:
         _close_when_drained(sock)
 
 
-def _close_when_drained(sock, attempt: int = 0) -> None:
+def _close_when_drained(sock) -> None:
     """Half-close once the response drains; the client reads to EOF. A hard
-    set_failed here could cut the queued write."""
-    from incubator_brpc_tpu.runtime.timer_thread import global_timer_thread
+    set_failed before the drain could cut the queued write."""
+    from incubator_brpc_tpu.transport.sock import when_drained
     from incubator_brpc_tpu.utils.status import ErrorCode
 
-    with sock._wlock:
-        drained = not sock._wqueue
-    if drained or attempt > 100:
-        sock.set_failed(ErrorCode.ECLOSE, "http connection: close")
-    else:
-        global_timer_thread().schedule(
-            lambda: _close_when_drained(sock, attempt + 1), delay=0.01
-        )
+    when_drained(sock, lambda s: s.set_failed(ErrorCode.ECLOSE, "http connection: close"))
 
 
 HTTP = Protocol(

@@ -87,13 +87,19 @@ def pack_exception(method: str, message: str, seqid: int, type_id: int = 6) -> b
 def _read_string(buf: memoryview, off: int) -> Tuple[bytes, int]:
     (n,) = struct.unpack_from(">i", buf, off)
     off += 4
+    if n < 0 or off + n > len(buf):
+        raise ThriftError(f"bad string length {n} at offset {off - 4}")
     return bytes(buf[off : off + n]), off + n
 
 
 def _skip_field(buf: memoryview, off: int, ftype: int) -> int:
-    """Skip an unrecognized field (forward compatibility)."""
+    """Skip an unrecognized field (forward compatibility). Wire lengths are
+    untrusted: a negative or overlong length must raise, never move ``off``
+    backwards (which would cycle the cut loop forever)."""
     if ftype == TT_STRING:
         (n,) = struct.unpack_from(">i", buf, off)
+        if n < 0 or off + 4 + n > len(buf):
+            raise ThriftError(f"bad skip-string length {n} at offset {off}")
         return off + 4 + n
     if ftype == TT_I32:
         return off + 4
@@ -114,6 +120,16 @@ def parse_frame(buf: bytes) -> Tuple[Optional[dict], int]:
     if len(buf) < 4 + flen:
         return None, -1
     mv = memoryview(buf)[4 : 4 + flen]
+    try:
+        return _parse_body(mv, flen)
+    except struct.error as e:
+        # a *complete* frame whose declared flen is too short for its own
+        # structure: wire corruption, not an incomplete read — surface it as
+        # ThriftError so the client's fail-fast path runs
+        raise ThriftError(f"truncated structure inside frame: {e}") from None
+
+
+def _parse_body(mv: memoryview, flen: int) -> Tuple[Optional[dict], int]:
     (vt,) = struct.unpack_from(">I", mv, 0)
     if vt & 0xFFFF0000 != VERSION_1:
         raise ThriftError(f"bad thrift version {vt:#x}")

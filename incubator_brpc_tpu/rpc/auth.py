@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import hashlib
 import hmac
+import time
 from typing import Optional
 
 
@@ -32,22 +33,51 @@ class Authenticator:
 
 class SharedSecretAuthenticator(Authenticator):
     """HMAC over a shared secret — a usable default (the reference ships
-    ALL of its real authenticators as org-internal stubs)."""
+    ALL of its real authenticators as org-internal stubs).
 
-    def __init__(self, secret: str, identity: str = "client"):
+    The credential is ``identity:timestamp:HMAC(secret, identity|timestamp)``
+    and the server rejects timestamps outside ``freshness_window`` seconds,
+    bounding replay to that window. Limitations (documented, not solved —
+    match the reference's plaintext-credential posture): within the window
+    an observer of one plaintext connection can replay the credential, and
+    there is no channel binding; run over a trusted network or wrap the
+    transport in TLS for anything stronger. ``freshness_window=0`` disables
+    the check (accepts legacy two-part ``identity:digest`` credentials too).
+    """
+
+    def __init__(
+        self, secret: str, identity: str = "client", freshness_window: float = 300.0
+    ):
         self._secret = secret.encode()
         self.identity = identity
+        self.freshness_window = freshness_window
+
+    def _digest(self, identity: str, ts: str) -> str:
+        msg = f"{identity}|{ts}".encode()
+        return hmac.new(self._secret, msg, hashlib.sha256).hexdigest()
 
     def generate_credential(self) -> str:
-        mac = hmac.new(self._secret, self.identity.encode(), hashlib.sha256)
-        return f"{self.identity}:{mac.hexdigest()}"
+        ts = str(int(time.time()))
+        return f"{self.identity}:{ts}:{self._digest(self.identity, ts)}"
 
     def verify_credential(self, auth_str: str, remote_side) -> bool:
-        identity, _, digest = (auth_str or "").partition(":")
-        if not identity or not digest:
-            return False
-        want = hmac.new(self._secret, identity.encode(), hashlib.sha256)
-        return hmac.compare_digest(want.hexdigest(), digest)
+        parts = (auth_str or "").split(":")
+        if len(parts) == 3:
+            identity, ts, digest = parts
+            # isdecimal (not isdigit: rejects superscripts etc.) + a length
+            # bound so a crafted timestamp can't raise out of the fail-closed
+            # path (int() conversion limits, float OverflowError)
+            if not identity or not ts.isdecimal() or len(ts) > 20:
+                return False
+            if self.freshness_window and abs(time.time() - int(ts)) > self.freshness_window:
+                return False
+            return hmac.compare_digest(self._digest(identity, ts), digest)
+        if len(parts) == 2 and not self.freshness_window:
+            # legacy timestamp-less form, only when freshness is disabled
+            identity, digest = parts
+            want = hmac.new(self._secret, identity.encode(), hashlib.sha256)
+            return hmac.compare_digest(want.hexdigest(), digest)
+        return False
 
 
 def _clear_on_revive(sock) -> None:

@@ -43,17 +43,12 @@ _client_messenger = InputMessenger()
 _client_socket_map = SocketMap(messenger=_client_messenger)
 
 
-def _recycle_when_drained(sock, attempt: int = 0) -> None:
+def _recycle_when_drained(sock) -> None:
     """Close once queued writes flushed: recycling immediately would drop
     frames still on the MPSC queue (e.g. a stream's CLOSE)."""
-    with sock._wlock:
-        drained = not sock._wqueue
-    if drained or attempt > 200:
-        sock.recycle()
-    else:
-        global_timer_thread().schedule(
-            lambda: _recycle_when_drained(sock, attempt + 1), delay=0.01
-        )
+    from incubator_brpc_tpu.transport.sock import when_drained
+
+    when_drained(sock, lambda s: s.recycle())
 
 
 def process_response(sock, frame: ParsedFrame) -> None:

@@ -147,7 +147,11 @@ class Stream:
             with self._lock:
                 if self.state != CONNECTED:
                     return ErrorCode.EINVAL
-                if not limit or (self._produced + n - self._remote_consumed) <= limit:
+                # Admit while the current gap is below the limit — one
+                # in-flight message may overshoot the window, so a message
+                # larger than max_buf_size still goes out on an idle stream
+                # (AppendIfNotFull stream.cpp:263 checks the same way).
+                if not limit or (self._produced - self._remote_consumed) < limit:
                     self._produced += n
                     sock, rid = self._sock, self.remote_id
                     break
@@ -163,7 +167,7 @@ class Stream:
                 blocked = (
                     self.state == CONNECTED
                     and limit
-                    and (self._produced + n - self._remote_consumed) > limit
+                    and (self._produced - self._remote_consumed) >= limit
                 )
             if blocked and self._wbutex.wait(seq, timeout=remaining) == ETIMEDOUT:
                 return ErrorCode.EAGAIN
@@ -172,9 +176,12 @@ class Stream:
         rc = sock.write(pack_frame_iobuf(meta, data, 0, flags=FLAG_STREAM))
         if rc == ErrorCode.EOVERCROWDED:
             # transient socket backpressure (socket.cpp:1537): surface it,
-            # don't kill the stream
+            # don't kill the stream; the rollback reopens the window so any
+            # writer parked on it must be woken (no feedback will do it)
             with self._lock:
                 self._produced -= n
+            self._wbutex.add(1)
+            self._wbutex.wake_all()
             return rc
         if rc != 0:
             self._fail(rc, "stream data write failed")

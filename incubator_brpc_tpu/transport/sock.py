@@ -54,6 +54,25 @@ in_bytes = Adder(name="socket_in_bytes")
 out_bytes = Adder(name="socket_out_bytes")
 
 
+def when_drained(sock, action, stalls: int = 0, last_unwritten: int = -1) -> None:
+    """Run ``action(sock)`` once the write queue drains. Forces the action
+    only after a sustained *stall* (unwritten bytes unchanged across ~2s of
+    10ms checks) — a slow-but-progressing reader keeps its connection; a
+    fixed deadline could truncate a large payload."""
+    from incubator_brpc_tpu.runtime.timer_thread import global_timer_thread
+
+    with sock._wlock:
+        drained = not sock._wqueue
+        unwritten = sock._unwritten
+    stalls = stalls + 1 if unwritten == last_unwritten else 0
+    if drained or stalls > 200:
+        action(sock)
+    else:
+        global_timer_thread().schedule(
+            lambda: when_drained(sock, action, stalls, unwritten), delay=0.01
+        )
+
+
 class _Registry:
     """SocketId = version<<32 | slot. address() is None once failed or
     recycled; slots are reused with a bumped version (ABA-safe)."""

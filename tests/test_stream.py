@@ -221,3 +221,26 @@ class TestClose:
         s2._sock.set_failed(ErrorCode.EFAILEDSOCKET, "injected")
         assert client_rec.failed.wait(timeout=5)
         assert s2.write(b"z") == ErrorCode.EINVAL
+
+
+class TestOversizedMessage:
+    def test_message_larger_than_window_still_goes_out(self, echo_server):
+        # A single message bigger than max_buf_size must be admitted on an
+        # idle stream (one in-flight message may overshoot the window;
+        # reference AppendIfNotFull stream.cpp:263). Before the fix this
+        # parked the writer forever.
+        server, accepted = echo_server
+        rec = Recorder()
+        _, s = _connect(
+            server,
+            accepted,
+            handler=rec,
+            client_opts=StreamOptions(handler=Recorder(), max_buf_size=64 * 1024),
+        )
+        big = bytes(256 * 1024)  # 4x the window
+        assert s.write(big, timeout=5) == 0
+        assert _wait(lambda: len(rec.messages) == 1)
+        assert rec.messages[0] == big
+        # and the window still functions afterwards: feedback caught up
+        assert _wait(lambda: s.unconsumed_bytes == 0)
+        s.close()

@@ -91,3 +91,53 @@ class TestClient:
         for t in ts:
             t.join()
         assert not errs
+
+
+class TestMalformedFrames:
+    def test_negative_string_length_raises(self):
+        import struct
+
+        from incubator_brpc_tpu.protocol.thrift import (
+            VERSION_1,
+            ThriftError,
+            parse_frame,
+        )
+
+        # frame: version|T_REPLY, method "m", seqid, then a field header
+        # claiming a string with negative length — must raise, not loop
+        body = (
+            struct.pack(">I", VERSION_1 | 2)
+            + struct.pack(">i", 1)
+            + b"m"
+            + struct.pack(">i", 7)
+            + struct.pack(">bh", 11, 0)  # TT_STRING, fid 0
+            + struct.pack(">i", -5)  # poisoned length
+        )
+        buf = struct.pack(">i", len(body)) + body
+        import pytest as _pytest
+
+        with _pytest.raises(ThriftError):
+            parse_frame(buf)
+
+    def test_overlong_skip_length_raises(self):
+        import struct
+
+        from incubator_brpc_tpu.protocol.thrift import (
+            VERSION_1,
+            ThriftError,
+            parse_frame,
+        )
+
+        body = (
+            struct.pack(">I", VERSION_1 | 2)
+            + struct.pack(">i", 1)
+            + b"m"
+            + struct.pack(">i", 7)
+            + struct.pack(">bh", 11, 9)  # unknown fid → skipped
+            + struct.pack(">i", 1 << 20)  # claims 1MiB that isn't there
+        )
+        buf = struct.pack(">i", len(body)) + body
+        import pytest as _pytest
+
+        with _pytest.raises(ThriftError):
+            parse_frame(buf)
