@@ -77,6 +77,7 @@ def bench_rpc_echo(results: dict) -> None:
     from incubator_brpc_tpu.rpc import (
         Channel,
         Server,
+        ServerOptions,
         StreamHandler,
         StreamOptions,
         stream_accept,
@@ -97,7 +98,10 @@ def bench_rpc_echo(results: dict) -> None:
         stream_accept(cntl, StreamOptions(handler=Sink(), max_buf_size=8 << 20))
         return b""
 
-    server = Server()
+    # echo/stream handlers never block: run them inline on the reactors
+    # (ServerOptions.usercode_inline — the tuning a non-blocking service
+    # uses in production, analogous to the reference's usercode knobs)
+    server = Server(ServerOptions(usercode_inline=True))
     server.add_service("bench", {"echo": lambda cntl, req: req})
     server.add_service("bench_stream", {"open": open_stream})
     started = server.start(0)

@@ -27,6 +27,20 @@ class _Ref(ctypes.Structure):
     _fields_ = [("data", ctypes.c_void_p), ("length", ctypes.c_size_t)]
 
 
+class TbusHdr(ctypes.Structure):
+    """Mirror of tb_tbus_hdr (src/tbutil/tbutil.h)."""
+
+    _fields_ = [
+        ("body_len", ctypes.c_uint32),
+        ("flags", ctypes.c_uint32),
+        ("cid_lo", ctypes.c_uint32),
+        ("cid_hi", ctypes.c_uint32),
+        ("meta_len", ctypes.c_uint32),
+        ("crc", ctypes.c_uint32),
+        ("error_code", ctypes.c_uint32),
+    ]
+
+
 RELEASE_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_void_p)
 
 
@@ -79,6 +93,36 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
         "tb_crc32": (
             ctypes.c_uint32,
             [ctypes.c_uint32, ctypes.c_char_p, ctypes.c_size_t],
+        ),
+        "tb_crc32c": (
+            ctypes.c_uint32,
+            [ctypes.c_uint32, ctypes.c_void_p, ctypes.c_size_t],
+        ),
+        "tb_iobuf_crc32c": (
+            ctypes.c_uint32,
+            [b, ctypes.c_uint32, ctypes.c_size_t, ctypes.c_size_t],
+        ),
+        "tb_tbus_peek": (ctypes.c_int, [b, ctypes.POINTER(TbusHdr)]),
+        "tb_tbus_cut": (
+            ctypes.c_int,
+            [b, ctypes.POINTER(TbusHdr), ctypes.c_void_p, b],
+        ),
+        "tb_tbus_pack": (
+            None,
+            [
+                b,
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+                ctypes.c_uint32,
+                ctypes.c_uint32,
+                ctypes.c_uint32,
+                ctypes.c_uint32,
+                ctypes.c_int,
+            ],
         ),
         "tb_fast_rand": (ctypes.c_uint64, []),
         "tb_fast_rand_less_than": (ctypes.c_uint64, [ctypes.c_uint64]),
@@ -189,6 +233,36 @@ def crc32(data: bytes, seed: int = 0) -> int:
     import zlib
 
     return zlib.crc32(data, seed) & 0xFFFFFFFF
+
+
+_CRC32C_TABLE = None
+
+
+def _crc32c_py(data, seed: int = 0) -> int:
+    """Table-driven CRC32C for the no-native fallback (slow; only runs when
+    libtbutil could not be built). Same chaining contract as tb_crc32c."""
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        table = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (0x82F63B78 ^ (c >> 1)) if c & 1 else c >> 1
+            table.append(c)
+        _CRC32C_TABLE = table
+    crc = ~seed & 0xFFFFFFFF
+    tab = _CRC32C_TABLE
+    for byte in bytes(data):
+        crc = tab[(crc ^ byte) & 0xFF] ^ (crc >> 8)
+    return ~crc & 0xFFFFFFFF
+
+
+def crc32c(data, seed: int = 0) -> int:
+    """CRC32C (Castagnoli; SSE4.2-accelerated when native). zlib-style
+    chaining: pass the previous return value as ``seed``."""
+    if LIB is not None:
+        return LIB.tb_crc32c(seed, bytes(data), len(data))
+    return _crc32c_py(data, seed)
 
 
 class ResourcePool:

@@ -23,11 +23,16 @@ from incubator_brpc_tpu.protocol.registry import Protocol, protocol_registry
 # attached by the rpc layer at import (the reference registers everything
 # up front in global.cpp:364-525; here registration is at package import
 # and the rpc hooks bind lazily).
+from incubator_brpc_tpu.native import NATIVE_AVAILABLE as _NATIVE  # noqa: E402
+
 TBUS_STD = Protocol(
     name="tbus_std",
     parse=try_parse_frame,
     parse_header=parse_header,
     pack_request=pack_frame,
+    # native chain cut — no whole-frame copy into Python (src/tbutil
+    # tb_tbus_peek/cut); bytes path stays as the fallback
+    parse_iobuf=tbus_std.parse_frame_iobuf if _NATIVE else None,
 )
 
 if "tbus_std" not in protocol_registry:

@@ -30,6 +30,10 @@ class Protocol:
     # (the reference registers streaming_rpc as its own Protocol; here the
     # stream frames share tbus_std's header so they share its row)
     process_stream: Optional[Callable] = None
+    # native cut: (read IOBuf) -> (parsed_or_None, consumed) operating on
+    # the socket's read chain directly — no whole-frame copy into Python.
+    # Optional; the messenger prefers it when present.
+    parse_iobuf: Optional[Callable] = None
 
 
 class ProtocolRegistry:

@@ -3,34 +3,45 @@
 Layout (little-endian). The header shares the magic and the 8×uint32 shape
 with the device frame of ops/framing.py, but field semantics differ (word 1
 is body *bytes* here vs payload *words* there; word 5 is meta length vs
-method id; word 6 is crc32 vs sum-xor) — host frames are re-framed at the
+method id; word 6 is crc32c vs sum-xor) — host frames are re-framed at the
 host↔HBM boundary by the device transport, they do not parse as device
 frames:
 
     8 × uint32 header:
         0 magic "TPRC"
         1 body length in BYTES (meta + payload + attachment)
-        2 flags (bit0 response, bit1 stream, bit2 has-meta)
+        2 flags (bit0 response, bit1 stream, bit2 has-meta, bit3 body-crc)
         3 correlation id low
         4 correlation id high
         5 meta length in bytes
-        6 crc32 of body
+        6 crc32c (over meta; over the whole body when bit3 is set)
         7 error code (responses)
     body = meta (JSON, self-describing like baidu_std's RpcMeta proto —
     policy/baidu_rpc_meta.proto) + payload + attachment.
 
 The reference carries service/method/compress/attachment_size in a protobuf
 RpcMeta; a JSON meta keeps the frame self-describing without a codegen
-dependency (the native C++ runtime will read the same bytes).
+dependency (the native C++ runtime reads the same bytes — the per-frame
+byte path lives in src/tbutil tb_tbus_pack/peek/cut).
+
+Checksum model: CRC32C (hardware-accelerated) always covers the meta — the
+routing information. Payload bytes are covered only when FLAG_BODY_CRC is
+set per frame (flag ``tbus_body_crc``); the default trusts the transport's
+own integrity exactly like the reference, whose baidu_std header carries
+sizes and NO checksum at all (baidu_rpc_protocol.cpp:53-58) because TCP
+already checksums segments.
 """
 
 from __future__ import annotations
 
+import ctypes
 import json
 import struct
-import zlib
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, field
 from typing import Optional, Tuple
+
+from incubator_brpc_tpu.native import LIB, TbusHdr, crc32c
+from incubator_brpc_tpu.utils.flags import define_flag, get_flag
 
 MAGIC = 0x54505243  # "TPRC" — same as ops.framing.MAGIC
 MAGIC_BYTES = struct.pack("<I", MAGIC)
@@ -40,6 +51,20 @@ _HDR = struct.Struct("<8I")
 FLAG_RESPONSE = 1
 FLAG_STREAM = 2
 FLAG_HAS_META = 4
+FLAG_BODY_CRC = 8
+
+define_flag(
+    "tbus_body_crc",
+    False,
+    "checksum full frame bodies (default: meta only, like the reference "
+    "whose baidu_std trusts TCP's checksums for payload bytes)",
+    lambda v: True,
+)
+
+# payloads at least this large are wrapped zero-copy into the send IOBuf
+# (below it, one memcpy into a pooled block is cheaper than the external-
+# block bookkeeping)
+_EXTERNAL_THRESHOLD = 32 * 1024
 
 
 @dataclass
@@ -62,18 +87,67 @@ class Meta:
     error_text: str = ""
     extra: dict = field(default_factory=dict)
 
-    def to_bytes(self) -> bytes:
-        d = {k: v for k, v in self.__dict__.items() if v not in ("", 0, False, {}, None)}
+    def to_bytes(self, attachment_size: Optional[int] = None) -> bytes:
+        """Wire meta. ``attachment_size`` overrides the field (so frame
+        packers never need a Meta copy just to stamp it). Explicit field
+        checks — this runs per frame; a dict comprehension over __dict__
+        costs ~4x."""
+        d = {}
+        if self.service:
+            d["service"] = self.service
+        if self.method:
+            d["method"] = self.method
+        if self.compress:
+            d["compress"] = self.compress
+        att = self.attachment_size if attachment_size is None else attachment_size
+        if att:
+            d["attachment_size"] = att
+        if self.log_id:
+            d["log_id"] = self.log_id
+        if self.trace_id:
+            d["trace_id"] = self.trace_id
+        if self.span_id:
+            d["span_id"] = self.span_id
+        if self.parent_span_id:
+            d["parent_span_id"] = self.parent_span_id
+        if self.stream_id:
+            d["stream_id"] = self.stream_id
+        if self.stream_offset:
+            d["stream_offset"] = self.stream_offset
+        if self.stream_close:
+            d["stream_close"] = True
+        if self.error_text:
+            d["error_text"] = self.error_text
+        if self.extra:
+            d["extra"] = self.extra
         return json.dumps(d, separators=(",", ":")).encode()
 
     @classmethod
     def from_bytes(cls, b: bytes) -> "Meta":
         m = cls()
         if b:
-            for k, v in json.loads(b).items():
-                if hasattr(m, k):
-                    setattr(m, k, v)
+            o = json.loads(b)
+            g = o.get
+            m.service = g("service", "")
+            m.method = g("method", "")
+            m.compress = g("compress", "")
+            m.attachment_size = g("attachment_size", 0)
+            m.log_id = g("log_id", 0)
+            m.trace_id = g("trace_id", 0)
+            m.span_id = g("span_id", 0)
+            m.parent_span_id = g("parent_span_id", 0)
+            m.stream_id = g("stream_id", 0)
+            m.stream_offset = g("stream_offset", 0)
+            m.stream_close = g("stream_close", False)
+            m.error_text = g("error_text", "")
+            m.extra = g("extra", {})
         return m
+
+
+def _effective_flags(flags: int) -> int:
+    if get_flag("tbus_body_crc"):
+        flags |= FLAG_BODY_CRC
+    return flags
 
 
 def _build_header(
@@ -85,21 +159,23 @@ def _build_header(
     attachment: bytes,
 ):
     """The single source of truth for the frame layout: returns
-    (header_bytes, meta_bytes). attachment_size is authoritative per frame
-    (as in the reference's RpcMeta): always (re)computed, never inherited
-    from a reused Meta, and the caller's Meta is never mutated. CRC is
-    computed incrementally so callers never need a body concatenation."""
+    (header_bytes, meta_bytes, flags). attachment_size is authoritative per
+    frame (as in the reference's RpcMeta): always (re)computed, never
+    inherited from a reused Meta, and the caller's Meta is never mutated.
+    CRC is computed incrementally so callers never need a body
+    concatenation."""
     if attachment and meta is None:
         raise ValueError("non-empty attachment requires a Meta to carry its size")
+    flags = _effective_flags(flags)
     meta_bytes = b""
     if meta is not None:
-        meta = replace(meta, attachment_size=len(attachment))
-        meta_bytes = meta.to_bytes()
+        meta_bytes = meta.to_bytes(attachment_size=len(attachment))
         flags |= FLAG_HAS_META
-    crc = zlib.crc32(meta_bytes)
-    crc = zlib.crc32(payload, crc)
-    if attachment:
-        crc = zlib.crc32(attachment, crc)
+    crc = crc32c(meta_bytes)
+    if flags & FLAG_BODY_CRC:
+        crc = crc32c(payload, crc)
+        if attachment:
+            crc = crc32c(attachment, crc)
     header = _HDR.pack(
         MAGIC,
         len(meta_bytes) + len(payload) + len(attachment),
@@ -110,7 +186,7 @@ def _build_header(
         crc & 0xFFFFFFFF,
         error_code,
     )
-    return header, meta_bytes
+    return header, meta_bytes, flags
 
 
 def pack_frame(
@@ -123,7 +199,7 @@ def pack_frame(
 ) -> bytes:
     """Serialize one frame to bytes. The reference splits this between
     SerializeRequest and PackRpcRequest (baidu_rpc_protocol.cpp:585-668)."""
-    header, meta_bytes = _build_header(
+    header, meta_bytes, _ = _build_header(
         meta, payload, correlation_id, flags, error_code, attachment
     )
     return header + meta_bytes + payload + attachment
@@ -137,16 +213,49 @@ def pack_frame_iobuf(
     error_code: int = 0,
     attachment: bytes = b"",
 ):
-    """pack_frame without the body/frame concatenations: each part is
-    appended to an IOBuf once (Socket.write accepts IOBufs). Saves two
-    full-payload copies per frame on the send hot path — the wire bytes
-    are identical to pack_frame (same _build_header)."""
+    """pack_frame without the body/frame concatenations: header+meta are
+    built (and the CRC computed) in ONE native pass, then payload and
+    attachment are appended to the IOBuf — zero-copy external refs when
+    large. The wire bytes are identical to pack_frame."""
     from incubator_brpc_tpu.iobuf import IOBuf
 
-    header, meta_bytes = _build_header(
+    buf = IOBuf()
+    if LIB is not None:  # IOBuf is the native class exactly when LIB loaded
+        if attachment and meta is None:
+            raise ValueError("non-empty attachment requires a Meta to carry its size")
+        flags = _effective_flags(flags)
+        meta_bytes = b""
+        if meta is not None:
+            meta_bytes = meta.to_bytes(attachment_size=len(attachment))
+            flags |= FLAG_HAS_META
+        copy_body = (
+            len(payload) < _EXTERNAL_THRESHOLD
+            and len(attachment) < _EXTERNAL_THRESHOLD
+        )
+        LIB.tb_tbus_pack(
+            buf._h,
+            meta_bytes,
+            len(meta_bytes),
+            payload,
+            len(payload),
+            attachment,
+            len(attachment),
+            correlation_id & 0xFFFFFFFF,
+            (correlation_id >> 32) & 0xFFFFFFFF,
+            flags,
+            error_code,
+            1 if copy_body else 0,
+        )
+        if not copy_body:
+            for part in (payload, attachment):
+                if len(part) >= _EXTERNAL_THRESHOLD:
+                    buf.append_external(part)
+                elif part:
+                    buf.append(part)
+        return buf
+    header, meta_bytes, _ = _build_header(
         meta, payload, correlation_id, flags, error_code, attachment
     )
-    buf = IOBuf()
     buf.append(header + meta_bytes)  # header+meta are small: one append
     if payload:
         buf.append(payload)
@@ -178,6 +287,12 @@ class ParseError(Exception):
     reference's PARSE_ERROR_TRY_OTHERS→close path."""
 
 
+class FatalParseError(ParseError):
+    """Corruption detected AFTER bytes were irreversibly consumed from the
+    read chain: the connection cannot re-synchronize and must be failed —
+    'try other protocols' is not an option."""
+
+
 def parse_header(header: bytes) -> Optional[int]:
     """Total frame size from the fixed header, None if the header itself is
     still incomplete, ParseError if these bytes are not tbus_std. The
@@ -196,8 +311,18 @@ def parse_header(header: bytes) -> Optional[int]:
     return HEADER_BYTES + body_len
 
 
+def _split_body(meta: Meta, body_mv) -> Tuple[bytes, bytes]:
+    att = meta.attachment_size
+    if att > len(body_mv):
+        raise ParseError(f"attachment_size {att} exceeds body remainder {len(body_mv)}")
+    if att:
+        return bytes(body_mv[: len(body_mv) - att]), bytes(body_mv[len(body_mv) - att :])
+    return bytes(body_mv), b""
+
+
 def try_parse_frame(buf: bytes) -> Tuple[Optional[ParsedFrame], int]:
-    """Attempt to cut one frame off ``buf``.
+    """Attempt to cut one frame off ``buf`` (bytes path — tools, tests, and
+    the pure-Python fallback; the Socket read loop uses parse_frame_iobuf).
 
     Returns (frame, consumed). (None, 0) means not enough bytes yet — the
     resumable-parse contract of InputMessenger::CutInputMessage
@@ -213,21 +338,12 @@ def try_parse_frame(buf: bytes) -> Tuple[Optional[ParsedFrame], int]:
     total = HEADER_BYTES + body_len
     if len(buf) < total:
         return None, 0
-    # memoryview slicing: ONE copy per extracted part instead of an extra
-    # whole-body copy (this is the per-byte hot path of large streams)
     body = memoryview(buf)[HEADER_BYTES:total]
-    if zlib.crc32(body) & 0xFFFFFFFF != crc:
+    span = body_len if flags & FLAG_BODY_CRC else meta_len
+    if crc32c(body[:span]) != crc:
         raise ParseError("crc mismatch")
     meta = Meta.from_bytes(bytes(body[:meta_len]))
-    rest = body[meta_len:]
-    att = meta.attachment_size
-    if att > len(rest):
-        raise ParseError(f"attachment_size {att} exceeds body remainder {len(rest)}")
-    if att:
-        payload = bytes(rest[: len(rest) - att])
-        attachment = bytes(rest[len(rest) - att :])
-    else:
-        payload, attachment = bytes(rest), b""
+    payload, attachment = _split_body(meta, body[meta_len:])
     frame = ParsedFrame(
         meta=meta,
         payload=payload,
@@ -235,5 +351,64 @@ def try_parse_frame(buf: bytes) -> Tuple[Optional[ParsedFrame], int]:
         correlation_id=cid_lo | (cid_hi << 32),
         flags=flags,
         error_code=err,
+    )
+    return frame, total
+
+
+def parse_frame_iobuf(buf, max_total: Optional[int] = None) -> Tuple[Optional[ParsedFrame], int]:
+    """Native cut: header peek + CRC walk + zero-copy body cut all happen in
+    src/tbutil over the socket's read chain — Python never copies the frame
+    wholesale (the reference gets the same property from CutInputMessage
+    operating on the IOPortal, input_messenger.cpp:60-129).
+
+    Same contract as try_parse_frame: (frame, consumed) | (None, 0);
+    ParseError on corruption. ``max_total`` rejects oversized frames at
+    HEADER time — before their body is ever buffered — so a crafted
+    header cannot balloon the read buffer."""
+    from incubator_brpc_tpu.iobuf import IOBuf
+
+    hdr = TbusHdr()
+    rc = LIB.tb_tbus_peek(buf._h, ctypes.byref(hdr))
+    if rc == 1:
+        return None, 0
+    if rc == -1:
+        raise ParseError("bad magic")
+    total = HEADER_BYTES + hdr.body_len
+    if max_total is not None and total > max_total:
+        raise ParseError(f"frame of {total} B exceeds limit {max_total}")
+    if hdr.meta_len > hdr.body_len:
+        # validate header-claimed sizes BEFORE any allocation: both fields
+        # are untrusted (the crc does not cover the header)
+        raise ParseError("meta longer than body")
+    if len(buf) < total:
+        return None, 0
+    meta_buf = ctypes.create_string_buffer(hdr.meta_len) if hdr.meta_len else None
+    body = IOBuf()
+    rc = LIB.tb_tbus_cut(buf._h, ctypes.byref(hdr), meta_buf, body._h)
+    if rc == -2:
+        raise ParseError("crc mismatch")
+    if rc == -3:
+        raise ParseError("meta longer than body")
+    if rc != 0:
+        return None, 0
+    meta = Meta.from_bytes(meta_buf.raw if meta_buf is not None else b"")
+    att = meta.attachment_size
+    body_rest = hdr.body_len - hdr.meta_len
+    if att > body_rest:
+        # the frame is already consumed: the stream cannot re-sync, so this
+        # must kill the connection, not fall back to other protocols
+        raise FatalParseError(
+            f"attachment_size {att} exceeds body remainder {body_rest}"
+        )
+    payload_len = body_rest - att
+    payload = body.to_bytes(payload_len)
+    attachment = body.to_bytes(att, pos=payload_len) if att else b""
+    frame = ParsedFrame(
+        meta=meta,
+        payload=payload,
+        attachment=attachment,
+        correlation_id=hdr.cid_lo | (hdr.cid_hi << 32),
+        flags=hdr.flags,
+        error_code=hdr.error_code,
     )
     return frame, total

@@ -26,7 +26,7 @@ from incubator_brpc_tpu.protocol.tbus_std import (
     FLAG_RESPONSE,
     Meta,
     ParsedFrame,
-    pack_frame,
+    pack_frame_iobuf,
 )
 from incubator_brpc_tpu.rpc.controller import RETRIABLE, Controller
 from incubator_brpc_tpu.runtime.correlation_id import call_id_space
@@ -53,11 +53,34 @@ def _recycle_when_drained(sock) -> None:
 
 def process_response(sock, frame: ParsedFrame) -> None:
     """tbus_std Protocol.process_response hook: route a response frame to
-    its in-flight RPC via the correlation id (baidu_rpc_protocol.cpp:543)."""
+    its in-flight RPC via the correlation id (baidu_rpc_protocol.cpp:543).
+
+    On a reactor thread (inline reads) a contended id — a concurrent
+    timeout/backup holder, possibly mid-reconnect — must not park the
+    reactor: the blocking lock is deferred to a pool fiber."""
+    from incubator_brpc_tpu.runtime.correlation_id import EBUSY
+    from incubator_brpc_tpu.transport.event_dispatcher import on_reactor_thread
+
+    cid = frame.correlation_id
+    on_reactor = on_reactor_thread()
+    rc, cntl = call_id_space.lock(cid, nowait=on_reactor)
+    if rc == EBUSY:
+        global_worker_pool().spawn(_process_response_blocking, sock, frame)
+        return
+    if rc != 0 or cntl is None:
+        return  # stale/duplicate response after EndRPC: drop
+    channel = cntl._channel
+    if channel is None:
+        call_id_space.unlock(cid)
+        return
+    channel._on_rpc_returned(cntl, frame, sock)
+
+
+def _process_response_blocking(sock, frame: ParsedFrame) -> None:
     cid = frame.correlation_id
     rc, cntl = call_id_space.lock(cid)
     if rc != 0 or cntl is None:
-        return  # stale/duplicate response after EndRPC: drop
+        return
     channel = cntl._channel
     if channel is None:
         call_id_space.unlock(cid)
@@ -210,7 +233,13 @@ class Channel:
 
         timer = global_timer_thread()
         pool = global_worker_pool()
-        if cntl.timeout_ms is not None and cntl.timeout_ms > 0:
+        # Sync calls without backup requests enforce their deadline from
+        # the caller's own wait loop (_sync_wait) — no timer round trip.
+        # Async calls and backup-enabled calls need the TimerThread.
+        needs_timeout_timer = done is not None or (
+            cntl.backup_request_ms and cntl.backup_request_ms > 0
+        )
+        if needs_timeout_timer and cntl.timeout_ms is not None and cntl.timeout_ms > 0:
             cntl._timer_ids.append(
                 timer.schedule(
                     lambda: pool.spawn(
@@ -235,14 +264,84 @@ class Channel:
                 )
             )
 
+        if done is None:
+            cntl._want_poll = True
         rc, _ = call_id_space.lock(cid)
         if rc == 0:
             self._issue_rpc(cntl)
             call_id_space.unlock(cid)
+        # Only the initial caller-thread issue may pre-claim read ownership:
+        # a later retry on a pool thread claiming a socket after the sync
+        # caller stopped polling would leave a connection nobody reads.
+        cntl._want_poll = False
 
         if done is None:
-            call_id_space.join(cid)
+            self._sync_wait(cntl, cid)
         return cntl
+
+    def _sync_wait(self, cntl: Controller, cid: int) -> None:
+        """Synchronous completion. When the request's socket is otherwise
+        idle, the caller becomes its reader and processes the response on
+        its OWN thread — a sync round trip then involves zero reactor or
+        fiber wakeups on the client (Socket.poll_and_process; the reference
+        parks on the id butex instead because bthread wakes are ~free,
+        bthread_id_join). Falls back to the plain join when another thread
+        is already reading the socket."""
+        import time as _time
+
+        from incubator_brpc_tpu.transport.sock import CONNECTED as _UP
+
+        deadline = cntl._deadline or None
+        # whether a TimerThread entry owns this call's deadline (see
+        # call_method); if not, THIS loop delivers ERPCTIMEDOUT
+        has_timer = bool(cntl._timer_ids)
+
+        def _deadline_hit() -> bool:
+            if has_timer or deadline is None or _time.monotonic() < deadline:
+                return False
+            call_id_space.error(
+                cid, ErrorCode.ERPCTIMEDOUT, f"deadline {cntl.timeout_ms} ms exceeded"
+            )
+            return True
+
+        def _join_with_deadline() -> None:
+            # the deadline stays enforced even with no TimerThread entry:
+            # a dead server that never answers must still yield
+            # ERPCTIMEDOUT, not an unbounded park
+            while call_id_space.valid(cid):
+                remaining = None if deadline is None else deadline - _time.monotonic()
+                if call_id_space.join(cid, timeout=remaining):
+                    return
+                if _deadline_hit():
+                    break
+            call_id_space.join(cid)
+
+        sock = cntl._poll_owned
+        if sock is None:
+            sock = cntl._sent_sockets[-1] if cntl._sent_sockets else None
+            if sock is None or not sock.try_read_ownership():
+                _join_with_deadline()
+                return
+        cntl._poll_sock = sock
+        try:
+            while call_id_space.valid(cid):
+                if _deadline_hit():
+                    break
+                if sock.state != _UP:
+                    break
+                # 0.5s safety tick: a missed kick (no eventfd) or a
+                # response rerouted to another socket (retry/backup) is
+                # picked up by the next valid() check
+                t = 0.5
+                if deadline is not None:
+                    t = min(t, max(0.001, deadline - _time.monotonic()))
+                if not sock.poll_and_process(t):
+                    break
+        finally:
+            cntl._poll_sock = None
+            cntl._poll_owned = None
+            sock.release_read_ownership()
+        _join_with_deadline()
 
     # convenience alias
     call = call_method
@@ -319,6 +418,10 @@ class Channel:
             return
         cntl.remote_side = sock.remote
         cntl._sent_sockets.append(sock)
+        if cntl._want_poll and cntl._poll_owned is None and sock.try_read_ownership():
+            # sync caller will drive this socket's reads (see _sync_wait);
+            # claiming before the write keeps the post-send GIL window tiny
+            cntl._poll_owned = sock
         meta = Meta(
             service=cntl._service,
             method=cntl._method,
@@ -338,7 +441,7 @@ class Channel:
             payload = cntl._request_payload
             if cntl.compress_type:
                 payload = compress_mod.compress(cntl.compress_type, payload)
-            data = pack_frame(
+            data = pack_frame_iobuf(
                 meta,
                 payload,
                 cid,
@@ -398,6 +501,20 @@ class Channel:
         ):
             cntl.retried_count += 1
             cntl._excluded_sockets.add(sock.id)
+            from incubator_brpc_tpu.transport.event_dispatcher import (
+                on_reactor_thread,
+            )
+
+            if on_reactor_thread():
+                # re-issuing may dial a fresh connection (blocking): hand
+                # off to a fiber; the id STAYS locked across the handoff
+                # (the lock is state, not thread-bound)
+                def _retry_off_reactor():
+                    self._issue_rpc(cntl)
+                    call_id_space.unlock(cntl.call_id)
+
+                global_worker_pool().spawn(_retry_off_reactor)
+                return
             self._issue_rpc(cntl)
             call_id_space.unlock(cntl.call_id)
             return
@@ -492,5 +609,10 @@ class Channel:
                     cntl.error_text or "stream not accepted",
                 )
         call_id_space.unlock_and_destroy(cntl.call_id)
+        ps = cntl._poll_sock
+        if ps is not None:
+            # a sync caller is poll-driving some socket: if the RPC ended on
+            # a different path (other socket, timer), wake it now
+            ps.kick_poller()
         if cntl._done is not None:
             global_worker_pool().spawn(cntl._done, cntl)

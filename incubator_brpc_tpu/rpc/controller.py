@@ -76,6 +76,16 @@ class Controller:
         self._request_stream = None
         self._accepted_stream_id: int = 0
         self._sock = None  # server side: the connection the request came on
+        # set while a sync caller is poll-driving a socket's reads; whoever
+        # ends the RPC kicks it so the poller stops waiting (sock.py's
+        # caller-driven read path)
+        self._poll_sock = None
+        # sync fast path: _issue_rpc pre-claims read ownership of the
+        # request socket BEFORE writing, so the caller reaches select with
+        # almost no GIL-held work after the send syscall (every Python op
+        # between write and select delays the server's reactor wake)
+        self._want_poll = False
+        self._poll_owned = None
         # (kind, socket) per attempt for pooled/short connection types —
         # disposed together at EndRPC (never mid-call: a backup request
         # keeps the original attempt's connection in flight)

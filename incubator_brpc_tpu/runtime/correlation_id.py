@@ -30,6 +30,7 @@ from typing import Any, Callable, List, Optional
 from incubator_brpc_tpu.runtime.butex import Butex
 
 EINVAL = 22
+EBUSY = 16
 
 # on_error(call_id, data, error_code, error_text) -> None; called with the id
 # LOCKED; it must eventually unlock() or unlock_and_destroy().
@@ -107,9 +108,11 @@ class CallIdSpace:
 
     # -- operations ---------------------------------------------------------
 
-    def lock(self, call_id: int) -> tuple:
+    def lock(self, call_id: int, nowait: bool = False) -> tuple:
         """Lock the id; returns (0, data) or (EINVAL, None) if the version
-        is stale/destroyed. Contenders park on the slot butex."""
+        is stale/destroyed. Contenders park on the slot butex — unless
+        ``nowait``, which returns (EBUSY, None) instead of parking (for
+        reactor threads that must not block on another holder)."""
         slot = self._slot_of(call_id)
         if slot is None:
             return EINVAL, None
@@ -121,6 +124,8 @@ class CallIdSpace:
                 if not slot.locked:
                     slot.locked = True
                     return 0, slot.data
+                if nowait:
+                    return EBUSY, None
                 epoch = slot.contenders.load()
             slot.contenders.wait(epoch)
 

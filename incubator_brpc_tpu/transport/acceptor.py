@@ -31,10 +31,12 @@ class Acceptor:
         on_connection: Optional[Callable[[Socket], None]] = None,
         conn_context: Optional[dict] = None,
         backlog: int = 128,
+        inline_read: bool = False,
     ):
         self._messenger = messenger
         self._user_message_handler = user_message_handler
         self._on_connection = on_connection
+        self._inline_read = inline_read
         # seeded into every accepted Socket BEFORE it goes live (a request
         # can arrive in the same burst as the accept)
         self._conn_context = conn_context
@@ -122,6 +124,7 @@ class Acceptor:
                     messenger=self._messenger,
                     user_message_handler=self._user_message_handler,
                     context=self._conn_context,
+                    inline_read=self._inline_read,
                 )
                 with self._conn_lock:
                     self._connections[sock.id] = sock

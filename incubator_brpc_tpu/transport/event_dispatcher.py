@@ -30,6 +30,15 @@ EVENT_IN = select.EPOLLIN
 EVENT_OUT = select.EPOLLOUT
 EVENT_ERR = select.EPOLLERR | select.EPOLLHUP
 
+_tls = threading.local()
+
+
+def on_reactor_thread() -> bool:
+    """True on an EventDispatcher loop thread. Work that may block for a
+    long bound (connects, lock waits) checks this and defers to the worker
+    pool instead of stalling a reactor's other sockets."""
+    return getattr(_tls, "is_reactor", False)
+
 
 class EventDispatcher:
     """One epoll loop thread. Handlers run inline and must be cheap
@@ -118,6 +127,7 @@ class EventDispatcher:
                 logger.debug("dispatcher cmd %s fd=%d failed: %s", op, fd, e)
 
     def _run(self) -> None:
+        _tls.is_reactor = True
         wake_fd = self._wake_r
         self._epoll.register(wake_fd, select.EPOLLIN)
         while not self._stopped:

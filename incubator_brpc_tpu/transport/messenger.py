@@ -18,7 +18,7 @@ import logging
 from typing import List, Optional, Tuple
 
 from incubator_brpc_tpu.protocol.registry import Protocol, protocol_registry
-from incubator_brpc_tpu.protocol.tbus_std import ParseError
+from incubator_brpc_tpu.protocol.tbus_std import FatalParseError, ParseError
 from incubator_brpc_tpu.runtime.worker_pool import global_worker_pool
 from incubator_brpc_tpu.utils.flags import get_flag
 from incubator_brpc_tpu.utils.status import ErrorCode
@@ -51,9 +51,35 @@ class InputMessenger:
         cut: List[Tuple[Protocol, object]] = []
         buf = sock._read_buf
         max_body = int(get_flag("max_body_size"))
+        retry_others = False
         while True:
             if len(buf) < 8:
                 break
+            # native fast path: once the connection's protocol is known and
+            # it can cut directly off the read chain, skip the peek/copy
+            # machinery entirely (the steady state for binary connections).
+            # A ParseError here falls through ONCE to the full protocol scan
+            # (the reference's TRY_OTHERS), which terminates the connection
+            # itself if nothing matches.
+            pref = sock.preferred_protocol
+            if pref is not None and pref.parse_iobuf is not None and not retry_others:
+                try:
+                    frame, consumed = pref.parse_iobuf(
+                        buf, max_total=max_body + _MAX_HEADER_PEEK
+                    )
+                except FatalParseError as e:
+                    # bytes already consumed: the stream cannot re-sync
+                    self._dispatch(sock, cut)
+                    sock.set_failed(ErrorCode.EREQUEST, f"corrupt frame: {e}")
+                    return
+                except ParseError:
+                    retry_others = True
+                    continue
+                if frame is not None:
+                    cut.append((pref, frame))
+                    continue
+                break  # incomplete: wait for more bytes
+            retry_others = False
             header = buf.to_bytes(_HEADER_PEEK)
             matched = None
             total = None

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import errno as _errno
 import logging
+import os
 import socket as _pysocket
 import threading
 from collections import deque
@@ -153,6 +154,7 @@ class Socket:
         health_check_interval: Optional[float] = None,
         user_message_handler: Optional[Callable] = None,
         context: Optional[Dict] = None,
+        inline_read: bool = False,
     ):
         conn.setblocking(False)
         # NOTE: no explicit SO_RCVBUF/SO_SNDBUF — setting them disables
@@ -177,6 +179,14 @@ class Socket:
         # must be set before the dispatcher registration below: a request
         # can arrive in the same packet burst as the connect
         self.user_message_handler = user_message_handler
+        # Inline reads: drain + cut + process ON the reactor thread instead
+        # of a pool fiber — removes two thread handoffs per message. The
+        # reference gets the same shape from bthread_start_urgent switching
+        # the dispatcher's own worker onto ProcessEvent (socket.cpp:2113).
+        # Only safe when message processing never blocks for long: client
+        # response paths (framework-only; user done callbacks are spawned),
+        # and servers that opt in with usercode_inline.
+        self.inline_read = inline_read
         self.on_failed: List[Callable[["Socket"], None]] = []
         self.on_revived: List[Callable[["Socket"], None]] = []
 
@@ -199,6 +209,7 @@ class Socket:
         # this from Socket refcounting)
         self._io_refs = 0
         self._pending_close: Optional[_pysocket.socket] = None
+        self._kick_fd: Optional[int] = None  # lazy eventfd for poller wakes
         if health_check_interval is None:
             health_check_interval = float(get_flag("health_check_interval"))
         self.health_check_interval = health_check_interval
@@ -364,7 +375,10 @@ class Socket:
                     self._reading = True
                     spawned_reader = True
             if spawned_reader:
-                self._pool.spawn(self._process_event)
+                if self.inline_read:
+                    self._process_event()
+                else:
+                    self._pool.spawn(self._process_event)
         if revents & EVENT_OUT:
             with self._state_lock:
                 self._want_out = False
@@ -386,6 +400,41 @@ class Socket:
         if mask:
             self._dispatcher.rearm(self.fd, mask)
 
+    def _drain_and_cut(self) -> bool:
+        """Drain the fd to EAGAIN into the read IOBuf and run the messenger
+        cut loop. Caller holds an io ref AND read ownership. Returns False
+        if the socket died (EOF / read error) — it is already failed."""
+        eof = False
+        # must equal what one native readv can actually deliver: a
+        # larger ask would make every full read look "short" and kill
+        # the drain loop
+        read_chunk = read_burst_bytes()
+        while True:
+            rc = self._read_buf.append_from_fd(self.fd, read_chunk)
+            if rc > 0:
+                in_bytes << rc
+                if rc < read_chunk:
+                    break  # short read: kernel buffer drained
+                continue
+            if rc == 0:
+                eof = True
+                break
+            if rc in (-_errno.EAGAIN, -_errno.EWOULDBLOCK):
+                break
+            if rc == -_errno.EINTR:
+                continue
+            self.set_failed(
+                ErrorCode.EFAILEDSOCKET,
+                f"read failed: {_errno.errorcode.get(-rc, rc)}",
+            )
+            return False
+        if self.messenger is not None and len(self._read_buf):
+            self.messenger.process(self)
+        if eof:
+            self.set_failed(ErrorCode.EEOF, "remote closed connection")
+            return False
+        return True
+
     def _process_event(self) -> None:
         """ProcessEvent fiber: drain fd → cut messages → dispatch."""
         if not self._acquire_io():
@@ -393,40 +442,94 @@ class Socket:
                 self._reading = False
             return
         try:
-            eof = False
-            # must equal what one native readv can actually deliver: a
-            # larger ask would make every full read look "short" and kill
-            # the drain loop
-            read_chunk = read_burst_bytes()
-            while True:
-                rc = self._read_buf.append_from_fd(self.fd, read_chunk)
-                if rc > 0:
-                    in_bytes << rc
-                    if rc < read_chunk:
-                        break  # short read: kernel buffer drained
-                    continue
-                if rc == 0:
-                    eof = True
-                    break
-                if rc in (-_errno.EAGAIN, -_errno.EWOULDBLOCK):
-                    break
-                if rc == -_errno.EINTR:
-                    continue
-                self.set_failed(
-                    ErrorCode.EFAILEDSOCKET,
-                    f"read failed: {_errno.errorcode.get(-rc, rc)}",
-                )
-                return
-            if self.messenger is not None and len(self._read_buf):
-                self.messenger.process(self)
-            if eof:
-                self.set_failed(ErrorCode.EEOF, "remote closed connection")
+            if not self._drain_and_cut():
                 return
         finally:
             self._release_io()
             with self._state_lock:
                 self._reading = False
             self._arm()
+
+    # -- caller-driven reads (sync-call fast path) --------------------------
+    #
+    # A synchronous caller that just wrote a request can take over the
+    # socket's read side and poll it on its OWN thread: the response is
+    # processed with zero reactor/fiber wakeups — the only threads in a
+    # sync round trip are the caller and the peer. Under the GIL a thread
+    # handoff costs tens of µs, so this is the difference between ~300 µs
+    # and ~30 µs echo latency. The reference needs no analog because waking
+    # a bthread costs ~100 ns; the role (completion processed on the
+    # waiter's context) matches its butex wait-wake path.
+
+    def try_read_ownership(self) -> bool:
+        """Claim the reader role (the dispatcher will not schedule reads
+        while held). False if someone else is reading or the socket is
+        down."""
+        with self._state_lock:
+            if self.state != CONNECTED or self._reading:
+                return False
+            self._reading = True
+        # clear any stale kick so the first poll doesn't spuriously wake
+        kick = self._kick_fd
+        if kick is not None:
+            try:
+                os.read(kick, 8)
+            except (OSError, BlockingIOError):
+                pass
+        return True
+
+    def release_read_ownership(self) -> None:
+        with self._state_lock:
+            self._reading = False
+        self._arm()
+
+    def _ensure_kick_fd(self) -> Optional[int]:
+        k = self._kick_fd
+        if k is None:  # first use: create under the lock; stable afterwards
+            with self._state_lock:
+                if self._kick_fd is None:
+                    try:
+                        self._kick_fd = os.eventfd(0, os.EFD_NONBLOCK)
+                    except (AttributeError, OSError):
+                        self._kick_fd = -1  # no eventfd: ticks instead
+                k = self._kick_fd
+        return k if k != -1 else None
+
+    def kick_poller(self) -> None:
+        """Wake a thread parked in poll_and_process (e.g. its RPC finished
+        on another socket)."""
+        kick = self._kick_fd
+        if kick is not None and kick != -1:
+            try:
+                os.eventfd_write(kick, 1)
+            except OSError:
+                pass
+
+    def poll_and_process(self, timeout: float) -> bool:
+        """Block THIS thread until the fd is readable (or kicked / timeout),
+        then drain + cut + process inline. Requires read ownership. Returns
+        False when the socket died."""
+        import select as _select
+
+        if not self._acquire_io():
+            return False
+        try:
+            kick = self._ensure_kick_fd()
+            rlist = [self.fd] if kick is None else [self.fd, kick]
+            try:
+                r, _, _ = _select.select(rlist, [], [], timeout)
+            except (OSError, ValueError):
+                return False  # fd closed under us
+            if kick is not None and kick in r:
+                try:
+                    os.read(kick, 8)
+                except (OSError, BlockingIOError):
+                    pass
+            if self.fd not in r:
+                return self.state == CONNECTED
+            return self._drain_and_cut()
+        finally:
+            self._release_io()
 
     # -- failure / revival --------------------------------------------------
 
@@ -544,6 +647,16 @@ class Socket:
         with self._state_lock:
             self.state = RECYCLED
         _registry.recycle(self.id)
+
+    def __del__(self):
+        # the kick eventfd lives as long as this object: closing it earlier
+        # would race late kick_poller() calls against kernel fd-number reuse
+        kick = getattr(self, "_kick_fd", None)
+        if kick is not None and kick != -1:
+            try:
+                os.close(kick)
+            except OSError:
+                pass
 
     # -- introspection ------------------------------------------------------
 

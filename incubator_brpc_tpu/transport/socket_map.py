@@ -36,6 +36,9 @@ class SocketMap:
             sock = self._map.get(key)
             if sock is not None and sock.state != RECYCLED:
                 return sock  # FAILED sockets stay: health check may revive
+        # client response processing is framework-only (done callbacks are
+        # spawned to the pool), so reads run inline on the reactor
+        kwargs.setdefault("inline_read", True)
         sock = Socket.connect(ep, messenger=self._messenger, timeout=timeout, **kwargs)
         with self._lock:
             cur = self._map.get(key)
@@ -81,6 +84,7 @@ class SocketMap:
             return sock
         # no health checking: a dead pooled connection is simply discarded
         # at the next pop (the pool replaces, it never revives)
+        kwargs.setdefault("inline_read", True)
         return Socket.connect(
             ep,
             messenger=self._messenger,
@@ -120,6 +124,7 @@ class SocketMap:
         Socket::GetShortSocket) — dialed with THIS map's messenger so
         short-connection traffic parses like everything else."""
         ep = str2endpoint(remote) if isinstance(remote, str) else remote
+        kwargs.setdefault("inline_read", True)
         return Socket.connect(
             ep,
             messenger=self._messenger,

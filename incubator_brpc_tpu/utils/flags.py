@@ -85,7 +85,14 @@ set_flag_unchecked = flag_registry.set_unchecked
 
 # Core framework flags (reference: DEFINE_* scattered through src/brpc/)
 define_flag("health_check_interval", 3, "seconds between health-check probes of a failed socket", lambda v: v > 0)
-define_flag("event_dispatcher_num", 1, "number of event dispatchers")
+define_flag(
+    "event_dispatcher_num",
+    4,
+    "number of event dispatchers (sockets hash across them by fd). With "
+    "inline reads the reactors double as the message-processing threads — "
+    "the reference's dispatcher-is-a-bthread-worker shape — so this is "
+    "sized like a small worker pool, not 1",
+)
 define_flag("fiber_concurrency", 8, "number of worker threads in the fiber scheduler")
 define_flag(
     "fiber_concurrency_max",

@@ -592,6 +592,177 @@ size_t tb_region_free_blocks(int rid) {
   return r.freelist.size();
 }
 
+// ---- wire fast path ----
+
+}  // extern "C"
+
+namespace {
+
+// CRC32C (Castagnoli, reflected poly 0x82F63B78). zlib-style chaining:
+// internal state is ~crc so seed 0 composes across calls.
+uint32_t g_crc32c_table[8][256];
+
+void crc32c_init_table() {
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; ++k) c = (c & 1) ? 0x82F63B78u ^ (c >> 1) : c >> 1;
+    g_crc32c_table[0][i] = c;
+  }
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t c = g_crc32c_table[0][i];
+    for (int s = 1; s < 8; ++s) {
+      c = g_crc32c_table[0][c & 0xFF] ^ (c >> 8);
+      g_crc32c_table[s][i] = c;
+    }
+  }
+}
+
+uint32_t crc32c_sw(uint32_t crc, const unsigned char* p, size_t n) {
+  // slice-by-8
+  while (n >= 8) {
+    uint64_t w;
+    memcpy(&w, p, 8);
+    w ^= crc;
+    crc = g_crc32c_table[7][w & 0xFF] ^ g_crc32c_table[6][(w >> 8) & 0xFF] ^
+          g_crc32c_table[5][(w >> 16) & 0xFF] ^
+          g_crc32c_table[4][(w >> 24) & 0xFF] ^
+          g_crc32c_table[3][(w >> 32) & 0xFF] ^
+          g_crc32c_table[2][(w >> 40) & 0xFF] ^
+          g_crc32c_table[1][(w >> 48) & 0xFF] ^ g_crc32c_table[0][w >> 56];
+    p += 8;
+    n -= 8;
+  }
+  while (n--) crc = g_crc32c_table[0][(crc ^ *p++) & 0xFF] ^ (crc >> 8);
+  return crc;
+}
+
+#if defined(__x86_64__)
+__attribute__((target("sse4.2"))) uint32_t crc32c_hw(uint32_t crc,
+                                                     const unsigned char* p,
+                                                     size_t n) {
+  uint64_t c = crc;
+  while (n >= 8) {
+    uint64_t w;
+    memcpy(&w, p, 8);
+    c = __builtin_ia32_crc32di(c, w);
+    p += 8;
+    n -= 8;
+  }
+  uint32_t c32 = static_cast<uint32_t>(c);
+  while (n--) c32 = __builtin_ia32_crc32qi(c32, *p++);
+  return c32;
+}
+#endif
+
+uint32_t (*pick_crc32c_impl())(uint32_t, const unsigned char*, size_t) {
+  crc32c_init_table();
+#if defined(__x86_64__)
+  if (__builtin_cpu_supports("sse4.2")) return crc32c_hw;
+#endif
+  return crc32c_sw;
+}
+
+// resolved once at load time (before any Python thread exists)
+uint32_t (*const g_crc32c_impl)(uint32_t, const unsigned char*, size_t) =
+    pick_crc32c_impl();
+
+inline uint32_t crc32c_update(uint32_t state, const void* data, size_t n) {
+  return g_crc32c_impl(state, static_cast<const unsigned char*>(data), n);
+}
+
+constexpr uint32_t kTbusMagic = 0x54505243u;  // "TPRC" little-endian
+
+}  // namespace
+
+extern "C" {
+
+uint32_t tb_crc32c(uint32_t seed, const void* data, size_t n) {
+  return ~crc32c_update(~seed, data, n);
+}
+
+uint32_t tb_iobuf_crc32c(const tb_iobuf* b, uint32_t seed, size_t pos,
+                         size_t n) {
+  uint32_t state = ~seed;
+  for (const BlockRef& r : b->refs) {
+    if (n == 0) break;
+    if (pos >= r.length) {
+      pos -= r.length;
+      continue;
+    }
+    size_t avail = r.length - pos;
+    size_t m = n < avail ? n : avail;
+    state = crc32c_update(state, r.block->data + r.offset + pos, m);
+    n -= m;
+    pos = 0;
+  }
+  return ~state;
+}
+
+int tb_tbus_peek(const tb_iobuf* in, tb_tbus_hdr* out) {
+  if (in->nbytes < 32) return 1;
+  uint32_t w[8];
+  tb_iobuf_copy_to(in, w, 32, 0);
+  if (w[0] != kTbusMagic) return -1;
+  out->body_len = w[1];
+  out->flags = w[2];
+  out->cid_lo = w[3];
+  out->cid_hi = w[4];
+  out->meta_len = w[5];
+  out->crc = w[6];
+  out->error_code = w[7];
+  return 0;
+}
+
+// flag bit 3: the frame's crc covers the whole body (meta+payload+
+// attachment). Default frames cover META ONLY — the reference's baidu_std
+// carries no body checksum at all (TCP already checksums segments;
+// baidu_rpc_protocol.cpp:53-58's header is just sizes), so routing info is
+// protected here and bulk bytes ride the transport's own integrity.
+constexpr uint32_t kFlagBodyCrc = 8;
+
+int tb_tbus_cut(tb_iobuf* in, const tb_tbus_hdr* hdr, void* meta_out,
+                tb_iobuf* body_out) {
+  if (hdr->meta_len > hdr->body_len) return -3;
+  const size_t total = 32 + static_cast<size_t>(hdr->body_len);
+  if (in->nbytes < total) return 1;
+  const size_t span =
+      (hdr->flags & kFlagBodyCrc) ? hdr->body_len : hdr->meta_len;
+  if (tb_iobuf_crc32c(in, 0, 32, span) != hdr->crc) return -2;
+  tb_iobuf_popn(in, 32);
+  if (hdr->meta_len) {
+    tb_iobuf_copy_to(in, meta_out, hdr->meta_len, 0);
+    tb_iobuf_popn(in, hdr->meta_len);
+  }
+  tb_iobuf_cutn(in, body_out, hdr->body_len - hdr->meta_len);
+  return 0;
+}
+
+void tb_tbus_pack(tb_iobuf* out, const void* meta, size_t meta_len,
+                  const void* payload, size_t payload_len, const void* att,
+                  size_t att_len, uint32_t cid_lo, uint32_t cid_hi,
+                  uint32_t flags, uint32_t error_code, int copy_body) {
+  uint32_t state = ~0u;
+  if (meta_len) state = crc32c_update(state, meta, meta_len);
+  if (flags & kFlagBodyCrc) {
+    if (payload_len) state = crc32c_update(state, payload, payload_len);
+    if (att_len) state = crc32c_update(state, att, att_len);
+  }
+  uint32_t hdr[8] = {kTbusMagic,
+                     static_cast<uint32_t>(meta_len + payload_len + att_len),
+                     flags,
+                     cid_lo,
+                     cid_hi,
+                     static_cast<uint32_t>(meta_len),
+                     ~state,
+                     error_code};
+  tb_iobuf_append(out, hdr, sizeof(hdr));
+  if (meta_len) tb_iobuf_append(out, meta, meta_len);
+  if (copy_body) {
+    if (payload_len) tb_iobuf_append(out, payload, payload_len);
+    if (att_len) tb_iobuf_append(out, att, att_len);
+  }
+}
+
 // ---- misc ----
 
 uint32_t tb_crc32(uint32_t seed, const void* data, size_t n) {

@@ -96,6 +96,51 @@ int tb_iobuf_append_from_region(tb_iobuf* b, int rid, const void* data,
 // free blocks available in region.
 size_t tb_region_free_blocks(int rid);
 
+// ---- wire fast path (tbus_std framing; reference splits this between
+// policy/baidu_rpc_protocol.cpp pack/parse and input_messenger.cpp's cut
+// loop — here the whole per-frame byte path is native so Python never
+// copies or checksums payload bytes) ----
+
+// CRC32C (Castagnoli) with zlib-style chaining: seed 0 to start, feed the
+// previous return value to continue. Uses SSE4.2 hardware CRC when the CPU
+// has it (one u64 step per cycle), a slice-table otherwise.
+uint32_t tb_crc32c(uint32_t seed, const void* data, size_t n);
+// CRC32C over [pos, pos+n) of the chain without copying.
+uint32_t tb_iobuf_crc32c(const tb_iobuf* b, uint32_t seed, size_t pos,
+                         size_t n);
+
+typedef struct tb_tbus_hdr {
+  uint32_t body_len;
+  uint32_t flags;
+  uint32_t cid_lo;
+  uint32_t cid_hi;
+  uint32_t meta_len;
+  uint32_t crc;
+  uint32_t error_code;
+} tb_tbus_hdr;
+
+// Peek the fixed 32-byte header off the front of `in` without consuming.
+// 0 = filled `out`; 1 = fewer than 32 bytes buffered; -1 = bad magic.
+int tb_tbus_peek(const tb_iobuf* in, tb_tbus_hdr* out);
+// Consume one complete frame: verify CRC32C (over the meta, or the whole
+// body when header flag bit 3 is set) by walking the block refs (no copy),
+// pop the header, copy the (small) meta into `meta_out` (capacity >=
+// hdr->meta_len), and CUT payload+attachment into `body_out` zero-copy
+// (refs move, bytes don't).
+// 0 = ok; 1 = frame incomplete; -2 = crc mismatch (nothing consumed);
+// -3 = malformed (meta_len > body_len).
+int tb_tbus_cut(tb_iobuf* in, const tb_tbus_hdr* hdr, void* meta_out,
+                tb_iobuf* body_out);
+// Append header + meta to `out`, computing the CRC32C over meta (and over
+// payload+attachment too when flags bit 3 is set) in one native pass.
+// copy_body != 0: payload+attachment are appended (copied) too — the whole
+// frame in ONE call, right for small frames. copy_body == 0: the caller
+// appends them after (zero-copy via append_external if large).
+void tb_tbus_pack(tb_iobuf* out, const void* meta, size_t meta_len,
+                  const void* payload, size_t payload_len, const void* att,
+                  size_t att_len, uint32_t cid_lo, uint32_t cid_hi,
+                  uint32_t flags, uint32_t error_code, int copy_body);
+
 // ---- misc ----
 uint32_t tb_crc32(uint32_t seed, const void* data, size_t n);
 uint64_t tb_fast_rand(void);

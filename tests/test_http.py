@@ -132,7 +132,7 @@ class TestPortal:
         # non-reloadable flags are refused (reloadable_flags.h gate)
         status, _, _ = fetch(portal_server, "/flags/event_dispatcher_num?setvalue=2")
         assert status == 403
-        assert flag_registry.get("event_dispatcher_num") == 1
+        assert flag_registry.get("event_dispatcher_num") == 4  # default kept
 
     def test_rpcz_records_real_calls(self, portal_server):
         assert set_flag("enable_rpcz", True)

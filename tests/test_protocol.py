@@ -73,13 +73,77 @@ def test_resumable_parse_contract():
 
 
 def test_bad_magic_and_crc_raise():
+    from incubator_brpc_tpu.utils.flags import set_flag
+
     meta = Meta(service="S", method="m")
-    wire = bytearray(pack_frame(meta, b"abc", correlation_id=4))
     with pytest.raises(ParseError):
         try_parse_frame(b"\x00" * HEADER_BYTES)
-    wire[-1] ^= 0xFF  # corrupt body
+    # the crc always covers the meta (routing info)
+    wire = bytearray(pack_frame(meta, b"abc", correlation_id=4))
+    wire[HEADER_BYTES + 2] ^= 0xFF  # corrupt a meta byte
     with pytest.raises(ParseError):
         try_parse_frame(bytes(wire))
+    # payload bytes are covered only under tbus_body_crc (the default
+    # trusts the transport, like baidu_std which carries no checksum)
+    set_flag("tbus_body_crc", True)
+    try:
+        wire = bytearray(pack_frame(meta, b"abc", correlation_id=4))
+        wire[-1] ^= 0xFF  # corrupt body
+        with pytest.raises(ParseError):
+            try_parse_frame(bytes(wire))
+    finally:
+        set_flag("tbus_body_crc", False)
+
+
+def test_parse_frame_iobuf_matches_bytes_path():
+    from incubator_brpc_tpu.iobuf import IOBuf
+    from incubator_brpc_tpu.native import NATIVE_AVAILABLE
+    from incubator_brpc_tpu.protocol.tbus_std import parse_frame_iobuf
+
+    if not NATIVE_AVAILABLE:
+        pytest.skip("native runtime unavailable")
+    meta = Meta(service="S", method="m", log_id=9)
+    wire = pack_frame(meta, b"pay" * 1000, correlation_id=(7 << 32) | 5,
+                      attachment=b"att" * 10)
+    ref, ref_consumed = try_parse_frame(wire)
+    buf = IOBuf()
+    # split the frame across appends so the native cut walks multiple blocks
+    buf.append(wire[:10])
+    buf.append(wire[10:200])
+    buf.append(wire[200:])
+    buf.append(b"nextframe-prefix")
+    frame, consumed = parse_frame_iobuf(buf)
+    assert consumed == ref_consumed
+    assert frame.meta.service == "S" and frame.meta.log_id == 9
+    assert frame.payload == ref.payload
+    assert frame.attachment == ref.attachment
+    assert frame.correlation_id == (7 << 32) | 5
+    assert len(buf) == len(b"nextframe-prefix")  # only the frame consumed
+
+
+def test_parse_frame_iobuf_incomplete_and_corrupt():
+    from incubator_brpc_tpu.iobuf import IOBuf
+    from incubator_brpc_tpu.native import NATIVE_AVAILABLE
+    from incubator_brpc_tpu.protocol.tbus_std import parse_frame_iobuf
+
+    if not NATIVE_AVAILABLE:
+        pytest.skip("native runtime unavailable")
+    wire = pack_frame(Meta(service="S", method="m"), b"xyz", correlation_id=1)
+    for cut in (1, HEADER_BYTES - 1, HEADER_BYTES, len(wire) - 1):
+        buf = IOBuf()
+        buf.append(wire[:cut])
+        assert parse_frame_iobuf(buf) == (None, 0)
+        assert len(buf) == cut  # nothing consumed on incomplete
+    corrupt = bytearray(wire)
+    corrupt[HEADER_BYTES + 1] ^= 0xFF  # meta byte: always crc-covered
+    buf = IOBuf()
+    buf.append(bytes(corrupt))
+    with pytest.raises(ParseError):
+        parse_frame_iobuf(buf)
+    buf = IOBuf()
+    buf.append(b"\x00" * HEADER_BYTES)
+    with pytest.raises(ParseError):
+        parse_frame_iobuf(buf)
 
 
 def test_response_flag_and_error_code():

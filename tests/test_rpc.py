@@ -356,3 +356,31 @@ class TestRpcz:
         finally:
             set_flag_unchecked("enable_rpcz", old)
             span_store.clear()
+
+
+class TestSyncDeadlineWithoutTimer:
+    def test_silent_server_times_out(self):
+        # Sync calls carry NO TimerThread entry (the caller's poll loop owns
+        # the deadline): a server that accepts the request and never
+        # responds must still produce ERPCTIMEDOUT on time.
+        from incubator_brpc_tpu.rpc import Channel, Controller, Server
+
+        srv = Server()
+
+        def black_hole(cntl, req):
+            cntl.set_async()  # handler keeps the response forever
+            return None
+
+        srv.add_service("t", {"hole": black_hole})
+        assert srv.start(0)
+        try:
+            ch = Channel()
+            assert ch.init(f"127.0.0.1:{srv.port}")
+            t0 = time.monotonic()
+            cntl = ch.call_method("t", "hole", b"x", cntl=Controller(timeout_ms=300))
+            dt = time.monotonic() - t0
+            assert cntl.error_code == ErrorCode.ERPCTIMEDOUT
+            assert 0.2 < dt < 2.0, f"deadline not enforced: {dt:.2f}s"
+        finally:
+            srv.stop()
+            srv.join(timeout=5)

@@ -403,3 +403,43 @@ def test_iobuf_write_zero_copy_path(echo_server):
         assert frame.payload == payload
     finally:
         c.sock.recycle()
+
+
+class TestOversizedHeaderRejected:
+    def test_crafted_giant_header_fails_connection_before_buffering(self):
+        # A valid-magic header declaring a ~4GiB body must be rejected at
+        # header time on the native fast path (not buffered until OOM).
+        import socket as pysocket
+        import struct
+        import time
+
+        from incubator_brpc_tpu.rpc import Channel, Server
+
+        srv = Server()
+        srv.add_service("t", {"echo": lambda cntl, req: req})
+        assert srv.start(0)
+        try:
+            # establish the preferred protocol with one good call first
+            ch = Channel()
+            assert ch.init(f"127.0.0.1:{srv.port}")
+            assert ch.call_method("t", "echo", b"ok").ok()
+
+            c = pysocket.create_connection(("127.0.0.1", srv.port))
+            hdr = struct.pack(
+                "<8I", 0x54505243, 0xFFFFFF00, 0, 1, 0, 0, 0, 0
+            )
+            c.sendall(hdr + b"slow-drip")
+            c.settimeout(5)
+            # server must close the connection (recv -> EOF), not buffer
+            deadline = time.monotonic() + 5
+            got = b"x"
+            while got and time.monotonic() < deadline:
+                try:
+                    got = c.recv(4096)
+                except (ConnectionResetError, OSError):
+                    got = b""
+            assert not got, "connection not closed after oversized header"
+            c.close()
+        finally:
+            srv.stop()
+            srv.join(timeout=5)
