@@ -83,11 +83,65 @@ class MethodStatus:
 
 
 class MethodProperty:
-    __slots__ = ("handler", "status")
+    __slots__ = ("handler", "status", "full_name")
 
-    def __init__(self, handler: Callable, status: MethodStatus):
+    def __init__(self, handler: Callable, status: MethodStatus, full_name: str):
         self.handler = handler
         self.status = status
+        self.full_name = full_name
+
+
+class _MethodMap:
+    """Method table on the native open-addressing FlatMap (src/tbutil
+    tb_flatmap; reference server.cpp:1209 builds _method_map on
+    butil::FlatMap for the same hot lookup). Keys are a 64-bit double-CRC
+    of the full name (crc32c | crc32<<32 — two polynomials, so a clash
+    requires both to collide); values index a Python list holding the
+    MethodProperty objects, each verified by name on hit. A str-keyed dict
+    remains for registration, iteration, and the (never-yet-seen)
+    double-collision overflow."""
+
+    def __init__(self) -> None:
+        from incubator_brpc_tpu import native
+
+        self._by_name: Dict[str, MethodProperty] = {}
+        self._props: list = []
+        self._fm = native.FlatMap(64) if native.NATIVE_AVAILABLE else None
+        self._crc32 = native.crc32
+        self._crc32c = native.crc32c
+
+    def _key(self, name: str) -> int:
+        b = name.encode()
+        return self._crc32c(b) | (self._crc32(b) << 32)
+
+    def insert(self, full: str, prop: MethodProperty) -> None:
+        self._by_name[full] = prop
+        if self._fm is not None:
+            key = self._key(full)
+            if key not in self._fm:  # double-collision → dict overflow
+                self._fm[key] = len(self._props)
+                self._props.append(prop)
+
+    def get(self, full: str) -> Optional[MethodProperty]:
+        if self._fm is not None:
+            idx = self._fm.get(self._key(full))
+            if idx is not None:
+                prop = self._props[idx]
+                if prop.full_name == full:
+                    return prop
+        return self._by_name.get(full)
+
+    def __contains__(self, full: str) -> bool:
+        return self.get(full) is not None
+
+    def __iter__(self):
+        return iter(self._by_name)
+
+    def items(self):
+        return self._by_name.items()
+
+    def as_dict(self) -> Dict[str, MethodProperty]:
+        return dict(self._by_name)
 
 
 class ServerOptions:
@@ -120,7 +174,7 @@ class ServerOptions:
 class Server:
     def __init__(self, options: Optional[ServerOptions] = None):
         self.options = options or ServerOptions()
-        self._methods: Dict[str, MethodProperty] = {}
+        self._methods = _MethodMap()
         self._http_handlers: Dict[str, Callable] = {}
         self._acceptor: Optional[Acceptor] = None
         self._messenger = InputMessenger()
@@ -154,7 +208,7 @@ class Server:
                 if max_concurrency is not None
                 else self.options.method_max_concurrency
             )
-            self._methods[full] = MethodProperty(handler, MethodStatus(full, mc))
+            self._methods.insert(full, MethodProperty(handler, MethodStatus(full, mc), full))
 
     def add_http_handler(self, path: str, handler: Callable) -> None:
         """Register an HTTP handler ``fn(HttpFrame) -> (status, content_type,
@@ -179,7 +233,7 @@ class Server:
         return prop.status if prop else None
 
     def methods(self) -> Dict[str, MethodProperty]:
-        return dict(self._methods)
+        return self._methods.as_dict()
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -75,44 +75,74 @@ def when_drained(sock, action, stalls: int = 0, last_unwritten: int = -1) -> Non
 
 
 class _Registry:
-    """SocketId = version<<32 | slot. address() is None once failed or
-    recycled; slots are reused with a bumped version (ABA-safe)."""
+    """Socket registry on the native versioned-id slab (src/tbutil
+    tb_respool; reference resource_pool.h:24-83 backing SocketId). The
+    slot/version/ABA discipline — what makes Address-after-SetFailed safe —
+    lives in native code; Python keeps only the slot-indexed object array
+    (PyObjects can't live in the C slab). SocketId = version<<32 | slot,
+    version odd while live."""
 
     def __init__(self):
+        from incubator_brpc_tpu.native import NATIVE_AVAILABLE, ResourcePool
+
         self._lock = threading.Lock()
-        self._slots: List[Optional["Socket"]] = []
+        self._objs: List[Optional["Socket"]] = []
+        self._pool = ResourcePool(8) if NATIVE_AVAILABLE else None
+        # pure-Python fallback state (toolchain-less hosts only)
         self._versions: List[int] = []
         self._free: List[int] = []
 
     def insert(self, sock: "Socket") -> int:
         with self._lock:
+            if self._pool is not None:
+                sid = self._pool.get()
+                slot = sid & 0xFFFFFFFF
+                while len(self._objs) <= slot:
+                    self._objs.append(None)
+                self._objs[slot] = sock
+                return sid
             if self._free:
                 slot = self._free.pop()
-                self._versions[slot] += 1
-                self._slots[slot] = sock
+                self._versions[slot] += 2
+                self._objs[slot] = sock
             else:
-                slot = len(self._slots)
-                self._slots.append(sock)
+                slot = len(self._objs)
+                self._objs.append(sock)
                 self._versions.append(1)
             return (self._versions[slot] << 32) | slot
 
     def address(self, sid: int) -> Optional["Socket"]:
-        slot, version = sid & 0xFFFFFFFF, sid >> 32
+        slot = sid & 0xFFFFFFFF
         with self._lock:
-            if slot >= len(self._slots) or self._versions[slot] != version:
-                return None
-            sock = self._slots[slot]
+            if self._pool is not None:
+                if self._pool.address(sid) is None:
+                    return None  # stale version: recycled (or never issued)
+                sock = self._objs[slot] if slot < len(self._objs) else None
+            else:
+                if slot >= len(self._objs) or self._versions[slot] != sid >> 32:
+                    return None
+                sock = self._objs[slot]
         if sock is None or sock.state != CONNECTED:
             return None
         return sock
 
     def recycle(self, sid: int) -> None:
-        slot, version = sid & 0xFFFFFFFF, sid >> 32
+        slot = sid & 0xFFFFFFFF
         with self._lock:
-            if slot < len(self._slots) and self._versions[slot] == version:
-                self._slots[slot] = None
+            if self._pool is not None:
+                if self._pool.return_(sid) and slot < len(self._objs):
+                    self._objs[slot] = None
+                return
+            if slot < len(self._objs) and self._versions[slot] == sid >> 32:
+                self._objs[slot] = None
                 self._versions[slot] += 1
                 self._free.append(slot)
+
+    def live_count(self) -> int:
+        if self._pool is not None:
+            return self._pool.live
+        with self._lock:
+            return sum(1 for s in self._objs if s is not None)
 
 
 _registry = _Registry()
