@@ -222,6 +222,34 @@ def bench_device_rpc(results: dict) -> None:
     server.stop()
 
 
+def bench_device_link(results: dict) -> None:
+    """transport=tpu end to end: the two-party device link (handshake over
+    the host socket, frames over the jitted exchange step). On this bench
+    host both parties share the one real chip (loopback swap geometry);
+    the tunneled device fetches (~100-250 ms each) dominate latency — the
+    structure, not the wire speed, is what this measures."""
+    from incubator_brpc_tpu.rpc import Channel, ChannelOptions, Server, ServerOptions
+
+    server = Server(ServerOptions(usercode_inline=True))
+    server.add_service("bench", {"echo": lambda cntl, req: req})
+    assert server.start(0)
+    ch = Channel()
+    assert ch.init(
+        f"127.0.0.1:{server.port}",
+        options=ChannelOptions(transport="tpu", timeout_ms=120000),
+    )
+    payload = b"d" * 1024
+    c = ch.call_method("bench", "echo", payload)  # warm: compiles the step
+    assert c.ok(), c.error_text
+    n = 10
+    t0 = time.perf_counter()
+    for _ in range(n):
+        c = ch.call_method("bench", "echo", payload)
+        assert c.ok(), c.error_text
+    results["device_link_echo_us"] = (time.perf_counter() - t0) / n * 1e6
+    server.stop()
+
+
 def bench_fabricnet(results: dict) -> None:
     """Flagship train step on the real chip at a bench-scale config."""
     from incubator_brpc_tpu.models import fabricnet
@@ -279,6 +307,7 @@ def main() -> None:
     bench_device_echo(results)
     bench_rpc_echo(results)
     bench_device_rpc(results)
+    bench_device_link(results)
     bench_fabricnet(results)
 
     gbps = results["large_frame_gbps"]
@@ -299,6 +328,7 @@ def main() -> None:
                     "stream_gbps": round(results["stream_gbps"], 3),
                     "device_rpc_us": round(results["device_rpc_us"], 1),
                     "device_rpc_qps": round(results["device_rpc_qps"]),
+                    "device_link_echo_us": round(results["device_link_echo_us"], 1),
                     "fabricnet_step_ms": round(results["fabricnet_step_ms"], 2),
                     # null (not 0) when cost analysis was unavailable
                     "fabricnet_tflops": (

@@ -5,13 +5,15 @@ EchoService::Echo returns the request, client prints the round trip).
 Run server:  python examples/echo.py server [port]
 Run client:  python examples/echo.py client <port> [message]
 Or demo both in one process:  python examples/echo.py demo
+Flip the transport (same service, frames over the device plane — the
+reference's use_rdma flip):  python examples/echo.py demo tpu
 """
 
 import sys
 
 sys.path.insert(0, ".")
 
-from incubator_brpc_tpu.rpc import Channel, Server  # noqa: E402
+from incubator_brpc_tpu.rpc import Channel, ChannelOptions, Server  # noqa: E402
 
 
 def make_server(port: int = 0) -> Server:
@@ -29,17 +31,21 @@ def make_server(port: int = 0) -> Server:
     return server
 
 
-def run_client(port: int, message: str = "hello world") -> None:
+def run_client(port: int, message: str = "hello world", transport: str = "tcp") -> None:
     ch = Channel()
-    assert ch.init(f"127.0.0.1:{port}")
+    opts = ChannelOptions(transport=transport, timeout_ms=60000)
+    assert ch.init(f"127.0.0.1:{port}", options=opts)
     cntl = ch.call_method(
         "EchoService", "Echo", message.encode(), attachment=b"piggyback"
     )
     if cntl.failed():
         raise SystemExit(f"RPC failed: {cntl.error_text}")
+    via = ""
+    if transport == "tpu" and ch._device_sock is not None:
+        via = f" via device link {ch._device_sock.link.devices}"
     print(f"response={cntl.response_payload!r} "
           f"attachment={cntl.response_attachment!r} "
-          f"latency={cntl.latency_us:.0f}us")
+          f"latency={cntl.latency_us:.0f}us{via}")
 
 
 def main() -> None:
@@ -56,8 +62,9 @@ def main() -> None:
     elif mode == "client":
         run_client(int(sys.argv[2]), *(sys.argv[3:4] or []))
     else:
+        transport = sys.argv[2] if len(sys.argv) > 2 else "tcp"
         server = make_server(0)
-        run_client(server.port)
+        run_client(server.port, transport=transport)
         server.stop()
 
 

@@ -102,6 +102,10 @@ class ChannelOptions:
         protocol: str = "tbus_std",
         auth=None,
         connection_type: str = "single",
+        transport: str = "tcp",
+        device_index: int = 0,
+        link_slot_words: int = 16384,
+        link_window: int = 4,
     ):
         self.timeout_ms = timeout_ms
         self.max_retry = max_retry
@@ -115,6 +119,17 @@ class ChannelOptions:
         if connection_type not in ("single", "pooled", "short"):
             raise ValueError(f"unknown connection_type {connection_type!r}")
         self.connection_type = connection_type
+        # "tcp" (host sockets) or "tpu" (two-party device link: handshake
+        # over the host socket, frames over the device plane — the
+        # reference's ChannelOptions.use_rdma slot, channel.h)
+        if transport not in ("tcp", "tpu"):
+            raise ValueError(f"unknown transport {transport!r}")
+        if transport == "tpu" and connection_type != "single":
+            raise ValueError("transport='tpu' supports connection_type='single'")
+        self.transport = transport
+        self.device_index = device_index
+        self.link_slot_words = link_slot_words
+        self.link_window = link_window
 
 
 class Channel:
@@ -131,6 +146,8 @@ class Channel:
         self._lb = None  # LoadBalancerWithNaming (lb/__init__.py), task #5
         self._socket_map = _client_socket_map
         self._init_done = False
+        self._device_sock = None  # transport="tpu": the established link
+        self._device_lock = threading.Lock()
 
     def init(
         self,
@@ -143,6 +160,12 @@ class Channel:
         if isinstance(target, EndPoint):
             self._single_server = target
         elif "://" in str(target) and not str(target).startswith("unix://"):
+            if self._options.transport == "tpu":
+                raise ValueError(
+                    "transport='tpu' requires a single-server target (the "
+                    "link binds one device pair; LB fan-out lowers to "
+                    "collectives via ParallelChannel instead)"
+                )
             if self._options.connection_type != "single":
                 # visible error, not a silent downgrade: LB targets ride
                 # the shared main sockets (the reference hangs secondaries
@@ -375,8 +398,46 @@ class Channel:
         else:
             _recycle_when_drained(sock)
 
+    def _call_host(self, service, method, request, cntl=None):
+        """A call forced onto the HOST (TCP) path even when this channel's
+        transport is 'tpu' — the handshake itself must ride the bootstrap
+        socket (the reference's deferred-handshake-over-TCP,
+        socket.cpp:1692-1704)."""
+        if cntl is None:
+            cntl = Controller()
+        cntl._force_host = True
+        return self.call_method(service, method, request, cntl=cntl)
+
+    def _get_device_socket(self, cntl: Controller):
+        """transport='tpu': the established DeviceSocket, re-handshaking a
+        dead link (the host socket below it reconnects via its own paths)."""
+        from incubator_brpc_tpu.transport.device_link import establish_device_link
+        from incubator_brpc_tpu.transport.sock import CONNECTED
+
+        with self._device_lock:
+            ds = self._device_sock
+            if ds is not None and ds.state == CONNECTED:
+                return ds
+            if ds is not None:
+                ds.recycle()  # free the dead link's registry slot
+            ds = establish_device_link(
+                self,
+                device_index=self._options.device_index,
+                slot_words=self._options.link_slot_words,
+                window=self._options.link_window,
+                timeout_ms=cntl.timeout_ms or 60000,
+            )
+            self._device_sock = ds
+            return ds
+
     def _pick_socket(self, cntl: Controller):
         ctype = self._options.connection_type
+        if self._options.transport == "tpu" and not getattr(
+            cntl, "_force_host", False
+        ):
+            if self._single_server is None:
+                raise ConnectionError("transport='tpu' requires a single server")
+            return self._get_device_socket(cntl)
         if self._single_server is not None:
             if ctype == "single":
                 return self._socket_map.get_or_create(
@@ -454,11 +515,17 @@ class Channel:
             self._end_rpc(cntl)
             return
         pool = global_worker_pool()
+        import time as _time
+
+        remaining = None
+        if cntl._deadline:
+            remaining = max(0.001, cntl._deadline - _time.monotonic())
         rc = sock.write(
             data,
             on_error=lambda code, text: pool.spawn(
                 call_id_space.error, cid, code, text
             ),
+            timeout=remaining,
         )
         if rc != 0:
             self._arbitrate_error(cntl, rc, f"write to {sock.remote} failed")

@@ -86,6 +86,9 @@ class Controller:
         # between write and select delays the server's reactor wake)
         self._want_poll = False
         self._poll_owned = None
+        # forces this call onto the host (TCP) socket even on a
+        # transport='tpu' channel (the device-link handshake itself)
+        self._force_host = False
         # (kind, socket) per attempt for pooled/short connection types —
         # disposed together at EndRPC (never mid-call: a backup request
         # keeps the original attempt's connection in flight)

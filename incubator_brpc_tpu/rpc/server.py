@@ -155,12 +155,16 @@ class ServerOptions:
         has_builtin_services: bool = True,
         auth=None,
         usercode_inline: bool = False,
+        device_index: Optional[int] = None,
     ):
         self.max_concurrency = max_concurrency
         self.method_max_concurrency = method_max_concurrency
         self.idle_timeout_s = idle_timeout_s
         self.has_builtin_services = has_builtin_services
         self.auth = auth  # Authenticator (rpc/auth.py)
+        # device this server binds for transport='tpu' links (None = pick a
+        # neighbor of the client's device; the reference's use_rdma slot)
+        self.device_index = device_index
         # Run request processing (cut + handler) inline on the reactor
         # thread instead of a pool fiber — removes two thread handoffs per
         # request, the analog of the reference running user code directly
@@ -186,6 +190,7 @@ class Server:
         self.nrequest = Adder(name=None)
         self.nerror = Adder(name=None)
         self.listen_endpoint: Optional[EndPoint] = None
+        self._device_socks: list = []  # transport='tpu' links we accepted
 
     # -- registration --------------------------------------------------------
 
@@ -248,6 +253,23 @@ class Server:
             ep = str2endpoint(listen)  # "ip:port" or "unix:///path"
         else:
             ep = listen
+        # the transport='tpu' bootstrap: every server answers the device
+        # handshake on its host port (the reference's Socket accepts the
+        # RDMA magic on any connection when rdma is compiled in)
+        from incubator_brpc_tpu.transport.device_link import (
+            HANDSHAKE_METHOD,
+            HANDSHAKE_SERVICE,
+            make_handshake_handler,
+        )
+
+        hs = f"{HANDSHAKE_SERVICE}.{HANDSHAKE_METHOD}"
+        if hs not in self._methods:
+            self._methods.insert(
+                hs,
+                MethodProperty(
+                    make_handshake_handler(self), MethodStatus(hs, 0), hs
+                ),
+            )
         self._acceptor = Acceptor(
             ep,
             messenger=self._messenger,
@@ -272,6 +294,12 @@ class Server:
         self._stopping = True
         if self._acceptor is not None:
             self._acceptor.stop()
+        for ds in list(self._device_socks):
+            try:
+                ds.set_failed(ErrorCode.ECLOSE, "server stopped")
+            except Exception:
+                logger.exception("device link teardown raised")
+        self._device_socks.clear()
         if self.options.has_builtin_services:
             from incubator_brpc_tpu.builtin import portal
 

@@ -282,9 +282,13 @@ class Socket:
         self,
         data: Union[bytes, IOBuf],
         on_error: Optional[Callable[[int, str], None]] = None,
+        timeout: Optional[float] = None,
     ) -> int:
         """Queue data; returns 0 or an ErrorCode. Never blocks the caller
-        beyond one nonblocking writev (the StartWrite inline attempt)."""
+        beyond one nonblocking writev (the StartWrite inline attempt) —
+        ``timeout`` is accepted for write-path interface parity (the device
+        transport's send can block on its window; this one backpressures
+        via EOVERCROWDED instead)."""
         if self.state != CONNECTED:
             return ErrorCode.EFAILEDSOCKET
         if isinstance(data, (bytes, bytearray, memoryview)):

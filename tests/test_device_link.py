@@ -1,0 +1,175 @@
+"""Two-party device transport tests (reference shape:
+test/brpc_rdma_unittest.cpp — handshake, data path, flow control, teardown
+— run loopback on the virtual device mesh, SURVEY §4's prescription)."""
+
+import threading
+import time
+
+import pytest
+
+from incubator_brpc_tpu.rpc import Channel, ChannelOptions, Server
+from incubator_brpc_tpu.utils.status import ErrorCode
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+@pytest.fixture
+def echo_server():
+    server = Server()
+
+    def echo(cntl, req):
+        cntl.response_attachment = cntl.request_attachment
+        return req
+
+    server.add_service("EchoService", {"Echo": echo})
+    assert server.start(0)
+    yield server
+    server.stop()
+    server.join(timeout=5)
+
+
+def _tpu_channel(server, **opts) -> Channel:
+    ch = Channel()
+    assert ch.init(
+        f"127.0.0.1:{server.port}",
+        options=ChannelOptions(transport="tpu", timeout_ms=30000, **opts),
+    )
+    return ch
+
+
+class TestDeviceEcho:
+    def test_echo_roundtrip_crosses_two_devices(self, echo_server):
+        import jax
+
+        ch = _tpu_channel(echo_server)
+        cntl = ch.call_method("EchoService", "Echo", b"over the device plane")
+        assert cntl.ok(), cntl.error_text
+        assert cntl.response_payload == b"over the device plane"
+        ds = ch._device_sock
+        assert ds is not None
+        if len(jax.devices()) > 1:
+            # the two halves really sit on different mesh devices
+            assert ds.link.devices[0] != ds.link.devices[1]
+            assert ds.link._mesh is not None  # shard_map/ppermute path
+
+    def test_attachment_and_meta_survive(self, echo_server):
+        ch = _tpu_channel(echo_server)
+        cntl = ch.call_method(
+            "EchoService", "Echo", b"payload", attachment=b"piggyback"
+        )
+        assert cntl.ok(), cntl.error_text
+        assert cntl.response_payload == b"payload"
+        assert cntl.response_attachment == b"piggyback"
+
+    def test_payload_larger_than_slot_spans_steps(self, echo_server):
+        # slot_words=256 -> 1 KiB slots; a 64 KiB frame needs ~64 steps of
+        # byte-stream chunking each way
+        ch = _tpu_channel(echo_server, link_slot_words=256, link_window=4)
+        big = bytes(range(256)) * 256
+        cntl = ch.call_method("EchoService", "Echo", big)
+        assert cntl.ok(), cntl.error_text
+        assert cntl.response_payload == big
+
+    def test_many_sequential_calls_share_one_link(self, echo_server):
+        ch = _tpu_channel(echo_server)
+        first = None
+        for i in range(20):
+            cntl = ch.call_method("EchoService", "Echo", f"msg-{i}".encode())
+            assert cntl.ok(), cntl.error_text
+            assert cntl.response_payload == f"msg-{i}".encode()
+            if first is None:
+                first = ch._device_sock
+        assert ch._device_sock is first  # one handshake, one link
+
+    def test_handshake_used_host_socket(self, echo_server):
+        ch = _tpu_channel(echo_server)
+        assert ch.call_method("EchoService", "Echo", b"x").ok()
+        # the bootstrap TCP socket exists in the client map independently
+        # of the device link
+        host = ch._socket_map.get_or_create(ch._single_server)
+        assert host is not ch._device_sock
+
+
+class TestContentionAndFlowControl:
+    def test_contended_writers(self, echo_server):
+        ch = _tpu_channel(echo_server, link_slot_words=512, link_window=2)
+        errs = []
+
+        def worker(i):
+            for j in range(10):
+                body = (f"t{i}-{j}-".encode()) + bytes((i * 31 + j) % 256 for _ in range(3000))
+                c = ch.call_method("EchoService", "Echo", body)
+                if c.failed() or c.response_payload != body:
+                    errs.append((i, j, c.error_code, c.error_text))
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs[:3]
+
+    def test_window_bounds_inflight_steps(self, echo_server):
+        ch = _tpu_channel(echo_server, link_slot_words=256, link_window=2)
+        big = b"w" * 50000
+        cntl = ch.call_method("EchoService", "Echo", big)
+        assert cntl.ok(), cntl.error_text
+        link = ch._device_sock.link
+        # the credit window held dispatched-but-undrained steps at <= window
+        assert link.inflight_steps <= link.window
+
+    def test_writer_stalls_then_resumes_on_backlog(self, echo_server):
+        # direct link-level test: a tiny window and slot make the byte
+        # budget small; a burst of sends must block (not error) and all
+        # bytes must still arrive in order
+        ch = _tpu_channel(echo_server, link_slot_words=64, link_window=1)
+        assert ch.call_method("EchoService", "Echo", b"warm").ok()
+        link = ch._device_sock.link
+        blob = b"AB" * 4000  # far past the 1-slot byte budget
+
+        rc = link.send(0, blob)  # blocks internally while draining
+        assert rc == 0
+
+        # server side got the byte stream appended to its read buffer; the
+        # messenger will reject it as garbage eventually, but the transport
+        # delivered every byte in order first — assert via the socket's
+        # buffer growth before the parse error fails the link
+        assert _wait(lambda: link._closed or link._out_nbytes[0] == 0)
+
+
+class TestTeardown:
+    def test_server_stop_fails_client_link(self, echo_server):
+        ch = _tpu_channel(echo_server)
+        assert ch.call_method("EchoService", "Echo", b"x").ok()
+        ds = ch._device_sock
+        echo_server.stop()
+        assert _wait(lambda: ds.state != 0)  # CONNECTED == 0
+        # subsequent calls fail fast or re-handshake-fail, never hang
+        c = ch.call_method("EchoService", "Echo", b"y")
+        assert c.failed()
+
+    def test_link_failure_reports_not_hangs(self, echo_server):
+        ch = _tpu_channel(echo_server)
+        assert ch.call_method("EchoService", "Echo", b"x").ok()
+        ch._device_sock.link.fail("injected")
+        c = ch.call_method("EchoService", "Echo", b"y")
+        # the failed link is detected and re-handshaken (fresh link), or
+        # the call fails visibly — either way no hang
+        assert c.ok() or c.error_code != 0
+
+    def test_reconnect_after_link_failure(self, echo_server):
+        ch = _tpu_channel(echo_server)
+        assert ch.call_method("EchoService", "Echo", b"x").ok()
+        old = ch._device_sock
+        old.link.fail("injected")
+        assert _wait(lambda: old.state != 0)
+        c = ch.call_method("EchoService", "Echo", b"again")
+        assert c.ok(), c.error_text
+        assert ch._device_sock is not old  # fresh handshake, fresh link
