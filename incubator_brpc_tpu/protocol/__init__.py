@@ -42,6 +42,10 @@ if "tbus_std" not in protocol_registry:
 # keeps first-try priority in the InputMessenger loop)
 from incubator_brpc_tpu.protocol import http as _http  # noqa: E402,F401
 
+# baidu_std: the reference's exact wire format ("PRPC" header + protobuf
+# RpcMeta), selectable per channel and auto-recognized per connection
+from incubator_brpc_tpu.protocol import baidu_std as _baidu_std  # noqa: E402,F401
+
 __all__ = [
     "HEADER_BYTES",
     "Meta",

@@ -502,12 +502,30 @@ class Channel:
             payload = cntl._request_payload
             if cntl.compress_type:
                 payload = compress_mod.compress(cntl.compress_type, payload)
-            data = pack_frame_iobuf(
-                meta,
-                payload,
-                cid,
-                attachment=cntl.request_attachment,
-            )
+            proto_name = self._options.protocol
+            if proto_name == "tbus_std":
+                data = pack_frame_iobuf(
+                    meta,
+                    payload,
+                    cid,
+                    attachment=cntl.request_attachment,
+                )
+            else:
+                # protocol selected by name (reference AdaptiveProtocolType):
+                # the registry's packer produces that protocol's exact bytes
+                from incubator_brpc_tpu.protocol.registry import protocol_registry
+
+                if proto_name not in protocol_registry:
+                    raise ValueError(f"unknown protocol {proto_name!r}")
+                proto = protocol_registry.get(proto_name)
+                if proto.pack_request is None:
+                    raise ValueError(f"protocol {proto_name!r} cannot pack requests")
+                data = proto.pack_request(
+                    meta,
+                    payload,
+                    cid,
+                    attachment=cntl.request_attachment,
+                )
         except (ValueError, TypeError) as e:
             # unknown codec / bad frame inputs: fail the RPC, never leak the
             # locked id out of IssueRPC

@@ -342,6 +342,9 @@ class Server:
         cntl._service = meta.service
         cntl._method = meta.method
         cntl._sock = sock  # stream_accept needs the connection
+        # answer in the protocol the request arrived in (the reference keys
+        # SendRpcResponse off the request's protocol the same way)
+        cntl._wire_protocol = getattr(frame, "wire_protocol", "tbus_std")
         cntl._mark_start()
 
         if self._stopping:
@@ -566,14 +569,26 @@ class Server:
         attachment = b"" if failed else cntl.response_attachment
         if attachment and meta is None:
             meta = Meta()
-        data = pack_frame_iobuf(
-            meta,
-            payload,
-            cntl.call_id,
-            flags=FLAG_RESPONSE,
-            error_code=cntl.error_code,
-            attachment=attachment,
-        )
+        wire = getattr(cntl, "_wire_protocol", "tbus_std")
+        if wire == "baidu_std":
+            from incubator_brpc_tpu.protocol import baidu_std
+
+            data = baidu_std.pack_response(
+                meta,
+                payload,
+                cntl.call_id,
+                error_code=cntl.error_code,
+                attachment=attachment,
+            )
+        else:
+            data = pack_frame_iobuf(
+                meta,
+                payload,
+                cntl.call_id,
+                flags=FLAG_RESPONSE,
+                error_code=cntl.error_code,
+                attachment=attachment,
+            )
         rc = sock.write(data)
         if rc != 0:
             logger.warning(

@@ -699,6 +699,14 @@ uint32_t tb_iobuf_crc32c(const tb_iobuf* b, uint32_t seed, size_t pos,
 }
 
 int tb_tbus_peek(const tb_iobuf* in, tb_tbus_hdr* out) {
+  // Reject a foreign magic as soon as 4 bytes exist — a short frame of
+  // another protocol must yield "not mine" (so the messenger tries other
+  // parsers), never "incomplete" (which would wait forever).
+  if (in->nbytes >= 4) {
+    uint32_t magic;
+    tb_iobuf_copy_to(in, &magic, 4, 0);
+    if (magic != kTbusMagic) return -1;
+  }
   if (in->nbytes < 32) return 1;
   uint32_t w[8];
   tb_iobuf_copy_to(in, w, 32, 0);

@@ -1,0 +1,174 @@
+"""baidu_std wire-format conformance + end-to-end selection.
+
+The byte-exact fixtures are hand-assembled from the reference's format
+notes (baidu_rpc_protocol.cpp:53-58: 12-byte "PRPC" header, network-order
+sizes, protobuf RpcMeta per baidu_rpc_meta.proto) — the interop oracle
+SURVEY §7 step 4 calls for."""
+
+import struct
+
+import pytest
+
+from incubator_brpc_tpu.protocol import baidu_std
+from incubator_brpc_tpu.protocol.baidu_std import RpcMeta
+from incubator_brpc_tpu.protocol.tbus_std import Meta, ParseError
+from incubator_brpc_tpu.rpc import Channel, ChannelOptions, Server
+from incubator_brpc_tpu.utils.status import ErrorCode
+
+
+class TestWireFormat:
+    def test_request_frame_byte_exact(self):
+        # RpcRequestMeta{service_name:"Echo", method_name:"E"} +
+        # correlation_id=5 — protobuf bytes computed by hand:
+        #   request (field 1, LEN): 0a 09 ( 0a 04 "Echo" 12 01 "E" )
+        #   correlation_id (field 4, VARINT): 20 05
+        expected_meta = bytes.fromhex("0a090a044563686f12014520" "05")
+        payload = b"hello"
+        expected = (
+            b"PRPC"
+            + struct.pack(">II", len(expected_meta) + len(payload), len(expected_meta))
+            + expected_meta
+            + payload
+        )
+        got = baidu_std.pack_request(
+            Meta(service="Echo", method="E"), payload, correlation_id=5
+        )
+        assert got == expected
+
+    def test_response_frame_byte_exact(self):
+        # RpcResponseMeta{error_code:1001, error_text:"no"} + cid=7:
+        #   response (field 2, LEN): 12 07 ( 08 e9 07 12 02 "no" )
+        #   correlation_id: 20 07
+        expected_meta = bytes.fromhex("120708e90712026e6f2007")
+        expected = (
+            b"PRPC"
+            + struct.pack(">II", len(expected_meta) + 2, len(expected_meta))
+            + expected_meta
+            + b"ok"
+        )
+        got = baidu_std.pack_response(
+            Meta(error_text="no"), b"ok", correlation_id=7, error_code=1001
+        )
+        assert got == expected
+
+    def test_attachment_sets_meta_field(self):
+        wire = baidu_std.pack_request(
+            Meta(service="S", method="m"), b"pp", correlation_id=1,
+            attachment=b"attach",
+        )
+        frame, consumed = baidu_std.try_parse_frame(wire)
+        assert consumed == len(wire)
+        assert frame.payload == b"pp"
+        assert frame.attachment == b"attach"
+        assert frame.meta.attachment_size == 6
+
+    def test_roundtrip_all_fields(self):
+        meta = Meta(
+            service="svc", method="mth", log_id=9, trace_id=11, span_id=13,
+            compress="gzip",
+        )
+        meta.extra["auth"] = "cred"
+        wire = baidu_std.pack_request(meta, b"xyz", correlation_id=(3 << 32) | 4)
+        frame, _ = baidu_std.try_parse_frame(wire)
+        m = frame.meta
+        assert (m.service, m.method) == ("svc", "mth")
+        assert (m.log_id, m.trace_id, m.span_id) == (9, 11, 13)
+        assert m.compress == "gzip"  # CompressType GZIP=2 mapped back
+        assert m.extra["auth"] == "cred"
+        assert frame.correlation_id == (3 << 32) | 4
+        assert not frame.is_response
+
+    def test_parse_header_sizes_the_cut(self):
+        wire = baidu_std.pack_request(Meta(service="S", method="m"), b"12345", 1)
+        assert baidu_std.parse_header(wire[:12]) == len(wire)
+        assert baidu_std.parse_header(wire[:8]) is None
+        with pytest.raises(ParseError):
+            baidu_std.parse_header(b"TPRCxxxxxxxx")  # other protocol's magic
+
+    def test_resumable_and_meta_size_guard(self):
+        wire = baidu_std.pack_request(Meta(service="S", method="m"), b"body", 2)
+        for cut in (0, 3, 11, len(wire) - 1):
+            assert baidu_std.try_parse_frame(wire[:cut]) == (None, 0)
+        bad = bytearray(wire)
+        struct.pack_into(">I", bad, 8, 1 << 20)  # meta_size > body_size
+        with pytest.raises(ParseError):
+            baidu_std.try_parse_frame(bytes(bad))
+
+    def test_rpc_meta_decode_skips_unknown_fields(self):
+        # forward compat: an unknown varint field (99) must not break decode
+        # (field 99's tag encodes as a two-byte varint)
+        blob = RpcMeta(service_name="a", method_name="b").encode()
+        tag = (99 << 3) | 0
+        blob += bytes([tag & 0x7F | 0x80, tag >> 7]) + b"\x2a"
+        m = RpcMeta.decode(blob)
+        assert m.service_name == "a" and m.unknown.get(99) == 42
+
+
+class TestEndToEnd:
+    @pytest.fixture
+    def server(self):
+        srv = Server()
+
+        def echo(cntl, req):
+            cntl.response_attachment = cntl.request_attachment
+            return req
+
+        def boom(cntl, req):
+            cntl.set_failed(ErrorCode.EINTERNAL, "kaboom")
+            return b""
+
+        srv.add_service("EchoService", {"Echo": echo, "Boom": boom})
+        assert srv.start(0)
+        yield srv
+        srv.stop()
+        srv.join(timeout=5)
+
+    def _channel(self, srv) -> Channel:
+        ch = Channel()
+        assert ch.init(
+            f"127.0.0.1:{srv.port}", options=ChannelOptions(protocol="baidu_std")
+        )
+        return ch
+
+    def test_echo_over_baidu_std(self, server):
+        ch = self._channel(server)
+        cntl = ch.call_method("EchoService", "Echo", b"ping", attachment=b"att")
+        assert cntl.ok(), cntl.error_text
+        assert cntl.response_payload == b"ping"
+        assert cntl.response_attachment == b"att"
+
+    def test_error_propagates_with_text(self, server):
+        ch = self._channel(server)
+        cntl = ch.call_method("EchoService", "Boom", b"")
+        assert cntl.failed()
+        assert cntl.error_code == ErrorCode.EINTERNAL
+        assert "kaboom" in cntl.error_text
+
+    def test_same_port_serves_both_protocols(self, server):
+        b = self._channel(server)
+        t = Channel()
+        assert t.init(f"127.0.0.1:{server.port}")  # default tbus_std
+        for i in range(5):
+            cb = b.call_method("EchoService", "Echo", f"b{i}".encode())
+            ct = t.call_method("EchoService", "Echo", f"t{i}".encode())
+            assert cb.ok() and cb.response_payload == f"b{i}".encode()
+            assert ct.ok() and ct.response_payload == f"t{i}".encode()
+
+    def test_concurrent_baidu_calls(self, server):
+        import threading
+
+        ch = self._channel(server)
+        errs = []
+
+        def worker(i):
+            for j in range(20):
+                c = ch.call_method("EchoService", "Echo", f"{i}-{j}".encode())
+                if c.failed() or c.response_payload != f"{i}-{j}".encode():
+                    errs.append((i, j, c.error_code))
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join()
+        assert not errs, errs[:3]
