@@ -108,7 +108,11 @@ class RoundRobinLB(_SnapshotLB):
                 ep = lst[(start + i) % n]
                 if not excluded or ep not in excluded:
                     return ep
-            return lst[start % n]  # all excluded: better any than none
+            # All excluded: FAIL the selection and let retry arbitration
+            # decide (reference ExcludedServers, controller.cpp:578-615) —
+            # silently re-picking a just-failed server defeats retry
+            # avoidance on small clusters.
+            return None
 
 
 class RandomLB(_SnapshotLB):
@@ -118,8 +122,9 @@ class RandomLB(_SnapshotLB):
         with self._dbd.read() as lst:
             if not lst:
                 return None
-            cand = [ep for ep in lst if not excluded or ep not in excluded] or lst
-            return random.choice(cand)
+            cand = [ep for ep in lst if not excluded or ep not in excluded]
+            # all excluded -> fail selection (ExcludedServers semantics)
+            return random.choice(cand) if cand else None
 
 
 class WeightedRoundRobinLB(LoadBalancer):
@@ -422,6 +427,14 @@ class LoadBalancerWithNaming:
                 # select() already charged this pick (LA in-flight): settle it
                 self.lb.feedback(ep, 0.0, ErrorCode.EFAILEDSOCKET)
                 excluded_eps.add(ep)  # connect refused: try another server
+                continue
+            from incubator_brpc_tpu.transport.sock import CONNECTED
+
+            if sock.state != CONNECTED and not sock.connect_if_not():
+                # dead and not revivable right now: treat like a refused
+                # connect instead of burning the attempt (ConnectIfNot)
+                self.lb.feedback(ep, 0.0, ErrorCode.EFAILEDSOCKET)
+                excluded_eps.add(ep)
                 continue
             with self._map_lock:
                 self._ep_by_sid[sock.id] = ep

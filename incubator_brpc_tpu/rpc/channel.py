@@ -43,6 +43,11 @@ _client_messenger = InputMessenger()
 _client_socket_map = SocketMap(messenger=_client_messenger)
 
 
+class NoServerError(ConnectionError):
+    """LB selection failed: every candidate excluded or the cluster is
+    empty (reference ExcludedServers -> EHOSTDOWN)."""
+
+
 def _recycle_when_drained(sock) -> None:
     """Close once queued writes flushed: recycling immediately would drop
     frames still on the MPSC queue (e.g. a stream's CLOSE)."""
@@ -440,11 +445,19 @@ class Channel:
             return self._get_device_socket(cntl)
         if self._single_server is not None:
             if ctype == "single":
-                return self._socket_map.get_or_create(
+                sock = self._socket_map.get_or_create(
                     self._single_server,
                     timeout=self._options.connect_timeout,
                     key_tag=self._auth_key_tag(),
                 )
+                from incubator_brpc_tpu.transport.sock import CONNECTED
+
+                if sock.state != CONNECTED:
+                    # dropped-but-healthy peer: reconnect inline instead of
+                    # burning the attempt against a dead socket until the
+                    # health probe fires (ConnectIfNot, socket.cpp:1591)
+                    sock.connect_if_not(self._options.connect_timeout)
+                return sock
             if ctype == "pooled":
                 sock = self._socket_map.get_pooled(
                     self._single_server,
@@ -464,7 +477,7 @@ class Channel:
         # pooled/short secondaries off the main socket)
         sock = self._lb.select_server(excluded=cntl._excluded_sockets)
         if sock is None:
-            raise ConnectionError("no available server in load balancer")
+            raise NoServerError("no available server (all excluded or empty)")
         return sock
 
     def _issue_rpc(self, cntl: Controller) -> None:
@@ -473,6 +486,12 @@ class Channel:
         cid = cntl.call_id
         try:
             sock = self._pick_socket(cntl)
+        except NoServerError as e:
+            # every candidate excluded / empty cluster: EHOSTDOWN, letting
+            # retry arbitration decide (reference ExcludedServers,
+            # controller.cpp:578-615)
+            self._arbitrate_error(cntl, ErrorCode.EHOSTDOWN, str(e))
+            return
         except (OSError, ConnectionError) as e:
             # connection failed: arbitrate like a socket failure
             self._arbitrate_error(cntl, ErrorCode.EFAILEDSOCKET, str(e))

@@ -130,7 +130,8 @@ class Controller:
 
 
 # retriable errors (reference default RetryPolicy, retry_policy.cpp: retries
-# connectivity failures, never server-side application errors or timeouts)
+# connectivity failures — including EHOSTDOWN — never server-side
+# application errors or timeouts)
 RETRIABLE = frozenset(
-    {ErrorCode.EFAILEDSOCKET, ErrorCode.EEOF, ErrorCode.ECLOSE}
+    {ErrorCode.EFAILEDSOCKET, ErrorCode.EEOF, ErrorCode.ECLOSE, ErrorCode.EHOSTDOWN}
 )

@@ -240,6 +240,7 @@ class Socket:
         self._io_refs = 0
         self._pending_close: Optional[_pysocket.socket] = None
         self._kick_fd: Optional[int] = None  # lazy eventfd for poller wakes
+        self._reconnecting = False  # connect_if_not single-dialer gate
         if health_check_interval is None:
             health_check_interval = float(get_flag("health_check_interval"))
         self.health_check_interval = health_check_interval
@@ -635,6 +636,29 @@ class Socket:
             lambda: self._pool.spawn(self._health_probe),
             delay=self.health_check_interval,
         )
+
+    def connect_if_not(self, timeout: float = 1.0) -> bool:
+        """Inline bounded reconnect of a FAILED client socket — the write
+        path's ConnectIfNot (socket.cpp:1591-1686): a healthy-but-dropped
+        peer reconnects on the NEXT call instead of waiting out the
+        health-check interval. One dialer at a time; the periodic health
+        probe keeps running and revives through the same _revive gate."""
+        with self._state_lock:
+            if self.state == CONNECTED:
+                return True
+            if self.state != FAILED or not self.is_client or self.remote is None:
+                return False
+            if self._reconnecting:
+                return False  # another caller is dialing right now
+            self._reconnecting = True
+        try:
+            conn = _dial(self.remote, timeout=timeout)
+        except OSError:
+            return False
+        finally:
+            with self._state_lock:
+                self._reconnecting = False
+        return self._revive(conn)
 
     def _health_probe(self) -> None:
         if self.state != FAILED:

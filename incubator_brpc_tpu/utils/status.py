@@ -35,6 +35,10 @@ class ErrorCode(enum.IntEnum):
     EUNUSED = 1015  # socket never used
     ESSL = 1016
 
+    # System errno reused verbatim (the reference raises the POSIX value
+    # from LB selection failure, controller.cpp SelectServer paths)
+    EHOSTDOWN = 112  # no available server (all excluded / empty cluster)
+
     # Errno caused by server
     EINTERNAL = 2001  # server internal error
     ERESPONSE = 2002  # bad response
@@ -75,6 +79,7 @@ _DESCRIPTIONS = {
     ErrorCode.EFAILEDSOCKET: "Broken socket during RPC",
     ErrorCode.EOVERCROWDED: "The socket is overcrowded",
     ErrorCode.EEOF: "Got EOF",
+    ErrorCode.EHOSTDOWN: "No available server",
     ErrorCode.ETRANSPORT: "Device transport error",
     ErrorCode.ETRANSPORTCM: "Mesh connection-manager error",
     ErrorCode.ETERMINATED: "Terminated",

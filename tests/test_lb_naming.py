@@ -16,6 +16,7 @@ from incubator_brpc_tpu.lb import (
 )
 from incubator_brpc_tpu.rpc import Channel, ChannelOptions, Server
 from incubator_brpc_tpu.utils.endpoint import EndPoint
+from incubator_brpc_tpu.utils.status import ErrorCode
 
 
 def ep(port):
@@ -165,3 +166,81 @@ class TestNamingMidTraffic:
             assert cntl.failed()  # no server: fails, doesn't hang
         finally:
             s1.stop()
+
+
+class TestAllExcludedAndReconnect:
+    def test_all_excluded_fails_selection(self):
+        # rr/random must FAIL the pick when every server is excluded
+        # (reference ExcludedServers), never silently return an excluded one
+        from incubator_brpc_tpu.lb import RandomLB, RoundRobinLB
+        from incubator_brpc_tpu.utils.endpoint import EndPoint
+
+        for lb in (RoundRobinLB(), RandomLB()):
+            eps = [EndPoint("127.0.0.1", 7001), EndPoint("127.0.0.1", 7002)]
+            for ep in eps:
+                lb.add_server(ep)
+            assert lb.select(excluded=set(eps)) is None
+            assert lb.select(excluded={eps[0]}) == eps[1]
+
+    def test_all_excluded_rpc_fails_with_ehostdown(self):
+        # one server, max_retry=1: first attempt fails (dead socket), the
+        # retry excludes it -> selection fails -> EHOSTDOWN surfaces
+        import tempfile
+
+        from incubator_brpc_tpu.rpc import Channel, ChannelOptions, Controller, Server
+
+        srv = Server()
+        srv.add_service("t", {"echo": lambda cntl, req: req})
+        assert srv.start(0)
+        with tempfile.NamedTemporaryFile("w", suffix=".lst", delete=False) as f:
+            f.write(f"127.0.0.1:{srv.port}\n")
+            path = f.name
+        ch = Channel()
+        assert ch.init(f"file://{path}", "rr",
+                       options=ChannelOptions(timeout_ms=3000, max_retry=2))
+        assert ch.call_method("t", "echo", b"warm").ok()
+        # kill the server hard: the next call's attempts all fail, every
+        # candidate ends up excluded, and the terminal code is EHOSTDOWN
+        # (connect refused path) or EFAILEDSOCKET (write raced the close) —
+        # never a silent re-pick that hangs
+        srv.stop()
+        srv.join(timeout=5)
+        cntl = ch.call_method("t", "echo", b"x", cntl=Controller(timeout_ms=3000, max_retry=2))
+        assert cntl.failed()
+        assert cntl.error_code in (
+            ErrorCode.EHOSTDOWN, ErrorCode.EFAILEDSOCKET, ErrorCode.EEOF,
+        )
+
+    def test_fast_reconnect_without_health_check_wait(self):
+        # kill the server, restart it on the SAME port, call immediately:
+        # connect_if_not must revive the socket inline — no 3s health wait
+        from incubator_brpc_tpu.rpc import Channel, Controller, Server
+        from incubator_brpc_tpu.utils.flags import get_flag
+
+        srv = Server()
+        srv.add_service("t", {"echo": lambda cntl, req: req})
+        assert srv.start(0)
+        port = srv.port
+        ch = Channel()
+        assert ch.init(f"127.0.0.1:{port}")
+        assert ch.call_method("t", "echo", b"warm").ok()
+        srv.stop()
+        srv.join(timeout=5)
+        # burn one call so the client notices the socket died
+        ch.call_method("t", "echo", b"probe", cntl=Controller(timeout_ms=300, max_retry=0))
+        srv2 = Server()
+        srv2.add_service("t", {"echo": lambda cntl, req: req})
+        assert srv2.start(port)
+        try:
+            t0 = time.monotonic()
+            cntl = ch.call_method(
+                "t", "echo", b"back", cntl=Controller(timeout_ms=4000, max_retry=1)
+            )
+            dt = time.monotonic() - t0
+            assert cntl.ok(), cntl.error_text
+            assert dt < float(get_flag("health_check_interval")), (
+                f"reconnect took {dt:.2f}s — waited for the health probe"
+            )
+        finally:
+            srv2.stop()
+            srv2.join(timeout=5)
