@@ -441,6 +441,13 @@ class LoadBalancerWithNaming:
             return sock
         return None
 
+    def register_socket(self, sock, ep: EndPoint) -> None:
+        """Track a secondary (pooled/short) connection under its endpoint
+        so feedback and retry exclusion resolve it (the reference reaches
+        the main socket's SharedPart from secondaries the same way)."""
+        with self._map_lock:
+            self._ep_by_sid[sock.id] = ep
+
     def feedback(self, sock, latency_us: float, error_code: int) -> None:
         with self._map_lock:
             ep = self._ep_by_sid.get(sock.id)

@@ -171,15 +171,6 @@ class Channel:
                     "link binds one device pair; LB fan-out lowers to "
                     "collectives via ParallelChannel instead)"
                 )
-            if self._options.connection_type != "single":
-                # visible error, not a silent downgrade: LB targets ride
-                # the shared main sockets (the reference hangs secondaries
-                # off the main socket; not implemented here)
-                raise ValueError(
-                    "connection_type "
-                    f"{self._options.connection_type!r} requires a "
-                    "single-server target"
-                )
             from incubator_brpc_tpu.lb import LoadBalancerWithNaming
 
             self._lb = LoadBalancerWithNaming(
@@ -202,13 +193,6 @@ class Channel:
         (partition_channel.cpp builds sub-channels the same way)."""
         if options is not None:
             self._options = options
-        if self._options.connection_type != "single":
-            # same visible rejection as init(): LB targets ride the shared
-            # main sockets, never a silent downgrade
-            raise ValueError(
-                f"connection_type {self._options.connection_type!r} "
-                "requires a single-server target"
-            )
         if not lb.start():
             return False
         self._lb = lb
@@ -397,8 +381,10 @@ class Channel:
         next caller (the reference closes non-single connections on error
         for the same reason). Short connections drain then close."""
         if kind == "pooled" and reusable:
+            # keyed by the connection's actual remote: pooled secondaries
+            # of LB targets park under their own endpoint's entry
             self._socket_map.return_pooled(
-                self._single_server, sock, key_tag=self._auth_key_tag()
+                sock.remote, sock, key_tag=self._auth_key_tag()
             )
         else:
             _recycle_when_drained(sock)
@@ -473,12 +459,32 @@ class Channel:
             # settled mid-call
             cntl._call_socks.append((ctype, sock))
             return sock
-        # LB targets use the shared main sockets (the reference hangs
-        # pooled/short secondaries off the main socket)
+        # LB targets: the LB resolves a healthy MAIN socket per endpoint;
+        # pooled/short secondaries hang off that endpoint's map entry (the
+        # reference's SharedPart design, socket_map.h:35 +
+        # Socket::GetPooledSocket/GetShortSocket)
         sock = self._lb.select_server(excluded=cntl._excluded_sockets)
         if sock is None:
             raise NoServerError("no available server (all excluded or empty)")
-        return sock
+        if ctype == "single":
+            return sock
+        ep = sock.remote
+        if ctype == "pooled":
+            sec = self._socket_map.get_pooled(
+                ep,
+                timeout=self._options.connect_timeout,
+                key_tag=self._auth_key_tag(),
+            )
+        else:  # short
+            sec = self._socket_map.get_short(
+                ep, timeout=self._options.connect_timeout
+            )
+        # LB feedback and retry exclusion track the secondary's id too
+        reg = getattr(self._lb, "register_socket", None)
+        if reg is not None:
+            reg(sec, ep)
+        cntl._call_socks.append((ctype, sec))
+        return sec
 
     def _issue_rpc(self, cntl: Controller) -> None:
         """IssueRPC (controller.cpp:941): pick socket, pack, write. Called

@@ -73,13 +73,22 @@ class TestConnectionTypes:
         # sequential calls reuse ONE pooled connection
         assert server.connection_count() == 1
 
-    def test_lb_target_rejects_non_single(self, server):
+    def test_lb_target_accepts_non_single(self, server):
+        # pooled/short now work with naming+LB (secondaries hang off each
+        # endpoint's map entry); transport='tpu' still requires single-server
         ch = Channel()
+        assert ch.init(
+            f"list://127.0.0.1:{server.port}",
+            "rr",
+            options=ChannelOptions(connection_type="short"),
+        )
+        assert ch.call_method("ct", "echo", b"via-short-lb").ok()
+        ch2 = Channel()
         with pytest.raises(ValueError):
-            ch.init(
+            ch2.init(
                 f"list://127.0.0.1:{server.port}",
                 "rr",
-                options=ChannelOptions(connection_type="short"),
+                options=ChannelOptions(transport="tpu"),
             )
 
     def test_backup_request_keeps_original_connection(self):
@@ -157,3 +166,62 @@ class TestConnectionTypes:
         finally:
             s.stop()
             s.join(timeout=5)
+
+
+class TestConnectionTypesWithNaming:
+    """Pooled/short for LB targets: secondaries hang off each endpoint's
+    map entry (reference SharedPart design, socket_map.h:35)."""
+
+    @pytest.fixture
+    def two_servers(self):
+        import tempfile
+
+        servers = []
+        for _ in range(2):
+            s = Server()
+            s.add_service("ct", {"echo": lambda cntl, req: req,
+                                 "who": lambda cntl, req: str(s.port).encode()})
+            assert s.start(0)
+            servers.append(s)
+        with tempfile.NamedTemporaryFile("w", suffix=".lst", delete=False) as f:
+            for s in servers:
+                f.write(f"127.0.0.1:{s.port}\n")
+            path = f.name
+        yield servers, path
+        for s in servers:
+            s.stop()
+            s.join(timeout=5)
+
+    @pytest.mark.parametrize("ctype", ["pooled", "short"])
+    def test_lb_target_with_secondary_connections(self, two_servers, ctype):
+        servers, path = two_servers
+        ch = Channel()
+        assert ch.init(
+            f"file://{path}", "rr",
+            options=ChannelOptions(connection_type=ctype, timeout_ms=5000),
+        )
+        seen = set()
+        for i in range(8):
+            cntl = ch.call_method("ct", "echo", f"m{i}".encode())
+            assert cntl.ok(), cntl.error_text
+            assert cntl.response_payload == f"m{i}".encode()
+            seen.add((cntl.remote_side.ip, cntl.remote_side.port))
+        # rr across both servers, through secondary connections
+        assert len(seen) == 2
+
+    def test_pooled_lb_parks_per_endpoint(self, two_servers):
+        servers, path = two_servers
+        ch = Channel()
+        assert ch.init(
+            f"file://{path}", "rr",
+            options=ChannelOptions(connection_type="pooled", timeout_ms=5000),
+        )
+        for i in range(4):
+            assert ch.call_method("ct", "echo", b"x").ok()
+        # idle pooled connections parked under BOTH endpoints' keys
+        pooled_keys = {
+            k for k, v in ch._socket_map._pooled.items() if v
+        }
+        ports = {int(k.split("|")[0].rsplit(":", 1)[1]) for k in pooled_keys}
+        # superset: the shared client socket map may hold other tests' pools
+        assert ports >= {s.port for s in servers}
