@@ -46,11 +46,18 @@ def _release_trampoline(_data, ctx):
 def _buffer_info(obj):
     """(address, nbytes) of the contiguous memory behind a buffer-protocol
     object. nbytes comes from memoryview — len() would count elements, not
-    bytes, for numpy arrays and typed memoryviews."""
-    nbytes = memoryview(obj).nbytes
+    bytes, for numpy arrays and typed memoryviews. Read-only buffers (e.g.
+    views of a device step's fetched output) resolve through numpy, since
+    ctypes.from_buffer demands writability the wrap never needs."""
+    mv = memoryview(obj)
+    nbytes = mv.nbytes
     if isinstance(obj, bytes):
         # c_char_p points at the bytes object's internal storage (CPython).
         return ctypes.cast(ctypes.c_char_p(obj), ctypes.c_void_p).value, nbytes
+    if mv.readonly:
+        import numpy as _np
+
+        return _np.frombuffer(mv, dtype=_np.uint8).ctypes.data, nbytes
     c = (ctypes.c_char * max(1, nbytes)).from_buffer(obj)
     return ctypes.addressof(c), nbytes
 

@@ -158,15 +158,27 @@ class DeviceLink:
     def attach(self, side: int, sock: "DeviceSocket") -> None:
         self.socks[side] = sock
 
-    def send(self, side: int, data: bytes, timeout: Optional[float] = 10.0) -> int:
-        """Queue bytes for the peer. 0, or EOVERCROWDED when the backlog
-        stays above the window's byte budget past ``timeout``. The in-order
-        deliverer thread never parks here (a handler responding inline
-        during delivery would deadlock the link waiting on itself) — its
-        writes are admitted past the budget, bounded by one response per
-        delivered request."""
+    def send(self, side: int, data, timeout: Optional[float] = 10.0) -> int:
+        """Queue bytes (bytes or IOBuf) for the peer. 0, or EOVERCROWDED
+        when the backlog stays above the window's byte budget past
+        ``timeout``. The in-order deliverer thread never parks here (a
+        handler responding inline during delivery would deadlock the link
+        waiting on itself) — its writes are admitted past the budget,
+        bounded by one response per delivered request.
+
+        An IOBuf is queued as zero-copy views of its blocks (kept alive by
+        the IOBuf itself): the only host copy of outbound payload bytes is
+        the gather into the slot — the registered-ring staging write of the
+        RDMA template (rdma_endpoint.h:105-123)."""
         if self._closed:
             return ErrorCode.EFAILEDSOCKET
+        if isinstance(data, (bytes, bytearray, memoryview)):
+            chunks = [[memoryview(data).cast("B"), data]]
+        else:  # IOBuf: views stay valid while the IOBuf is referenced
+            chunks = [[v, data] for v in data.views() if len(v)]
+        n = sum(len(v) for v, _ in chunks)
+        if n == 0:
+            return 0
         budget = self.window * self._slot_bytes
         deadline = None
         while True:
@@ -177,8 +189,8 @@ class DeviceLink:
                     self._out_nbytes[side] <= budget
                     or threading.get_ident() == self._deliver_tid
                 ):
-                    self._out[side].append(data)
-                    self._out_nbytes[side] += len(data)
+                    self._out[side].extend(chunks)
+                    self._out_nbytes[side] += n
                     break
                 seq = self._wbutex.load()
             # window stall: park until a step drains (credit released)
@@ -251,22 +263,26 @@ class DeviceLink:
             )
 
     def _fill_slot_locked(self, side: int) -> np.ndarray:
-        """Pack queued bytes head-to-tail into one slot (byte stream: a
-        frame may split across slots; the receiver's messenger re-cuts)."""
+        """Pack queued views head-to-tail into one slot (byte stream: a
+        frame may split across slots; the receiver's messenger re-cuts).
+        ONE gather copy per byte — the staging write into the 'ring'."""
         row = np.zeros(self._width, dtype=np.uint32)
+        rb = row.view(np.uint8)
         used = 0
-        chunks = []
         q = self._out[side]
         cap = self._slot_bytes
+        base = LINK_HEADER_WORDS * 4
         while q and used < cap:
-            chunk = q[0]
-            take = min(len(chunk), cap - used)
-            if take == len(chunk):
-                q.popleft()
-                chunks.append(chunk)
+            entry = q[0]
+            view = entry[0]
+            take = min(len(view), cap - used)
+            rb[base + used : base + used + take] = np.frombuffer(
+                view[:take], dtype=np.uint8
+            )
+            if take == len(view):
+                q.popleft()  # keepalive dropped with the entry
             else:
-                chunks.append(chunk[:take])
-                q[0] = chunk[take:]
+                entry[0] = view[take:]
             used += take
         self._out_nbytes[side] -= used
         flags = F_DATA if used else 0
@@ -284,13 +300,6 @@ class DeviceLink:
         row[3] = self._next_deliver & 0xFFFFFFFF
         row[4] = flags
         if used:
-            blob = b"".join(chunks)
-            pad = (-used) % 4
-            if pad:
-                blob += b"\x00" * pad
-            row[LINK_HEADER_WORDS : LINK_HEADER_WORDS + len(blob) // 4] = (
-                np.frombuffer(blob, dtype=np.uint32)
-            )
             link_bytes << used
         return row
 
@@ -358,10 +367,15 @@ class DeviceLink:
             flags = int(row[4])
             sock = self.socks[side]
             if used and sock is not None:
-                payload = row[
-                    LINK_HEADER_WORDS : LINK_HEADER_WORDS + (used + 3) // 4
-                ].tobytes()[:used]
-                sock._feed(payload)
+                # ZERO-copy delivery: the read IOBuf's block wraps the step
+                # output's own buffer (external block + release-cb — the
+                # HBM-backed IOBuf of the RDMA template, block_pool.h:20-66
+                # / iobuf.cpp:258-306); the row stays alive until the last
+                # ref drops. Payload bytes materialize once, at the
+                # handler/parse boundary.
+                base = LINK_HEADER_WORDS * 4
+                view = memoryview(row.view(np.uint8))[base : base + used]
+                sock._feed(view)
             if flags & F_CLOSE and sock is not None:
                 sock.set_failed(ErrorCode.ECLOSE, "peer closed device link")
 
@@ -430,12 +444,8 @@ class DeviceSocket:
 
         if self.state != CONNECTED:
             return ErrorCode.EFAILEDSOCKET
-        if not isinstance(data, (bytes, bytearray, memoryview)):
-            data = data.to_bytes()  # IOBuf
-        else:
-            data = bytes(data)
-        if not data:
-            return 0
+        # bytes and IOBufs both queue zero-copy (the link keeps the IOBuf
+        # alive and gathers straight from its block views into the slot)
         rc = self.link.send(self.side, data, timeout=timeout)
         if rc != 0 and on_error is not None:
             try:
@@ -446,12 +456,19 @@ class DeviceSocket:
 
     # -- read path (driven by link completions) ------------------------------
 
-    def _feed(self, data: bytes) -> None:
+    def _feed(self, data) -> None:
         """Link delivery: append the byte-stream chunk and run the normal
         messenger cut loop (completions feeding InputMessenger — the
-        rdma_completion_queue.cpp:152 shape)."""
+        rdma_completion_queue.cpp:152 shape). A memoryview is wrapped
+        zero-copy as an external block (its backing step-output buffer is
+        kept alive until the last ref drops); small chunks copy into
+        pooled blocks where the external-block bookkeeping would cost more
+        than the memcpy."""
         with self._feed_lock:  # per-socket reader serialization
-            self._read_buf.append(data)
+            if isinstance(data, memoryview) and len(data) >= 4096:
+                self._read_buf.append_external(data)
+            else:
+                self._read_buf.append(bytes(data))
             if self.messenger is not None and len(self._read_buf):
                 self.messenger.process(self)
 

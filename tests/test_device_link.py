@@ -173,3 +173,82 @@ class TestTeardown:
         c = ch.call_method("EchoService", "Echo", b"again")
         assert c.ok(), c.error_text
         assert ch._device_sock is not old  # fresh handshake, fresh link
+
+
+class TestZeroCopyDelivery:
+    def test_received_blocks_reference_step_output_memory(self, echo_server):
+        # The receive path must wrap the link step's output buffer as an
+        # external IOBuf block (HBM-backed IOBuf: rdma block_pool.h:20-66 /
+        # iobuf.cpp:258-306) — no host-side payload copy before the parse
+        # boundary. Asserted by address identity: the fed block's view must
+        # point INTO the delivered row's own buffer.
+        import numpy as np
+
+        from incubator_brpc_tpu.iobuf import IOBuf
+        from incubator_brpc_tpu.transport import device_link as dl
+
+        ch = _tpu_channel(echo_server, link_slot_words=4096)
+        assert ch.call_method("EchoService", "Echo", b"warm").ok()
+
+        ext_addrs = []  # addresses handed to append_external (zero-copy wraps)
+        row_spans = []  # [start, end) of delivered rows' buffers
+
+        orig_ext = IOBuf.append_external
+
+        def ext_spy(iobuf_self, obj, release_cb=None):
+            a = np.frombuffer(memoryview(obj), dtype=np.uint8)
+            ext_addrs.append((a.ctypes.data, a.nbytes))
+            return orig_ext(iobuf_self, obj, release_cb)
+
+        orig_rows = dl.DeviceLink._rows_to_host
+
+        def rows_spy(link_self, arrays):
+            rows = orig_rows(link_self, arrays)
+            for row in rows:
+                if row is not None:
+                    b = row.view(np.uint8)
+                    row_spans.append((b.ctypes.data, b.ctypes.data + b.nbytes))
+            return rows
+
+        IOBuf.append_external = ext_spy
+        dl.DeviceLink._rows_to_host = rows_spy
+        try:
+            big = b"q" * 12000  # > 4096: external-block delivery path
+            cntl = ch.call_method("EchoService", "Echo", big)
+            assert cntl.ok(), cntl.error_text
+            assert cntl.response_payload == big
+        finally:
+            IOBuf.append_external = orig_ext
+            dl.DeviceLink._rows_to_host = orig_rows
+        # at least one received chunk was wrapped IN PLACE inside a
+        # delivered row's own buffer — no host copy before the parse
+        aliased = [
+            (a, n)
+            for a, n in ext_addrs
+            for lo, hi in row_spans
+            if lo <= a and a + n <= hi
+        ]
+        assert aliased, f"no external block aliased a delivered row: {ext_addrs[:3]} vs {row_spans[:3]}"
+
+    def test_iobuf_write_queues_block_views(self, echo_server):
+        # DeviceSocket.write(IOBuf) must not flatten to bytes: the link
+        # gathers from the IOBuf's own block views
+        from incubator_brpc_tpu.iobuf import IOBuf
+
+        ch = _tpu_channel(echo_server)
+        assert ch.call_method("EchoService", "Echo", b"warm").ok()
+        link = ch._device_sock.link
+        buf = IOBuf()
+        payload = b"Z" * 9000
+        buf.append_external(payload)
+        # inject directly: the queue entries must be views, with the IOBuf
+        # itself as the keepalive
+        rc = link.send(0, buf)
+        assert rc == 0
+        # drained by the driver shortly; the send accounting was by view
+        import time as _t
+
+        deadline = _t.monotonic() + 5
+        while link._out_nbytes[0] and _t.monotonic() < deadline:
+            _t.sleep(0.01)
+        assert link._out_nbytes[0] == 0
