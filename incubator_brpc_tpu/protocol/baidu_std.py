@@ -108,10 +108,16 @@ def _walk_fields(buf: memoryview):
                 raise ParseError("bad length-delimited field in RpcMeta")
             yield field_no, wt, buf[off : off + n]
             off += n
-        elif wt == 5:
-            off += 4
         elif wt == 1:
+            if off + 8 > len(buf):
+                raise ParseError("truncated fixed64")
+            yield field_no, wt, buf[off : off + 8]
             off += 8
+        elif wt == 5:
+            if off + 4 > len(buf):
+                raise ParseError("truncated fixed32")
+            yield field_no, wt, buf[off : off + 4]
+            off += 4
         else:
             raise ParseError(f"unsupported proto wire type {wt}")
 

@@ -488,6 +488,23 @@ class Server:
             return 404, "text/plain", f"no method {service}.{method}\n".encode()
         if self._stopping:
             return 503, "text/plain", b"server stopping\n"
+        # json2pb transcoding: when the handler carries a schema and the
+        # body is JSON, transcode request in / response out — one handler
+        # serves binary RPC and curl alike (the reference's http+pb story,
+        # src/json2pb powering http_rpc_protocol.cpp)
+        transcode = None
+        from incubator_brpc_tpu.protocol.json2pb import schema_of
+
+        schema = schema_of(prop.handler)
+        if schema is not None and body.lstrip()[:1] in (b"{", b""):
+            from incubator_brpc_tpu.protocol.tbus_std import ParseError as _PE
+
+            req_cls, resp_cls = schema
+            try:
+                body = req_cls.from_json(body or b"{}").to_binary()
+            except _PE as e:
+                return 400, "text/plain", f"bad request json: {e}\n".encode()
+            transcode = resp_cls
         status = prop.status
         if not self._admit(status):
             return 503, "text/plain", b"concurrency limit reached\n"
@@ -546,6 +563,16 @@ class Server:
         if cntl.failed():
             self.nerror << 1
             return 500, "text/plain", f"{cntl.error_text}\n".encode()
+        if transcode is not None:
+            try:
+                return (
+                    200,
+                    "application/json",
+                    transcode.from_binary(response or b"").to_json(),
+                )
+            except Exception:
+                logger.exception("response transcode failed for %s.%s", service, method)
+                return 500, "text/plain", b"response transcode failed\n"
         return 200, "application/octet-stream", response or b""
 
     def _send_response(self, sock, cntl: Controller, response: bytes) -> None:
