@@ -71,6 +71,41 @@ def render_cpu_text(result: Dict[str, object], top: int = 30) -> str:
     return "\n".join(lines)
 
 
+def render_cpu_folded(result: Dict[str, object]) -> str:
+    """pprof/flamegraph folded-stack text: one line per unique stack,
+    root;...;leaf count — the interchange format go-pprof tooling and
+    flamegraph.pl consume (the reference's hotspots_service renders
+    through the bundled pprof.pl into the same family)."""
+    lines = []
+    for stack, count in result["stacks"]:
+        frames = []
+        for row in stack.splitlines():
+            row = row.strip()
+            # "  file:line name" -> "name file:line"
+            loc, _, name = row.partition(" ")
+            frames.append(f"{name} {loc}" if name else row)
+        lines.append(f"{';'.join(frames)} {count}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def render_contention_folded(top: int = 1000) -> str:
+    """Contention profile in the same folded format; the sample weight is
+    total wait microseconds (pprof's contention convention of delay-
+    weighted samples, mutex.cpp:145's '--- contention' family)."""
+    from incubator_brpc_tpu.runtime.mutex import contention_profile
+
+    lines = []
+    for stack, count, wait_us in contention_profile()[:top]:
+        frames = []
+        for row in stack.strip().splitlines():
+            row = row.strip()
+            loc, _, name = row.partition(" ")
+            frames.append(f"{name} {loc}" if name else row)
+        if frames:
+            lines.append(f"{';'.join(frames)} {int(wait_us)}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 def render_contention_text(top: int = 30) -> str:
     from incubator_brpc_tpu.runtime.mutex import (
         contended_acquires,

@@ -148,10 +148,18 @@ def _rpcz(server, frame) -> Resp:
 
 def _hotspots(server, frame) -> Resp:
     """hotspots_service.cpp: /hotspots (cpu sampling, bounded window) and
-    /hotspots/contention (mutex contention by call site)."""
+    /hotspots/contention (mutex contention by call site).
+    ``?format=folded`` renders pprof/flamegraph folded stacks — the
+    go-pprof-compatible interchange the reference's /pprof/* family
+    serves (pprof_service.cpp; also at /pprof/profile, /pprof/contention)."""
     from incubator_brpc_tpu.builtin import hotspots
 
+    folded = frame.query.get("format") == "folded" or frame.path.startswith(
+        "/pprof/"
+    )
     if frame.path.rstrip("/").endswith("/contention"):
+        if folded:
+            return 200, "text/plain", hotspots.render_contention_folded().encode()
         return 200, "text/plain", hotspots.render_contention_text().encode()
     try:
         seconds = min(10.0, float(frame.query.get("seconds", "1")))
@@ -161,6 +169,8 @@ def _hotspots(server, frame) -> Resp:
         result = hotspots.sample_cpu(seconds=seconds)
     except RuntimeError as e:
         return 503, "text/plain", f"{e}\n".encode()
+    if folded:
+        return 200, "text/plain", hotspots.render_cpu_folded(result).encode()
     return 200, "text/plain", hotspots.render_cpu_text(result).encode()
 
 
@@ -169,6 +179,71 @@ def _connections(server, frame) -> Resp:
 
     servers = [server] if server is not None else list(running_servers())
     lines = [f"{s.listen_endpoint} connections={s.connection_count()}" for s in servers]
+    return 200, "text/plain", ("\n".join(lines) + "\n").encode()
+
+
+def _sockets(server, frame) -> Resp:
+    """builtin/sockets_service + connections_service per-socket detail:
+    every live socket in the registry — TCP and device-link alike — with
+    state, backlog, and role."""
+    from incubator_brpc_tpu.transport.sock import (
+        CONNECTED,
+        FAILED,
+        RECYCLED,
+        _registry,
+    )
+
+    st_name = {CONNECTED: "up", FAILED: "failed", RECYCLED: "recycled"}
+    with _registry._lock:
+        socks = [s for s in _registry._objs if s is not None]
+    lines = [f"live sockets: {len(socks)}  (slab live={_registry.live_count()})"]
+    for s in socks:
+        kind = type(s).__name__
+        fd = getattr(s, "fd", None)
+        unwritten = getattr(s, "_unwritten", None)
+        rbuf = len(s._read_buf) if getattr(s, "_read_buf", None) is not None else 0
+        extra = []
+        if fd is not None:
+            extra.append(f"fd={fd}")
+        if unwritten is not None:
+            extra.append(f"unwritten={unwritten}")
+        if getattr(s, "inline_read", False):
+            extra.append("inline")
+        if getattr(s, "is_client", False):
+            extra.append("client")
+        lines.append(
+            f"  {s.id:#018x} {kind} remote={s.remote} "
+            f"state={st_name.get(s.state, s.state)} rbuf={rbuf} "
+            + " ".join(extra)
+        )
+    return 200, "text/plain", ("\n".join(lines) + "\n").encode()
+
+
+def _fibers(server, frame) -> Resp:
+    """/bthreads analog: worker-pool scheduler stats."""
+    from incubator_brpc_tpu.runtime.worker_pool import global_worker_pool
+
+    st = global_worker_pool().stats()
+    lines = [f"{k}: {v}" for k, v in st.items()]
+    return 200, "text/plain", ("\n".join(lines) + "\n").encode()
+
+
+def _ids(server, frame) -> Resp:
+    """/ids analog: correlation-id slab + registry slab occupancy."""
+    from incubator_brpc_tpu.rpc.stream import _streams, _streams_lock
+    from incubator_brpc_tpu.runtime.correlation_id import call_id_space
+    from incubator_brpc_tpu.transport.sock import _registry
+
+    with call_id_space._lock:
+        total = len(call_id_space._slots)
+        free = len(call_id_space._free)
+    with _streams_lock:
+        nstreams = len(_streams)
+    lines = [
+        f"call_ids: slots={total} live={total - free} free={free}",
+        f"sockets: live={_registry.live_count()}",
+        f"streams: live={nstreams}",
+    ]
     return 200, "text/plain", ("\n".join(lines) + "\n").encode()
 
 
@@ -193,8 +268,13 @@ _PAGES: Dict[str, object] = {
     "/flags": _flags,
     "/rpcz": _rpcz,
     "/connections": _connections,
+    "/sockets": _sockets,
+    "/fibers": _fibers,
+    "/ids": _ids,
     "/hotspots": _hotspots,
     "/hotspots/contention": _hotspots,
+    "/pprof/profile": _hotspots,
+    "/pprof/contention": _hotspots,
 }
 
 

@@ -275,6 +275,23 @@ class WorkerPool:
     def concurrency(self) -> int:
         return self._concurrency
 
+    def stats(self) -> dict:
+        """Live scheduler stats for the /fibers portal page (the reference
+        exposes the same through /bthreads + TaskControl bvars)."""
+        with self._grow_lock:
+            nworkers = len(self._workers)
+        local = sum(len(w.rq) for w in self._workers[:nworkers])
+        return {
+            "workers": nworkers,
+            "target_concurrency": self._concurrency,
+            "max_concurrency": self._max_concurrency,
+            "idle": self._nidle,
+            "blocked": self._nblocked,
+            "queued_remote": self._remote_len(),
+            "queued_local": local,
+            "fibers_run": self.nfibers_run.get_value(),
+        }
+
     def stop_and_join(self) -> None:
         self._stopped = True
         self._lot.stop()

@@ -316,3 +316,66 @@ class TestPortal:
         assert ch.call_method("demo", "echo", b"bin").response_payload == b"bin"
         status, _, body = fetch(portal_server, "/health")
         assert status == 200 and body == b"OK"
+
+
+class TestPortalDepth:
+    """Round-3 portal pages: /sockets /fibers /ids + pprof folded output
+    (reference builtin/sockets_service, /bthreads, /ids, pprof_service)."""
+
+    def test_sockets_lists_live_connections(self, portal_server):
+        ch = Channel()
+        assert ch.init(f"127.0.0.1:{portal_server.port}")
+        assert ch.call_method("demo", "echo", b"x").ok()
+        status, _, body = fetch(portal_server, "/sockets")
+        assert status == 200
+        assert b"live sockets:" in body
+        assert b"state=up" in body
+        assert b"Socket" in body
+
+    def test_fibers_shows_scheduler_stats(self, portal_server):
+        status, _, body = fetch(portal_server, "/fibers")
+        assert status == 200
+        for key in (b"workers:", b"idle:", b"queued_remote:", b"fibers_run:"):
+            assert key in body
+
+    def test_ids_shows_slab_occupancy(self, portal_server):
+        ch = Channel()
+        assert ch.init(f"127.0.0.1:{portal_server.port}")
+        assert ch.call_method("demo", "echo", b"x").ok()
+        status, _, body = fetch(portal_server, "/ids")
+        assert status == 200
+        assert b"call_ids: slots=" in body
+        assert b"sockets: live=" in body
+
+    def test_pprof_folded_profile(self, portal_server):
+        # background load so the sampler sees stacks
+        import threading as _t
+
+        stop = _t.Event()
+
+        def burn():
+            ch = Channel()
+            assert ch.init(f"127.0.0.1:{portal_server.port}")
+            while not stop.is_set():
+                ch.call_method("demo", "echo", b"load")
+
+        th = _t.Thread(target=burn)
+        th.start()
+        try:
+            status, _, body = fetch(
+                portal_server, "/pprof/profile?seconds=0.3"
+            )
+        finally:
+            stop.set()
+            th.join()
+        assert status == 200
+        lines = [l for l in body.decode().splitlines() if l.strip()]
+        assert lines, "no folded samples"
+        # folded format: 'frame;frame;... count'
+        for line in lines[:5]:
+            stack, _, count = line.rpartition(" ")
+            assert stack and count.isdigit(), line
+
+    def test_pprof_contention_folded(self, portal_server):
+        status, _, body = fetch(portal_server, "/pprof/contention")
+        assert status == 200  # may be empty without contention; format only
