@@ -179,6 +179,16 @@ class NamingServiceThread:
 
     def start(self) -> bool:
         self._refresh()
+        if getattr(self.ns, "watch", False):
+            # push-model service (watch://): a dedicated fiber runs the
+            # blocking-query loop (the reference's RunNamingService thread,
+            # naming_service.h:49-74) instead of the periodic poll
+            from incubator_brpc_tpu.runtime.worker_pool import global_worker_pool
+
+            global_worker_pool().spawn(
+                self.ns.watch_loop, self._apply, lambda: self._stopped
+            )
+            return True
         if self.ns.poll_interval_s:
             self._schedule()
         return True
@@ -229,6 +239,9 @@ class NamingServiceThread:
         fresh = self.ns.get_servers()
         if fresh is None:
             return
+        self._apply(fresh)
+
+    def _apply(self, fresh: List[EndPoint]) -> None:
         with self._lock:
             # diff on (endpoint, tag): EndPoint identity ignores the tag, but
             # a server whose tag changed (e.g. moved partitions) must be seen
@@ -256,6 +269,10 @@ class NamingServiceThread:
                 self.ns.service_name, len(added), len(removed), len(self._current),
             )
 
+
+# watch:// (consul-style long poll) registers itself on import; imported
+# last so its `from incubator_brpc_tpu.naming import ...` resolves
+from incubator_brpc_tpu.naming import watch as _watch  # noqa: E402,F401
 
 __all__ = [
     "NamingService",

@@ -46,6 +46,10 @@ from incubator_brpc_tpu.protocol import http as _http  # noqa: E402,F401
 # RpcMeta), selectable per channel and auto-recognized per connection
 from incubator_brpc_tpu.protocol import baidu_std as _baidu_std  # noqa: E402,F401
 
+# nshead: the legacy framing family's representative, multiplexed on the
+# same port via the registry scan (policy/nshead_protocol.cpp)
+from incubator_brpc_tpu.protocol import nshead as _nshead  # noqa: E402,F401
+
 __all__ = [
     "HEADER_BYTES",
     "Meta",

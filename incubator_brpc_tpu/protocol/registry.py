@@ -34,6 +34,12 @@ class Protocol:
     # the socket's read chain directly — no whole-frame copy into Python.
     # Optional; the messenger prefers it when present.
     parse_iobuf: Optional[Callable] = None
+    # (sock) -> bool: whether this protocol participates in the scan for
+    # this connection. Lets option-dependent protocols (nshead needs a
+    # registered service; its magic sits too deep to classify short
+    # garbage) stay out of connections that can never speak them — the
+    # reference gates serving on ServerOptions the same way.
+    enabled_for: Optional[Callable] = None
 
 
 class ProtocolRegistry:

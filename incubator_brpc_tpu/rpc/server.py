@@ -156,6 +156,7 @@ class ServerOptions:
         auth=None,
         usercode_inline: bool = False,
         device_index: Optional[int] = None,
+        nshead_service=None,
     ):
         self.max_concurrency = max_concurrency
         self.method_max_concurrency = method_max_concurrency
@@ -165,6 +166,9 @@ class ServerOptions:
         # device this server binds for transport='tpu' links (None = pick a
         # neighbor of the client's device; the reference's use_rdma slot)
         self.device_index = device_index
+        # fn(cntl, head: dict, body: bytes) -> bytes — the single legacy
+        # nshead handler (reference ServerOptions.nshead_service)
+        self.nshead_service = nshead_service
         # Run request processing (cut + handler) inline on the reactor
         # thread instead of a pool fiber — removes two thread handoffs per
         # request, the analog of the reference running user code directly

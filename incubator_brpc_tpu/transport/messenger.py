@@ -41,6 +41,9 @@ class InputMessenger:
             if self._protocols is not None
             else protocol_registry.ordered()
         )
+        protos = [
+            p for p in protos if p.enabled_for is None or p.enabled_for(sock)
+        ]
         pref = sock.preferred_protocol
         if pref is not None and pref in protos and protos[0] is not pref:
             protos = [pref] + [p for p in protos if p is not pref]

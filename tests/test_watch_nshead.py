@@ -1,0 +1,173 @@
+"""watch:// long-poll naming + nshead legacy protocol tests (reference
+policy/consul_naming_service.cpp blocking queries; nshead.h +
+policy/nshead_protocol.cpp multiplexed on the shared port)."""
+
+import socket as pysocket
+import struct
+import threading
+import time
+
+import pytest
+
+from incubator_brpc_tpu.naming.watch import (
+    WatchRegistry,
+    install_watch_endpoint,
+)
+from incubator_brpc_tpu.protocol import nshead
+from incubator_brpc_tpu.rpc import Channel, Server, ServerOptions
+
+
+@pytest.fixture
+def watch_server():
+    """A framework Server hosting the watch endpoint (the test stand-in
+    for consul, same shape as the reference's consul unittest mock)."""
+    registry = WatchRegistry()
+    srv = Server()
+    install_watch_endpoint(srv, registry)
+    assert srv.start(0)
+    yield srv, registry
+    srv.stop()
+    srv.join(timeout=5)
+
+
+class TestWatchNaming:
+    def test_blocking_query_returns_on_update(self, watch_server):
+        srv, registry = watch_server
+        registry.update("db", ["127.0.0.1:7001"])
+        from incubator_brpc_tpu.protocol.http import http_call
+
+        status, _, body = http_call(
+            "127.0.0.1", srv.port, "/naming/db?index=0&wait=5"
+        )
+        assert status == 200
+        import json
+
+        obj = json.loads(body)
+        assert obj["index"] == 1
+        assert obj["servers"] == ["127.0.0.1:7001"]
+
+        # index=current parks until the NEXT update, then returns fast
+        got = {}
+
+        def poll():
+            s, _, b = http_call(
+                "127.0.0.1", srv.port, "/naming/db?index=1&wait=10", timeout=15
+            )
+            got["resp"] = json.loads(b)
+
+        t = threading.Thread(target=poll)
+        t0 = time.monotonic()
+        t.start()
+        time.sleep(0.2)
+        registry.update("db", ["127.0.0.1:7001", "127.0.0.1:7002"])
+        t.join(timeout=10)
+        dt = time.monotonic() - t0
+        assert got["resp"]["index"] == 2
+        assert len(got["resp"]["servers"]) == 2
+        assert dt < 5, f"watch did not wake on update ({dt:.1f}s)"
+
+    def test_mid_traffic_server_set_change_propagates_fast(self, watch_server):
+        # the Done criterion: a server-set change reaches a live channel's
+        # LB through the watch, without polling lag
+        watch_srv, registry = watch_server
+
+        backends = []
+        for _ in range(2):
+            b = Server()
+            port_holder = {}
+
+            def who(cntl, req, holder=port_holder):
+                return str(holder["port"]).encode()
+
+            b.add_service("w", {"who": who})
+            assert b.start(0)
+            port_holder["port"] = b.port
+            backends.append(b)
+
+        try:
+            registry.update("pool", [f"127.0.0.1:{backends[0].port}"])
+            ch = Channel()
+            assert ch.init(
+                f"watch://127.0.0.1:{watch_srv.port}/pool", "rr"
+            )
+            seen = set()
+            for _ in range(4):
+                c = ch.call_method("w", "who", b"")
+                assert c.ok(), c.error_text
+                seen.add(c.response_payload)
+            assert seen == {str(backends[0].port).encode()}
+
+            # add the second backend mid-traffic: the blocking query should
+            # push it within ~an RTT (assert well under any poll interval)
+            registry.update(
+                "pool",
+                [f"127.0.0.1:{b.port}" for b in backends],
+            )
+            deadline = time.monotonic() + 5
+            seen2 = set()
+            while time.monotonic() < deadline and len(seen2) < 2:
+                c = ch.call_method("w", "who", b"")
+                if c.ok():
+                    seen2.add(c.response_payload)
+                time.sleep(0.05)
+            assert seen2 == {str(b.port).encode() for b in backends}, (
+                "watch update did not propagate"
+            )
+        finally:
+            for b in backends:
+                b.stop()
+                b.join(timeout=5)
+
+
+class TestNshead:
+    def test_frame_roundtrip_and_header_layout(self):
+        wire = nshead.pack_frame(b"body!", id=3, version=1, log_id=77)
+        assert len(wire) == 36 + 5
+        # magic at byte 24 (2+2+4+16 preceding bytes), little-endian
+        assert struct.unpack_from("<I", wire, 24)[0] == 0xFB709394
+        frame, consumed = nshead.try_parse_frame(wire)
+        assert consumed == len(wire)
+        assert frame.head["id"] == 3
+        assert frame.head["log_id"] == 77
+        assert frame.payload == b"body!"
+
+    def test_incomplete_and_foreign(self):
+        wire = nshead.pack_frame(b"x" * 10)
+        for cut in (0, 20, 35, 40):
+            assert nshead.try_parse_frame(wire[:cut]) == (None, 0)
+        from incubator_brpc_tpu.protocol.tbus_std import ParseError
+
+        with pytest.raises(ParseError):
+            nshead.try_parse_frame(b"Z" * 40)
+
+    def test_nshead_multiplexes_on_tbus_port(self):
+        # one server, one port: tbus_std echo AND nshead frames both served
+        def ns_handler(cntl, head, body):
+            return b"ns:" + body + b":" + str(head["log_id"]).encode()
+
+        srv = Server(ServerOptions(nshead_service=ns_handler))
+        srv.add_service("t", {"echo": lambda cntl, req: req})
+        assert srv.start(0)
+        try:
+            # binary tbus call
+            ch = Channel()
+            assert ch.init(f"127.0.0.1:{srv.port}")
+            assert ch.call_method("t", "echo", b"tbus-ok").ok()
+            # raw nshead call on the same port
+            c = pysocket.create_connection(("127.0.0.1", srv.port))
+            c.settimeout(5)
+            c.sendall(nshead.pack_frame(b"legacy", id=9, log_id=42))
+            buf = b""
+            while True:
+                buf += c.recv(65536)
+                frame, consumed = nshead.try_parse_frame(buf)
+                if frame is not None:
+                    break
+            assert frame.payload == b"ns:legacy:42"
+            assert frame.head["id"] == 9
+            c.close()
+            # and tbus still works afterwards
+            assert ch.call_method("t", "echo", b"still-ok").ok()
+        finally:
+            srv.stop()
+            srv.join(timeout=5)
