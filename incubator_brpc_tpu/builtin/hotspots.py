@@ -125,3 +125,67 @@ def render_contention_text(top: int = 30) -> str:
         lines.append(stack.rstrip())
         lines.append("")
     return "\n".join(lines)
+
+
+# -- heap profile (reference /hotspots/heap + /hotspots/growth via
+#    MallocExtension, details/tcmalloc_extension.cpp; here tracemalloc is
+#    the allocator hook: start it once, snapshot on demand) ------------------
+
+def heap_profiling_active() -> bool:
+    import tracemalloc
+
+    return tracemalloc.is_tracing()
+
+
+def start_heap_profiling(nframes: int = 16) -> None:
+    """Begin tracking allocations (a few % overhead while on — the same
+    tradeoff as running with tcmalloc's sampling heap profiler)."""
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        tracemalloc.start(nframes)
+
+
+def stop_heap_profiling() -> None:
+    import tracemalloc
+
+    if tracemalloc.is_tracing():
+        tracemalloc.stop()
+
+
+def render_heap_text(top: int = 30) -> str:
+    """Live-bytes by allocation site (the /hotspots/heap view)."""
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        return (
+            "heap profiling is off - POST/GET /hotspots/heap?start=1 to "
+            "begin tracking, then fetch this page again\n"
+        )
+    snap = tracemalloc.take_snapshot()
+    total = sum(st.size for st in snap.statistics("filename"))
+    lines = [f"tracked live bytes: {total}", "", "--- by allocation site ---"]
+    for st in snap.statistics("lineno")[:top]:
+        frame = st.traceback[-1]
+        lines.append(
+            f"{st.size:12d} B over {st.count:8d} blocks  "
+            f"{frame.filename}:{frame.lineno}"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def render_heap_folded(top: int = 1000) -> str:
+    """Folded stacks weighted by live bytes (pprof inuse_space family)."""
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        return ""
+    snap = tracemalloc.take_snapshot()
+    lines = []
+    for st in snap.statistics("traceback")[:top]:
+        frames = [
+            f"{f.filename}:{f.lineno}" for f in st.traceback
+        ]  # root-first
+        if frames:
+            lines.append(f"{';'.join(frames)} {st.size}")
+    return "\n".join(lines) + ("\n" if lines else "")

@@ -157,6 +157,19 @@ def _hotspots(server, frame) -> Resp:
     folded = frame.query.get("format") == "folded" or frame.path.startswith(
         "/pprof/"
     )
+    if frame.path.rstrip("/").endswith("/heap"):
+        if frame.query.get("start"):
+            hotspots.start_heap_profiling()
+            return 200, "text/plain", b"heap profiling started\n"
+        if frame.query.get("stop"):
+            hotspots.stop_heap_profiling()
+            return 200, "text/plain", b"heap profiling stopped\n"
+        body = (
+            hotspots.render_heap_folded()
+            if folded
+            else hotspots.render_heap_text()
+        )
+        return 200, "text/plain", body.encode()
     if frame.path.rstrip("/").endswith("/contention"):
         if folded:
             return 200, "text/plain", hotspots.render_contention_folded().encode()
@@ -273,8 +286,10 @@ _PAGES: Dict[str, object] = {
     "/ids": _ids,
     "/hotspots": _hotspots,
     "/hotspots/contention": _hotspots,
+    "/hotspots/heap": _hotspots,
     "/pprof/profile": _hotspots,
     "/pprof/contention": _hotspots,
+    "/pprof/heap": _hotspots,
 }
 
 

@@ -379,3 +379,33 @@ class TestPortalDepth:
     def test_pprof_contention_folded(self, portal_server):
         status, _, body = fetch(portal_server, "/pprof/contention")
         assert status == 200  # may be empty without contention; format only
+
+
+class TestHeapProfile:
+    def test_heap_page_start_snapshot_stop(self, portal_server):
+        from incubator_brpc_tpu.builtin import hotspots
+
+        status, _, body = fetch(portal_server, "/hotspots/heap")
+        assert status == 200 and b"off" in body
+        try:
+            status, _, body = fetch(portal_server, "/hotspots/heap?start=1")
+            assert status == 200
+            # allocate something attributable, then snapshot
+            ch = Channel()
+            assert ch.init(f"127.0.0.1:{portal_server.port}")
+            for _ in range(5):
+                assert ch.call_method("demo", "echo", b"h" * 2048).ok()
+            status, _, body = fetch(portal_server, "/hotspots/heap")
+            assert status == 200
+            assert b"tracked live bytes:" in body
+            assert b"by allocation site" in body
+            status, _, body = fetch(
+                portal_server, "/pprof/heap"
+            )
+            assert status == 200  # folded: 'file:line;... bytes' lines
+            for line in body.decode().splitlines()[:3]:
+                stack, _, weight = line.rpartition(" ")
+                assert weight.isdigit()
+        finally:
+            fetch(portal_server, "/hotspots/heap?stop=1")
+            assert not hotspots.heap_profiling_active()
