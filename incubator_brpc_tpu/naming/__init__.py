@@ -139,6 +139,50 @@ class DnsNamingService(NamingService):
         return seen
 
 
+class RemoteFileNamingService(NamingService):
+    """remotefile://host:port/path — a server list fetched over HTTP, one
+    'host:port [tag]' per line (reference policy/remotefile_naming_service;
+    same poll cadence as file://, same keep-last-good-list error posture)."""
+
+    offload_refresh = True  # network fetch must not run on the TimerThread
+
+    def __init__(self, service_name: str):
+        super().__init__(service_name)
+        self.poll_interval_s = float(get_flag("ns_refresh_interval_s"))
+        authority, slash, path = service_name.partition("/")
+        host, _, port = authority.partition(":")
+        if not host:
+            raise ValueError(f"remotefile url needs host[:port]/path, got {service_name!r}")
+        self._host = host
+        self._port = int(port) if port else 80
+        self._path = (slash + path) if slash else "/"
+        self._last_body: Optional[bytes] = None
+
+    def get_servers(self) -> Optional[List[EndPoint]]:
+        from incubator_brpc_tpu.protocol.http import http_call
+
+        try:
+            status, _, body = http_call(
+                self._host, self._port, self._path, timeout=5.0
+            )
+        except OSError:
+            return None  # keep the previous list across fetch hiccups
+        if status != 200:
+            return None
+        if body == self._last_body:
+            return None  # unchanged: no diff churn
+        servers: List[EndPoint] = []
+        try:
+            for line in body.decode(errors="replace").splitlines():
+                line = line.split("#", 1)[0].strip()
+                if line:
+                    servers.append(_parse_node(line))
+        except ValueError:
+            return None
+        self._last_body = body
+        return servers
+
+
 _factories: Dict[str, Callable[[str], NamingService]] = {}
 
 
@@ -152,6 +196,7 @@ register_naming_service("list", ListNamingService)
 register_naming_service("file", FileNamingService)
 register_naming_service("dns", DnsNamingService)
 register_naming_service("http", DnsNamingService)
+register_naming_service("remotefile", RemoteFileNamingService)
 
 
 def create_naming_service(url: str) -> NamingService:

@@ -171,3 +171,42 @@ class TestNshead:
         finally:
             srv.stop()
             srv.join(timeout=5)
+
+
+class TestRemoteFileNaming:
+    def test_remotefile_serves_and_refreshes(self):
+        # host the list on a framework Server's http handler
+        from incubator_brpc_tpu.utils.flags import set_flag_unchecked
+
+        listing = {"body": b""}
+        srv = Server()
+        srv.add_http_handler(
+            "/servers.lst", lambda frame: (200, "text/plain", listing["body"])
+        )
+        assert srv.start(0)
+
+        backend = Server()
+        backend.add_service("rf", {"echo": lambda cntl, req: req})
+        assert backend.start(0)
+        listing["body"] = f"127.0.0.1:{backend.port}\n".encode()
+
+        old = None
+        try:
+            from incubator_brpc_tpu.utils.flags import flag_registry
+
+            old = flag_registry.get("ns_refresh_interval_s")
+            set_flag_unchecked("ns_refresh_interval_s", 0.1)
+            ch = Channel()
+            assert ch.init(
+                f"remotefile://127.0.0.1:{srv.port}/servers.lst", "rr"
+            )
+            c = ch.call_method("rf", "echo", b"via-remotefile")
+            assert c.ok(), c.error_text
+            assert c.response_payload == b"via-remotefile"
+        finally:
+            if old is not None:
+                set_flag_unchecked("ns_refresh_interval_s", old)
+            backend.stop()
+            backend.join(timeout=5)
+            srv.stop()
+            srv.join(timeout=5)
