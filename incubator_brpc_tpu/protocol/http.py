@@ -69,6 +69,29 @@ def looks_like_http(buf: bytes) -> bool:
     return any(head.startswith(m[: len(head)]) for m in _METHODS)
 
 
+def looks_like_http_response(buf: bytes) -> bool:
+    head = buf[:5]
+    return b"HTTP/"[: len(head)] == head
+
+
+class HttpResponseFrame:
+    """One parsed response (the client side of the Channel http stack).
+    HTTP/1.1 has no correlation ids: responses match requests in FIFO
+    order per connection, so processing is pinned inline on the reader."""
+
+    is_response = True
+    is_stream = False
+    process_inline = True
+
+    def __init__(self, status: int, headers: Dict[str, str], body: bytes):
+        self.status = status
+        self.headers = headers
+        self.body = body
+
+    def __repr__(self) -> str:
+        return f"<HttpResponseFrame {self.status} {len(self.body)}B>"
+
+
 def _content_length(headers_blob: str) -> int:
     """Extract+validate Content-Length from a raw header block. ParseError
     on malformed or negative values (the InputMessenger contract: anything
@@ -89,7 +112,8 @@ def parse_header(header: bytes) -> Optional[int]:
     lets the messenger cut without copying the whole pending buffer, and
     puts HTTP bodies under the same max_body_size guard as tbus_std).
     None = header block incomplete (the messenger re-peeks deeper)."""
-    if not looks_like_http(header):
+    is_resp = looks_like_http_response(header)
+    if not is_resp and not looks_like_http(header):
         raise ParseError("not http")
     head_end = header.find(b"\r\n\r\n")
     if head_end < 0:
@@ -98,13 +122,48 @@ def parse_header(header: bytes) -> Optional[int]:
         return None
     blob = header[:head_end].decode("latin-1", errors="replace")
     if "chunked" in blob.lower() and "transfer-encoding" in blob.lower():
+        if is_resp:
+            # progressive/chunked responses belong to the blocking helper
+            # or streams; the channel client speaks Content-Length
+            raise ParseError("chunked responses not supported on channels")
         raise ParseError("chunked request bodies not supported")
     return head_end + 4 + _content_length(blob)
 
 
+def _parse_response(buf: bytes) -> Tuple[Optional[HttpResponseFrame], int]:
+    head_end = buf.find(b"\r\n\r\n")
+    if head_end < 0:
+        if len(buf) > _MAX_HEADER_BYTES:
+            raise ParseError("http header block too large")
+        return None, 0
+    head = buf[:head_end].decode("latin-1")
+    lines = head.split("\r\n")
+    parts = lines[0].split(" ", 2)
+    if len(parts) < 2 or not parts[1].isdigit():
+        raise ParseError(f"bad status line {lines[0]!r}")
+    status = int(parts[1])
+    headers: Dict[str, str] = {}
+    for line in lines[1:]:
+        if ":" in line:
+            k, v = line.split(":", 1)
+            headers[k.strip().lower()] = v.strip()
+    if "chunked" in headers.get("transfer-encoding", ""):
+        raise ParseError("chunked responses not supported on channels")
+    raw_len = headers.get("content-length", "0") or "0"
+    if not raw_len.isdigit():
+        raise ParseError(f"bad Content-Length {raw_len!r}")
+    total = head_end + 4 + int(raw_len)
+    if len(buf) < total:
+        return None, 0
+    return HttpResponseFrame(status, headers, bytes(buf[head_end + 4 : total])), total
+
+
 def parse(buf: bytes) -> Tuple[Optional[HttpFrame], int]:
-    """Cut one request off ``buf``. (None, 0) = incomplete; ParseError =
-    not HTTP (try other protocols / fail the connection)."""
+    """Cut one request (server side) or response (channel client side) off
+    ``buf``. (None, 0) = incomplete; ParseError = not HTTP (try other
+    protocols / fail the connection)."""
+    if looks_like_http_response(buf):
+        return _parse_response(buf)
     if not looks_like_http(buf):
         raise ParseError("not http")
     head_end = buf.find(b"\r\n\r\n")
@@ -316,11 +375,84 @@ def _close_when_drained(sock) -> None:
     when_drained(sock, lambda s: s.set_failed(ErrorCode.ECLOSE, "http connection: close"))
 
 
+# -- channel client side (the reference's full http client rides the same
+#    Channel/Socket machinery as baidu_std, http_rpc_protocol.cpp's
+#    SerializeHttpRequest/PackHttpRequest + ProcessHttpResponse) -------------
+
+
+def pack_channel_request(
+    meta,
+    payload: bytes,
+    correlation_id: int,
+    flags: int = 0,
+    error_code: int = 0,
+    attachment: bytes = b"",
+) -> bytes:
+    """Protocol.pack_request slot: service/method map to POST
+    /<service>/<method> (the same route the server's gateway serves), the
+    payload is the body. No wire correlation id — the channel records the
+    cid in the connection's FIFO (fifo_responses)."""
+    if attachment:
+        raise ValueError("attachments do not exist in HTTP; use the body")
+    host = (meta.extra or {}).get("http_host", "") if meta else ""
+    path = f"/{meta.service}/{meta.method}" if meta else "/"
+    head = (
+        f"POST {path} HTTP/1.1\r\n"
+        f"Host: {host}\r\n"
+        f"Content-Length: {len(payload)}\r\n"
+        "Content-Type: application/octet-stream\r\n"
+        "Connection: keep-alive\r\n"
+    )
+    if meta is not None and meta.log_id:
+        head += f"x-tbrpc-log-id: {meta.log_id}\r\n"
+    return head.encode("latin-1") + b"\r\n" + payload
+
+
+def process_response(sock, frame: HttpResponseFrame) -> None:
+    """Match the response to the OLDEST in-flight call on this connection
+    (HTTP/1.1 pipelining is strictly FIFO) and complete it through the
+    ordinary channel return path."""
+    from incubator_brpc_tpu.runtime.correlation_id import call_id_space
+    from incubator_brpc_tpu.utils.status import ErrorCode
+
+    pending = sock.context.get("http_pending")
+    cid = None
+    if pending:
+        try:
+            cid = pending.popleft()
+        except IndexError:
+            cid = None
+    if cid is None:
+        logger.warning("http response on %r with no in-flight call", sock)
+        return
+    rc, cntl = call_id_space.lock(cid)
+    if rc != 0 or cntl is None:
+        return  # call already settled (timeout): drop the late response
+    channel = cntl._channel
+    if channel is None:
+        call_id_space.unlock(cid)
+        return
+    cntl.http_status = frame.status
+    if frame.status == 200:
+        cntl.response_payload = frame.body
+    else:
+        cntl.set_failed(
+            ErrorCode.EHTTP,
+            f"HTTP {frame.status}: {frame.body[:200].decode(errors='replace')}",
+        )
+    channel._end_rpc(cntl)
+    if frame.headers.get("connection", "").lower() == "close":
+        sock.set_failed(ErrorCode.ECLOSE, "server sent Connection: close")
+
+
 HTTP = Protocol(
     name="http",
     parse=parse,
     parse_header=parse_header,
     process_request=process_request,
+    process_response=process_response,
+    pack_request=pack_channel_request,
+    fifo_responses=True,
 )
 
 if "http" not in protocol_registry:

@@ -40,6 +40,10 @@ class Protocol:
     # garbage) stay out of connections that can never speak them — the
     # reference gates serving on ServerOptions the same way.
     enabled_for: Optional[Callable] = None
+    # True: the wire carries no correlation ids, responses match requests
+    # strictly in order per connection (HTTP) — the channel keeps a FIFO of
+    # in-flight cids on the socket instead of reading ids off the frame.
+    fifo_responses: bool = False
 
 
 class ProtocolRegistry:

@@ -545,12 +545,20 @@ class Channel:
                 proto = protocol_registry.get(proto_name)
                 if proto.pack_request is None:
                     raise ValueError(f"protocol {proto_name!r} cannot pack requests")
+                if proto.fifo_responses and sock.remote is not None:
+                    meta.extra["http_host"] = f"{sock.remote.ip}:{sock.remote.port}"
                 data = proto.pack_request(
                     meta,
                     payload,
                     cid,
                     attachment=cntl.request_attachment,
                 )
+                if proto.fifo_responses:
+                    # no wire correlation id: record the cid in the
+                    # connection's FIFO atomically with the write, so the
+                    # pending order always equals the wire order
+                    self._write_fifo_correlated(sock, cntl, cid, data)
+                    return
         except (ValueError, TypeError) as e:
             # unknown codec / bad frame inputs: fail the RPC, never leak the
             # locked id out of IssueRPC
@@ -571,6 +579,39 @@ class Channel:
             timeout=remaining,
         )
         if rc != 0:
+            self._arbitrate_error(cntl, rc, f"write to {sock.remote} failed")
+
+    def _write_fifo_correlated(self, sock, cntl: Controller, cid: int, data) -> None:
+        """Write a frame whose response matches by connection order (HTTP):
+        append the cid to the socket's pending FIFO and write under one
+        lock so two callers can't interleave order; dead sockets clear the
+        FIFO (late responses then fail their id lock and drop). Called with
+        the id locked, like the rest of IssueRPC."""
+        import collections
+
+        lock = sock.context.get("_fifo_lock")
+        if lock is None:
+            lock = sock.context.setdefault("_fifo_lock", threading.Lock())
+        pending = sock.context.get("http_pending")
+        if pending is None:
+            pending = sock.context.setdefault("http_pending", collections.deque())
+            sock.on_failed.append(
+                lambda s: s.context.get("http_pending", collections.deque()).clear()
+            )
+        pool = global_worker_pool()
+        with lock:
+            pending.append(cid)
+            rc = sock.write(
+                data,
+                on_error=lambda code, text: pool.spawn(
+                    call_id_space.error, cid, code, text
+                ),
+            )
+        if rc != 0:
+            try:
+                pending.remove(cid)
+            except ValueError:
+                pass
             self._arbitrate_error(cntl, rc, f"write to {sock.remote} failed")
 
     def _handle_id_error(self, cid: int, cntl: Controller, code: int, text: str) -> None:

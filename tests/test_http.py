@@ -409,3 +409,78 @@ class TestHeapProfile:
         finally:
             fetch(portal_server, "/hotspots/heap?stop=1")
             assert not hotspots.heap_profiling_active()
+
+
+class TestHttpChannelClient:
+    """HTTP as a first-class Channel protocol (reference
+    http_rpc_protocol.cpp client path): same Socket stack, FIFO response
+    correlation, pipelining on one keep-alive connection."""
+
+    def test_echo_over_http_channel(self, portal_server):
+        from incubator_brpc_tpu.rpc import ChannelOptions
+
+        ch = Channel()
+        assert ch.init(
+            f"127.0.0.1:{portal_server.port}",
+            options=ChannelOptions(protocol="http"),
+        )
+        cntl = ch.call_method("demo", "echo", b"over http")
+        assert cntl.ok(), cntl.error_text
+        assert cntl.response_payload == b"over http"
+        assert cntl.http_status == 200
+
+    def test_http_error_status_maps_to_ehttp(self, portal_server):
+        from incubator_brpc_tpu.rpc import ChannelOptions
+        from incubator_brpc_tpu.utils.status import ErrorCode
+
+        ch = Channel()
+        assert ch.init(
+            f"127.0.0.1:{portal_server.port}",
+            options=ChannelOptions(protocol="http"),
+        )
+        cntl = ch.call_method("demo", "missing_method", b"")
+        assert cntl.failed()
+        assert cntl.error_code == ErrorCode.EHTTP
+        assert "404" in cntl.error_text
+
+    def test_concurrent_pipelined_http_calls(self, portal_server):
+        import threading
+
+        from incubator_brpc_tpu.rpc import ChannelOptions
+
+        ch = Channel()
+        assert ch.init(
+            f"127.0.0.1:{portal_server.port}",
+            options=ChannelOptions(protocol="http", timeout_ms=10000),
+        )
+        errs = []
+
+        def worker(i):
+            for j in range(15):
+                body = f"{i}:{j}".encode()
+                c = ch.call_method("demo", "echo", body)
+                if c.failed() or c.response_payload != body:
+                    errs.append((i, j, c.error_code, c.error_text))
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs, errs[:3]
+
+    def test_http_channel_and_binary_share_the_server(self, portal_server):
+        from incubator_brpc_tpu.rpc import ChannelOptions
+
+        hch = Channel()
+        assert hch.init(
+            f"127.0.0.1:{portal_server.port}",
+            options=ChannelOptions(protocol="http"),
+        )
+        bch = Channel()
+        assert bch.init(f"127.0.0.1:{portal_server.port}")
+        for i in range(5):
+            hc = hch.call_method("demo", "echo", f"h{i}".encode())
+            bc = bch.call_method("demo", "echo", f"b{i}".encode())
+            assert hc.ok() and hc.response_payload == f"h{i}".encode()
+            assert bc.ok() and bc.response_payload == f"b{i}".encode()
