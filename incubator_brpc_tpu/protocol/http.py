@@ -149,10 +149,7 @@ def _parse_response(buf: bytes) -> Tuple[Optional[HttpResponseFrame], int]:
             headers[k.strip().lower()] = v.strip()
     if "chunked" in headers.get("transfer-encoding", ""):
         raise ParseError("chunked responses not supported on channels")
-    raw_len = headers.get("content-length", "0") or "0"
-    if not raw_len.isdigit():
-        raise ParseError(f"bad Content-Length {raw_len!r}")
-    total = head_end + 4 + int(raw_len)
+    total = head_end + 4 + _content_length(head)  # shared validation
     if len(buf) < total:
         return None, 0
     return HttpResponseFrame(status, headers, bytes(buf[head_end + 4 : total])), total
@@ -394,6 +391,11 @@ def pack_channel_request(
     cid in the connection's FIFO (fifo_responses)."""
     if attachment:
         raise ValueError("attachments do not exist in HTTP; use the body")
+    if meta is not None and meta.compress:
+        # the channel compressed the payload, but nothing here would carry
+        # Content-Encoding or decompress on the server: reject loudly
+        # rather than hand the handler gzip bytes it can't parse
+        raise ValueError("compress_type is not supported on http channels")
     host = (meta.extra or {}).get("http_host", "") if meta else ""
     path = f"/{meta.service}/{meta.method}" if meta else "/"
     head = (
@@ -411,9 +413,12 @@ def pack_channel_request(
 def process_response(sock, frame: HttpResponseFrame) -> None:
     """Match the response to the OLDEST in-flight call on this connection
     (HTTP/1.1 pipelining is strictly FIFO) and complete it through the
-    ordinary channel return path."""
-    from incubator_brpc_tpu.runtime.correlation_id import call_id_space
-    from incubator_brpc_tpu.utils.status import ErrorCode
+    ordinary channel return path. On a reactor thread a contended id (a
+    concurrent timeout holder, possibly mid-reconnect) must not park the
+    reactor — the blocking completion is deferred to a pool fiber, same
+    discipline as the tbus response path."""
+    from incubator_brpc_tpu.runtime.correlation_id import EBUSY, call_id_space
+    from incubator_brpc_tpu.transport.event_dispatcher import on_reactor_thread
 
     pending = sock.context.get("http_pending")
     cid = None
@@ -425,15 +430,36 @@ def process_response(sock, frame: HttpResponseFrame) -> None:
     if cid is None:
         logger.warning("http response on %r with no in-flight call", sock)
         return
-    rc, cntl = call_id_space.lock(cid)
+    rc, cntl = call_id_space.lock(cid, nowait=on_reactor_thread())
+    if rc == EBUSY:
+        from incubator_brpc_tpu.runtime.worker_pool import global_worker_pool
+
+        global_worker_pool().spawn(_complete_blocking, sock, frame, cid)
+        return
     if rc != 0 or cntl is None:
         return  # call already settled (timeout): drop the late response
+    _complete_locked(sock, frame, cid, cntl)
+
+
+def _complete_blocking(sock, frame: HttpResponseFrame, cid: int) -> None:
+    from incubator_brpc_tpu.runtime.correlation_id import call_id_space
+
+    rc, cntl = call_id_space.lock(cid)
+    if rc != 0 or cntl is None:
+        return
+    _complete_locked(sock, frame, cid, cntl)
+
+
+def _complete_locked(sock, frame: HttpResponseFrame, cid: int, cntl) -> None:
+    from incubator_brpc_tpu.runtime.correlation_id import call_id_space
+    from incubator_brpc_tpu.utils.status import ErrorCode
+
     channel = cntl._channel
     if channel is None:
         call_id_space.unlock(cid)
         return
     cntl.http_status = frame.status
-    if frame.status == 200:
+    if 200 <= frame.status < 300:  # any 2xx is an HTTP success
         cntl.response_payload = frame.body
     else:
         cntl.set_failed(

@@ -600,18 +600,19 @@ class Channel:
             )
         pool = global_worker_pool()
         with lock:
-            pending.append(cid)
+            # write FIRST, append on success — both under the lock. A
+            # refused write must never leave a dead cid at the FIFO head
+            # (it would consume the NEXT call's response); the lock fixes
+            # wire order = FIFO order either way.
             rc = sock.write(
                 data,
                 on_error=lambda code, text: pool.spawn(
                     call_id_space.error, cid, code, text
                 ),
             )
+            if rc == 0:
+                pending.append(cid)
         if rc != 0:
-            try:
-                pending.remove(cid)
-            except ValueError:
-                pass
             self._arbitrate_error(cntl, rc, f"write to {sock.remote} failed")
 
     def _handle_id_error(self, cid: int, cntl: Controller, code: int, text: str) -> None:
