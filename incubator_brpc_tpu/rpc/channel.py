@@ -600,18 +600,23 @@ class Channel:
             )
         pool = global_worker_pool()
         with lock:
-            # write FIRST, append on success — both under the lock. A
-            # refused write must never leave a dead cid at the FIFO head
-            # (it would consume the NEXT call's response); the lock fixes
-            # wire order = FIFO order either way.
+            # append BEFORE the write: the inline drain can flush the
+            # request and the reactor can process its response before this
+            # thread takes another step — the cid must already be in the
+            # FIFO. A refused write removes it under the SAME lock, so no
+            # concurrent writer can interleave and land behind a dead head.
+            pending.append(cid)
             rc = sock.write(
                 data,
                 on_error=lambda code, text: pool.spawn(
                     call_id_space.error, cid, code, text
                 ),
             )
-            if rc == 0:
-                pending.append(cid)
+            if rc != 0:
+                try:
+                    pending.remove(cid)
+                except ValueError:
+                    pass  # a (failed) response path already consumed it
         if rc != 0:
             self._arbitrate_error(cntl, rc, f"write to {sock.remote} failed")
 

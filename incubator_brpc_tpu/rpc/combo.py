@@ -30,6 +30,7 @@ from typing import Callable, List, Optional, Tuple
 
 from incubator_brpc_tpu.rpc.channel import Channel, ChannelOptions
 from incubator_brpc_tpu.rpc.controller import RETRIABLE, Controller
+from incubator_brpc_tpu.utils.endpoint import EndPoint
 from incubator_brpc_tpu.utils.status import ErrorCode, berror
 
 logger = logging.getLogger(__name__)
@@ -212,21 +213,30 @@ class ParallelChannel:
 
 class SelectiveChannel:
     """Replica-set chooser: each sub-channel is a schedulable unit; retries
-    move to a different sub-channel (selective_channel.cpp). The internal
-    scheduler here is round-robin with failure feedback — the reference
-    embeds a full LB over fake SocketIds; per-sub-channel health (a failure
-    skips the unit for one rotation) covers the same failover contract."""
+    move to a different sub-channel (selective_channel.cpp). Like the
+    reference — which wraps sub-channels in fake SocketIds and feeds them
+    to an embedded LoadBalancer — the scheduler here IS a real LB from the
+    registry (rr/random/wrr/la) over per-sub pseudo-endpoints, with
+    latency/error feedback after every attempt, so ``lb_name="la"`` gives
+    locality-aware replica selection across clusters."""
 
-    def __init__(self, max_retry: int = 3):
+    def __init__(self, max_retry: int = 3, lb_name: str = "rr"):
+        from incubator_brpc_tpu.lb import create_load_balancer
+
         self.max_retry = max_retry
         self._subs: List[Channel] = []
-        self._next = 0
+        self._eps: List[EndPoint] = []  # pseudo endpoint per sub-channel
+        self._lb = create_load_balancer(lb_name)
         self._lock = threading.Lock()
 
     def add_channel(self, channel: Channel) -> int:
         with self._lock:
+            idx = len(self._subs)
             self._subs.append(channel)
-            return len(self._subs) - 1
+            ep = EndPoint(ip="subchannel", port=idx)
+            self._eps.append(ep)
+        self._lb.add_server(ep)
+        return idx
 
     @property
     def channel_count(self) -> int:
@@ -234,13 +244,16 @@ class SelectiveChannel:
 
     def _pick(self, excluded: set) -> Optional[int]:
         with self._lock:
-            n = len(self._subs)
-            for _ in range(n):
-                i = self._next % n
-                self._next += 1
-                if i not in excluded:
-                    return i
-        return None
+            excluded_eps = {self._eps[i] for i in excluded if i < len(self._eps)}
+        ep = self._lb.select(excluded=excluded_eps)
+        return ep.port if ep is not None else None
+
+    def _feedback(self, index: int, latency_us: float, error_code: int) -> None:
+        with self._lock:
+            if index >= len(self._eps):
+                return
+            ep = self._eps[index]
+        self._lb.feedback(ep, latency_us, error_code)
 
     def call_method(
         self,
@@ -313,6 +326,7 @@ class SelectiveChannel:
             sc.log_id = cntl.log_id
             sub.call_method(service, method, request, cntl=sc)
             last = sc
+            self._feedback(i, sc.latency_us, sc.error_code)
             if sc.ok():
                 cntl.response_payload = sc.response_payload
                 cntl.response_attachment = sc.response_attachment

@@ -330,3 +330,66 @@ class TestPartitionChannel:
         assert cntl.ok(), cntl.error_text
         assert cntl.response_payload == b"s0:e"
         pc.stop()
+
+
+class TestSelectiveChannelEmbeddedLB:
+    def test_la_lb_prefers_the_fast_replica(self):
+        # two replicas, one slow: the embedded locality-aware LB should
+        # shift traffic to the fast one (the reference's embedded-LB
+        # contract over fake SocketIds, selective_channel.cpp)
+        import time as _time
+
+        fast = Server()
+        fast.add_service("s", {"m": lambda cntl, req: b"fast"})
+        assert fast.start(0)
+        slow = Server()
+
+        def slow_m(cntl, req):
+            _time.sleep(0.05)
+            return b"slow"
+
+        slow.add_service("s", {"m": slow_m})
+        assert slow.start(0)
+        try:
+            sc = SelectiveChannel(lb_name="la")
+            for srv in (fast, slow):
+                ch = Channel()
+                assert ch.init(f"127.0.0.1:{srv.port}")
+                sc.add_channel(ch)
+            results = []
+            for _ in range(30):
+                c = sc.call_method("s", "m", b"")
+                assert c.ok(), c.error_text
+                results.append(c.response_payload)
+            # after warmup the LA scheduler should strongly prefer fast
+            tail = results[10:]
+            assert tail.count(b"fast") > tail.count(b"slow"), tail
+        finally:
+            fast.stop()
+            fast.join(timeout=5)
+            slow.stop()
+            slow.join(timeout=5)
+
+    def test_failed_replica_excluded_then_recovers_selection(self):
+        alive = Server()
+        alive.add_service("s", {"m": lambda cntl, req: b"ok"})
+        assert alive.start(0)
+        dead = Server()
+        dead.add_service("s", {"m": lambda cntl, req: b"dead"})
+        assert dead.start(0)
+        dead_port = dead.port
+        dead.stop()
+        dead.join(timeout=5)
+        try:
+            sc = SelectiveChannel(max_retry=2, lb_name="rr")
+            for target in (f"127.0.0.1:{dead_port}", f"127.0.0.1:{alive.port}"):
+                ch = Channel()
+                assert ch.init(target)
+                sc.add_channel(ch)
+            for _ in range(4):
+                c = sc.call_method("s", "m", b"")
+                assert c.ok(), c.error_text
+                assert c.response_payload == b"ok"
+        finally:
+            alive.stop()
+            alive.join(timeout=5)
