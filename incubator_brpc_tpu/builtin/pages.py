@@ -270,6 +270,35 @@ def _vars_json(server, frame) -> Resp:
     )
 
 
+def _vars_series(server, frame) -> Resp:
+    """Sampled history for every windowed var (the reference's flot.js
+    series, vars_service + detail/series.h — served as JSON here). Each
+    entry: {"timestamps": [monotonic s], "values": [...]} at 1 Hz."""
+    import time as _time
+
+    from incubator_brpc_tpu.bvar.variable import expose_registry
+
+    prefix = frame.query.get("prefix", "")
+    now = _time.monotonic()
+    out = {}
+    with expose_registry._lock:
+        items = list(expose_registry._vars.items())
+    for name, var in items:
+        if prefix and not name.startswith(prefix):
+            continue
+        series_fn = getattr(var, "series", None)
+        if series_fn is None:
+            continue
+        pts = series_fn()
+        if not pts:
+            continue
+        out[name] = {
+            "ages_s": [round(now - ts, 1) for ts, _ in pts],  # newest ~0
+            "values": [v for _, v in pts],
+        }
+    return 200, "application/json", json.dumps(out).encode()
+
+
 _PAGES: Dict[str, object] = {
     "/": _index,
     "/index": _index,
@@ -277,6 +306,7 @@ _PAGES: Dict[str, object] = {
     "/version": _version,
     "/vars": _vars,
     "/vars.json": _vars_json,
+    "/vars/series.json": _vars_series,
     "/status": _status,
     "/flags": _flags,
     "/rpcz": _rpcz,

@@ -78,9 +78,29 @@ class Window(Variable):
         super().__init__(name)
         _sampler_thread.register(self)
 
+    # per-second points kept for plotting (/vars/series.json — the
+    # reference's vars_service serves flot.js series off the same 1 Hz
+    # sampler, detail/series.h); 3 minutes of history
+    SERIES_POINTS = 180
+
     def _take_sample(self) -> None:
+        now = time.monotonic()
         with self._samples_lock:
-            self._samples.append((time.monotonic(), self._reducer.get_value()))
+            self._samples.append((now, self._reducer.get_value()))
+        # the plotted point is the WINDOWED value (what get_value shows);
+        # computed OUTSIDE the lock — get_span re-takes it
+        point = self.get_value()
+        with self._samples_lock:
+            if not hasattr(self, "_series"):
+                self._series: Deque[Tuple[float, object]] = deque(
+                    maxlen=self.SERIES_POINTS
+                )
+            self._series.append((now, point))
+
+    def series(self):
+        """[(monotonic_ts, windowed_value)] — newest last."""
+        with self._samples_lock:
+            return list(getattr(self, "_series", ()))
 
     def get_span(self) -> Tuple[float, object]:
         """(seconds, delta) actually covered — may be < window_size early on."""

@@ -30,7 +30,7 @@ import threading
 from collections import deque
 from typing import Callable, Dict, List, Optional, Union
 
-from incubator_brpc_tpu.bvar import Adder
+from incubator_brpc_tpu.bvar import Adder, PerSecond
 from incubator_brpc_tpu.iobuf import IOBuf, read_burst_bytes
 from incubator_brpc_tpu.runtime.butex import Butex
 from incubator_brpc_tpu.runtime.worker_pool import global_worker_pool
@@ -53,6 +53,10 @@ RECYCLED = 2
 
 in_bytes = Adder(name="socket_in_bytes")
 out_bytes = Adder(name="socket_out_bytes")
+# per-second rates, sampled at 1 Hz — these feed /vars/series.json (the
+# reference's vars_service series graphs off the same sampler)
+in_bytes_ps = PerSecond(in_bytes, name="socket_in_bytes_per_second")
+out_bytes_ps = PerSecond(out_bytes, name="socket_out_bytes_per_second")
 
 
 def when_drained(sock, action, stalls: int = 0, last_unwritten: int = -1) -> None:

@@ -484,3 +484,29 @@ class TestHttpChannelClient:
             bc = bch.call_method("demo", "echo", f"b{i}".encode())
             assert hc.ok() and hc.response_payload == f"h{i}".encode()
             assert bc.ok() and bc.response_payload == f"b{i}".encode()
+
+
+class TestVarsSeries:
+    def test_series_json_has_sampled_points(self, portal_server):
+        import json as _json
+        import time as _time
+
+        ch = Channel()
+        assert ch.init(f"127.0.0.1:{portal_server.port}")
+        # traffic + wait for 2+ sampler ticks (1 Hz)
+        deadline = _time.monotonic() + 6
+        obj = {}
+        while _time.monotonic() < deadline:
+            assert ch.call_method("demo", "echo", b"tick").ok()
+            status, _, body = fetch(portal_server, "/vars/series.json")
+            assert status == 200
+            obj = _json.loads(body)
+            s = obj.get("socket_in_bytes_per_second")
+            if s and len(s["values"]) >= 2:
+                break
+            _time.sleep(0.5)
+        s = obj.get("socket_in_bytes_per_second")
+        assert s and len(s["values"]) >= 2, obj.keys()
+        assert len(s["ages_s"]) == len(s["values"])
+        # newest point is recent, ages ascend toward the past
+        assert s["ages_s"][-1] <= s["ages_s"][0] + 1e-6 or len(s["ages_s"]) == 1
