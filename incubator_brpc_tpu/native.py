@@ -55,6 +55,10 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
         ),
         "tb_iobuf_read_burst": (ctypes.c_size_t, []),
         "tb_iobuf_create": (b, []),
+        "tb_iobuf_handle_pool_stats": (
+            None,
+            [ctypes.POINTER(ctypes.c_size_t), ctypes.POINTER(ctypes.c_size_t)],
+        ),
         "tb_iobuf_destroy": (None, [b]),
         "tb_iobuf_clear": (None, [b]),
         "tb_iobuf_size": (ctypes.c_size_t, [b]),

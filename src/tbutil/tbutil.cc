@@ -14,6 +14,7 @@
 #include <cstdlib>
 #include <deque>
 #include <mutex>
+#include <new>
 #include <vector>
 
 namespace {
@@ -270,7 +271,20 @@ void tb_block_pool_stats(size_t* live, size_t* cached) {
   }
 }
 
-tb_iobuf* tb_iobuf_create(void) { return new tb_iobuf(); }
+// IOBuf handles churn once per frame on the hot path: they come from the
+// never-freeing ObjectPool (placement-new over pooled slots) instead of
+// malloc/free — the reference backs its hottest fixed-size objects with
+// the same pool (object_pool.h; butex objects, TaskMeta).
+static tb_objpool* iobuf_handle_pool() {
+  static tb_objpool* pool = tb_objpool_create(sizeof(tb_iobuf));
+  return pool;
+}
+
+tb_iobuf* tb_iobuf_create(void) {
+  void* mem = tb_objpool_get(iobuf_handle_pool());
+  if (!mem) return nullptr;
+  return new (mem) tb_iobuf();
+}
 
 void tb_iobuf_clear(tb_iobuf* b) {
   for (BlockRef& r : b->refs) dec_ref(r.block);
@@ -281,7 +295,13 @@ void tb_iobuf_clear(tb_iobuf* b) {
 void tb_iobuf_destroy(tb_iobuf* b) {
   if (!b) return;
   tb_iobuf_clear(b);
-  delete b;
+  b->~tb_iobuf();
+  tb_objpool_return(iobuf_handle_pool(), b);
+}
+
+void tb_iobuf_handle_pool_stats(size_t* live, size_t* free_count) {
+  if (live) *live = tb_objpool_live(iobuf_handle_pool());
+  if (free_count) *free_count = tb_objpool_free_count(iobuf_handle_pool());
 }
 
 size_t tb_iobuf_size(const tb_iobuf* b) { return b->nbytes; }

@@ -50,7 +50,10 @@ void tb_block_pool_stats(size_t* live, size_t* cached);
 size_t tb_iobuf_read_burst(void);
 
 // ---- IOBuf ----
+// Handles are placement-new'd over ObjectPool slots (never freed to the
+// OS); stats expose the pool's live/free counts for tests and /ids.
 tb_iobuf* tb_iobuf_create(void);
+void tb_iobuf_handle_pool_stats(size_t* live, size_t* free_count);
 void tb_iobuf_destroy(tb_iobuf* b);
 void tb_iobuf_clear(tb_iobuf* b);
 size_t tb_iobuf_size(const tb_iobuf* b);

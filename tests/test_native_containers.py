@@ -256,3 +256,32 @@ class TestContainersLoadBearing:
         assert srv._methods.get("svc.a").full_name == "svc.a"
         assert srv._methods.get("svc.nope") is None
         assert "svc.b" in srv._methods
+
+    def test_iobuf_handles_ride_the_object_pool(self):
+        # IOBuf handles are pooled (placement-new over tb_objpool slots):
+        # a create/destroy churn must recycle slots, not grow live count
+        import ctypes
+
+        from incubator_brpc_tpu.iobuf import IOBuf
+        from incubator_brpc_tpu.native import LIB, NATIVE_AVAILABLE
+
+        if not NATIVE_AVAILABLE:
+            pytest.skip("native runtime unavailable")
+
+        def stats():
+            live = ctypes.c_size_t()
+            free = ctypes.c_size_t()
+            LIB.tb_iobuf_handle_pool_stats(ctypes.byref(live), ctypes.byref(free))
+            return live.value, free.value
+
+        bufs = [IOBuf() for _ in range(32)]
+        live1, _ = stats()
+        del bufs
+        live2, free2 = stats()
+        assert live2 <= live1 - 32  # all 32 handles returned to the pool
+        assert free2 >= 32  # ...and parked for reuse, never freed
+        again = [IOBuf() for _ in range(16)]
+        live3, free3 = stats()
+        assert live3 == live2 + 16
+        assert free3 <= free2 - 16 + 1  # slots came from the free list
+        del again
