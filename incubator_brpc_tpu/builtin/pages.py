@@ -273,7 +273,8 @@ def _vars_json(server, frame) -> Resp:
 def _vars_series(server, frame) -> Resp:
     """Sampled history for every windowed var (the reference's flot.js
     series, vars_service + detail/series.h — served as JSON here). Each
-    entry: {"timestamps": [monotonic s], "values": [...]} at 1 Hz."""
+    entry: {"ages_s": [seconds before now, newest ~0], "values": [...]}
+    at 1 Hz."""
     import time as _time
 
     from incubator_brpc_tpu.bvar.variable import expose_registry

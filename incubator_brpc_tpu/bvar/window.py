@@ -74,6 +74,7 @@ class Window(Variable):
         self._reducer = reducer
         self._window_size = min(window_size, _MAX_WINDOW)
         self._samples: Deque[Tuple[float, object]] = deque(maxlen=self._window_size + 1)
+        self._series: Deque[Tuple[float, object]] = deque(maxlen=self.SERIES_POINTS)
         self._samples_lock = threading.Lock()
         super().__init__(name)
         _sampler_thread.register(self)
@@ -91,16 +92,12 @@ class Window(Variable):
         # computed OUTSIDE the lock — get_span re-takes it
         point = self.get_value()
         with self._samples_lock:
-            if not hasattr(self, "_series"):
-                self._series: Deque[Tuple[float, object]] = deque(
-                    maxlen=self.SERIES_POINTS
-                )
             self._series.append((now, point))
 
     def series(self):
         """[(monotonic_ts, windowed_value)] — newest last."""
         with self._samples_lock:
-            return list(getattr(self, "_series", ()))
+            return list(self._series)
 
     def get_span(self) -> Tuple[float, object]:
         """(seconds, delta) actually covered — may be < window_size early on."""

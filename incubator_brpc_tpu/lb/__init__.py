@@ -156,11 +156,13 @@ load_balancer.cpp; smooth-WRR gives the same proportional schedule)."""
 
     def select(self, excluded=None, request_code=None) -> Optional[EndPoint]:
         with self._lock:
+            # all excluded -> fail the selection (ExcludedServers semantics,
+            # consistent across every LB policy)
             cand = {
                 ep: w
                 for ep, w in self._weights.items()
                 if not excluded or ep not in excluded
-            } or dict(self._weights)
+            }
             if not cand:
                 return None
             total = sum(cand.values())
@@ -238,7 +240,7 @@ class ConsistentHashLB(LoadBalancer):
                 ep = self._owners[self._ring[(idx + i) % len(self._ring)]]
                 if not excluded or ep not in excluded:
                     return ep
-            return self._owners[self._ring[idx]]
+            return None  # every ring owner excluded: fail the selection
 
     def servers(self) -> List[EndPoint]:
         with self._lock:
@@ -285,7 +287,8 @@ class LocalityAwareLB(_SnapshotLB):
 
     def select(self, excluded=None, request_code=None) -> Optional[EndPoint]:
         with self._dbd.read() as lst:
-            cand = [ep for ep in lst if not excluded or ep not in excluded] or list(lst)
+            # all excluded -> None (ExcludedServers), like every other policy
+            cand = [ep for ep in lst if not excluded or ep not in excluded]
         if not cand:
             return None
         weights = [self._weight(ep) for ep in cand]

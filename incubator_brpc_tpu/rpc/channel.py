@@ -606,12 +606,21 @@ class Channel:
             # FIFO. A refused write removes it under the SAME lock, so no
             # concurrent writer can interleave and land behind a dead head.
             pending.append(cid)
-            rc = sock.write(
-                data,
-                on_error=lambda code, text: pool.spawn(
-                    call_id_space.error, cid, code, text
-                ),
-            )
+            try:
+                rc = sock.write(
+                    data,
+                    on_error=lambda code, text: pool.spawn(
+                        call_id_space.error, cid, code, text
+                    ),
+                )
+            except BaseException:
+                # an exception must not strand a dead cid at the FIFO head
+                # (it would shift every later response one call off)
+                try:
+                    pending.remove(cid)
+                except ValueError:
+                    pass
+                raise
             if rc != 0:
                 try:
                     pending.remove(cid)

@@ -53,10 +53,18 @@ RECYCLED = 2
 
 in_bytes = Adder(name="socket_in_bytes")
 out_bytes = Adder(name="socket_out_bytes")
-# per-second rates, sampled at 1 Hz — these feed /vars/series.json (the
-# reference's vars_service series graphs off the same sampler)
-in_bytes_ps = PerSecond(in_bytes, name="socket_in_bytes_per_second")
-out_bytes_ps = PerSecond(out_bytes, name="socket_out_bytes_per_second")
+
+_rate_vars: list = []
+
+
+def _ensure_rate_vars() -> None:
+    """Per-second rates for /vars/series.json, created on FIRST socket
+    construction — a Window registers with the 1 Hz bvar sampler thread,
+    which must not spawn as an import side effect (fork-after-import
+    would strand registered vars without their sampler)."""
+    if not _rate_vars:
+        _rate_vars.append(PerSecond(in_bytes, name="socket_in_bytes_per_second"))
+        _rate_vars.append(PerSecond(out_bytes, name="socket_out_bytes_per_second"))
 
 
 def when_drained(sock, action, stalls: int = 0, last_unwritten: int = -1) -> None:
@@ -190,6 +198,7 @@ class Socket:
         context: Optional[Dict] = None,
         inline_read: bool = False,
     ):
+        _ensure_rate_vars()
         conn.setblocking(False)
         # NOTE: no explicit SO_RCVBUF/SO_SNDBUF — setting them disables
         # kernel autotuning and is silently clamped to rmem_max/wmem_max,
