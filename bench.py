@@ -123,7 +123,7 @@ def bench_rpc_echo(results: dict) -> None:
             nerr += 1
     dt = time.perf_counter() - t0
     assert nerr == 0, f"{nerr}/{n} echo calls failed during latency run"
-    results["rpc_echo_us"] = dt / n * 1e6
+    results["rpc_echo_py_us"] = dt / n * 1e6
 
     # concurrent qps: 8 caller threads, sync calls
     nthreads, per_thread = 8, 1000
@@ -142,7 +142,7 @@ def bench_rpc_echo(results: dict) -> None:
     for t in threads:
         t.join()
     dt = time.perf_counter() - t0
-    results["rpc_echo_qps"] = (nthreads * per_thread - len(errs)) / dt
+    results["rpc_echo_py_qps"] = (nthreads * per_thread - len(errs)) / dt
 
     # streaming GB/s through the credit window — three passes, best kept
     # (this host is shared; a single pass can land in someone else's burst)
@@ -168,6 +168,86 @@ def bench_rpc_echo(results: dict) -> None:
         best = max(best, total / dt / 1e9)
         s.close()
     results["stream_gbps"] = best
+    server.stop()
+
+
+def bench_native_plane(results: dict) -> None:
+    """The native data plane (src/tbnet): echo through the C++ reactor +
+    dispatcher with native client. Three numbers:
+    - rpc_echo_us: sync Channel.call_method latency over the native path
+      (the framework's sanctioned fast path: ChannelOptions(native_plane));
+    - rpc_echo_qps: 8 sync caller threads (GIL-bound Python L5 on top of
+      the native plane — the honest cost of the Python user API);
+    - native_pump_ns/qps: pipelined per-request processing cost measured
+      entirely in C++ (the comparable for the reference's 200-300 ns/req
+      single-thread echo number, docs/cn/benchmark.md:57);
+    - native_echo_32k_gbps: 32 KiB echo throughput, single connection
+      (the reference's large-request table, benchmark.md:106)."""
+    from incubator_brpc_tpu.rpc import (
+        Channel,
+        ChannelOptions,
+        Server,
+        ServerOptions,
+        native_echo,
+    )
+    from incubator_brpc_tpu.transport import native_plane as np_mod
+
+    if not np_mod.NET_AVAILABLE:
+        return
+    server = Server(
+        ServerOptions(native_plane=True, usercode_inline=True, native_loops=2)
+    )
+    server.add_service("bench", {"echo": native_echo})
+    assert server.start(0)
+    assert server._native_plane is not None
+    ch = Channel()
+    assert ch.init(
+        f"127.0.0.1:{server.port}", options=ChannelOptions(native_plane=True)
+    )
+    payload = b"x" * 64
+    for _ in range(100):
+        c = ch.call_method("bench", "echo", payload)
+        assert c.ok(), c.error_text
+    n = 3000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        if ch.call_method("bench", "echo", payload).failed():
+            raise AssertionError("native echo failed mid-run")
+    results["rpc_echo_us"] = (time.perf_counter() - t0) / n * 1e6
+
+    nthreads, per = 8, 2000
+    errs = []
+
+    def worker():
+        for _ in range(per):
+            if ch.call_method("bench", "echo", payload).failed():
+                errs.append(1)
+
+    threads = [threading.Thread(target=worker) for _ in range(nthreads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    assert not errs, f"{len(errs)} native echo calls failed"
+    results["rpc_echo_qps"] = nthreads * per / dt
+
+    nch = np_mod.NativeClientChannel("127.0.0.1", server.port)
+    try:
+        nch.pump("bench", "echo", payload, 2000, inflight=64)  # warm
+        best = min(
+            nch.pump("bench", "echo", payload, 100000, inflight=128)
+            for _ in range(3)
+        )
+        results["native_pump_ns"] = best
+        results["native_pump_qps"] = 1e9 / best
+        big = b"x" * 32768
+        ns = min(nch.pump("bench", "echo", big, 10000, inflight=32) for _ in range(2))
+        # bidirectional: the payload crosses the loopback twice per request
+        results["native_echo_32k_gbps"] = 2 * len(big) / ns
+    finally:
+        nch.close()
     server.stop()
 
 
@@ -332,6 +412,7 @@ def main() -> None:
     results: dict = {}
     bench_device_echo(results)
     bench_rpc_echo(results)
+    bench_native_plane(results)
     bench_device_rpc(results)
     bench_device_link(results)
     bench_fabricnet(results)
@@ -349,8 +430,19 @@ def main() -> None:
                     "device": str(jax.devices()[0]),
                     "small_frame_us": round(results["small_frame_us"], 2),
                     "small_frame_qps": round(results["small_frame_qps"]),
-                    "rpc_echo_us": round(results["rpc_echo_us"], 1),
-                    "rpc_echo_qps": round(results["rpc_echo_qps"]),
+                    # native data plane (src/tbnet) — the sanctioned fast path
+                    "rpc_echo_us": round(results.get("rpc_echo_us", 0.0), 1) or None,
+                    "rpc_echo_qps": round(results.get("rpc_echo_qps", 0)) or None,
+                    "native_pump_ns": round(results.get("native_pump_ns", 0)) or None,
+                    "native_pump_qps": round(results.get("native_pump_qps", 0)) or None,
+                    "native_echo_32k_gbps": (
+                        round(results["native_echo_32k_gbps"], 3)
+                        if "native_echo_32k_gbps" in results
+                        else None
+                    ),
+                    # pure-Python plane (the portable fallback)
+                    "rpc_echo_py_us": round(results["rpc_echo_py_us"], 1),
+                    "rpc_echo_py_qps": round(results["rpc_echo_py_qps"]),
                     "stream_gbps": round(results["stream_gbps"], 3),
                     "device_rpc_us": round(results["device_rpc_us"], 1),
                     "device_rpc_qps": round(results["device_rpc_qps"]),
@@ -369,7 +461,8 @@ def main() -> None:
                     ),
                     "baselines": {
                         "large_frame": "brpc same-machine >=32KB multi-conn ~2.3 GB/s (docs/cn/benchmark.md:106); on-device HBM echo vs network loopback — not apples-to-apples",
-                        "rpc_echo": "brpc single-thread echo 200-300 ns/req, 3-5 M qps/thread (docs/cn/benchmark.md:57); ours crosses the Python host plane",
+                        "rpc_echo": "brpc single-thread echo 200-300 ns/req, 3-5 M qps/thread (docs/cn/benchmark.md:57); native_pump_ns is the comparable (pipelined, no interpreter); rpc_echo_us crosses the Python L5 API into the native plane",
+                        "native_echo_32k": "brpc same-machine >=32KB single-conn ~0.8 GB/s, multi-conn ~2.3 GB/s (docs/cn/benchmark.md:106); ours is one connection, bidirectional bytes",
                         "stream": "brpc same-machine single-conn ~0.8 GB/s (docs/cn/benchmark.md:106)",
                         "fabricnet_mfu": "vs v5e peak bf16 197 TFLOP/s",
                     },

@@ -61,7 +61,20 @@ def _status(server, frame) -> Resp:
     for s in servers:
         out.append(f"server {s.listen_endpoint}")
         out.append(f"  connections: {s.connection_count()}")
-        out.append(f"  requests: {s.nrequest.get_value()}")
+        nreq = s.nrequest.get_value()
+        plane = getattr(s, "_native_plane", None)
+        if plane is not None:
+            # requests answered by natively-registered methods never touch
+            # the Python counters; fold the plane's own counts in so the
+            # hottest path is not invisible here
+            ps = plane.stats()
+            nreq += ps["native_reqs"]
+            out.append(
+                f"  native plane: reqs={ps['native_reqs']} "
+                f"cb_frames={ps['cb_frames']} handoffs={ps['handoffs']} "
+                f"accepted={ps['accepted']}"
+            )
+        out.append(f"  requests: {nreq}")
         out.append(f"  errors: {s.nerror.get_value()}")
         for full_name, prop in sorted(s.methods().items()):
             st = prop.status

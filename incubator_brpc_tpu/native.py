@@ -43,6 +43,29 @@ class TbusHdr(ctypes.Structure):
 
 RELEASE_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_void_p)
 
+# tbnet callbacks (src/tbnet/tbnet.h): the per-frame Python route and the
+# protocol-sniff connection handoff
+FRAME_FN = ctypes.CFUNCTYPE(
+    None,
+    ctypes.c_void_p,  # ctx
+    ctypes.c_uint64,  # conn token
+    ctypes.c_uint32,  # cid_lo
+    ctypes.c_uint32,  # cid_hi
+    ctypes.c_uint32,  # flags
+    ctypes.c_uint32,  # error_code
+    ctypes.c_void_p,  # meta ptr
+    ctypes.c_size_t,  # meta len
+    ctypes.c_void_p,  # body tb_iobuf* (ownership transfers)
+)
+HANDOFF_FN = ctypes.CFUNCTYPE(
+    None,
+    ctypes.c_void_p,  # ctx
+    ctypes.c_int,  # fd (ownership transfers)
+    ctypes.c_void_p,  # buffered bytes
+    ctypes.c_size_t,  # buffered len
+)
+CLOSED_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_uint64)
+
 
 def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
     b = ctypes.c_void_p
@@ -156,6 +179,112 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
         "tb_flatmap_erase": (ctypes.c_int, [b, ctypes.c_uint64]),
         "tb_flatmap_size": (ctypes.c_size_t, [b]),
         "tb_flatmap_capacity": (ctypes.c_size_t, [b]),
+        # ---- tbnet (src/tbnet): native network plane ----
+        "tb_server_create": (b, [ctypes.c_int]),
+        "tb_server_set_frame_cb": (None, [b, FRAME_FN, ctypes.c_void_p]),
+        "tb_server_set_handoff_cb": (None, [b, HANDOFF_FN, ctypes.c_void_p]),
+        "tb_server_set_closed_cb": (None, [b, CLOSED_FN, ctypes.c_void_p]),
+        "tb_server_set_max_body": (None, [b, ctypes.c_size_t]),
+        "tb_server_register_native": (
+            ctypes.c_int,
+            [b, ctypes.c_char_p, ctypes.c_int, ctypes.c_uint32],
+        ),
+        "tb_server_listen": (ctypes.c_int, [b, ctypes.c_char_p, ctypes.c_int]),
+        "tb_server_port": (ctypes.c_int, [b]),
+        "tb_server_stop": (None, [b]),
+        "tb_server_destroy": (None, [b]),
+        "tb_server_stats": (
+            None,
+            [b] + [ctypes.POINTER(ctypes.c_uint64)] * 5,
+        ),
+        "tb_conn_respond": (
+            ctypes.c_int,
+            [
+                ctypes.c_uint64,
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+                ctypes.c_uint32,
+                ctypes.c_uint32,
+                ctypes.c_uint32,
+                ctypes.c_uint32,
+            ],
+        ),
+        "tb_conn_write": (ctypes.c_int, [ctypes.c_uint64, b]),
+        "tb_conn_peer": (
+            ctypes.c_int,
+            [ctypes.c_uint64, ctypes.c_char_p, ctypes.c_size_t],
+        ),
+        "tb_conn_close": (ctypes.c_int, [ctypes.c_uint64]),
+        "tb_channel_connect": (
+            b,
+            [ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+             ctypes.POINTER(ctypes.c_int)],
+        ),
+        "tb_channel_call": (
+            ctypes.c_long,
+            [
+                b,
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+                ctypes.c_uint32,
+                b,
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_uint32),
+                ctypes.POINTER(ctypes.c_uint32),
+                ctypes.c_int,
+            ],
+        ),
+        "tb_channel_send": (
+            ctypes.c_uint64,
+            [
+                b,
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+                ctypes.c_uint32,
+                ctypes.POINTER(ctypes.c_int),
+            ],
+        ),
+        "tb_channel_recv": (
+            ctypes.c_long,
+            [
+                b,
+                ctypes.POINTER(ctypes.c_uint64),
+                b,
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_uint32),
+                ctypes.POINTER(ctypes.c_uint32),
+                ctypes.c_int,
+            ],
+        ),
+        "tb_channel_error": (ctypes.c_int, [b]),
+        "tb_channel_destroy": (None, [b]),
+        "tb_channel_pump": (
+            ctypes.c_long,
+            [
+                b,
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+                ctypes.c_int,
+                ctypes.c_int,
+                ctypes.c_int,
+            ],
+        ),
     }
     for name, (restype, argtypes) in sigs.items():
         fn = getattr(lib, name)

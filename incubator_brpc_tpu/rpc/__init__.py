@@ -34,6 +34,7 @@ from incubator_brpc_tpu.rpc.stream import (
     stream_accept,
     stream_create,
 )
+from incubator_brpc_tpu.transport.native_plane import native_echo, native_nop
 
 __all__ = [
     "Authenticator",
@@ -55,6 +56,8 @@ __all__ = [
     "Stream",
     "StreamHandler",
     "StreamOptions",
+    "native_echo",
+    "native_nop",
     "stream_accept",
     "stream_create",
 ]

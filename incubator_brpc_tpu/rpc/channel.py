@@ -35,7 +35,7 @@ from incubator_brpc_tpu.runtime.worker_pool import global_worker_pool
 from incubator_brpc_tpu.transport.messenger import InputMessenger
 from incubator_brpc_tpu.transport.socket_map import SocketMap
 from incubator_brpc_tpu.utils.endpoint import EndPoint, str2endpoint
-from incubator_brpc_tpu.utils.status import ErrorCode
+from incubator_brpc_tpu.utils.status import ErrorCode, berror
 
 logger = logging.getLogger(__name__)
 
@@ -111,6 +111,7 @@ class ChannelOptions:
         device_index: int = 0,
         link_slot_words: int = 16384,
         link_window: int = 4,
+        native_plane: bool = False,
     ):
         self.timeout_ms = timeout_ms
         self.max_retry = max_retry
@@ -135,6 +136,12 @@ class ChannelOptions:
         self.device_index = device_index
         self.link_slot_words = link_slot_words
         self.link_window = link_window
+        # Route eligible sync calls through the native client (src/tbnet):
+        # pack/write/read/match in C++ with the GIL released, one shared
+        # connection with an elected completion-pump reader. Calls that
+        # need Python-plane features (streams, backup, auth, compression,
+        # LB targets) silently use the regular path.
+        self.native_plane = native_plane
 
 
 class Channel:
@@ -153,6 +160,9 @@ class Channel:
         self._init_done = False
         self._device_sock = None  # transport="tpu": the established link
         self._device_lock = threading.Lock()
+        self._native_ch = None  # NativeClientChannel (lazy; native_plane)
+        self._native_lock = threading.Lock()
+        self._native_tls = threading.local()  # pooled: one conn per thread
 
     def init(
         self,
@@ -229,6 +239,20 @@ class Channel:
         if request_stream is not None:
             cntl._request_stream = request_stream
         cntl._mark_start()
+
+        # native fast path: a sync, stream-less, unauthenticated,
+        # uncompressed call to a single TCP server rides src/tbnet end to
+        # end (C++ pack/write/pump; correlation handled by the native
+        # channel's own cid space). Transport failures fall through to the
+        # regular path, whose dial/retry machinery owns recovery.
+        if (
+            done is None
+            and request_stream is None
+            and self._options.native_plane
+            and self._native_eligible(cntl)
+            and self._native_call(cntl, service, method, request, attachment)
+        ):
+            return cntl
 
         # one id covers the first send + every retry/backup
         # (bthread_id_create_ranged with 2 + max_retry, channel.cpp:307)
@@ -357,6 +381,121 @@ class Channel:
 
     # convenience alias
     call = call_method
+
+    # -- native fast path ----------------------------------------------------
+
+    def _native_eligible(self, cntl: Controller) -> bool:
+        return (
+            self._single_server is not None
+            and not self._single_server.ip.startswith("unix://")
+            and self._options.transport == "tcp"
+            and self._options.protocol == "tbus_std"
+            and self._options.auth is None
+            and self._options.connection_type in ("single", "pooled")
+            and not cntl.compress_type
+            and not (cntl.backup_request_ms and cntl.backup_request_ms > 0)
+            and not cntl._force_host
+        )
+
+    def _native_fresh_or_none(self, cached):
+        """Reuse `cached` if healthy, else dial a replacement (None on
+        connect failure). Shared by the pooled and single storage slots."""
+        from incubator_brpc_tpu.transport import native_plane as np_mod
+
+        if cached is not None and cached.healthy():
+            return cached
+        if cached is not None:
+            cached.close()
+        try:
+            return np_mod.NativeClientChannel(
+                self._single_server.ip,
+                self._single_server.port,
+                connect_timeout_ms=int(self._options.connect_timeout * 1000),
+            )
+        except OSError:
+            return None
+
+    def _native_channel(self):
+        from incubator_brpc_tpu.transport import native_plane as np_mod
+
+        if not np_mod.NET_AVAILABLE:
+            return None
+        if self._options.connection_type == "pooled":
+            # pooled + native = one exclusive connection per caller thread
+            # (no completion-pump contention; the reference's pooled type
+            # gives each in-flight call its own fd for the same reason)
+            ch = self._native_fresh_or_none(getattr(self._native_tls, "ch", None))
+            self._native_tls.ch = ch
+            return ch
+        with self._native_lock:
+            ch = self._native_fresh_or_none(self._native_ch)
+            self._native_ch = ch
+            return ch
+
+    def _native_call(
+        self, cntl: Controller, service, method, request, attachment
+    ) -> bool:
+        """One attempt over the native channel. True = the RPC completed
+        (ok, RPC error, or timeout — none retriable under the default
+        policy); False = transport trouble, caller falls through to the
+        regular path which dials fresh and owns retries."""
+        import errno as _errno
+
+        nch = self._native_channel()
+        if nch is None:
+            return False
+        from incubator_brpc_tpu.builtin.rpcz import end_client_span, start_client_span
+        from incubator_brpc_tpu.protocol.tbus_std import Meta
+
+        cntl._span = start_client_span(cntl)
+        rc, err_code, resp_meta, body = nch.call(
+            service,
+            method,
+            request,
+            attachment,
+            timeout_ms=cntl.timeout_ms,
+        )
+        if rc < 0:
+            if rc == -_errno.ETIMEDOUT:
+                cntl.set_failed(
+                    ErrorCode.ERPCTIMEDOUT,
+                    f"deadline {cntl.timeout_ms} ms exceeded",
+                )
+                cntl.remote_side = self._single_server
+                cntl._mark_end()
+                if cntl._span is not None:
+                    end_client_span(cntl)
+                return True
+            # connection-level failure: recycle and let the regular path
+            # (fresh dial + retry arbitration) handle this call
+            with self._native_lock:
+                if self._native_ch is nch:
+                    self._native_ch = None
+            nch.close()
+            if cntl._span is not None:
+                end_client_span(cntl)
+            cntl._span = None
+            return False
+        cntl.remote_side = self._single_server
+        if err_code:
+            meta = Meta.from_bytes(resp_meta) if resp_meta else Meta()
+            cntl.set_failed(int(err_code), meta.error_text or berror(int(err_code)))
+        else:
+            meta = Meta.from_bytes(resp_meta) if resp_meta else None
+            blen = len(body)
+            att = meta.attachment_size if meta is not None else 0
+            if att > blen:
+                cntl.set_failed(ErrorCode.ERESPONSE, "attachment exceeds body")
+            else:
+                cntl.response_meta = meta
+                cntl.response_payload = body.to_bytes(blen - att)
+                cntl.response_attachment = (
+                    body.to_bytes(att, pos=blen - att) if att else b""
+                )
+        cntl._mark_end()
+        if cntl._span is not None:
+            end_client_span(cntl)
+        return True
 
     # -- issue / return paths (run under the call-id lock) -------------------
 

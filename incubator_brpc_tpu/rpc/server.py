@@ -157,12 +157,22 @@ class ServerOptions:
         usercode_inline: bool = False,
         device_index: Optional[int] = None,
         nshead_service=None,
+        native_plane: bool = False,
+        native_loops: int = 2,
     ):
         self.max_concurrency = max_concurrency
         self.method_max_concurrency = method_max_concurrency
         self.idle_timeout_s = idle_timeout_s
         self.has_builtin_services = has_builtin_services
         self.auth = auth  # Authenticator (rpc/auth.py)
+        # Serve this port from the native C++ reactor (src/tbnet): tbus_std
+        # frames cut/dispatched in C++, natively-registered methods answered
+        # without the interpreter, other protocols handed off to the Python
+        # plane per connection. Requires libtbutil; silently falls back to
+        # the Python acceptor when the toolchain is missing or the listen
+        # endpoint is a unix socket.
+        self.native_plane = native_plane
+        self.native_loops = native_loops
         # device this server binds for transport='tpu' links (None = pick a
         # neighbor of the client's device; the reference's use_rdma slot)
         self.device_index = device_index
@@ -195,6 +205,7 @@ class Server:
         self.nerror = Adder(name=None)
         self.listen_endpoint: Optional[EndPoint] = None
         self._device_socks: list = []  # transport='tpu' links we accepted
+        self._native_plane = None  # NativeServerPlane when options ask for it
 
     # -- registration --------------------------------------------------------
 
@@ -274,13 +285,28 @@ class Server:
                     make_handshake_handler(self), MethodStatus(hs, 0), hs
                 ),
             )
-        self._acceptor = Acceptor(
-            ep,
-            messenger=self._messenger,
-            conn_context={"server": self},
-            inline_read=self.options.usercode_inline,
+        use_native = (
+            self.options.native_plane and not ep.ip.startswith("unix://")
         )
-        self.listen_endpoint = self._acceptor.endpoint
+        if use_native:
+            from incubator_brpc_tpu.transport import native_plane as np_mod
+
+            if not np_mod.NET_AVAILABLE:
+                use_native = False
+        if use_native:
+            plane = np_mod.NativeServerPlane(self, self.options.native_loops)
+            plane.register_methods()
+            port = plane.listen(ep.ip, ep.port)
+            self._native_plane = plane
+            self.listen_endpoint = EndPoint(ip=ep.ip, port=port)
+        else:
+            self._acceptor = Acceptor(
+                ep,
+                messenger=self._messenger,
+                conn_context={"server": self},
+                inline_read=self.options.usercode_inline,
+            )
+            self.listen_endpoint = self._acceptor.endpoint
         self._stopping = False
         self._started = True
         if self.options.has_builtin_services:
@@ -298,6 +324,8 @@ class Server:
         self._stopping = True
         if self._acceptor is not None:
             self._acceptor.stop()
+        if self._native_plane is not None:
+            self._native_plane.stop()
         for ds in list(self._device_socks):
             try:
                 ds.set_failed(ErrorCode.ECLOSE, "server stopped")
@@ -325,6 +353,8 @@ class Server:
         return self._started and not self._stopping
 
     def connection_count(self) -> int:
+        if self._native_plane is not None:
+            return self._native_plane.connection_count()
         return self._acceptor.connection_count() if self._acceptor else 0
 
     # -- request path --------------------------------------------------------

@@ -1,0 +1,504 @@
+"""Native network plane — Python face of src/tbnet.
+
+The reference's L2–L4 data plane is C++ (SURVEY.md §2 rules out Python
+stand-ins); tbnet is the native epoll reactor + tbus_std messenger + method
+dispatcher, and this module is the seam between it and the Python L5:
+
+- ``NativeServerPlane`` replaces the Python Acceptor/EventDispatcher for a
+  Server: tbus_std frames cut, verified and (for natively-registered
+  methods) ANSWERED without the interpreter; other frames surface here as
+  one callback per frame and run through the exact same
+  ``Server.process_request`` path (admission, auth, rpcz, dump) over a
+  ``NativeConnSock`` facade; connections that open with a different
+  protocol (the HTTP portal, baidu_std, nshead...) are handed off wholesale
+  to a real Python ``Socket`` — one port, every protocol, like the
+  reference's protocol scan (input_messenger.cpp:60-129).
+- ``NativeClientChannel`` is the client fast path: pack/write/read/match in
+  C++ with the GIL released; concurrent callers share one connection and
+  elect a completion-pump reader (the single-connection multi-caller shape
+  of the reference client).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import socket as _pysocket
+import threading
+from typing import Dict, Optional
+
+from incubator_brpc_tpu import native
+from incubator_brpc_tpu.native import CLOSED_FN, FRAME_FN, HANDOFF_FN, LIB
+from incubator_brpc_tpu.utils.endpoint import EndPoint
+from incubator_brpc_tpu.utils.status import ErrorCode
+
+logger = logging.getLogger(__name__)
+
+NET_AVAILABLE = native.NATIVE_AVAILABLE
+
+KIND_ECHO = 1
+KIND_NOP = 2
+
+# flags mirrored from protocol/tbus_std.py (also in tbnet.cc)
+_FLAG_RESPONSE = 1
+_FLAG_STREAM = 2
+
+
+def _native_kind(handler) -> Optional[int]:
+    return getattr(handler, "_native_kind", None)
+
+
+def native_echo(cntl, request: bytes) -> bytes:
+    """Echo handler the native plane can run without the interpreter; works
+    identically as a plain Python handler when the plane is off."""
+    cntl.response_attachment = cntl.request_attachment
+    return request
+
+
+native_echo._native_kind = KIND_ECHO
+
+
+def native_nop(cntl, request: bytes) -> bytes:
+    """No-op handler (empty response); native kind 2."""
+    return b""
+
+
+native_nop._native_kind = KIND_NOP
+
+
+class NativeConnSock:
+    """Socket facade over a tbnet connection token — just enough surface
+    for the Python request path (process_request, streams, auth): write,
+    context, remote, failure hooks. The real fd lives in C++."""
+
+    def __init__(self, token: int, server):
+        self.token = token
+        self.context: Dict = {"server": server}
+        self.on_failed = []
+        self.on_revived = []
+        self.error_code = 0
+        self.error_text = ""
+        self.state = 0  # transport/sock.CONNECTED
+        self.preferred_protocol = None
+        self.user_message_handler = None
+        ip = ctypes.create_string_buffer(64)
+        port = LIB.tb_conn_peer(token, ip, 64)
+        self.remote = (
+            EndPoint(ip=ip.value.decode(), port=port) if port >= 0 else None
+        )
+
+    def write(self, data, on_error=None, timeout=None) -> int:
+        from incubator_brpc_tpu.iobuf import IOBuf
+
+        if isinstance(data, (bytes, bytearray, memoryview)):
+            buf = IOBuf()
+            buf.append(bytes(data))
+        else:
+            buf = data
+        if LIB.tb_conn_write(self.token, buf._h) != 0:
+            if on_error is not None:
+                try:
+                    on_error(ErrorCode.EFAILEDSOCKET, "native conn gone")
+                except Exception:
+                    logger.exception("write on_error callback failed")
+            return ErrorCode.EFAILEDSOCKET
+        return 0
+
+    def set_failed(self, code: int = ErrorCode.EFAILEDSOCKET, reason: str = "") -> bool:
+        if self.state != 0:
+            return False
+        self.error_code = code
+        self.error_text = reason
+        LIB.tb_conn_close(self.token)
+        return True
+
+    def _mark_closed(self) -> None:
+        """tbnet says the connection died: run failure hooks (streams)."""
+        if self.state != 0:
+            return
+        self.state = 1  # FAILED
+        if not self.error_code:
+            self.error_code = ErrorCode.EEOF
+            self.error_text = "native conn closed"
+        for cb in list(self.on_failed):
+            try:
+                cb(self)
+            except Exception:
+                logger.exception("on_failed callback raised")
+
+    def __repr__(self) -> str:
+        return f"<NativeConnSock token={self.token:#x} remote={self.remote}>"
+
+
+class NativeServerPlane:
+    def __init__(self, server, nloops: int = 2):
+        if not NET_AVAILABLE:
+            raise RuntimeError("native plane unavailable")
+        self._server = server
+        self._srv = LIB.tb_server_create(nloops)
+        from incubator_brpc_tpu.utils.flags import get_flag
+
+        LIB.tb_server_set_max_body(
+            self._srv, int(get_flag("max_body_size")) + 64 * 1024
+        )
+        # keep callback objects alive for the server's lifetime
+        self._frame_cb = FRAME_FN(self._on_frame)
+        self._handoff_cb = HANDOFF_FN(self._on_handoff)
+        self._closed_cb = CLOSED_FN(self._on_closed)
+        LIB.tb_server_set_frame_cb(self._srv, self._frame_cb, None)
+        LIB.tb_server_set_handoff_cb(self._srv, self._handoff_cb, None)
+        LIB.tb_server_set_closed_cb(self._srv, self._closed_cb, None)
+        self._socks: Dict[int, NativeConnSock] = {}
+        self._socks_lock = threading.Lock()
+        self._handoff_socks: set = set()  # live handed-off Python Sockets
+        self._stopped = False
+        self.port = 0
+
+    # -- registration ------------------------------------------------------
+
+    def register_methods(self) -> None:
+        """Register native-kind handlers (echo/nop) for pure-C++ dispatch;
+        everything else stays on the per-frame Python route. Gates the
+        Python route enforces per request — the Authenticator and the
+        server-wide max_concurrency — cannot be skipped by a fast path, so
+        servers configured with either keep ALL methods on the Python
+        route (native kinds only elide work, never checks)."""
+        if (
+            self._server.options.auth is not None
+            or self._server.options.max_concurrency
+        ):
+            return
+        for full, prop in self._server.methods().items():
+            kind = _native_kind(prop.handler)
+            if kind is not None:
+                LIB.tb_server_register_native(
+                    self._srv, full.encode(), kind, prop.status.max_concurrency
+                )
+
+    def listen(self, ip: str, port: int) -> int:
+        rc = LIB.tb_server_listen(self._srv, ip.encode(), port)
+        if rc < 0:
+            raise OSError(-rc, "tb_server_listen failed")
+        self.port = rc
+        return rc
+
+    # -- callbacks from loop threads --------------------------------------
+
+    def _sock_for(self, token: int) -> NativeConnSock:
+        with self._socks_lock:
+            s = self._socks.get(token)
+            if s is None:
+                s = NativeConnSock(token, self._server)
+                self._socks[token] = s
+            return s
+
+    def _on_frame(self, _ctx, token, cid_lo, cid_hi, flags, error_code,
+                  meta_ptr, meta_len, body_h) -> None:
+        from incubator_brpc_tpu.iobuf import IOBuf
+        from incubator_brpc_tpu.protocol.tbus_std import Meta, ParsedFrame
+
+        try:
+            body = IOBuf(_handle=body_h)  # take ownership
+            meta_bytes = (
+                ctypes.string_at(meta_ptr, meta_len) if meta_len else b""
+            )
+            meta = Meta.from_bytes(meta_bytes)
+            blen = len(body)
+            att = meta.attachment_size
+            if att > blen:
+                # consumed, unrecoverable: kill the connection (the Python
+                # messenger's FatalParseError path)
+                LIB.tb_conn_close(token)
+                return
+            payload = body.to_bytes(blen - att)
+            attachment = body.to_bytes(att, pos=blen - att) if att else b""
+            frame = ParsedFrame(
+                meta=meta,
+                payload=payload,
+                attachment=attachment,
+                correlation_id=cid_lo | (cid_hi << 32),
+                flags=flags,
+                error_code=error_code,
+            )
+            sock = self._sock_for(token)
+            self._dispatch(sock, frame)
+        except Exception:
+            logger.exception("native frame dispatch failed")
+
+    def _dispatch(self, sock: NativeConnSock, frame) -> None:
+        """Mirror of InputMessenger._process_one for pre-cut tbus frames."""
+        from incubator_brpc_tpu import protocol as proto_pkg
+
+        proto = proto_pkg.TBUS_STD
+        if frame.is_stream and proto.process_stream is not None:
+            proto.process_stream(sock, frame)  # in wire order, inline
+            return
+        if frame.is_response:
+            if proto.process_response is not None:
+                proto.process_response(sock, frame)
+            return
+        if self._server.options.usercode_inline:
+            self._server.process_request(sock, frame)
+        else:
+            from incubator_brpc_tpu.runtime.worker_pool import global_worker_pool
+
+            global_worker_pool().spawn(
+                self._server.process_request, sock, frame
+            )
+
+    def _on_handoff(self, _ctx, fd, buffered_ptr, buffered_len) -> None:
+        """Non-tbus_std connection: wrap the fd in a real Python Socket so
+        the full protocol scan (HTTP portal, baidu_std, nshead, redis...)
+        runs exactly as with the Python acceptor."""
+        try:
+            data = (
+                ctypes.string_at(buffered_ptr, buffered_len)
+                if buffered_len
+                else b""
+            )
+            conn = _pysocket.socket(fileno=fd)
+            try:
+                peer = conn.getpeername()
+            except OSError:
+                peer = None
+            from incubator_brpc_tpu.transport.sock import Socket
+
+            sock = Socket.from_accepted(
+                conn,
+                peer,
+                messenger=self._server._messenger,
+                context={"server": self._server},
+                inline_read=self._server.options.usercode_inline,
+                preread=data,
+            )
+            with self._socks_lock:
+                self._handoff_socks.add(sock)
+            # self-pruning: a dead handed-off connection must not pin its
+            # Socket (and buffers) for the server's lifetime
+            sock.on_failed.append(self._forget_handoff)
+        except Exception:
+            logger.exception("native handoff failed")
+
+    def _forget_handoff(self, sock) -> None:
+        with self._socks_lock:
+            self._handoff_socks.discard(sock)
+
+    def _on_closed(self, _ctx, token) -> None:
+        with self._socks_lock:
+            sock = self._socks.pop(token, None)
+        if sock is not None:
+            try:
+                sock._mark_closed()
+            except Exception:
+                logger.exception("conn-closed hook raised")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        # stop joins the loop threads, so no callback can be in flight when
+        # destroy frees the epoll/event fds and the method table
+        LIB.tb_server_stop(self._srv)
+        self._final_stats = self.stats()
+        with self._socks_lock:
+            handoffs = list(self._handoff_socks)
+            self._handoff_socks.clear()
+        for sock in handoffs:
+            try:
+                sock.set_failed(ErrorCode.ECLOSE, "server stopped")
+            except Exception:
+                pass
+        with self._socks_lock:
+            socks, self._socks = list(self._socks.values()), {}
+        for s in socks:
+            s._mark_closed()
+        srv, self._srv = self._srv, None
+        LIB.tb_server_destroy(srv)
+
+    def stats(self) -> Dict[str, int]:
+        if self._srv is None:
+            return getattr(
+                self,
+                "_final_stats",
+                dict.fromkeys(
+                    ("accepted", "native_reqs", "cb_frames", "handoffs",
+                     "live_conns"),
+                    0,
+                ),
+            )
+        vals = [ctypes.c_uint64() for _ in range(5)]
+        LIB.tb_server_stats(self._srv, *[ctypes.byref(v) for v in vals])
+        keys = ("accepted", "native_reqs", "cb_frames", "handoffs", "live_conns")
+        return dict(zip(keys, (v.value for v in vals)))
+
+    def connection_count(self) -> int:
+        with self._socks_lock:
+            live_handoffs = sum(1 for s in self._handoff_socks if s.state == 0)
+        return self.stats()["live_conns"] + live_handoffs
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class NativeClientChannel:
+    """Client fast path over one shared native connection."""
+
+    _META_CACHE_MAX = 1024
+
+    def __init__(self, ip: str, port: int, connect_timeout_ms: int = 5000):
+        if not NET_AVAILABLE:
+            raise RuntimeError("native plane unavailable")
+        err = ctypes.c_int(0)
+        self._ch = LIB.tb_channel_connect(
+            ip.encode(), port, connect_timeout_ms, ctypes.byref(err)
+        )
+        if not self._ch:
+            raise OSError(err.value, f"connect {ip}:{port} failed")
+        self._meta_cache: Dict[tuple, bytes] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+        self._inflight = 0  # calls inside C; destroy only when drained
+        # reusable per-thread response-meta buffer: a fresh 64 KB
+        # create_string_buffer per call costs more than the whole native
+        # round trip
+        self._tls = threading.local()
+
+    def healthy(self) -> bool:
+        return not self._closed and LIB.tb_channel_error(self._ch) == 0
+
+    def _meta_bytes(self, service: str, method: str, att_len: int) -> bytes:
+        if att_len:
+            from incubator_brpc_tpu.protocol.tbus_std import Meta
+
+            return Meta(service=service, method=method).to_bytes(
+                attachment_size=att_len
+            )
+        key = (service, method)
+        m = self._meta_cache.get(key)
+        if m is None:
+            from incubator_brpc_tpu.protocol.tbus_std import Meta
+
+            m = Meta(service=service, method=method).to_bytes()
+            if len(self._meta_cache) < self._META_CACHE_MAX:
+                self._meta_cache[key] = m
+        return m
+
+    def call(
+        self,
+        service: str,
+        method: str,
+        payload: bytes,
+        attachment: bytes = b"",
+        timeout_ms: int = 500,
+    ):
+        """One native round trip. Returns (rc, err_code, resp_meta_bytes,
+        body: IOBuf) — rc < 0 is a transport errno, err_code the server's
+        RPC error."""
+        import errno as _errno
+
+        from incubator_brpc_tpu.iobuf import IOBuf
+        from incubator_brpc_tpu.protocol.tbus_std import FLAG_BODY_CRC
+        from incubator_brpc_tpu.utils.flags import get_flag
+
+        with self._lock:
+            if self._closed:
+                return -_errno.EPIPE, 0, b"", IOBuf()
+            self._inflight += 1
+        try:
+            meta = self._meta_bytes(service, method, len(attachment))
+            flags = FLAG_BODY_CRC if get_flag("tbus_body_crc") else 0
+            body = IOBuf()
+            tls = self._tls
+            try:
+                meta_out = tls.meta_out
+                meta_len = tls.meta_len
+                err_code = tls.err_code
+            except AttributeError:
+                meta_out = tls.meta_out = ctypes.create_string_buffer(64 * 1024)
+                meta_len = tls.meta_len = ctypes.c_uint32(0)
+                err_code = tls.err_code = ctypes.c_uint32(0)
+            rc = LIB.tb_channel_call(
+                self._ch,
+                meta,
+                len(meta),
+                payload,
+                len(payload),
+                attachment,
+                len(attachment),
+                flags,
+                body._h,
+                meta_out,
+                64 * 1024,
+                ctypes.byref(meta_len),
+                ctypes.byref(err_code),
+                int(timeout_ms) if timeout_ms and timeout_ms > 0 else 0,
+            )
+            resp_meta = meta_out.raw[: meta_len.value] if meta_len.value else b""
+            return rc, err_code.value, resp_meta, body
+        finally:
+            destroy = False
+            with self._lock:
+                self._inflight -= 1
+                destroy = self._closed and self._inflight == 0 and self._ch
+                if destroy:
+                    ch, self._ch = self._ch, None
+            if destroy:
+                LIB.tb_channel_destroy(ch)
+
+    def pump(
+        self,
+        service: str,
+        method: str,
+        payload: bytes,
+        n: int,
+        inflight: int = 64,
+        timeout_ms: int = 60000,
+    ) -> float:
+        """Pipelined native load run (example/rdma_performance client
+        analog): n requests with `inflight` outstanding, entirely in C++.
+        Returns ns/request. Requires exclusive use of this channel."""
+        import errno as _errno
+
+        with self._lock:
+            if self._closed:
+                raise OSError(_errno.EPIPE, "channel closed")
+            self._inflight += 1
+        try:
+            meta = self._meta_bytes(service, method, 0)
+            rc = LIB.tb_channel_pump(
+                self._ch, meta, len(meta), payload, len(payload), n, inflight,
+                timeout_ms,
+            )
+            if rc < 0:
+                raise OSError(-rc, "native pump failed")
+            return float(rc)
+        finally:
+            destroy = False
+            with self._lock:
+                self._inflight -= 1
+                destroy = self._closed and self._inflight == 0 and self._ch
+                if destroy:
+                    ch, self._ch = self._ch, None
+            if destroy:
+                LIB.tb_channel_destroy(ch)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            if self._inflight > 0 or not self._ch:
+                return  # last call out destroys
+            ch, self._ch = self._ch, None
+        LIB.tb_channel_destroy(ch)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -197,6 +197,7 @@ class Socket:
         user_message_handler: Optional[Callable] = None,
         context: Optional[Dict] = None,
         inline_read: bool = False,
+        preread: bytes = b"",
     ):
         _ensure_rate_vars()
         conn.setblocking(False)
@@ -234,6 +235,11 @@ class Socket:
         self.on_revived: List[Callable[["Socket"], None]] = []
 
         self._read_buf = IOBuf()
+        # bytes another plane already read off this fd (the native plane's
+        # protocol-sniff handoff) — seeded BEFORE the dispatcher
+        # registration below makes the socket live
+        if preread:
+            self._read_buf.append(preread)
         self._wlock = threading.Lock()
         self._wqueue: deque = deque()
         self._writing = False
@@ -262,6 +268,15 @@ class Socket:
         self._pool = global_worker_pool()
         self.id = _registry.insert(self)
         self._dispatcher.add_consumer(self.fd, self._on_event, EVENT_IN)
+        if preread:
+            # frames may already be complete in the preread bytes and no
+            # further wire activity will announce them: run one read pass
+            with self._state_lock:
+                claimed = not self._reading and self.state == CONNECTED
+                if claimed:
+                    self._reading = True
+            if claimed:
+                self._pool.spawn(self._process_event)
 
     # -- construction -------------------------------------------------------
 

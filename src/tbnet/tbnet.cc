@@ -1,0 +1,1321 @@
+// tbnet — native network plane implementation.  See tbnet.h for the role
+// and the reference seams this re-designs (event_dispatcher.cpp,
+// input_messenger.cpp:60-129, socket.cpp:1591-1686, baidu_rpc_protocol.cpp).
+//
+// Threading model: N epoll loop threads own connections (a connection is
+// read by exactly its loop thread; LT events, no oneshot re-arm needed).
+// Foreign threads (Python handlers answering asynchronously, the client's
+// writers) touch a connection only through versioned tokens resolved out
+// of a tb_respool — the same Address-after-SetFailed discipline the
+// reference builds on Socket's versioned refs (socket.h:619-630).  Writes
+// from any thread serialize on the connection's write mutex; the fd is
+// closed only after every in-flight token holder drops its ref.
+
+#include "tbnet.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include <zlib.h>  // crc32: the dispatch key's second polynomial
+
+namespace {
+
+// wire constants — must match protocol/tbus_std.py and tbutil.cc
+constexpr uint32_t kMagic = 0x54505243;  // "TPRC"
+constexpr uint32_t kFlagResponse = 1;
+constexpr uint32_t kFlagStream = 2;
+constexpr uint32_t kFlagHasMeta = 4;
+constexpr uint32_t kFlagBodyCrc = 8;
+constexpr size_t kHeader = 32;
+
+constexpr int kKindEcho = 1;
+constexpr int kKindNop = 2;
+
+uint64_t now_ms() {
+  return static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::milliseconds>(
+          std::chrono::steady_clock::now().time_since_epoch())
+          .count());
+}
+
+// ---------------------------------------------------------------------------
+// minimal JSON scanner for the flat meta object.  The native plane needs
+// only the routing fields (service/method/attachment_size); any meta it
+// cannot fully vouch for (escapes, compression, stream/trace fields, parse
+// trouble) routes to the Python frame callback, which parses properly.
+// ---------------------------------------------------------------------------
+
+struct MetaLite {
+  bool ok = false;         // meta parsed cleanly
+  bool to_python = false;  // fields beyond the native fast path's scope
+  std::string service;
+  std::string method;
+  long attachment = 0;
+};
+
+struct Scan {
+  const char* p;
+  const char* end;
+  void ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+  bool lit(char c) {
+    ws();
+    if (p < end && *p == c) {
+      ++p;
+      return true;
+    }
+    return false;
+  }
+  // raw string body between quotes; *escaped set if any backslash seen
+  bool str(std::string* out, bool* escaped) {
+    ws();
+    if (p >= end || *p != '"') return false;
+    ++p;
+    const char* s = p;
+    bool esc = false;
+    while (p < end) {
+      if (*p == '\\') {
+        esc = true;
+        p += 2;
+        continue;
+      }
+      if (*p == '"') {
+        if (out) out->assign(s, p - s);
+        if (escaped) *escaped = esc;
+        ++p;
+        return true;
+      }
+      ++p;
+    }
+    return false;
+  }
+  bool skip_value();
+  bool skip_container(char open, char close) {
+    int depth = 1;
+    ++p;  // past open
+    while (p < end && depth > 0) {
+      if (*p == '"') {
+        if (!str(nullptr, nullptr)) return false;
+        continue;
+      }
+      if (*p == open) ++depth;
+      if (*p == close) --depth;
+      ++p;
+    }
+    return depth == 0;
+  }
+};
+
+bool Scan::skip_value() {
+  ws();
+  if (p >= end) return false;
+  char c = *p;
+  if (c == '"') return str(nullptr, nullptr);
+  if (c == '{') return skip_container('{', '}');
+  if (c == '[') return skip_container('[', ']');
+  const char* s = p;  // number / true / false / null
+  while (p < end && *p != ',' && *p != '}' && *p != ']' && *p != ' ' &&
+         *p != '\t' && *p != '\n' && *p != '\r')
+    ++p;
+  return p > s;
+}
+
+MetaLite scan_meta(const char* s, size_t n) {
+  MetaLite m;
+  if (n == 0) {
+    m.ok = true;
+    return m;
+  }
+  Scan sc{s, s + n};
+  if (!sc.lit('{')) return m;
+  sc.ws();
+  if (sc.p < sc.end && *sc.p == '}') {
+    m.ok = true;
+    return m;
+  }
+  for (;;) {
+    std::string key;
+    bool kesc = false;
+    if (!sc.str(&key, &kesc) || kesc) return m;
+    if (!sc.lit(':')) return m;
+    if (key == "service" || key == "method") {
+      std::string v;
+      bool vesc = false;
+      if (!sc.str(&v, &vesc)) return m;
+      if (vesc) m.to_python = true;  // escaped name: Python unescapes
+      (key == "service" ? m.service : m.method) = std::move(v);
+    } else if (key == "attachment_size") {
+      sc.ws();
+      char* endp = nullptr;
+      m.attachment = strtol(sc.p, &endp, 10);
+      if (endp == sc.p || m.attachment < 0) return m;
+      sc.p = endp;
+    } else {
+      // compress, stream ids, trace ids, error_text, extra...: semantics
+      // the native fast path doesn't implement — Python handles them
+      if (!sc.skip_value()) return m;
+      m.to_python = true;
+    }
+    sc.ws();
+    if (sc.p < sc.end && *sc.p == ',') {
+      ++sc.p;
+      continue;
+    }
+    if (sc.lit('}')) break;
+    return m;
+  }
+  m.ok = true;
+  return m;
+}
+
+// ---------------------------------------------------------------------------
+// frame pack helpers
+// ---------------------------------------------------------------------------
+
+// append the 32-byte header (+ small meta) contiguously
+void append_header(tb_iobuf* out, const void* meta, size_t meta_len,
+                   size_t body_rest_len, uint32_t crc, uint32_t cid_lo,
+                   uint32_t cid_hi, uint32_t flags, uint32_t error_code) {
+  uint32_t h[8];
+  h[0] = kMagic;
+  h[1] = static_cast<uint32_t>(meta_len + body_rest_len);
+  h[2] = flags;
+  h[3] = cid_lo;
+  h[4] = cid_hi;
+  h[5] = static_cast<uint32_t>(meta_len);
+  h[6] = crc;
+  h[7] = error_code;
+  if (meta_len > 0 && meta_len <= 4096) {
+    char scratch[4096 + sizeof h];
+    memcpy(scratch, h, sizeof h);
+    memcpy(scratch + sizeof h, meta, meta_len);
+    tb_iobuf_append(out, scratch, sizeof h + meta_len);
+  } else {
+    tb_iobuf_append(out, h, sizeof h);
+    if (meta_len) tb_iobuf_append(out, meta, meta_len);
+  }
+}
+
+// whole frame from contiguous caller memory
+void pack_flat(tb_iobuf* out, const void* meta, size_t meta_len,
+               const void* payload, size_t payload_len, const void* att,
+               size_t att_len, uint32_t cid_lo, uint32_t cid_hi,
+               uint32_t flags, uint32_t error_code) {
+  if (meta_len) flags |= kFlagHasMeta;
+  uint32_t crc = tb_crc32c(0, meta, meta_len);
+  if (flags & kFlagBodyCrc) {
+    crc = tb_crc32c(crc, payload, payload_len);
+    crc = tb_crc32c(crc, att, att_len);
+  }
+  append_header(out, meta, meta_len, payload_len + att_len, crc, cid_lo,
+                cid_hi, flags, error_code);
+  if (payload_len) tb_iobuf_append(out, payload, payload_len);
+  if (att_len) tb_iobuf_append(out, att, att_len);
+}
+
+// ---------------------------------------------------------------------------
+// connection registry (token = versioned respool id; global resolve mutex +
+// per-conn refcount gate the fd against cross-thread teardown)
+// ---------------------------------------------------------------------------
+
+struct NetLoop;
+
+struct PollObj {
+  int kind;  // 0 conn, 1 listener, 2 wake
+  explicit PollObj(int k) : kind(k) {}
+  virtual ~PollObj() = default;
+};
+
+struct NetConn : PollObj {
+  NetConn() : PollObj(0) {}
+  int fd = -1;
+  uint64_t token = 0;
+  NetLoop* loop = nullptr;
+  tb_server* srv = nullptr;
+  tb_iobuf* rbuf = nullptr;
+  tb_iobuf* wbuf = nullptr;
+  std::mutex wmu;
+  bool want_out = false;
+  bool sniffed = false;
+  std::atomic<bool> dead{false};
+  std::atomic<int> refs{0};
+};
+
+std::mutex g_conn_mu;
+tb_respool* g_conn_pool = nullptr;  // slots hold NetConn*
+
+uint64_t conn_register(NetConn* c) {
+  std::lock_guard<std::mutex> g(g_conn_mu);
+  if (g_conn_pool == nullptr) g_conn_pool = tb_respool_create(sizeof(void*));
+  uint64_t id = 0;
+  void* slot = tb_respool_get(g_conn_pool, &id);
+  *static_cast<NetConn**>(slot) = c;
+  c->token = id;
+  return id;
+}
+
+NetConn* conn_resolve(uint64_t token) {
+  std::lock_guard<std::mutex> g(g_conn_mu);
+  if (g_conn_pool == nullptr) return nullptr;
+  void* slot = tb_respool_address(g_conn_pool, token);
+  if (slot == nullptr) return nullptr;
+  NetConn* c = *static_cast<NetConn**>(slot);
+  if (c == nullptr || c->dead.load(std::memory_order_acquire)) return nullptr;
+  c->refs.fetch_add(1, std::memory_order_acq_rel);
+  return c;
+}
+
+void conn_unref(NetConn* c) { c->refs.fetch_sub(1, std::memory_order_acq_rel); }
+
+// retire the token and wait out foreign holders; afterwards the caller owns
+// the conn exclusively (the deferred-close discipline of sock.py _io_refs)
+void conn_retire(NetConn* c) {
+  {
+    std::lock_guard<std::mutex> g(g_conn_mu);
+    c->dead.store(true, std::memory_order_release);
+    tb_respool_return(g_conn_pool, c->token);
+  }
+  while (c->refs.load(std::memory_order_acquire) > 0) usleep(50);
+}
+
+// ---------------------------------------------------------------------------
+// server structures
+// ---------------------------------------------------------------------------
+
+struct Wake : PollObj {
+  Wake() : PollObj(2) {}
+  int fd = -1;
+};
+
+struct NetLoop {
+  int epfd = -1;
+  Wake wake;
+  std::thread th;
+  std::atomic<bool> stopping{false};
+  std::vector<NetConn*> conns;
+  std::mutex conns_mu;  // guards conns (loop thread + stop-time sweep)
+};
+
+struct NativeMethod {
+  int kind;
+  uint32_t max_concurrency;
+  std::atomic<uint32_t> nprocessing{0};
+  std::atomic<uint64_t> nreq{0};
+  std::atomic<uint64_t> nerr{0};
+  std::string full_name;
+};
+
+struct Listener : PollObj {
+  Listener() : PollObj(1) {}
+  int fd = -1;
+};
+
+struct ErrorCodes {
+  // mirrors utils/status.py ErrorCode (the cross-plane error constants)
+  uint32_t enomethod = 1002;
+  uint32_t elimit = 2004;
+  uint32_t erequest = 1003;
+};
+
+}  // namespace
+
+struct tb_server {
+  std::vector<NetLoop*> loops;
+  Listener listener;
+  int port = 0;
+  std::atomic<size_t> next_loop{0};
+  tb_frame_fn frame_cb = nullptr;
+  void* frame_ctx = nullptr;
+  tb_handoff_fn handoff_cb = nullptr;
+  void* handoff_ctx = nullptr;
+  tb_closed_fn closed_cb = nullptr;
+  void* closed_ctx = nullptr;
+  size_t max_body = 512u << 20;
+  ErrorCodes errs;
+  tb_flatmap* methods = nullptr;  // key -> index into native_methods
+  std::vector<NativeMethod*> native_methods;
+  std::atomic<uint64_t> accepted{0};
+  std::atomic<uint64_t> native_reqs{0};
+  std::atomic<uint64_t> cb_frames{0};
+  std::atomic<uint64_t> handoffs{0};
+  std::atomic<uint64_t> live_conns{0};
+  std::atomic<bool> stopped{false};
+};
+
+namespace {
+
+uint64_t method_key(const char* name, size_t n) {
+  uint64_t lo = tb_crc32c(0, name, n);
+  uint64_t hi =
+      crc32(0, reinterpret_cast<const Bytef*>(name), static_cast<uInt>(n));
+  return lo | (hi << 32);
+}
+
+void set_nonblock(int fd) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+void set_nodelay(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+}
+
+// ---- write path (per-conn mutex; any thread) ----
+
+// under c->wmu: drain wbuf to the fd, arming/disarming EPOLLOUT
+void conn_flush_locked(NetConn* c) {
+  while (tb_iobuf_size(c->wbuf) > 0) {
+    long rc = tb_iobuf_cut_into_fd(c->wbuf, c->fd, 4u << 20);
+    if (rc > 0) continue;
+    if (rc == -EINTR) continue;
+    if (rc == 0 || rc == -EAGAIN || rc == -EWOULDBLOCK) {
+      if (!c->want_out) {
+        c->want_out = true;
+        epoll_event ev{};
+        ev.events = EPOLLIN | EPOLLOUT;
+        ev.data.ptr = static_cast<PollObj*>(c);
+        epoll_ctl(c->loop->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+      }
+      return;
+    }
+    // hard error: shutdown so the loop thread reaps via EPOLLHUP
+    shutdown(c->fd, SHUT_RDWR);
+    return;
+  }
+  if (c->want_out) {
+    c->want_out = false;
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.ptr = static_cast<PollObj*>(c);
+    epoll_ctl(c->loop->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+  }
+}
+
+void conn_queue_iobuf(NetConn* c, const tb_iobuf* data) {
+  std::lock_guard<std::mutex> g(c->wmu);
+  tb_iobuf_append_iobuf(c->wbuf, data);
+  conn_flush_locked(c);
+}
+
+// loop-thread-only teardown; fd closes only after foreign refs drain
+void conn_destroy(NetConn* c, bool close_fd) {
+  epoll_ctl(c->loop->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+  uint64_t token = c->token;
+  conn_retire(c);
+  if (close_fd && c->fd >= 0) close(c->fd);
+  if (c->srv) c->srv->live_conns.fetch_sub(1);
+  // close_fd==false means handoff: the connection lives on in Python
+  if (close_fd && c->srv && c->srv->closed_cb != nullptr)
+    c->srv->closed_cb(c->srv->closed_ctx, token);
+  {
+    std::lock_guard<std::mutex> g(c->loop->conns_mu);
+    auto& v = c->loop->conns;
+    for (size_t i = 0; i < v.size(); ++i)
+      if (v[i] == c) {
+        v[i] = v.back();
+        v.pop_back();
+        break;
+      }
+  }
+  tb_iobuf_destroy(c->rbuf);
+  tb_iobuf_destroy(c->wbuf);
+  delete c;
+}
+
+// ---- server-side frame dispatch ----
+
+void respond_error(NetConn* c, uint32_t cid_lo, uint32_t cid_hi, uint32_t code,
+                   const char* text) {
+  char meta[256];
+  int n = snprintf(meta, sizeof meta, "{\"error_text\":\"%s\"}", text);
+  if (n < 0) n = 0;
+  tb_iobuf* out = tb_iobuf_create();
+  pack_flat(out, meta, static_cast<size_t>(n), nullptr, 0, nullptr, 0, cid_lo,
+            cid_hi, kFlagResponse, code);
+  conn_queue_iobuf(c, out);
+  tb_iobuf_destroy(out);
+}
+
+// echo/nop native kinds: the response is built and queued without ever
+// leaving C++ — the whole ProcessRpcRequest/SendRpcResponse round
+// (baidu_rpc_protocol.cpp:307,136) for these methods is native
+void run_native(NetConn* c, NativeMethod* nm, const tb_tbus_hdr* hdr,
+                const MetaLite& ml, tb_iobuf* body) {
+  nm->nreq.fetch_add(1, std::memory_order_relaxed);
+  c->srv->native_reqs.fetch_add(1, std::memory_order_relaxed);
+  if (nm->max_concurrency &&
+      nm->nprocessing.fetch_add(1) >= nm->max_concurrency) {
+    nm->nprocessing.fetch_sub(1);
+    nm->nerr.fetch_add(1, std::memory_order_relaxed);
+    respond_error(c, hdr->cid_lo, hdr->cid_hi, c->srv->errs.elimit,
+                  "concurrency limit reached");
+    tb_iobuf_destroy(body);
+    return;
+  }
+  uint32_t flags = kFlagResponse | (hdr->flags & kFlagBodyCrc);
+  char meta[64];
+  size_t meta_len = 0;
+  tb_iobuf* out = tb_iobuf_create();
+  if (nm->kind == kKindEcho) {
+    if (ml.attachment > 0) {
+      int n = snprintf(meta, sizeof meta, "{\"attachment_size\":%ld}",
+                       ml.attachment);
+      meta_len = n > 0 ? static_cast<size_t>(n) : 0;
+    }
+    if (meta_len) flags |= kFlagHasMeta;
+    uint32_t crc = tb_crc32c(0, meta, meta_len);
+    size_t blen = tb_iobuf_size(body);
+    if (flags & kFlagBodyCrc) crc = tb_iobuf_crc32c(body, crc, 0, blen);
+    append_header(out, meta, meta_len, blen, crc, hdr->cid_lo, hdr->cid_hi,
+                  flags, 0);
+    tb_iobuf_append_iobuf(out, body);  // zero-copy: request refs shared
+  } else {                             // nop
+    append_header(out, nullptr, 0, 0, tb_crc32c(0, nullptr, 0), hdr->cid_lo,
+                  hdr->cid_hi, flags, 0);
+  }
+  conn_queue_iobuf(c, out);
+  tb_iobuf_destroy(out);
+  tb_iobuf_destroy(body);
+  if (nm->max_concurrency) nm->nprocessing.fetch_sub(1);
+}
+
+enum class FrameStatus { kOk, kHandoff, kKilled };
+
+void do_handoff(NetConn* c) {
+  tb_server* s = c->srv;
+  s->handoffs.fetch_add(1, std::memory_order_relaxed);
+  size_t n = tb_iobuf_size(c->rbuf);
+  char* buffered = static_cast<char*>(malloc(n ? n : 1));
+  if (n) tb_iobuf_copy_to(c->rbuf, buffered, n, 0);
+  int fd = c->fd;
+  tb_handoff_fn cb = s->handoff_cb;
+  void* ctx = s->handoff_ctx;
+  conn_destroy(c, /*close_fd=*/false);
+  if (cb != nullptr) {
+    cb(ctx, fd, buffered, n);  // callee owns fd from here
+  } else {
+    close(fd);
+  }
+  free(buffered);
+}
+
+FrameStatus process_frames(NetConn* c) {
+  tb_server* s = c->srv;
+  if (!c->sniffed) {
+    if (tb_iobuf_size(c->rbuf) < 4) return FrameStatus::kOk;
+    uint32_t magic = 0;
+    tb_iobuf_copy_to(c->rbuf, &magic, 4, 0);
+    if (magic != kMagic) {
+      do_handoff(c);
+      return FrameStatus::kHandoff;
+    }
+    c->sniffed = true;
+  }
+  for (;;) {
+    tb_tbus_hdr hdr;
+    int rc = tb_tbus_peek(c->rbuf, &hdr);
+    if (rc == 1) return FrameStatus::kOk;
+    if (rc == -1 || hdr.meta_len > hdr.body_len || hdr.body_len > s->max_body) {
+      conn_destroy(c, true);
+      return FrameStatus::kKilled;
+    }
+    if (tb_iobuf_size(c->rbuf) < kHeader + hdr.body_len) return FrameStatus::kOk;
+    std::string meta(hdr.meta_len, '\0');
+    tb_iobuf* body = tb_iobuf_create();
+    rc = tb_tbus_cut(c->rbuf, &hdr, meta.empty() ? nullptr : &meta[0], body);
+    if (rc != 0) {  // crc mismatch / malformed: the stream can't re-sync
+      tb_iobuf_destroy(body);
+      conn_destroy(c, true);
+      return FrameStatus::kKilled;
+    }
+    // native fast path: plain request frame whose meta is fully understood
+    if ((hdr.flags & (kFlagResponse | kFlagStream)) == 0) {
+      MetaLite ml = scan_meta(meta.data(), meta.size());
+      if (ml.ok && !ml.to_python &&
+          ml.attachment <= static_cast<long>(tb_iobuf_size(body))) {
+        char full[256];
+        int fn = snprintf(full, sizeof full, "%s.%s", ml.service.c_str(),
+                          ml.method.c_str());
+        if (fn > 0 && static_cast<size_t>(fn) < sizeof full) {
+          uint64_t idx = 0;
+          if (s->methods != nullptr &&
+              tb_flatmap_get(s->methods,
+                             method_key(full, static_cast<size_t>(fn)),
+                             &idx) == 1 &&
+              s->native_methods[idx]->full_name == full) {
+            run_native(c, s->native_methods[idx], &hdr, ml, body);
+            continue;
+          }
+        }
+      }
+    }
+    // python route (responses, streams, compressed, unknown methods —
+    // admission/stats/errors stay consistent with the Python server path)
+    s->cb_frames.fetch_add(1, std::memory_order_relaxed);
+    if (s->frame_cb == nullptr) {
+      if ((hdr.flags & kFlagResponse) == 0)
+        respond_error(c, hdr.cid_lo, hdr.cid_hi, s->errs.enomethod,
+                      "no such method");
+      tb_iobuf_destroy(body);
+      continue;
+    }
+    s->frame_cb(s->frame_ctx, c->token, hdr.cid_lo, hdr.cid_hi, hdr.flags,
+                hdr.error_code, meta.data(), meta.size(), body);
+  }
+}
+
+void conn_readable(NetConn* c) {
+  size_t burst = tb_iobuf_read_burst();
+  bool eof = false;
+  for (;;) {
+    long rc = tb_iobuf_append_from_fd(c->rbuf, c->fd, burst);
+    if (rc > 0) {
+      if (static_cast<size_t>(rc) < burst) break;
+      continue;
+    }
+    if (rc == -EAGAIN || rc == -EWOULDBLOCK) break;
+    if (rc == -EINTR) continue;
+    eof = true;  // 0 = EOF; other negatives = read error
+    break;
+  }
+  if (tb_iobuf_size(c->rbuf) > 0) {
+    FrameStatus st = process_frames(c);
+    if (st != FrameStatus::kOk) return;  // conn already gone
+  }
+  if (eof) conn_destroy(c, true);
+}
+
+void accept_ready(tb_server* s) {
+  for (;;) {
+    int fd = accept4(s->listener.fd, nullptr, nullptr,
+                     SOCK_NONBLOCK | SOCK_CLOEXEC);
+    if (fd < 0) return;  // EAGAIN / EMFILE / EINTR: next event retries
+    set_nodelay(fd);
+    s->accepted.fetch_add(1, std::memory_order_relaxed);
+    s->live_conns.fetch_add(1, std::memory_order_relaxed);
+    NetConn* c = new NetConn();
+    c->fd = fd;
+    c->srv = s;
+    c->loop = s->loops[s->next_loop.fetch_add(1) % s->loops.size()];
+    c->rbuf = tb_iobuf_create();
+    c->wbuf = tb_iobuf_create();
+    conn_register(c);
+    {
+      std::lock_guard<std::mutex> g(c->loop->conns_mu);
+      c->loop->conns.push_back(c);
+    }
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.ptr = static_cast<PollObj*>(c);
+    if (epoll_ctl(c->loop->epfd, EPOLL_CTL_ADD, fd, &ev) != 0)
+      conn_destroy(c, true);
+  }
+}
+
+void loop_run(tb_server* s, NetLoop* l) {
+  epoll_event evs[128];
+  while (!l->stopping.load(std::memory_order_acquire)) {
+    int n = epoll_wait(l->epfd, evs, 128, 500);
+    for (int i = 0; i < n; ++i) {
+      PollObj* o = static_cast<PollObj*>(evs[i].data.ptr);
+      if (o == nullptr) continue;
+      if (o->kind == 2) {  // wake
+        uint64_t v;
+        ssize_t r = read(static_cast<Wake*>(o)->fd, &v, sizeof v);
+        (void)r;
+        continue;
+      }
+      if (o->kind == 1) {  // listener
+        accept_ready(s);
+        continue;
+      }
+      NetConn* c = static_cast<NetConn*>(o);
+      uint32_t e = evs[i].events;
+      if (e & (EPOLLERR | EPOLLHUP)) {
+        conn_destroy(c, true);
+        continue;
+      }
+      if (e & EPOLLOUT) {
+        std::lock_guard<std::mutex> g(c->wmu);
+        conn_flush_locked(c);
+      }
+      if (e & EPOLLIN) conn_readable(c);
+    }
+  }
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// server C API
+// ---------------------------------------------------------------------------
+
+tb_server* tb_server_create(int nloops) {
+  if (nloops < 1) nloops = 1;
+  tb_server* s = new tb_server();
+  s->methods = tb_flatmap_create(64);
+  for (int i = 0; i < nloops; ++i) {
+    NetLoop* l = new NetLoop();
+    l->epfd = epoll_create1(EPOLL_CLOEXEC);
+    l->wake.fd = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.ptr = static_cast<PollObj*>(&l->wake);
+    epoll_ctl(l->epfd, EPOLL_CTL_ADD, l->wake.fd, &ev);
+    s->loops.push_back(l);
+  }
+  return s;
+}
+
+void tb_server_set_frame_cb(tb_server* s, tb_frame_fn cb, void* ctx) {
+  s->frame_cb = cb;
+  s->frame_ctx = ctx;
+}
+
+void tb_server_set_handoff_cb(tb_server* s, tb_handoff_fn cb, void* ctx) {
+  s->handoff_cb = cb;
+  s->handoff_ctx = ctx;
+}
+
+void tb_server_set_closed_cb(tb_server* s, tb_closed_fn cb, void* ctx) {
+  s->closed_cb = cb;
+  s->closed_ctx = ctx;
+}
+
+void tb_server_set_max_body(tb_server* s, size_t bytes) { s->max_body = bytes; }
+
+int tb_server_register_native(tb_server* s, const char* full_name, int kind,
+                              uint32_t max_concurrency) {
+  if (kind != kKindEcho && kind != kKindNop) return -1;
+  uint64_t key = method_key(full_name, strlen(full_name));
+  uint64_t existing = 0;
+  if (tb_flatmap_get(s->methods, key, &existing) == 1)
+    return -1;  // double registration / key collision: keep the Python route
+  NativeMethod* nm = new NativeMethod();
+  nm->kind = kind;
+  nm->max_concurrency = max_concurrency;
+  nm->full_name = full_name;
+  s->native_methods.push_back(nm);
+  tb_flatmap_insert(s->methods, key, s->native_methods.size() - 1);
+  return 0;
+}
+
+int tb_server_listen(tb_server* s, const char* ip, int port) {
+  int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+  if (fd < 0) return -errno;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, ip, &addr.sin_addr) != 1) {
+    close(fd);
+    return -EINVAL;
+  }
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0 ||
+      listen(fd, 1024) != 0) {
+    int e = errno;
+    close(fd);
+    return -e;
+  }
+  socklen_t alen = sizeof addr;
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  s->port = ntohs(addr.sin_port);
+  s->listener.fd = fd;
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.ptr = static_cast<PollObj*>(&s->listener);
+  epoll_ctl(s->loops[0]->epfd, EPOLL_CTL_ADD, fd, &ev);
+  for (NetLoop* l : s->loops) l->th = std::thread(loop_run, s, l);
+  return s->port;
+}
+
+int tb_server_port(const tb_server* s) { return s->port; }
+
+void tb_server_stop(tb_server* s) {
+  if (s->stopped.exchange(true)) return;
+  for (NetLoop* l : s->loops) {
+    l->stopping.store(true, std::memory_order_release);
+    uint64_t one = 1;
+    ssize_t r = write(l->wake.fd, &one, sizeof one);
+    (void)r;
+  }
+  for (NetLoop* l : s->loops)
+    if (l->th.joinable()) l->th.join();
+  if (s->listener.fd >= 0) {
+    close(s->listener.fd);
+    s->listener.fd = -1;
+  }
+  // loops are quiescent: sweep remaining conns single-threaded
+  for (NetLoop* l : s->loops) {
+    std::vector<NetConn*> left;
+    {
+      std::lock_guard<std::mutex> g(l->conns_mu);
+      left = l->conns;
+    }
+    for (NetConn* c : left) conn_destroy(c, true);
+  }
+}
+
+void tb_server_destroy(tb_server* s) {
+  tb_server_stop(s);
+  for (NetLoop* l : s->loops) {
+    close(l->wake.fd);
+    close(l->epfd);
+    delete l;
+  }
+  for (NativeMethod* nm : s->native_methods) delete nm;
+  tb_flatmap_destroy(s->methods);
+  delete s;
+}
+
+void tb_server_stats(const tb_server* s, uint64_t* accepted,
+                     uint64_t* native_reqs, uint64_t* cb_frames,
+                     uint64_t* handoffs, uint64_t* live_conns) {
+  if (accepted) *accepted = s->accepted.load();
+  if (native_reqs) *native_reqs = s->native_reqs.load();
+  if (cb_frames) *cb_frames = s->cb_frames.load();
+  if (handoffs) *handoffs = s->handoffs.load();
+  if (live_conns) *live_conns = s->live_conns.load();
+}
+
+// ---------------------------------------------------------------------------
+// per-connection API (token-addressed; any thread)
+// ---------------------------------------------------------------------------
+
+int tb_conn_respond(uint64_t token, const void* meta, size_t meta_len,
+                    const void* payload, size_t payload_len, const void* att,
+                    size_t att_len, uint32_t cid_lo, uint32_t cid_hi,
+                    uint32_t flags, uint32_t error_code) {
+  NetConn* c = conn_resolve(token);
+  if (c == nullptr) return -1;
+  tb_iobuf* out = tb_iobuf_create();
+  pack_flat(out, meta, meta_len, payload, payload_len, att, att_len, cid_lo,
+            cid_hi, flags | kFlagResponse, error_code);
+  conn_queue_iobuf(c, out);
+  tb_iobuf_destroy(out);
+  conn_unref(c);
+  return 0;
+}
+
+int tb_conn_write(uint64_t token, const tb_iobuf* data) {
+  NetConn* c = conn_resolve(token);
+  if (c == nullptr) return -1;
+  conn_queue_iobuf(c, data);
+  conn_unref(c);
+  return 0;
+}
+
+int tb_conn_peer(uint64_t token, char* ip_out, size_t ip_cap) {
+  NetConn* c = conn_resolve(token);
+  if (c == nullptr) return -1;
+  sockaddr_in addr{};
+  socklen_t alen = sizeof addr;
+  int port = -1;
+  if (getpeername(c->fd, reinterpret_cast<sockaddr*>(&addr), &alen) == 0 &&
+      addr.sin_family == AF_INET) {
+    if (ip_out && ip_cap > 0) inet_ntop(AF_INET, &addr.sin_addr, ip_out, ip_cap);
+    port = ntohs(addr.sin_port);
+  }
+  conn_unref(c);
+  return port;
+}
+
+int tb_conn_close(uint64_t token) {
+  NetConn* c = conn_resolve(token);
+  if (c == nullptr) return -1;
+  shutdown(c->fd, SHUT_RDWR);  // the loop thread reaps via EPOLLHUP
+  conn_unref(c);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// client channel
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Pending {
+  bool targeted;
+  bool done = false;
+  uint32_t err_code = 0;
+  int fail = 0;   // -errno when the channel died under us
+  std::string meta;
+  tb_iobuf* body;  // targeted: caller's out buffer; any-mode: owned temp
+};
+
+}  // namespace
+
+struct tb_channel {
+  int fd = -1;
+  std::mutex wmu;  // writers (pack + writev serialize)
+  std::mutex rmu;  // reader election
+  std::mutex pmu;  // pending table + done queue + cv
+  std::condition_variable pcv;
+  std::unordered_map<uint64_t, Pending*> pending;
+  std::deque<std::pair<uint64_t, Pending*>> doneq;  // any-mode completions
+  std::atomic<uint64_t> next_cid{1};
+  tb_iobuf* rbuf = nullptr;
+  std::atomic<int> err{0};  // sticky -errno
+};
+
+namespace {
+
+void channel_fail(tb_channel* ch, int err) {
+  ch->err.store(err, std::memory_order_release);
+  std::lock_guard<std::mutex> g(ch->pmu);
+  for (auto& kv : ch->pending) {
+    if (!kv.second->done) {
+      kv.second->done = true;
+      kv.second->fail = err;
+      if (!kv.second->targeted) ch->doneq.emplace_back(kv.first, kv.second);
+    }
+  }
+  ch->pcv.notify_all();
+}
+
+// read whatever arrives within `slice_ms`, completing pendings.  Caller
+// holds rmu.  Returns false when the channel failed.
+bool pump_once(tb_channel* ch, int slice_ms) {
+  pollfd pf{ch->fd, POLLIN, 0};
+  int rc = poll(&pf, 1, slice_ms);
+  if (rc < 0) {
+    if (errno == EINTR) return true;
+    channel_fail(ch, -errno);
+    return false;
+  }
+  if (rc == 0) return true;
+  size_t burst = tb_iobuf_read_burst();
+  for (;;) {
+    long n = tb_iobuf_append_from_fd(ch->rbuf, ch->fd, burst);
+    if (n > 0) {
+      if (static_cast<size_t>(n) < burst) break;
+      continue;
+    }
+    if (n == -EAGAIN || n == -EWOULDBLOCK) break;
+    if (n == -EINTR) continue;
+    channel_fail(ch, n == 0 ? -EPIPE : static_cast<int>(n));
+    return false;
+  }
+  for (;;) {
+    tb_tbus_hdr hdr;
+    int prc = tb_tbus_peek(ch->rbuf, &hdr);
+    if (prc == 1) break;
+    if (prc == -1 || hdr.meta_len > hdr.body_len ||
+        hdr.body_len > (512u << 20)) {
+      channel_fail(ch, -EPROTO);
+      return false;
+    }
+    if (tb_iobuf_size(ch->rbuf) < kHeader + hdr.body_len) break;
+    uint64_t cid = static_cast<uint64_t>(hdr.cid_lo) |
+                   (static_cast<uint64_t>(hdr.cid_hi) << 32);
+    std::string meta(hdr.meta_len, '\0');
+    bool proto_err = false;
+    {
+      // completion runs under pmu so a timed-out caller can't free its
+      // Pending (or its body iobuf) while the cut writes into it
+      std::unique_lock<std::mutex> pl(ch->pmu);
+      auto it = ch->pending.find(cid);
+      Pending* p = it == ch->pending.end() ? nullptr : it->second;
+      tb_iobuf* dst =
+          (p != nullptr && p->targeted) ? p->body : tb_iobuf_create();
+      int crc =
+          tb_tbus_cut(ch->rbuf, &hdr, meta.empty() ? nullptr : &meta[0], dst);
+      if (crc != 0) {
+        if (p == nullptr || !p->targeted) tb_iobuf_destroy(dst);
+        proto_err = true;
+      } else if (p == nullptr) {
+        tb_iobuf_destroy(dst);  // timed-out caller already left: drop
+      } else {
+        p->meta = std::move(meta);
+        p->err_code = hdr.error_code;
+        if (!p->targeted) {
+          p->body = dst;
+          ch->doneq.emplace_back(cid, p);
+        }
+        p->done = true;
+        ch->pcv.notify_all();
+      }
+    }
+    if (proto_err) {
+      channel_fail(ch, -EPROTO);
+      return false;
+    }
+  }
+  return true;
+}
+
+// blocking full write of `frame` under wmu with a deadline
+int write_frame(tb_channel* ch, tb_iobuf* frame, uint64_t deadline) {
+  std::lock_guard<std::mutex> g(ch->wmu);
+  while (tb_iobuf_size(frame) > 0) {
+    long rc = tb_iobuf_cut_into_fd(frame, ch->fd, 4u << 20);
+    if (rc > 0) continue;
+    if (rc == -EINTR) continue;
+    if (rc == 0 || rc == -EAGAIN || rc == -EWOULDBLOCK) {
+      uint64_t now = now_ms();
+      if (now >= deadline) return -ETIMEDOUT;
+      pollfd pf{ch->fd, POLLOUT, 0};
+      poll(&pf, 1, static_cast<int>(deadline - now));
+      continue;
+    }
+    return static_cast<int>(rc);
+  }
+  return 0;
+}
+
+// pack with an explicit cid and write fully; 0 ok, -errno otherwise
+int channel_send_cid(tb_channel* ch, uint64_t cid, const void* meta,
+                     size_t meta_len, const void* payload, size_t payload_len,
+                     const void* att, size_t att_len, uint32_t flags_extra,
+                     uint64_t deadline) {
+  tb_iobuf* frame = tb_iobuf_create();
+  pack_flat(frame, meta, meta_len, payload, payload_len, att, att_len,
+            static_cast<uint32_t>(cid), static_cast<uint32_t>(cid >> 32),
+            flags_extra, 0);
+  int rc = write_frame(ch, frame, deadline);
+  tb_iobuf_destroy(frame);
+  if (rc != 0 && rc != -ETIMEDOUT) channel_fail(ch, rc);
+  return rc;
+}
+
+// shared wait-or-pump loop: wait until pred() under pmu, electing a reader
+// to pump completions when nobody else is.  Returns false on deadline.
+template <typename Pred>
+bool wait_or_pump(tb_channel* ch, std::unique_lock<std::mutex>& pl,
+                  uint64_t deadline, Pred pred) {
+  while (!pred()) {
+    if (ch->err.load(std::memory_order_acquire) != 0) return true;
+    uint64_t now = now_ms();
+    if (now >= deadline) return false;
+    if (ch->rmu.try_lock()) {
+      pl.unlock();
+      int slice = static_cast<int>(std::min<uint64_t>(deadline - now, 50));
+      pump_once(ch, slice);
+      ch->rmu.unlock();
+      pl.lock();
+      ch->pcv.notify_all();
+    } else {
+      ch->pcv.wait_for(pl, std::chrono::milliseconds(10));
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+tb_channel* tb_channel_connect(const char* ip, int port, int timeout_ms,
+                               int* err_out) {
+  int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+  if (fd < 0) {
+    if (err_out) *err_out = errno;
+    return nullptr;
+  }
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, ip, &addr.sin_addr) != 1) {
+    close(fd);
+    if (err_out) *err_out = EINVAL;
+    return nullptr;
+  }
+  int rc = connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr);
+  if (rc != 0 && errno == EINPROGRESS) {
+    pollfd pf{fd, POLLOUT, 0};
+    rc = poll(&pf, 1, timeout_ms > 0 ? timeout_ms : 5000);
+    if (rc == 1) {
+      int soerr = 0;
+      socklen_t slen = sizeof soerr;
+      getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &slen);
+      rc = soerr == 0 ? 0 : -1;
+      errno = soerr;
+    } else {
+      rc = -1;
+      errno = ETIMEDOUT;
+    }
+  }
+  if (rc != 0) {
+    if (err_out) *err_out = errno;
+    close(fd);
+    return nullptr;
+  }
+  set_nodelay(fd);
+  set_nonblock(fd);
+  tb_channel* ch = new tb_channel();
+  ch->fd = fd;
+  ch->rbuf = tb_iobuf_create();
+  return ch;
+}
+
+long tb_channel_call(tb_channel* ch, const void* meta, size_t meta_len,
+                     const void* payload, size_t payload_len, const void* att,
+                     size_t att_len, uint32_t flags_extra, tb_iobuf* body_out,
+                     void* meta_out, size_t meta_cap, uint32_t* meta_len_out,
+                     uint32_t* err_code_out, int timeout_ms) {
+  int sticky = ch->err.load(std::memory_order_acquire);
+  if (sticky != 0) return sticky;
+  uint64_t deadline = now_ms() + (timeout_ms > 0 ? timeout_ms : 60000);
+  uint64_t cid = ch->next_cid.fetch_add(1, std::memory_order_relaxed);
+  Pending p;
+  p.targeted = true;
+  p.body = body_out;
+  {
+    std::lock_guard<std::mutex> g(ch->pmu);
+    ch->pending.emplace(cid, &p);
+  }
+  int rc = channel_send_cid(ch, cid, meta, meta_len, payload, payload_len, att,
+                            att_len, flags_extra, deadline);
+  if (rc != 0) {
+    std::lock_guard<std::mutex> g(ch->pmu);
+    ch->pending.erase(cid);
+    return rc;
+  }
+  std::unique_lock<std::mutex> pl(ch->pmu);
+  bool in_time = wait_or_pump(ch, pl, deadline, [&] { return p.done; });
+  ch->pending.erase(cid);
+  if (!in_time) return -ETIMEDOUT;
+  if (!p.done) {  // channel failed before completion
+    int e = ch->err.load(std::memory_order_acquire);
+    return e != 0 ? e : -EPIPE;
+  }
+  int fail = p.fail;
+  std::string meta_resp = std::move(p.meta);
+  uint32_t ec = p.err_code;
+  pl.unlock();
+  if (fail != 0) return fail;
+  if (meta_len_out)
+    *meta_len_out = static_cast<uint32_t>(std::min(meta_resp.size(), meta_cap));
+  if (meta_out && meta_cap > 0 && !meta_resp.empty())
+    memcpy(meta_out, meta_resp.data(), std::min(meta_resp.size(), meta_cap));
+  if (err_code_out) *err_code_out = ec;
+  return static_cast<long>(tb_iobuf_size(body_out));
+}
+
+uint64_t tb_channel_send(tb_channel* ch, const void* meta, size_t meta_len,
+                         const void* payload, size_t payload_len,
+                         const void* att, size_t att_len, uint32_t flags_extra,
+                         int* err_out) {
+  int sticky = ch->err.load(std::memory_order_acquire);
+  if (sticky != 0) {
+    if (err_out) *err_out = -sticky;
+    return 0;
+  }
+  uint64_t cid = ch->next_cid.fetch_add(1, std::memory_order_relaxed);
+  Pending* p = new Pending();
+  p->targeted = false;
+  p->body = nullptr;
+  {
+    std::lock_guard<std::mutex> g(ch->pmu);
+    ch->pending.emplace(cid, p);
+  }
+  int rc = channel_send_cid(ch, cid, meta, meta_len, payload, payload_len, att,
+                            att_len, flags_extra, now_ms() + 60000);
+  if (rc != 0) {
+    std::lock_guard<std::mutex> g(ch->pmu);
+    auto it = ch->pending.find(cid);
+    if (it != ch->pending.end() && it->second == p && !p->done) {
+      ch->pending.erase(it);
+      delete p;
+    }  // else channel_fail moved it to doneq: recv() frees it
+    if (err_out) *err_out = -rc;
+    return 0;
+  }
+  return cid;
+}
+
+long tb_channel_recv(tb_channel* ch, uint64_t* cid_out, tb_iobuf* body_out,
+                     void* meta_out, size_t meta_cap, uint32_t* meta_len_out,
+                     uint32_t* err_code_out, int timeout_ms) {
+  uint64_t deadline = now_ms() + (timeout_ms > 0 ? timeout_ms : 60000);
+  std::unique_lock<std::mutex> pl(ch->pmu);
+  for (;;) {
+    if (!ch->doneq.empty()) {
+      auto [cid, p] = ch->doneq.front();
+      ch->doneq.pop_front();
+      ch->pending.erase(cid);
+      pl.unlock();
+      long n;
+      if (p->fail != 0) {
+        n = p->fail;
+      } else {
+        if (cid_out) *cid_out = cid;
+        if (meta_len_out)
+          *meta_len_out =
+              static_cast<uint32_t>(std::min(p->meta.size(), meta_cap));
+        if (meta_out && meta_cap > 0 && !p->meta.empty())
+          memcpy(meta_out, p->meta.data(), std::min(p->meta.size(), meta_cap));
+        if (err_code_out) *err_code_out = p->err_code;
+        n = 0;
+        if (p->body != nullptr) {
+          n = static_cast<long>(tb_iobuf_size(p->body));
+          tb_iobuf_append_iobuf(body_out, p->body);
+        }
+      }
+      if (p->body != nullptr) tb_iobuf_destroy(p->body);
+      delete p;
+      return n;
+    }
+    int sticky = ch->err.load(std::memory_order_acquire);
+    if (sticky != 0) {
+      pl.unlock();
+      return sticky;
+    }
+    if (!wait_or_pump(ch, pl, deadline, [&] { return !ch->doneq.empty(); })) {
+      pl.unlock();
+      return -ETIMEDOUT;
+    }
+  }
+}
+
+int tb_channel_error(const tb_channel* ch) {
+  return ch->err.load(std::memory_order_acquire);
+}
+
+long tb_channel_pump(tb_channel* ch, const void* meta, size_t meta_len,
+                     const void* payload, size_t payload_len, int n,
+                     int inflight, int timeout_ms) {
+  if (n <= 0) return -EINVAL;
+  if (inflight < 1) inflight = 1;
+  std::lock_guard<std::mutex> rg(ch->rmu);
+  std::lock_guard<std::mutex> wg(ch->wmu);
+  int sticky = ch->err.load(std::memory_order_acquire);
+  if (sticky != 0) return sticky;
+  uint64_t deadline = now_ms() + (timeout_ms > 0 ? timeout_ms : 60000);
+  size_t burst = tb_iobuf_read_burst();
+  tb_iobuf* frame = tb_iobuf_create();
+  int sent = 0, done = 0, outstanding = 0;
+  long result = 0;
+  auto t0 = std::chrono::steady_clock::now();
+  while (done < n && result == 0) {
+    // fill the window
+    while (outstanding < inflight && sent < n) {
+      uint64_t cid = ch->next_cid.fetch_add(1, std::memory_order_relaxed);
+      pack_flat(frame, meta, meta_len, payload, payload_len, nullptr, 0,
+                static_cast<uint32_t>(cid), static_cast<uint32_t>(cid >> 32),
+                0, 0);
+      while (tb_iobuf_size(frame) > 0) {
+        long rc = tb_iobuf_cut_into_fd(frame, ch->fd, 4u << 20);
+        if (rc > 0) continue;
+        if (rc == -EINTR) continue;
+        if (rc == 0 || rc == -EAGAIN || rc == -EWOULDBLOCK) break;
+        result = rc;  // hard write error
+        break;
+      }
+      ++sent;
+      ++outstanding;
+      if (result != 0 || tb_iobuf_size(frame) > 0) break;  // kernel full
+    }
+    if (result != 0) break;
+    // drain completions (and finish any partial write while waiting)
+    pollfd pf{ch->fd, static_cast<short>(
+                          POLLIN | (tb_iobuf_size(frame) > 0 ? POLLOUT : 0)),
+              0};
+    uint64_t now = now_ms();
+    if (now >= deadline) {
+      result = -ETIMEDOUT;
+      break;
+    }
+    int prc = poll(&pf, 1, static_cast<int>(std::min<uint64_t>(deadline - now, 100)));
+    if (prc < 0 && errno != EINTR) {
+      result = -errno;
+      break;
+    }
+    if (pf.revents & POLLOUT) {
+      while (tb_iobuf_size(frame) > 0) {
+        long rc = tb_iobuf_cut_into_fd(frame, ch->fd, 4u << 20);
+        if (rc > 0) continue;
+        if (rc == -EINTR) continue;
+        if (rc == 0 || rc == -EAGAIN || rc == -EWOULDBLOCK) break;
+        result = rc;
+        break;
+      }
+    }
+    if (pf.revents & POLLIN) {
+      for (;;) {
+        long rd = tb_iobuf_append_from_fd(ch->rbuf, ch->fd, burst);
+        if (rd > 0) {
+          if (static_cast<size_t>(rd) < burst) break;
+          continue;
+        }
+        if (rd == -EAGAIN || rd == -EWOULDBLOCK) break;
+        if (rd == -EINTR) continue;
+        result = rd == 0 ? -EPIPE : rd;
+        break;
+      }
+      while (result == 0) {
+        tb_tbus_hdr hdr;
+        int prc2 = tb_tbus_peek(ch->rbuf, &hdr);
+        if (prc2 == 1) break;
+        if (prc2 == -1 || hdr.meta_len > hdr.body_len) {
+          result = -EPROTO;
+          break;
+        }
+        if (tb_iobuf_size(ch->rbuf) < kHeader + hdr.body_len) break;
+        char mscratch[4096];
+        if (hdr.meta_len > sizeof mscratch) {
+          result = -EPROTO;
+          break;
+        }
+        tb_iobuf* body = tb_iobuf_create();
+        if (tb_tbus_cut(ch->rbuf, &hdr, hdr.meta_len ? mscratch : nullptr,
+                        body) != 0)
+          result = -EPROTO;
+        tb_iobuf_destroy(body);
+        if (result == 0) {
+          if (hdr.error_code != 0) result = -EREMOTEIO;
+          ++done;
+          --outstanding;
+        }
+      }
+    }
+  }
+  tb_iobuf_destroy(frame);
+  if (result != 0) return result;
+  auto dt = std::chrono::duration_cast<std::chrono::nanoseconds>(
+                std::chrono::steady_clock::now() - t0)
+                .count();
+  return static_cast<long>(dt / n);
+}
+
+void tb_channel_destroy(tb_channel* ch) {
+  channel_fail(ch, -ECANCELED);
+  if (ch->fd >= 0) close(ch->fd);
+  std::unique_lock<std::mutex> pl(ch->pmu);
+  for (auto& kv : ch->pending) {
+    Pending* p = kv.second;
+    if (!p->targeted) {
+      if (p->body != nullptr) tb_iobuf_destroy(p->body);
+      delete p;
+    }
+  }
+  ch->pending.clear();
+  ch->doneq.clear();
+  pl.unlock();
+  tb_iobuf_destroy(ch->rbuf);
+  delete ch;
+}

@@ -1,0 +1,130 @@
+// tbnet — native L2/L3 network plane: epoll reactor, tbus_std frame cut,
+// method dispatch, and a client channel, all in C++.
+//
+// Re-designed counterpart of the reference's I/O core
+// (/root/reference/src/brpc/event_dispatcher.cpp epoll loops,
+//  input_messenger.cpp:60-129 cut loop, socket.cpp:1591-1686 write path,
+//  baidu_rpc_protocol.cpp:92-668 parse/pack+dispatch).  NOT a port: one
+// C-ABI surface over the tbutil IOBuf/pool primitives, driven from Python
+// via ctypes.  The per-request path — readv, frame cut, CRC verify, method
+// lookup, response pack, writev — never touches the Python interpreter for
+// natively-registered methods; everything else routes to ONE Python
+// callback per frame (the "process_request" boundary), and connections
+// that speak a different protocol (HTTP portal, baidu_std, nshead...) are
+// handed off to the Python plane wholesale after the first bytes are
+// sniffed (the reference's server tries every registered protocol on a new
+// connection the same way, input_messenger.cpp:60-129).
+#ifndef TBNET_H
+#define TBNET_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#include "../tbutil/tbutil.h"
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct tb_server tb_server;
+typedef struct tb_channel tb_channel;
+
+// Per-frame Python route: meta/body of one request frame whose method is
+// not natively registered (or that carries stream/response flags,
+// compression, or JSON escapes).  Ownership of `body` (payload+attachment,
+// meta already stripped) transfers to the callee — it must eventually
+// tb_iobuf_destroy it.  Runs on a loop thread; must not block for long.
+typedef void (*tb_frame_fn)(void* ctx, uint64_t conn_token, uint32_t cid_lo,
+                            uint32_t cid_hi, uint32_t flags,
+                            uint32_t error_code, const char* meta,
+                            size_t meta_len, tb_iobuf* body);
+
+// Protocol-sniff handoff: the first bytes of a new connection are not
+// tbus_std.  The callee takes ownership of `fd` and receives whatever was
+// already buffered (copied; free'd by tbnet after the call returns).
+typedef void (*tb_handoff_fn)(void* ctx, int fd, const void* buffered,
+                              size_t len);
+
+// A connection died (EOF, error, server stop).  The token is already stale
+// when this fires; Python uses it to drop per-connection state (streams'
+// on_failed hooks).  Not fired for handed-off connections.
+typedef void (*tb_closed_fn)(void* ctx, uint64_t conn_token);
+
+// ---- server ----
+tb_server* tb_server_create(int nloops);
+void tb_server_set_frame_cb(tb_server* s, tb_frame_fn cb, void* ctx);
+void tb_server_set_handoff_cb(tb_server* s, tb_handoff_fn cb, void* ctx);
+void tb_server_set_closed_cb(tb_server* s, tb_closed_fn cb, void* ctx);
+void tb_server_set_max_body(tb_server* s, size_t bytes);
+// kind: 1 = echo (respond with the request body), 2 = nop (empty response).
+// max_concurrency 0 = unlimited; exceeding it answers ELIMIT natively.
+int tb_server_register_native(tb_server* s, const char* full_name, int kind,
+                              uint32_t max_concurrency);
+// listen on ip:port (port 0 = ephemeral); returns the bound port or -errno.
+int tb_server_listen(tb_server* s, const char* ip, int port);
+int tb_server_port(const tb_server* s);
+// stop accepting, fail every connection, join the loop threads.
+void tb_server_stop(tb_server* s);
+void tb_server_destroy(tb_server* s);
+void tb_server_stats(const tb_server* s, uint64_t* accepted,
+                     uint64_t* native_reqs, uint64_t* cb_frames,
+                     uint64_t* handoffs, uint64_t* live_conns);
+
+// ---- per-connection surface (used by the Python frame route) ----
+// Queue a response frame on the connection. 0 ok, -1 stale token.
+int tb_conn_respond(uint64_t token, const void* meta, size_t meta_len,
+                    const void* payload, size_t payload_len,
+                    const void* att, size_t att_len, uint32_t cid_lo,
+                    uint32_t cid_hi, uint32_t flags, uint32_t error_code);
+// Queue arbitrary pre-framed bytes (stream frames, feedback). Consumes
+// nothing from `data` (refs are shared). 0 ok, -1 stale token.
+int tb_conn_write(uint64_t token, const tb_iobuf* data);
+// Peer address. Returns port (>=0) and fills ip (textual), or -1.
+int tb_conn_peer(uint64_t token, char* ip_out, size_t ip_cap);
+// Fail + close the connection (0 ok, -1 stale).
+int tb_conn_close(uint64_t token);
+
+// ---- client channel ----
+// Blocking connect with timeout; NULL on failure (*err_out = errno).
+tb_channel* tb_channel_connect(const char* ip, int port, int timeout_ms,
+                               int* err_out);
+// Synchronous call over the shared connection.  Thread-safe: concurrent
+// callers elect one reader which pumps completions for everyone (the
+// single-connection multi-caller shape of the reference's client,
+// socket.cpp write queue + cid wakeups).  Returns body length (>=0) or
+// -errno (-ETIMEDOUT, -EPIPE, -EPROTO...).  body_out receives
+// payload+attachment; resp meta (JSON) is copied into meta_out.
+long tb_channel_call(tb_channel* ch, const void* meta, size_t meta_len,
+                     const void* payload, size_t payload_len,
+                     const void* att, size_t att_len, uint32_t flags_extra,
+                     tb_iobuf* body_out, void* meta_out, size_t meta_cap,
+                     uint32_t* meta_len_out, uint32_t* err_code_out,
+                     int timeout_ms);
+// Pipelined surface: send returns the frame's cid (>0) or 0 on error
+// (*err_out = errno); recv returns the body length of ONE completed
+// send()-originated frame (filling cid_out/meta/err_code) or -errno.
+uint64_t tb_channel_send(tb_channel* ch, const void* meta, size_t meta_len,
+                         const void* payload, size_t payload_len,
+                         const void* att, size_t att_len,
+                         uint32_t flags_extra, int* err_out);
+long tb_channel_recv(tb_channel* ch, uint64_t* cid_out, tb_iobuf* body_out,
+                     void* meta_out, size_t meta_cap, uint32_t* meta_len_out,
+                     uint32_t* err_code_out, int timeout_ms);
+// Sticky failure code (0 = healthy).
+int tb_channel_error(const tb_channel* ch);
+void tb_channel_destroy(tb_channel* ch);
+
+// Native perf harness (the example/rdma_performance client analog; the
+// Python rpc_press tool drives the same shape from the interpreter):
+// issue `n` requests keeping `inflight` outstanding on this connection,
+// entirely in C++.  Requires exclusive use of the channel for the call's
+// duration (takes both the writer and reader roles).  Returns ns/request,
+// or -errno.
+long tb_channel_pump(tb_channel* ch, const void* meta, size_t meta_len,
+                     const void* payload, size_t payload_len, int n,
+                     int inflight, int timeout_ms);
+
+#ifdef __cplusplus
+}
+#endif
+#endif  // TBNET_H
