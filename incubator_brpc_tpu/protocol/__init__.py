@@ -50,6 +50,10 @@ from incubator_brpc_tpu.protocol import baidu_std as _baidu_std  # noqa: E402,F4
 # same port via the registry scan (policy/nshead_protocol.cpp)
 from incubator_brpc_tpu.protocol import nshead as _nshead  # noqa: E402,F401
 
+# mongo: server-side wire protocol behind a MongoServiceAdaptor, gated to
+# servers that registered one (policy/mongo_protocol.cpp)
+from incubator_brpc_tpu.protocol import mongo as _mongo  # noqa: E402,F401
+
 __all__ = [
     "HEADER_BYTES",
     "Meta",

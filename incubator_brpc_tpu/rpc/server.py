@@ -157,6 +157,7 @@ class ServerOptions:
         usercode_inline: bool = False,
         device_index: Optional[int] = None,
         nshead_service=None,
+        mongo_service_adaptor=None,
         native_plane: bool = False,
         native_loops: int = 2,
     ):
@@ -179,6 +180,10 @@ class ServerOptions:
         # fn(cntl, head: dict, body: bytes) -> bytes — the single legacy
         # nshead handler (reference ServerOptions.nshead_service)
         self.nshead_service = nshead_service
+        # protocol/mongo.MongoServiceAdaptor — enables the mongo wire
+        # protocol on this server's port (reference
+        # ServerOptions.mongo_service_adaptor)
+        self.mongo_service_adaptor = mongo_service_adaptor
         # Run request processing (cut + handler) inline on the reactor
         # thread instead of a pool fiber — removes two thread handoffs per
         # request, the analog of the reference running user code directly

@@ -1,0 +1,232 @@
+"""mcpack codec + schema bridge (protocol/mcpack.py — the mcpack2pb
+analog; byte layouts per the reference's field_type.h:28-77 and the packed
+head structs in serializer.cpp:25-80).
+
+Fixtures are hand-assembled from the format description — the same way
+the reference's mcpack tests hand-build frames — so the codec is pinned
+to the WIRE, not to itself.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import pytest
+
+from incubator_brpc_tpu.protocol import mcpack
+from incubator_brpc_tpu.protocol.json2pb import Message, field
+from incubator_brpc_tpu.protocol.tbus_std import ParseError
+
+
+def obj_frame(*items: bytes, name: bytes = b"") -> bytes:
+    """Hand-build | FieldLongHead | name | ItemsHead | items |."""
+    body = struct.pack("<I", len(items)) + b"".join(items)
+    return struct.pack("<BBI", 0x10, len(name), len(body)) + name + body
+
+
+class TestWireFixtures:
+    def test_int32_field_bytes(self):
+        # {"a": 1}: OBJECT long head, then INT32 fixed head:
+        # | 0x14 | name_size=2 | "a\0" | 01 00 00 00 |
+        item = bytes([0x14, 2]) + b"a\x00" + struct.pack("<i", 1)
+        frame = obj_frame(item)
+        assert mcpack.loads(frame) == {"a": 1}
+        assert mcpack.dumps({"a": 1}) == frame  # byte-exact emit
+
+    def test_short_string_field_bytes(self):
+        # "s": "hi" → short head: | 0x50|0x80 | name=2 | value=3 | s\0 hi\0
+        item = bytes([0xD0, 2, 3]) + b"s\x00" + b"hi\x00"
+        frame = obj_frame(item)
+        assert mcpack.loads(frame) == {"s": "hi"}
+        assert mcpack.dumps({"s": "hi"}) == frame
+
+    def test_long_string_uses_long_head(self):
+        s = "x" * 300  # 301 incl NUL > 255 → FieldLongHead
+        data = mcpack.dumps({"s": s})
+        # top head(6) + items(4) + field head: type without short mask
+        assert data[10] == 0x50
+        assert mcpack.loads(data) == {"s": s}
+
+    def test_binary_field_bytes(self):
+        item = bytes([0xE0, 2, 4]) + b"b\x00" + b"\x00\x01\x02\xff"
+        frame = obj_frame(item)
+        assert mcpack.loads(frame) == {"b": b"\x00\x01\x02\xff"}
+        assert mcpack.dumps({"b": b"\x00\x01\x02\xff"}) == frame
+
+    def test_bool_null_double(self):
+        items = [
+            bytes([0x31, 2]) + b"t\x00" + b"\x01",
+            bytes([0x61, 2]) + b"n\x00" + b"\x00",
+            bytes([0x48, 2]) + b"d\x00" + struct.pack("<d", 2.5),
+        ]
+        frame = obj_frame(*items)
+        assert mcpack.loads(frame) == {"t": True, "n": None, "d": 2.5}
+
+    def test_nested_object_and_array(self):
+        value = {"outer": {"inner": [1, "two", None]}}
+        assert mcpack.loads(mcpack.dumps(value)) == value
+
+    def test_isoarray_parses(self):
+        # iso array of int32 [1,2,3]: long head ISOARRAY, value =
+        # | item_type=0x14 | 3 packed int32 |
+        body = bytes([0x14]) + struct.pack("<iii", 1, 2, 3)
+        item = struct.pack("<BBI", 0x30, 2, len(body)) + b"v\x00" + body
+        frame = obj_frame(item)
+        assert mcpack.loads(frame) == {"v": [1, 2, 3]}
+
+    def test_deleted_field_skipped(self):
+        # type 0x0F: & 0x70 == 0 → deleted; value_size = low nibble (15)
+        deleted = bytes([0x0F, 2]) + b"x\x00" + b"\xaa" * 15
+        keep = bytes([0x14, 2]) + b"k\x00" + struct.pack("<i", 7)
+        frame = obj_frame(deleted, keep)
+        assert mcpack.loads(frame) == {"k": 7}
+
+    def test_int_width_selection(self):
+        small = mcpack.dumps({"v": 1})
+        big = mcpack.dumps({"v": 1 << 40})
+        huge = mcpack.dumps({"v": (1 << 63) + 1})
+        assert small[10] == 0x14  # INT32
+        assert big[10] == 0x18  # INT64
+        assert huge[10] == 0x28  # UINT64
+        for frame, expect in ((small, 1), (big, 1 << 40), (huge, (1 << 63) + 1)):
+            assert mcpack.loads(frame) == {"v": expect}
+
+
+class TestRobustness:
+    def test_truncated_raises(self):
+        data = mcpack.dumps({"a": 1, "s": "hello"})
+        for cut in (1, 5, len(data) - 1):
+            with pytest.raises(ParseError):
+                mcpack.loads(data[:cut])
+
+    def test_bad_string_termination(self):
+        item = bytes([0xD0, 2, 2]) + b"s\x00" + b"hi"  # no NUL
+        with pytest.raises(ParseError):
+            mcpack.loads(obj_frame(item))
+
+    def test_name_missing_nul_rejected(self):
+        # name_size counts the NUL (field_type.h note); 'a' without it must
+        # raise, not silently become the empty name
+        item = bytes([0x14, 1]) + b"a" + struct.pack("<i", 1)
+        with pytest.raises(ParseError):
+            mcpack.loads(obj_frame(item))
+
+    def test_non_utf8_name_and_string_raise_parse_error(self):
+        item = bytes([0x14, 3]) + b"\xff\xfe\x00" + struct.pack("<i", 1)
+        with pytest.raises(ParseError):
+            mcpack.loads(obj_frame(item))
+        sval = bytes([0xD0, 2, 3]) + b"s\x00" + b"\xff\xfe\x00"
+        with pytest.raises(ParseError):
+            mcpack.loads(obj_frame(sval))
+
+    def test_depth_bomb_rejected(self):
+        v = {}
+        for _ in range(200):
+            v = {"d": v}
+        with pytest.raises(ValueError):
+            mcpack.dumps(v)
+
+    def test_isoarray_ragged_rejected(self):
+        body = bytes([0x14]) + b"\x01\x02\x03"  # 3 bytes, not /4
+        item = struct.pack("<BBI", 0x30, 0, len(body)) + body
+        with pytest.raises(ParseError):
+            mcpack.loads(obj_frame(item))
+
+    def test_value_roundtrip_all_kinds(self):
+        value = {
+            "i": -5,
+            "big": 1 << 50,
+            "f": 3.25,
+            "t": True,
+            "s": "héllo",
+            "b": b"\x00raw",
+            "n": None,
+            "arr": [1, [2, 3], {"k": "v"}],
+            "obj": {"nested": {"deep": 1}},
+        }
+        assert mcpack.loads(mcpack.dumps(value)) == value
+
+
+class Inner(Message):
+    tag = field(1, str)
+
+
+class Req(Message):
+    name = field(1, str)
+    count = field(2, int)
+    ratio = field(3, float)
+    blob = field(4, bytes)
+    inner = field(5, Inner)
+    values = field(6, int, repeated=True)
+
+
+class TestSchemaBridge:
+    def test_message_roundtrip(self):
+        msg = Req(
+            name="n",
+            count=12,
+            ratio=0.5,
+            blob=b"bb",
+            inner=Inner(tag="t"),
+            values=[1, 2, 3],
+        )
+        data = mcpack.message_to_mcpack(msg)
+        back = mcpack.message_from_mcpack(Req, data)
+        assert back == msg
+
+    def test_same_schema_serves_proto2_and_mcpack(self):
+        """The mcpack2pb promise: ONE typed message, two wire formats."""
+        msg = Req(name="dual", count=3)
+        pb = Req.from_binary(msg.to_binary())
+        mc = mcpack.message_from_mcpack(Req, mcpack.message_to_mcpack(msg))
+        assert pb == mc == msg
+
+    def test_int_coerces_to_float_field(self):
+        data = mcpack.dumps({"ratio": 2})
+        msg = mcpack.message_from_mcpack(Req, data)
+        assert msg.ratio == 2.0
+
+    def test_type_mismatch_raises(self):
+        data = mcpack.dumps({"count": "not-an-int"})
+        with pytest.raises(ParseError):
+            mcpack.message_from_mcpack(Req, data)
+
+
+class TestNsheadMcpackService:
+    def test_end_to_end_over_nshead(self):
+        """nshead+mcpack loopback: the reference's NsheadMcpackAdaptor
+        shape — typed dict in, typed dict out, nshead framing outside."""
+        from incubator_brpc_tpu.protocol import nshead
+        from incubator_brpc_tpu.rpc import Channel, Server, ServerOptions
+
+        def handler(cntl, req: dict) -> dict:
+            return {"echo": req.get("msg", ""), "n": req.get("n", 0) + 1}
+
+        srv = Server(
+            ServerOptions(
+                usercode_inline=True,
+                nshead_service=mcpack.make_mcpack_service(handler),
+            )
+        )
+        assert srv.start(0)
+        try:
+            import socket as pysock
+
+            body = mcpack.dumps({"msg": "hi", "n": 41})
+            conn = pysock.create_connection(("127.0.0.1", srv.port), timeout=5)
+            conn.sendall(nshead.pack_frame(body, log_id=7))
+            resp = b""
+            while True:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    break
+                resp += chunk
+                total = nshead.parse_header(resp[: nshead.HEADER_BYTES])
+                if total is not None and len(resp) >= total:
+                    break
+            frame, _ = nshead.try_parse_frame(resp)
+            assert frame is not None
+            assert mcpack.loads(frame.payload) == {"echo": "hi", "n": 42}
+            conn.close()
+        finally:
+            srv.stop()

@@ -1,0 +1,223 @@
+"""mongo server-side protocol (protocol/mongo.py — reference
+policy/mongo_protocol.cpp + mongo_service_adaptor.h).
+
+Wire fixtures are hand-built from the public mongo wire spec (the head
+layout in the reference's mongo_head.h) so the codec pins to the wire.
+"""
+
+from __future__ import annotations
+
+import socket as pysock
+import struct
+
+import pytest
+
+from incubator_brpc_tpu.protocol import mongo
+from incubator_brpc_tpu.protocol.tbus_std import ParseError
+from incubator_brpc_tpu.rpc import Server, ServerOptions
+
+
+class TestBson:
+    def test_roundtrip_all_kinds(self):
+        doc = {
+            "d": 1.5,
+            "s": "text",
+            "sub": {"k": 1},
+            "arr": [1, "two", None],
+            "bin": b"\x00\xff",
+            "oid": mongo.ObjectId(b"0123456789ab"),
+            "t": True,
+            "n": None,
+            "i32": 42,
+            "i64": 1 << 40,
+        }
+        data = mongo.bson_encode(doc)
+        back, used = mongo.bson_decode(data)
+        assert used == len(data)
+        assert back == doc
+
+    def test_known_fixture_bytes(self):
+        # {"hello": "world"} per the BSON spec's own worked example:
+        # \x16\x00\x00\x00 \x02 hello\x00 \x06\x00\x00\x00 world\x00 \x00
+        fixture = (
+            b"\x16\x00\x00\x00\x02hello\x00\x06\x00\x00\x00world\x00\x00"
+        )
+        assert mongo.bson_encode({"hello": "world"}) == fixture
+        doc, used = mongo.bson_decode(fixture)
+        assert doc == {"hello": "world"} and used == len(fixture)
+
+    def test_truncated_rejected(self):
+        data = mongo.bson_encode({"a": 1, "b": "xx"})
+        for cut in (3, 6, len(data) - 1):
+            with pytest.raises(ParseError):
+                mongo.bson_decode(data[:cut] + b"\x00" * 0)
+
+    def test_unknown_element_type_rejected(self):
+        bad = b"\x0b\x00\x00\x00\x7fx\x00\x00\x00\x00\x00"
+        with pytest.raises(ParseError):
+            mongo.bson_decode(bad)
+
+    def test_depth_bomb_rejected(self):
+        doc = {"k": 1}
+        for _ in range(200):
+            doc = {"d": doc}
+        data = mongo.bson_encode(doc)
+        with pytest.raises(ParseError):
+            mongo.bson_decode(data)
+
+    def test_malformed_raises_parse_error_not_struct_error(self):
+        # a double element whose 8 value bytes overrun the declared length
+        bad = struct.pack("<i", 8) + b"\x01d\x00" + b"\x00"
+        with pytest.raises(ParseError):
+            mongo.bson_decode(bad)
+        # an "array" whose keys are not numeric indices
+        arr_body = mongo.bson_encode({"notanum": 1})
+        elem = b"\x04a\x00" + arr_body
+        framed = struct.pack("<i", 4 + len(elem) + 1) + elem + b"\x00"
+        with pytest.raises(ParseError):
+            mongo.bson_decode(framed)
+
+
+def build_query(collection: str, query: dict, request_id: int = 7,
+                skip: int = 0, limit: int = 0) -> bytes:
+    body = (
+        struct.pack("<i", 0)
+        + collection.encode() + b"\x00"
+        + struct.pack("<ii", skip, limit)
+        + mongo.bson_encode(query)
+    )
+    return mongo.HEAD.pack(16 + len(body), request_id, 0, mongo.OP_QUERY) + body
+
+
+def parse_reply(data: bytes):
+    length, rid, rto, op = mongo.HEAD.unpack_from(data)
+    assert op == mongo.OP_REPLY and length == len(data)
+    flags, cursor, start, count = struct.unpack_from("<iqii", data, 16)
+    docs, off = [], 36
+    for _ in range(count):
+        doc, used = mongo.bson_decode(data, off)
+        docs.append(doc)
+        off += used
+    return rto, flags, docs
+
+
+class _Adaptor(mongo.MongoServiceAdaptor):
+    def __init__(self):
+        self.inserts = []
+
+    def create_socket_context(self):
+        return {"queries": 0}
+
+    def handle_query(self, ctx, q: mongo.QueryMessage):
+        ctx["queries"] += 1
+        if q.collection == "db.fail":
+            raise ParseError("synthetic failure")
+        return [
+            {"collection": q.collection, "n": ctx["queries"], **q.query},
+        ]
+
+    def handle_insert(self, ctx, body: bytes):
+        self.inserts.append(body)
+
+
+@pytest.fixture
+def mongo_server():
+    adaptor = _Adaptor()
+    srv = Server(
+        ServerOptions(usercode_inline=True, mongo_service_adaptor=adaptor)
+    )
+    assert srv.start(0)
+    yield srv, adaptor
+    srv.stop()
+
+
+def _recv_reply(conn) -> bytes:
+    data = b""
+    while len(data) < 4 or len(data) < struct.unpack_from("<i", data)[0]:
+        chunk = conn.recv(65536)
+        if not chunk:
+            break
+        data += chunk
+    return data
+
+
+class TestQueryPath:
+    def test_query_reply_and_per_conn_context(self, mongo_server):
+        srv, _ = mongo_server
+        conn = pysock.create_connection(("127.0.0.1", srv.port), timeout=5)
+        conn.sendall(build_query("db.items", {"x": 1}, request_id=11))
+        rto, flags, docs = parse_reply(_recv_reply(conn))
+        assert rto == 11 and flags == 0
+        assert docs == [{"collection": "db.items", "n": 1, "x": 1}]
+        # same connection: the context counter advances (stateful protocol)
+        conn.sendall(build_query("db.items", {}, request_id=12))
+        _, _, docs2 = parse_reply(_recv_reply(conn))
+        assert docs2[0]["n"] == 2
+        conn.close()
+        # a NEW connection gets a fresh context
+        conn2 = pysock.create_connection(("127.0.0.1", srv.port), timeout=5)
+        conn2.sendall(build_query("db.items", {}, request_id=13))
+        _, _, docs3 = parse_reply(_recv_reply(conn2))
+        assert docs3[0]["n"] == 1
+        conn2.close()
+
+    def test_adaptor_error_serializes_err_reply(self, mongo_server):
+        srv, _ = mongo_server
+        conn = pysock.create_connection(("127.0.0.1", srv.port), timeout=5)
+        conn.sendall(build_query("db.fail", {}, request_id=21))
+        rto, flags, docs = parse_reply(_recv_reply(conn))
+        assert rto == 21
+        assert flags & 2  # QueryFailure
+        assert "$err" in docs[0]
+        conn.close()
+
+    def test_insert_is_fire_and_forget(self, mongo_server):
+        srv, adaptor = mongo_server
+        body = struct.pack("<i", 0) + b"db.c\x00" + mongo.bson_encode({"v": 1})
+        frame = mongo.HEAD.pack(16 + len(body), 31, 0, mongo.OP_INSERT) + body
+        conn = pysock.create_connection(("127.0.0.1", srv.port), timeout=5)
+        conn.sendall(frame)
+        # follow with a query to prove the connection survived the no-reply op
+        conn.sendall(build_query("db.c", {}, request_id=32))
+        rto, _, _ = parse_reply(_recv_reply(conn))
+        assert rto == 32
+        assert len(adaptor.inserts) == 1
+        conn.close()
+
+    def test_get_more_reports_cursor_not_found(self, mongo_server):
+        srv, _ = mongo_server
+        body = struct.pack("<i", 0) + b"db.c\x00" + struct.pack("<iq", 0, 99)
+        frame = mongo.HEAD.pack(16 + len(body), 41, 0, mongo.OP_GET_MORE) + body
+        conn = pysock.create_connection(("127.0.0.1", srv.port), timeout=5)
+        conn.sendall(frame)
+        rto, flags, docs = parse_reply(_recv_reply(conn))
+        assert rto == 41 and flags & 1 and docs == []
+        conn.close()
+
+
+class TestGating:
+    def test_disabled_without_adaptor(self):
+        """A server with no adaptor must not speak mongo (the reference
+        returns TRY_OTHERS, and the scan then rejects the bytes)."""
+        srv = Server(ServerOptions(usercode_inline=True))
+        srv.add_service("svc", {"echo": lambda cntl, req: req})
+        assert srv.start(0)
+        try:
+            conn = pysock.create_connection(("127.0.0.1", srv.port), timeout=5)
+            conn.sendall(build_query("db.x", {}))
+            conn.settimeout(3)
+            assert conn.recv(1024) == b""  # connection failed, no reply
+            conn.close()
+        finally:
+            srv.stop()
+
+    def test_multiplexed_with_tbus_on_one_port(self, mongo_server):
+        from incubator_brpc_tpu.rpc import Channel
+
+        srv, _ = mongo_server
+        srv._methods  # server also serves tbus_std on the same port
+        ch = Channel()
+        assert ch.init(f"127.0.0.1:{srv.port}")
+        c = ch.call_method("nosuch", "m", b"")
+        # tbus_std reached the server's request path (error ≠ transport kill)
+        assert c.failed() and c.error_code in (1001, 1002)
