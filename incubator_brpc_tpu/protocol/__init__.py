@@ -54,6 +54,10 @@ from incubator_brpc_tpu.protocol import nshead as _nshead  # noqa: E402,F401
 # servers that registered one (policy/mongo_protocol.cpp)
 from incubator_brpc_tpu.protocol import mongo as _mongo  # noqa: E402,F401
 
+# rtmp: stateful media protocol behind an RtmpService — the extension
+# ceiling of the shared-port registry (policy/rtmp_protocol.cpp)
+from incubator_brpc_tpu.protocol import rtmp as _rtmp  # noqa: E402,F401
+
 __all__ = [
     "HEADER_BYTES",
     "Meta",

@@ -136,7 +136,7 @@ def bson_decode(data, offset: int = 0, _depth: int = 0) -> Tuple[Dict[str, Any],
         doc, end = _bson_decode_body(mv[:total], _depth)
     except ParseError:
         raise
-    except (struct.error, UnicodeDecodeError, ValueError) as e:
+    except (struct.error, UnicodeDecodeError, ValueError, IndexError) as e:
         raise ParseError(f"bson malformed: {e}")
     return doc, total
 

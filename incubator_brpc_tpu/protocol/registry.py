@@ -34,6 +34,14 @@ class Protocol:
     # the socket's read chain directly — no whole-frame copy into Python.
     # Optional; the messenger prefers it when present.
     parse_iobuf: Optional[Callable] = None
+    # stateful per-connection cut: (sock, read IOBuf) -> (parsed_or_None,
+    # consumed) for protocols whose framing depends on negotiated
+    # connection state (RTMP chunk sizes). The reference hangs such state
+    # off the Socket as a parsing context (socket.h reset_parsing_context;
+    # mongo/rtmp both use it); here the hook receives the socket and keeps
+    # its state in sock.context. consumed>0 with no frame = progress
+    # (handshake bytes); the messenger keeps cutting.
+    parse_conn: Optional[Callable] = None
     # (sock) -> bool: whether this protocol participates in the scan for
     # this connection. Lets option-dependent protocols (nshead needs a
     # registered service; its magic sits too deep to classify short

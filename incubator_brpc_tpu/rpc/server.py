@@ -158,6 +158,7 @@ class ServerOptions:
         device_index: Optional[int] = None,
         nshead_service=None,
         mongo_service_adaptor=None,
+        rtmp_service=None,
         native_plane: bool = False,
         native_loops: int = 2,
     ):
@@ -184,6 +185,9 @@ class ServerOptions:
         # protocol on this server's port (reference
         # ServerOptions.mongo_service_adaptor)
         self.mongo_service_adaptor = mongo_service_adaptor
+        # protocol/rtmp.RtmpService — enables RTMP (publish/play relay)
+        # on this server's port (reference ServerOptions.rtmp_service)
+        self.rtmp_service = rtmp_service
         # Run request processing (cut + handler) inline on the reactor
         # thread instead of a pool fiber — removes two thread handoffs per
         # request, the analog of the reference running user code directly

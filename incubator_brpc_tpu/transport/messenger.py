@@ -56,7 +56,13 @@ class InputMessenger:
         max_body = int(get_flag("max_body_size"))
         retry_others = False
         while True:
-            if len(buf) < 8:
+            pref = sock.preferred_protocol
+            # stateful protocols (parse_conn) can frame messages smaller
+            # than any fixed header (a 2-byte RTMP continuation chunk),
+            # and may hold already-cut messages in connection state that
+            # must drain even when the byte buffer is empty: always ask
+            has_conn_state = pref is not None and pref.parse_conn
+            if not has_conn_state and len(buf) < 8:
                 break
             # native fast path: once the connection's protocol is known and
             # it can cut directly off the read chain, skip the peek/copy
@@ -64,7 +70,26 @@ class InputMessenger:
             # A ParseError here falls through ONCE to the full protocol scan
             # (the reference's TRY_OTHERS), which terminates the connection
             # itself if nothing matches.
-            pref = sock.preferred_protocol
+            if pref is not None and pref.parse_conn is not None and not retry_others:
+                # stateful per-connection cut (RTMP): the protocol owns the
+                # connection's bytes once preferred; consumed-without-frame
+                # means handshake progress
+                try:
+                    frame, consumed = pref.parse_conn(sock, buf)
+                except FatalParseError as e:
+                    self._dispatch(sock, cut)
+                    sock.set_failed(ErrorCode.EREQUEST, f"corrupt frame: {e}")
+                    return
+                except ParseError as e:
+                    self._dispatch(sock, cut)
+                    sock.set_failed(ErrorCode.EREQUEST, f"unparsable: {e}")
+                    return
+                if frame is not None:
+                    cut.append((pref, frame))
+                    continue
+                if consumed:
+                    continue
+                break  # incomplete: wait for more bytes
             if pref is not None and pref.parse_iobuf is not None and not retry_others:
                 try:
                     frame, consumed = pref.parse_iobuf(
