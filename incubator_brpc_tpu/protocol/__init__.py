@@ -58,6 +58,11 @@ from incubator_brpc_tpu.protocol import mongo as _mongo  # noqa: E402,F401
 # ceiling of the shared-port registry (policy/rtmp_protocol.cpp)
 from incubator_brpc_tpu.protocol import rtmp as _rtmp  # noqa: E402,F401
 
+# the legacy Baidu family: hulu/sofa (full duplex), nova/public_pbrpc/
+# ubrpc_mcpack2/nshead_mcpack/esp clients + server adaptors
+# (policy/hulu_pbrpc_protocol.cpp and friends)
+from incubator_brpc_tpu.protocol import legacy_pbrpc as _legacy  # noqa: E402,F401
+
 __all__ = [
     "HEADER_BYTES",
     "Meta",

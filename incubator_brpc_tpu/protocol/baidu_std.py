@@ -344,6 +344,7 @@ BAIDU_STD = Protocol(
     parse=try_parse_frame,
     parse_header=parse_header,
     pack_request=pack_request,
+    pack_response=pack_response,
     process_request=_process_request,
     process_response=_process_response,
 )

@@ -53,14 +53,17 @@ def pack_frame(
     version: int = 0,
     log_id: int = 0,
     provider: bytes = b"tbrpc",
+    reserved: int = 0,
 ) -> bytes:
+    # `reserved` is protocol-defined: nova_pbrpc carries the method index
+    # there (policy/nova_pbrpc_protocol.cpp ParseNsheadMeta)
     return _HDR.pack(
         id & 0xFFFF,
         version & 0xFFFF,
         log_id & 0xFFFFFFFF,
         provider[:16].ljust(16, b"\x00"),
         MAGIC,
-        0,
+        reserved & 0xFFFFFFFF,
         len(body),
     ) + body
 
@@ -98,6 +101,7 @@ def try_parse_frame(buf: bytes) -> Tuple[Optional[NsheadFrame], int]:
         "version": version,
         "log_id": log_id,
         "provider": provider.rstrip(b"\x00").decode(errors="replace"),
+        "reserved": _res,
     }
     return NsheadFrame(head=head, payload=bytes(buf[HEADER_BYTES:total])), total
 

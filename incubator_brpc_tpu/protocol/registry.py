@@ -22,6 +22,12 @@ class Protocol:
     parse_header: Optional[Callable] = None
     # client side: (meta, payload, cid, ...) -> bytes
     pack_request: Optional[Callable] = None
+    # server side: (meta, payload, cid, error_code=, attachment=) -> bytes.
+    # The server answers in the protocol the request arrived in (the
+    # reference keys SendRpcResponse off the request's protocol); frames
+    # tag themselves with wire_protocol and the server looks the packer up
+    # here instead of hardcoding per-protocol imports.
+    pack_response: Optional[Callable] = None
     # server side: (socket, frame) -> None
     process_request: Optional[Callable] = None
     # client side: (socket, frame) -> None

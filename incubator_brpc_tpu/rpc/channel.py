@@ -502,14 +502,26 @@ class Channel:
     def _auth_key_tag(self) -> str:
         """Connection-pool partition for this channel's credentials — the
         reference's SocketMapKey carries the Authenticator for the same
-        reason (socket_map.h:35)."""
+        reason (socket_map.h:35). FIFO-correlated protocols partition by
+        protocol too: their responses carry no ids, so a socket's inbound
+        bytes are only decodable when exactly one such protocol ever
+        spoke on it (two channels to one endpoint speaking esp and
+        nova would otherwise corrupt each other's response framing)."""
         a = self._options.auth
-        if a is None:
-            return ""
-        tag = getattr(a, "_smap_tag", None)
-        if tag is None:
-            tag = f"auth-{id(a):x}"
-            a._smap_tag = tag
+        tag = ""
+        if a is not None:
+            tag = getattr(a, "_smap_tag", None)
+            if tag is None:
+                tag = f"auth-{id(a):x}"
+                a._smap_tag = tag
+        proto_name = self._options.protocol
+        if proto_name != "tbus_std":
+            from incubator_brpc_tpu.protocol.registry import protocol_registry
+
+            if proto_name in protocol_registry and protocol_registry.get(
+                proto_name
+            ).fifo_responses:
+                tag = f"{tag}|fifo-{proto_name}"
         return tag
 
     def _dispose_attempt_sock(self, kind: str, sock, reusable: bool = True) -> None:
@@ -657,6 +669,7 @@ class Channel:
             stream_id=(
                 cntl._request_stream.id if cntl._request_stream is not None else 0
             ),
+            extra=dict(cntl.request_extra) if cntl.request_extra else {},
         )
         if self._options.auth is not None:
             from incubator_brpc_tpu.rpc.auth import attach_credential
@@ -686,6 +699,11 @@ class Channel:
                     raise ValueError(f"protocol {proto_name!r} cannot pack requests")
                 if proto.fifo_responses and sock.remote is not None:
                     meta.extra["http_host"] = f"{sock.remote.ip}:{sock.remote.port}"
+                if proto.fifo_responses:
+                    # response frames on this connection belong to this
+                    # protocol — the legacy client rows gate their scan on
+                    # it (a client socket has no Server context to gate by)
+                    sock.context["fifo_protocol"] = proto_name
                 data = proto.pack_request(
                     meta,
                     payload,

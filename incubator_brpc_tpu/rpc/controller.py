@@ -42,6 +42,9 @@ class Controller:
         self.log_id = log_id
         self.compress_type: str = ""
         self.request_attachment: bytes = b""
+        # protocol-specific request meta extras copied into Meta.extra
+        # (hulu/nova method_index, esp addressing, ...)
+        self.request_extra: dict = {}
 
         # -- in/out state --
         self.call_id: int = 0

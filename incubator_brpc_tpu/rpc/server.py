@@ -640,10 +640,16 @@ class Server:
         if attachment and meta is None:
             meta = Meta()
         wire = getattr(cntl, "_wire_protocol", "tbus_std")
-        if wire == "baidu_std":
-            from incubator_brpc_tpu.protocol import baidu_std
+        wire_proto = None
+        if wire != "tbus_std":
+            from incubator_brpc_tpu.protocol.registry import protocol_registry
 
-            data = baidu_std.pack_response(
+            wire_proto = (
+                protocol_registry.get(wire) if wire in protocol_registry
+                else None
+            )
+        if wire_proto is not None and wire_proto.pack_response is not None:
+            data = wire_proto.pack_response(
                 meta,
                 payload,
                 cntl.call_id,

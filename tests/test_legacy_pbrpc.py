@@ -1,0 +1,386 @@
+"""The legacy Baidu protocol family (protocol/legacy_pbrpc.py — reference
+policy/hulu_pbrpc_protocol.cpp, sofa_pbrpc_protocol.cpp,
+nova_pbrpc_protocol.cpp, public_pbrpc_protocol.cpp, ubrpc2pb_protocol.cpp,
+esp_protocol.cpp): wire fixtures, loopback round trips on the shared port,
+error propagation, and the FIFO client correlation for the nshead family.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import pytest
+
+from incubator_brpc_tpu.protocol import legacy_pbrpc as lp
+from incubator_brpc_tpu.protocol import mcpack
+from incubator_brpc_tpu.protocol.tbus_std import Meta, ParseError
+from incubator_brpc_tpu.rpc import Channel, ChannelOptions, Server, ServerOptions
+
+
+@pytest.fixture
+def echo_server():
+    srv = Server(ServerOptions(usercode_inline=True))
+
+    def echo(cntl, req):
+        return req
+
+    def boom(cntl, req):
+        cntl.set_failed(1007, "deliberate failure")
+        return b""
+
+    srv.add_service("svc", {"echo": echo, "boom": boom})
+    assert srv.start(0)
+    yield srv
+    srv.stop()
+
+
+def _call(port, protocol, service="svc", method="echo", payload=b"x",
+          extra=None, timeout=5000):
+    from incubator_brpc_tpu.rpc import Controller
+
+    ch = Channel()
+    assert ch.init(
+        f"127.0.0.1:{port}",
+        options=ChannelOptions(protocol=protocol, timeout_ms=timeout),
+    )
+    cntl = Controller(timeout_ms=timeout)
+    if extra:
+        cntl.request_extra = dict(extra)
+    return ch.call_method(service, method, payload, cntl=cntl)
+
+
+class TestHuluWire:
+    def test_header_fixture(self):
+        # "HULU" + u32le(body=meta+payload) + u32le(meta) — host (LE) order
+        wire = lp._hulu_frame(b"M" * 10, b"P" * 3)
+        assert wire[:4] == b"HULU"
+        assert struct.unpack_from("<II", wire, 4) == (13, 10)
+
+    def test_request_roundtrip(self):
+        meta = Meta(service="svc", method="echo", log_id=77,
+                    extra={"method_index": 1})
+        wire = lp.hulu_pack_request(meta, b"hello", 42, attachment=b"att")
+        frame, consumed = lp.hulu_try_parse(wire)
+        assert consumed == len(wire)
+        assert not frame.is_response
+        assert frame.meta.service == "svc"
+        assert frame.meta.method == "echo"
+        assert frame.meta.extra["method_index"] == 1
+        assert frame.meta.log_id == 77
+        assert frame.correlation_id == 42
+        assert frame.payload == b"hello" and frame.attachment == b"att"
+
+    def test_response_roundtrip_sint64_cid(self):
+        # response correlation_id is sint64 (zigzag) on the wire
+        wire = lp.hulu_pack_response(None, b"out", 99, error_code=0)
+        frame, _ = lp.hulu_try_parse(wire)
+        assert frame.is_response and frame.correlation_id == 99
+        assert frame.payload == b"out" and frame.error_code == 0
+        wire = lp.hulu_pack_response(
+            Meta(error_text="nope"), b"", 7, error_code=1007
+        )
+        frame, _ = lp.hulu_try_parse(wire)
+        assert frame.error_code == 1007
+        assert frame.meta.error_text == "nope"
+
+    def test_meta_size_overflow_rejected(self):
+        bad = b"HULU" + struct.pack("<II", 4, 9) + b"xxxx"
+        with pytest.raises(ParseError):
+            lp.hulu_try_parse(bad)
+
+
+class TestSofaWire:
+    def test_header_fixture(self):
+        # "SOFA" + u32le(meta) + u64le(body) + u64le(meta+body)
+        wire = lp._sofa_frame(b"M" * 6, b"P" * 4)
+        assert wire[:4] == b"SOFA"
+        assert struct.unpack_from("<IQQ", wire, 4) == (6, 4, 10)
+
+    def test_request_roundtrip(self):
+        meta = Meta(service="pkg.EchoService", method="echo")
+        wire = lp.sofa_pack_request(meta, b"ping", 5)
+        frame, consumed = lp.sofa_try_parse(wire)
+        assert consumed == len(wire)
+        assert not frame.is_response
+        assert frame.meta.service == "pkg.EchoService"
+        assert frame.meta.method == "echo"
+        assert frame.correlation_id == 5
+
+    def test_response_failed(self):
+        wire = lp.sofa_pack_response(
+            Meta(error_text="broken"), b"", 8, error_code=2004
+        )
+        frame, _ = lp.sofa_try_parse(wire)
+        assert frame.is_response and frame.correlation_id == 8
+        assert frame.error_code == 2004
+        assert frame.meta.error_text == "broken"
+
+    def test_inconsistent_sizes_rejected(self):
+        bad = b"SOFA" + struct.pack("<IQQ", 2, 2, 99) + b"abcd"
+        with pytest.raises(ParseError):
+            lp.sofa_try_parse(bad)
+
+
+class TestHuluSofaLoopback:
+    def test_hulu_end_to_end(self, echo_server):
+        cntl = _call(echo_server.port, "hulu_pbrpc", payload=b"via-hulu")
+        assert cntl.ok(), cntl.error_text
+        assert cntl.response_payload == b"via-hulu"
+
+    def test_hulu_by_method_index(self, echo_server):
+        # no method name on the wire: index 1 = second registered = boom
+        cntl = _call(echo_server.port, "hulu_pbrpc", method="",
+                     extra={"method_index": 1})
+        assert not cntl.ok() and cntl.error_code == 1007
+
+    def test_hulu_error_propagates(self, echo_server):
+        cntl = _call(echo_server.port, "hulu_pbrpc", method="boom")
+        assert not cntl.ok()
+        assert cntl.error_code == 1007
+        assert "deliberate" in cntl.error_text
+
+    def test_sofa_end_to_end(self, echo_server):
+        cntl = _call(echo_server.port, "sofa_pbrpc", payload=b"via-sofa")
+        assert cntl.ok(), cntl.error_text
+        assert cntl.response_payload == b"via-sofa"
+
+    def test_sofa_error_propagates(self, echo_server):
+        cntl = _call(echo_server.port, "sofa_pbrpc", method="boom")
+        assert not cntl.ok() and cntl.error_code == 1007
+
+    def test_three_protocols_share_the_port(self, echo_server):
+        # tbus_std, hulu and sofa multiplex on one listener
+        for proto in ("tbus_std", "hulu_pbrpc", "sofa_pbrpc"):
+            cntl = _call(echo_server.port, proto, payload=proto.encode())
+            assert cntl.ok(), f"{proto}: {cntl.error_text}"
+            assert cntl.response_payload == proto.encode()
+
+
+class TestNovaLoopback:
+    @pytest.fixture
+    def nova_server(self):
+        srv = Server(
+            ServerOptions(
+                usercode_inline=True,
+                nshead_service=lp.NovaServiceAdaptor,
+            )
+        )
+        srv.add_service(
+            "svc",
+            {"echo": lambda cntl, req: req,
+             "rev": lambda cntl, req: req[::-1]},
+        )
+        assert srv.start(0)
+        yield srv
+        srv.stop()
+
+    def test_nova_by_index(self, nova_server):
+        cntl = _call(nova_server.port, "nova_pbrpc", payload=b"abc",
+                     extra={"method_index": 1})
+        assert cntl.ok(), cntl.error_text
+        assert cntl.response_payload == b"cba"
+
+    def test_nova_default_index(self, nova_server):
+        cntl = _call(nova_server.port, "nova_pbrpc", payload=b"abc")
+        assert cntl.ok(), cntl.error_text
+        assert cntl.response_payload == b"abc"
+
+
+class TestPublicPbrpcLoopback:
+    @pytest.fixture
+    def pub_server(self):
+        def boom(cntl, req):
+            cntl.set_failed(1008, "public failure")
+            return b""
+
+        srv = Server(
+            ServerOptions(
+                usercode_inline=True,
+                nshead_service=lp.PublicPbrpcServiceAdaptor,
+            )
+        )
+        srv.add_service(
+            "svc", {"echo": lambda cntl, req: req, "boom": boom}
+        )
+        assert srv.start(0)
+        yield srv
+        srv.stop()
+
+    def test_public_end_to_end(self, pub_server):
+        cntl = _call(pub_server.port, "public_pbrpc", payload=b"wrapped")
+        assert cntl.ok(), cntl.error_text
+        assert cntl.response_payload == b"wrapped"
+
+    def test_public_error_propagates(self, pub_server):
+        cntl = _call(pub_server.port, "public_pbrpc", method="boom",
+                     extra={"method_index": 1})
+        assert not cntl.ok() and cntl.error_code == 1008
+        assert "public failure" in cntl.error_text
+
+
+class TestUbrpcLoopback:
+    @pytest.fixture
+    def ub_server(self):
+        def add(cntl, req):
+            params = mcpack.loads(req)
+            return mcpack.dumps({"sum": params["a"] + params["b"]})
+
+        srv = Server(
+            ServerOptions(
+                usercode_inline=True,
+                nshead_service=lp.UbrpcServiceAdaptor,
+            )
+        )
+        srv.add_service("calc", {"add": add})
+        assert srv.start(0)
+        yield srv
+        srv.stop()
+
+    def test_ubrpc_end_to_end(self, ub_server):
+        payload = mcpack.dumps({"a": 3, "b": 4})
+        cntl = _call(ub_server.port, "ubrpc_mcpack2", service="calc",
+                     method="add", payload=payload)
+        assert cntl.ok(), cntl.error_text
+        assert mcpack.loads(cntl.response_payload) == {"sum": 7}
+
+    def test_ubrpc_unknown_method(self, ub_server):
+        payload = mcpack.dumps({"a": 1, "b": 2})
+        cntl = _call(ub_server.port, "ubrpc_mcpack2", service="calc",
+                     method="mul", payload=payload)
+        assert not cntl.ok()
+
+
+class TestEsp:
+    def test_head_fixture(self):
+        wire = lp.esp_pack_request(
+            Meta(extra={"to_stub": 2, "to_port": 8000, "to_ip": 0x7F000001,
+                        "esp_msg": 9}),
+            b"BODY", 1234,
+        )
+        assert len(wire) == lp.ESP_HEADER + 4
+        vals = lp._ESP_HEAD.unpack_from(wire)
+        assert vals[3:6] == (2, 8000, 0x7F000001)  # to
+        assert vals[6] == 9 and vals[7] == 1234 and vals[8] == 4
+
+    def test_parse_roundtrip(self):
+        wire = lp.esp_pack_request(Meta(extra={"esp_msg": 5}), b"pp", 7)
+        frame, consumed = lp.esp_try_parse(wire)
+        assert consumed == len(wire)
+        assert frame.head["msg"] == 5 and frame.head["msg_id"] == 7
+        assert frame.payload == b"pp"
+
+    def test_esp_against_mock_server(self, echo_server):
+        # the reference has no esp server: drive the client against a raw
+        # echo-the-esp-frame socket, the same shape its unittest uses
+        import socket as pysock
+        import threading
+
+        lsock = pysock.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(1)
+        port = lsock.getsockname()[1]
+
+        def serve():
+            conn, _ = lsock.accept()
+            data = b""
+            while len(data) < lp.ESP_HEADER:
+                data += conn.recv(4096)
+            body_len = struct.unpack_from("<i", data, lp.ESP_HEADER - 4)[0]
+            while len(data) < lp.ESP_HEADER + body_len:
+                data += conn.recv(4096)
+            conn.sendall(data)  # echo the whole esp frame back
+            conn.close()
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        try:
+            cntl = _call(port, "esp", payload=b"esp-body",
+                         extra={"esp_msg": 3})
+            assert cntl.ok(), cntl.error_text
+            assert cntl.response_payload == b"esp-body"
+            assert cntl.response_meta.extra["esp_head"]["msg"] == 3
+        finally:
+            lsock.close()
+
+
+class TestHuluEdgeCases:
+    def test_empty_payload_with_attachment(self):
+        # user_message_size=0 must be representable (present-with-zero):
+        # an empty message whose body is ALL attachment
+        wire = lp.hulu_pack_request(
+            Meta(service="svc", method="echo"), b"", 3, attachment=b"ATT"
+        )
+        frame, _ = lp.hulu_try_parse(wire)
+        assert frame.payload == b"" and frame.attachment == b"ATT"
+        wire = lp.hulu_pack_response(None, b"", 3, attachment=b"RSP")
+        frame, _ = lp.hulu_try_parse(wire)
+        assert frame.is_response
+        assert frame.payload == b"" and frame.attachment == b"RSP"
+
+    def test_service_required(self):
+        with pytest.raises(ValueError):
+            lp.hulu_pack_request(Meta(service="", method="m"), b"x", 1)
+
+    def test_method_name_only_still_a_request(self):
+        # classification keys on service_name OR method_name presence
+        mb = lp._hulu_request_meta(
+            Meta(service="", method="echo"), 5, 0, None
+        )
+        frame, _ = lp.hulu_try_parse(lp._hulu_frame(mb, b"p"))
+        assert not frame.is_response and frame.meta.method == "echo"
+
+
+class TestFifoSocketPartition:
+    def test_mixed_fifo_channels_get_separate_sockets(self, echo_server):
+        # two fifo protocols to ONE endpoint must not share a socket: the
+        # response framing would be undecodable (esp has no magic)
+        from incubator_brpc_tpu.rpc.channel import _client_socket_map
+
+        port = echo_server.port
+        for proto in ("nova_pbrpc", "esp"):
+            ch = Channel()
+            assert ch.init(
+                f"127.0.0.1:{port}",
+                options=ChannelOptions(protocol=proto, timeout_ms=500),
+            )
+            # calls fail (the tbus server speaks neither) — the sockets
+            # are what we are probing
+            _ = ch.call_method("svc", "echo", b"x")
+        keys = [
+            k for k in _client_socket_map._map
+            if k.endswith("fifo-nova_pbrpc") or k.endswith("fifo-esp")
+        ]
+        assert len({k.rsplit("|", 1)[1] for k in keys}) == 2, keys
+
+
+class TestNovaSnappy:
+    def test_compressed_request_decompressed_by_adaptor(self):
+        from incubator_brpc_tpu.protocol import compress as compress_mod
+        from incubator_brpc_tpu.rpc import Controller
+
+        if not compress_mod.has_codec("snappy"):
+            pytest.skip("snappy codec not present in this environment")
+
+        srv = Server(
+            ServerOptions(
+                usercode_inline=True,
+                nshead_service=lp.NovaServiceAdaptor,
+            )
+        )
+        srv.add_service("svc", {"echo": lambda cntl, req: req})
+        assert srv.start(0)
+        try:
+            ch = Channel()
+            assert ch.init(
+                f"127.0.0.1:{srv.port}",
+                options=ChannelOptions(protocol="nova_pbrpc",
+                                       timeout_ms=5000),
+            )
+            cntl = Controller(timeout_ms=5000)
+            cntl.compress_type = "snappy"
+            out = ch.call_method("svc", "echo", b"N" * 2048, cntl=cntl)
+            assert out.ok(), out.error_text
+            # adaptor decompressed: the echo returns the ORIGINAL bytes
+            assert out.response_payload == b"N" * 2048
+        finally:
+            srv.stop()
