@@ -172,8 +172,21 @@ class Stream:
             if blocked and self._wbutex.wait(seq, timeout=remaining) == ETIMEDOUT:
                 return ErrorCode.EAGAIN
         meta = Meta(stream_id=rid, extra={"ft": FT_DATA, "from": self.id})
-        # IOBuf pack: no body/frame concat copies on the data hot path
-        rc = sock.write(pack_frame_iobuf(meta, data, 0, flags=FLAG_STREAM))
+        # IOBuf pack: no body/frame concat copies on the data hot path.
+        # drain_inline: this thread is blocking-capable (it just passed the
+        # credit window), so it drives the kernel-buffer drain itself —
+        # no KeepWrite fiber + reactor wakeup relay per buffer-full cycle.
+        # The drain gets the REMAINING budget (the window wait above may
+        # have consumed most of ``timeout``), and its expiry only falls
+        # back to the KeepWrite fiber — the frame is still sent.
+        drain_budget = None
+        if deadline is not None:
+            drain_budget = max(0.0, deadline - _time.monotonic())
+        rc = sock.write(
+            pack_frame_iobuf(meta, data, 0, flags=FLAG_STREAM),
+            timeout=drain_budget,
+            drain_inline=True,
+        )
         if rc == ErrorCode.EOVERCROWDED:
             # transient socket backpressure (socket.cpp:1537): surface it,
             # don't kill the stream; the rollback reopens the window so any

@@ -27,6 +27,7 @@ import logging
 import os
 import socket as _pysocket
 import threading
+from time import monotonic as _monotonic
 from collections import deque
 from typing import Callable, Dict, List, Optional, Union
 
@@ -312,12 +313,20 @@ class Socket:
         data: Union[bytes, IOBuf],
         on_error: Optional[Callable[[int, str], None]] = None,
         timeout: Optional[float] = None,
+        drain_inline: bool = False,
     ) -> int:
         """Queue data; returns 0 or an ErrorCode. Never blocks the caller
         beyond one nonblocking writev (the StartWrite inline attempt) —
         ``timeout`` is accepted for write-path interface parity (the device
         transport's send can block on its window; this one backpressures
-        via EOVERCROWDED instead)."""
+        via EOVERCROWDED instead).
+
+        ``drain_inline=True`` opts a blocking-capable caller (a stream
+        writer already gated by its credit window) into driving the drain
+        on THIS thread with poll(POLLOUT) when the kernel buffer fills —
+        the write-side analog of the caller-driven sync read: no KeepWrite
+        fiber spawn, no reactor wakeup relay per buffer-full cycle. Falls
+        back to the KeepWrite fiber if ``timeout`` elapses."""
         if self.state != CONNECTED:
             return ErrorCode.EFAILEDSOCKET
         if isinstance(data, (bytes, bytearray, memoryview)):
@@ -340,8 +349,60 @@ class Socket:
             epoch = self._wepoch
         # we are the drainer: one inline nonblocking attempt, then hand off
         if not self._drain_once(epoch):
-            self._pool.spawn(self._keep_write, epoch)
+            if not (drain_inline and self._drain_polling(epoch, timeout, req)):
+                self._pool.spawn(self._keep_write, epoch)
         return 0
+
+    def _drain_polling(
+        self, epoch: int, timeout: Optional[float], req: "WriteRequest"
+    ) -> bool:
+        """Caller-driven KeepWrite: poll POLLOUT on the calling thread and
+        drain until the queue empties (True: drainer-ship released) — or
+        until ``timeout`` elapses / the CALLER's request has flushed while
+        contenders keep the queue non-empty (False: the caller spawns the
+        KeepWrite fiber, which keeps single-drainer discipline — this
+        thread must not be conscripted into draining other writers'
+        frames forever)."""
+        import select as _select
+
+        deadline = (
+            None if timeout is None else _monotonic() + timeout
+        )
+        poller = _select.poll()
+        registered = False
+        try:
+            while True:
+                if len(req.buf) == 0 or (
+                    deadline is not None and _monotonic() >= deadline
+                ):
+                    return False  # our frame flushed, or out of budget
+                if not self._acquire_io():
+                    # socket failed: set_failed's epoch bump makes the next
+                    # _drain_once release drainer-ship
+                    return self._drain_once(epoch)
+                try:
+                    if not registered:
+                        poller.register(self.fd, _select.POLLOUT)
+                        registered = True
+                    # bounded poll: re-check state/epoch every round so a
+                    # concurrent set_failed can't strand this thread, and
+                    # never overshoot a nearer deadline
+                    wait_ms = 100
+                    if deadline is not None:
+                        wait_ms = max(
+                            0, min(100, int((deadline - _monotonic()) * 1000))
+                        )
+                    poller.poll(wait_ms)
+                finally:
+                    self._release_io()
+                if self._drain_once(epoch):
+                    return True
+        finally:
+            if registered:
+                try:
+                    poller.unregister(self.fd)
+                except (KeyError, OSError):
+                    pass
 
     # -- fd I/O refs (deferred close) --------------------------------------
 

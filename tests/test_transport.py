@@ -443,3 +443,53 @@ class TestOversizedHeaderRejected:
         finally:
             srv.stop()
             srv.join(timeout=5)
+
+
+def test_drain_inline_slow_reader_and_contenders(echo_server):
+    """drain_inline=True (the stream writer's caller-driven KeepWrite):
+    the calling thread polls POLLOUT itself past a full kernel buffer, and
+    frames queued by contenders while it holds drainer-ship still flush in
+    order."""
+    c = _Client(f"{LOOP}:{echo_server.port}")
+    try:
+        # large enough to overrun the loopback sndbuf several times
+        big = b"D" * (8 << 20)
+        data = pack_frame(Meta(service="e", method="e"), big, 7001)
+        contender = pack_frame(Meta(service="e", method="e"), b"tail", 7002)
+        rcs = []
+
+        def contend():
+            rcs.append(c.sock.write(contender))
+
+        t = threading.Thread(target=contend)
+        rc = None
+
+        def drive():
+            nonlocal rc
+            t.start()  # contender races the inline drainer
+            rc = c.sock.write(data, timeout=30, drain_inline=True)
+
+        d = threading.Thread(target=drive)
+        d.start()
+        d.join(30)
+        t.join(10)
+        assert rc == 0 and rcs == [0]
+        assert c.wait(7001, timeout=30.0).payload == big
+        assert c.wait(7002, timeout=30.0).payload == b"tail"
+    finally:
+        c.sock.recycle()
+
+
+def test_drain_inline_timeout_falls_back_to_keepwrite(echo_server):
+    """When the inline drainer's timeout elapses with bytes still queued,
+    it must hand off to the KeepWrite fiber — the frame still arrives."""
+    c = _Client(f"{LOOP}:{echo_server.port}")
+    try:
+        big = b"F" * (8 << 20)
+        data = pack_frame(Meta(service="e", method="e"), big, 7003)
+        # timeout=0 expires immediately: the poll loop gives up on round one
+        rc = c.sock.write(data, timeout=0, drain_inline=True)
+        assert rc == 0
+        assert c.wait(7003, timeout=30.0).payload == big
+    finally:
+        c.sock.recycle()
