@@ -366,6 +366,7 @@ class LoadBalancerWithNaming:
         ns_thread=None,
         server_filter=None,
         key_tag: str = "",
+        conn_kwargs=None,
     ):
         """Either ``url`` (owns a fresh NamingServiceThread) or ``ns_thread``
         (shared, not stopped by us — how PartitionChannel feeds N filtered
@@ -382,6 +383,8 @@ class LoadBalancerWithNaming:
             self._owns_ns = True
         self._server_filter = server_filter
         self._key_tag = key_tag
+        # extra Socket.connect kwargs for every target (TLS contexts)
+        self._conn_kwargs = dict(conn_kwargs) if conn_kwargs else {}
         if socket_map is None:
             from incubator_brpc_tpu.transport.socket_map import global_socket_map
 
@@ -425,7 +428,9 @@ class LoadBalancerWithNaming:
             if ep is None:
                 return None
             try:
-                sock = self._socket_map.get_or_create(ep, key_tag=self._key_tag)
+                sock = self._socket_map.get_or_create(
+                    ep, key_tag=self._key_tag, **self._conn_kwargs
+                )
             except OSError:
                 # select() already charged this pick (LA in-flight): settle it
                 self.lb.feedback(ep, 0.0, ErrorCode.EFAILEDSOCKET)

@@ -112,6 +112,8 @@ class ChannelOptions:
         link_slot_words: int = 16384,
         link_window: int = 4,
         native_plane: bool = False,
+        ssl_context=None,
+        ssl_server_hostname=None,
     ):
         self.timeout_ms = timeout_ms
         self.max_retry = max_retry
@@ -142,6 +144,12 @@ class ChannelOptions:
         # need Python-plane features (streams, backup, auth, compression,
         # LB targets) silently use the regular path.
         self.native_plane = native_plane
+        # ssl.SSLContext for TLS to the server(s) (reference
+        # ChannelOptions.ssl_options). TLS sockets pump ciphertext through
+        # the same reactor; the native fast path is skipped (no TLS stack
+        # in src/tbnet).
+        self.ssl_context = ssl_context
+        self.ssl_server_hostname = ssl_server_hostname
 
 
 class Channel:
@@ -188,6 +196,7 @@ class Channel:
                 lb_name or "rr",
                 socket_map=self._socket_map,
                 key_tag=self._auth_key_tag(),
+                conn_kwargs=self._conn_kwargs(),
             )
             if not self._lb.start():
                 return False
@@ -389,6 +398,7 @@ class Channel:
             self._single_server is not None
             and not self._single_server.ip.startswith("unix://")
             and self._options.transport == "tcp"
+            and self._options.ssl_context is None
             and self._options.protocol == "tbus_std"
             and self._options.auth is None
             and self._options.connection_type in ("single", "pooled")
@@ -522,7 +532,23 @@ class Channel:
                 proto_name
             ).fifo_responses:
                 tag = f"{tag}|fifo-{proto_name}"
+        if self._options.ssl_context is not None:
+            # TLS and plaintext must never share a connection — and neither
+            # may two channels with DIFFERENT TLS configs (client certs,
+            # verification modes): the context's identity partitions too,
+            # like the reference SocketMapKey's ssl settings
+            tag = f"{tag}|ssl-{id(self._options.ssl_context):x}"
         return tag
+
+    def _conn_kwargs(self) -> dict:
+        """Extra Socket.connect kwargs every connection of this channel
+        needs (TLS today; the SocketMapKey's ssl slot, socket_map.h:35)."""
+        if self._options.ssl_context is None:
+            return {}
+        return {
+            "ssl_context": self._options.ssl_context,
+            "ssl_server_hostname": self._options.ssl_server_hostname,
+        }
 
     def _dispose_attempt_sock(self, kind: str, sock, reusable: bool = True) -> None:
         """One attempt's connection settles (Call::OnComplete disposition,
@@ -586,6 +612,7 @@ class Channel:
                     self._single_server,
                     timeout=self._options.connect_timeout,
                     key_tag=self._auth_key_tag(),
+                    **self._conn_kwargs(),
                 )
                 from incubator_brpc_tpu.transport.sock import CONNECTED
 
@@ -600,10 +627,13 @@ class Channel:
                     self._single_server,
                     timeout=self._options.connect_timeout,
                     key_tag=self._auth_key_tag(),
+                    **self._conn_kwargs(),
                 )
             else:  # short: fresh connection, closed at EndRPC
                 sock = self._socket_map.get_short(
-                    self._single_server, timeout=self._options.connect_timeout
+                    self._single_server,
+                    timeout=self._options.connect_timeout,
+                    **self._conn_kwargs(),
                 )
             # disposed together at EndRPC — a backup request keeps the
             # previous attempt's connection in flight, so NOTHING may be
@@ -625,10 +655,13 @@ class Channel:
                 ep,
                 timeout=self._options.connect_timeout,
                 key_tag=self._auth_key_tag(),
+                **self._conn_kwargs(),
             )
         else:  # short
             sec = self._socket_map.get_short(
-                ep, timeout=self._options.connect_timeout
+                ep,
+                timeout=self._options.connect_timeout,
+                **self._conn_kwargs(),
             )
         # LB feedback and retry exclusion track the secondary's id too
         reg = getattr(self._lb, "register_socket", None)

@@ -383,6 +383,19 @@ def _build_partition_channels(
     from incubator_brpc_tpu.lb import LoadBalancerWithNaming
     from incubator_brpc_tpu.rpc.channel import _client_socket_map
 
+    # sub-channel sockets must honor the caller's TLS config — the LB dials
+    # main sockets itself, so the context + the ssl-partitioned key tag
+    # have to reach it here (a Channel.init target gets this from
+    # _conn_kwargs/_auth_key_tag)
+    conn_kwargs: dict = {}
+    key_tag = ""
+    if options is not None and options.ssl_context is not None:
+        conn_kwargs = {
+            "ssl_context": options.ssl_context,
+            "ssl_server_hostname": options.ssl_server_hostname,
+        }
+        key_tag = f"|ssl-{id(options.ssl_context):x}"
+
     channels, lbs = [], []
     for part in range(partition_count):
         def _filter(ep, _part=part):
@@ -396,6 +409,8 @@ def _build_partition_channels(
             socket_map=_client_socket_map,
             ns_thread=ns_thread,
             server_filter=_filter,
+            key_tag=key_tag,
+            conn_kwargs=conn_kwargs,
         )
         ch = Channel()
         if not ch.init_with_lb(lb, options=options):

@@ -159,6 +159,7 @@ class ServerOptions:
         nshead_service=None,
         mongo_service_adaptor=None,
         rtmp_service=None,
+        ssl_context=None,
         native_plane: bool = False,
         native_loops: int = 2,
     ):
@@ -188,6 +189,11 @@ class ServerOptions:
         # protocol/rtmp.RtmpService — enables RTMP (publish/play relay)
         # on this server's port (reference ServerOptions.rtmp_service)
         self.rtmp_service = rtmp_service
+        # ssl.SSLContext with the server certificate loaded — every
+        # accepted connection speaks TLS (reference ServerOptions.ssl_options,
+        # details/ssl_helper.cpp). Mutually exclusive with native_plane:
+        # the C++ reactor has no TLS stack.
+        self.ssl_context = ssl_context
         # Run request processing (cut + handler) inline on the reactor
         # thread instead of a pool fiber — removes two thread handoffs per
         # request, the analog of the reference running user code directly
@@ -295,7 +301,11 @@ class Server:
                 ),
             )
         use_native = (
-            self.options.native_plane and not ep.ip.startswith("unix://")
+            self.options.native_plane
+            and not ep.ip.startswith("unix://")
+            # the C++ reactor has no TLS stack: TLS ports stay on the
+            # Python plane
+            and self.options.ssl_context is None
         )
         if use_native:
             from incubator_brpc_tpu.transport import native_plane as np_mod
@@ -314,6 +324,7 @@ class Server:
                 messenger=self._messenger,
                 conn_context={"server": self},
                 inline_read=self.options.usercode_inline,
+                ssl_context=self.options.ssl_context,
             )
             self.listen_endpoint = self._acceptor.endpoint
         self._stopping = False

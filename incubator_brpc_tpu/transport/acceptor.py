@@ -32,8 +32,12 @@ class Acceptor:
         conn_context: Optional[dict] = None,
         backlog: int = 128,
         inline_read: bool = False,
+        ssl_context=None,
     ):
         self._messenger = messenger
+        # server-side TLS: every accepted socket wraps with this context
+        # and pumps its handshake from the reactor (ServerOptions.ssl)
+        self._ssl_context = ssl_context
         self._user_message_handler = user_message_handler
         self._on_connection = on_connection
         self._inline_read = inline_read
@@ -125,6 +129,8 @@ class Acceptor:
                     user_message_handler=self._user_message_handler,
                     context=self._conn_context,
                     inline_read=self._inline_read,
+                    ssl_context=self._ssl_context,
+                    ssl_server_side=self._ssl_context is not None,
                 )
                 with self._conn_lock:
                     self._connections[sock.id] = sock

@@ -199,8 +199,27 @@ class Socket:
         context: Optional[Dict] = None,
         inline_read: bool = False,
         preread: bytes = b"",
+        ssl_context=None,
+        ssl_server_side: bool = False,
+        ssl_server_hostname: Optional[str] = None,
     ):
         _ensure_rate_vars()
+        # TLS rides ssl.MemoryBIO + SSLObject pumped by this socket's own
+        # read/write machinery (the reference's SSLHandshake/ssl_helper
+        # shape, socket.cpp:1880): ciphertext on the fd, plaintext in
+        # _read_buf, so the messenger/protocols never know. Client sockets
+        # handshake synchronously here (connect already blocks a fiber);
+        # server sockets pump the handshake from the reactor read path.
+        self._ssl_context = ssl_context
+        self._ssl_server_side = ssl_server_side
+        self._ssl_server_hostname = ssl_server_hostname
+        self._sslobj = None
+        self._ssl_done = False
+        if ssl_context is not None:
+            self._ssl_lock = threading.Lock()
+            self._ssl_init()
+            if not ssl_server_side:
+                self._ssl_blocking_handshake(conn)
         conn.setblocking(False)
         # NOTE: no explicit SO_RCVBUF/SO_SNDBUF — setting them disables
         # kernel autotuning and is silently clamped to rmem_max/wmem_max,
@@ -279,6 +298,141 @@ class Socket:
             if claimed:
                 self._pool.spawn(self._process_event)
 
+    # -- TLS ----------------------------------------------------------------
+
+    def _ssl_init(self) -> None:
+        """Fresh BIO pair + SSLObject (also on reconnect: TLS state never
+        survives a new TCP connection)."""
+        import ssl as _ssl  # stdlib; imported lazily to keep startup lean
+
+        self._in_bio = _ssl.MemoryBIO()
+        self._out_bio = _ssl.MemoryBIO()
+        self._sslobj = self._ssl_context.wrap_bio(
+            self._in_bio,
+            self._out_bio,
+            server_side=self._ssl_server_side,
+            server_hostname=self._ssl_server_hostname,
+        )
+        self._ssl_done = False
+
+    def _ssl_blocking_handshake(self, conn: _pysocket.socket) -> None:
+        """Client handshake on the still-blocking dial socket (connect
+        blocks a fiber, never a reactor — bthread_connect discipline)."""
+        import ssl as _ssl
+
+        try:
+            while True:
+                try:
+                    self._sslobj.do_handshake()
+                    break
+                except _ssl.SSLWantReadError:
+                    pending = self._out_bio.read()
+                    if pending:
+                        conn.sendall(pending)
+                    data = conn.recv(65536)
+                    if not data:
+                        raise ConnectionError(
+                            "TLS handshake: peer closed"
+                        )
+                    self._in_bio.write(data)
+            pending = self._out_bio.read()
+            if pending:
+                conn.sendall(pending)  # our Finished record
+            self._ssl_done = True
+        except (OSError, _ssl.SSLError):
+            try:
+                conn.close()
+            except OSError:
+                pass
+            raise
+
+    def _flush_ssl_out(self) -> None:
+        """Queue whatever ciphertext the SSLObject produced (handshake
+        records, KeyUpdate responses). force: TLS control records already
+        advanced the session state and can never be dropped — and they are
+        small, so bypassing the EOVERCROWDED gate is bounded."""
+        data = self._out_bio.read()
+        if not data:
+            return
+        buf = IOBuf()
+        buf.append(data)
+        rc, epoch, req = self._enqueue(buf, None, force=True)
+        if rc == 0 and epoch is not None:
+            self._drive_drain(epoch, req, None, False)
+
+    def _ssl_read_pump(self) -> bool:
+        """SSL read path: ciphertext fd → in_bio → handshake pump and/or
+        plaintext into _read_buf → messenger. Returns False if the socket
+        died (already failed)."""
+        import ssl as _ssl
+
+        eof = False
+        while True:
+            try:
+                data = self._conn.recv(65536)
+            except (BlockingIOError, _ssl.SSLWantReadError):
+                break
+            except InterruptedError:
+                continue
+            except OSError as e:
+                self.set_failed(
+                    ErrorCode.EFAILEDSOCKET, f"ssl read failed: {e}"
+                )
+                return False
+            if not data:
+                eof = True
+                break
+            in_bytes << len(data)
+            self._in_bio.write(data)
+        with self._ssl_lock:
+            if not self._ssl_done:
+                try:
+                    self._sslobj.do_handshake()
+                    self._ssl_done = True
+                except _ssl.SSLWantReadError:
+                    pass
+                except _ssl.SSLError as e:
+                    self._flush_ssl_out()  # alert, best effort
+                    self.set_failed(
+                        ErrorCode.EFAILEDSOCKET, f"TLS handshake failed: {e}"
+                    )
+                    return False
+                self._flush_ssl_out()
+                if not self._ssl_done:
+                    if eof:
+                        self.set_failed(
+                            ErrorCode.EEOF, "peer closed mid-handshake"
+                        )
+                        return False
+                    return True
+            while True:
+                try:
+                    pt = self._sslobj.read(65536)
+                except _ssl.SSLWantReadError:
+                    break
+                except _ssl.SSLZeroReturnError:
+                    eof = True
+                    break
+                except _ssl.SSLError as e:
+                    self.set_failed(
+                        ErrorCode.EFAILEDSOCKET, f"TLS record error: {e}"
+                    )
+                    return False
+                if not pt:
+                    eof = True
+                    break
+                self._read_buf.append(pt)
+            # a TLS 1.3 KeyUpdate response produced during the read loop
+            # sits in out_bio; on a read-mostly connection no app write
+            # would ever flush it
+            self._flush_ssl_out()
+        if self.messenger is not None and len(self._read_buf):
+            self.messenger.process(self)
+        if eof:
+            self.set_failed(ErrorCode.EEOF, "remote closed connection")
+            return False
+        return True
+
     # -- construction -------------------------------------------------------
 
     @classmethod
@@ -334,24 +488,93 @@ class Socket:
             buf.append(bytes(data))
         else:
             buf = data
+        if self._sslobj is not None:
+            # Encrypt-and-ENQUEUE atomically (two writers' TLS records must
+            # hit the queue in SSLObject order or the peer's record layer
+            # desyncs) — but DRAIN outside the ssl lock: the inline drain
+            # can block on poll(POLLOUT), and the reactor needs this lock
+            # in _ssl_read_pump. Backpressure is checked BEFORE encrypting:
+            # a record that passed the SSLObject has advanced the sequence
+            # number and can never be dropped.
+            import ssl as _ssl
+
+            with self._ssl_lock:
+                if not self._ssl_done:
+                    return ErrorCode.EFAILEDSOCKET  # handshake incomplete
+                with self._wlock:
+                    over = self._unwritten + len(buf) > int(
+                        get_flag("socket_max_unwritten_bytes")
+                    )
+                if over:
+                    return ErrorCode.EOVERCROWDED
+                try:
+                    self._sslobj.write(buf.to_bytes())
+                except _ssl.SSLError as e:
+                    self.set_failed(
+                        ErrorCode.EFAILEDSOCKET, f"TLS write failed: {e}"
+                    )
+                    return ErrorCode.EFAILEDSOCKET
+                cipher = IOBuf()
+                cipher.append(self._out_bio.read())
+                # force: the budget was charged against the plaintext above;
+                # TLS record overhead must not flip the verdict post-encrypt
+                rc, epoch, req = self._enqueue(cipher, on_error, force=True)
+            if rc == 0 and epoch is not None:
+                self._drive_drain(epoch, req, timeout, drain_inline)
+            return rc
+        return self._write_queued(buf, on_error, timeout, drain_inline)
+
+    def _write_queued(
+        self,
+        buf: IOBuf,
+        on_error: Optional[Callable[[int, str], None]],
+        timeout: Optional[float],
+        drain_inline: bool,
+    ) -> int:
+        """The raw enqueue + single-drainer path (StartWrite proper);
+        ``write`` is the encrypting front door."""
+        rc, epoch, req = self._enqueue(buf, on_error)
+        if rc == 0 and epoch is not None:
+            self._drive_drain(epoch, req, timeout, drain_inline)
+        return rc
+
+    def _enqueue(
+        self,
+        buf: IOBuf,
+        on_error: Optional[Callable[[int, str], None]],
+        force: bool = False,
+    ):
+        """Queue one request. Returns (rc, epoch_or_None, req): a non-None
+        epoch means the caller became the drainer and must _drive_drain.
+        ``force`` skips the EOVERCROWDED gate (TLS control records that
+        can no longer be dropped)."""
         n = len(buf)
         if n == 0:
-            return 0  # nothing to send; never enqueue an empty request
+            return 0, None, None  # never enqueue an empty request
         req = WriteRequest(buf, on_error)
         with self._wlock:
-            if self._unwritten + n > int(get_flag("socket_max_unwritten_bytes")):
-                return ErrorCode.EOVERCROWDED
+            if not force and self._unwritten + n > int(
+                get_flag("socket_max_unwritten_bytes")
+            ):
+                return ErrorCode.EOVERCROWDED, None, None
             self._wqueue.append(req)
             self._unwritten += n
             if self._writing:
-                return 0  # contender: the active drainer will pick it up
+                return 0, None, req  # contender: active drainer picks it up
             self._writing = True
-            epoch = self._wepoch
-        # we are the drainer: one inline nonblocking attempt, then hand off
+            return 0, self._wepoch, req
+
+    def _drive_drain(
+        self,
+        epoch: int,
+        req: "WriteRequest",
+        timeout: Optional[float],
+        drain_inline: bool,
+    ) -> None:
+        # one inline nonblocking attempt, then hand off (or drive inline)
         if not self._drain_once(epoch):
             if not (drain_inline and self._drain_polling(epoch, timeout, req)):
                 self._pool.spawn(self._keep_write, epoch)
-        return 0
 
     def _drain_polling(
         self, epoch: int, timeout: Optional[float], req: "WriteRequest"
@@ -528,6 +751,8 @@ class Socket:
         """Drain the fd to EAGAIN into the read IOBuf and run the messenger
         cut loop. Caller holds an io ref AND read ownership. Returns False
         if the socket died (EOF / read error) — it is already failed."""
+        if self._sslobj is not None:
+            return self._ssl_read_pump()
         eof = False
         # must equal what one native readv can actually deliver: a
         # larger ask would make every full read look "short" and kill
@@ -742,18 +967,30 @@ class Socket:
             self._reconnecting = True
         try:
             conn = _dial(self.remote, timeout=timeout)
-        except OSError:
+            if self._ssl_context is not None:
+                self._ssl_rewrap(conn)
+        except OSError:  # ssl.SSLError and ConnectionError both subclass it
             return False
         finally:
             with self._state_lock:
                 self._reconnecting = False
         return self._revive(conn)
 
+    def _ssl_rewrap(self, conn: _pysocket.socket) -> None:
+        """A reconnected TLS client starts a fresh session: new SSLObject,
+        blocking handshake on the dial socket (closes it on failure). The
+        ssl lock keeps a concurrent writer off the half-replaced state."""
+        with self._ssl_lock:
+            self._ssl_init()
+            self._ssl_blocking_handshake(conn)
+
     def _health_probe(self) -> None:
         if self.state != FAILED:
             return  # recycled or already revived: stop probing
         try:
             conn = _dial(self.remote, timeout=2.0)
+            if self._ssl_context is not None:
+                self._ssl_rewrap(conn)
         except OSError:
             self._schedule_health_check()
             return

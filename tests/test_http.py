@@ -105,9 +105,19 @@ class TestPortal:
         assert ch.init(f"127.0.0.1:{portal_server.port}")
         for _ in range(5):
             assert ch.call_method("demo", "echo", b"y").ok()
-        status, _, body = fetch(portal_server, "/status")
-        assert status == 200
-        text = body.decode()
+        # the server's on_responded accounting runs after the response
+        # write, so the client can observe its 5th reply a beat before the
+        # count does: poll briefly instead of racing it
+        import time as _time
+
+        text = ""
+        for _ in range(50):
+            status, _, body = fetch(portal_server, "/status")
+            assert status == 200
+            text = body.decode()
+            if "count=5" in text:
+                break
+            _time.sleep(0.02)
         assert "demo.echo" in text
         assert "count=5" in text
 
