@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""tls_echo — encrypted echo (reference example/http_c++ ssl options /
+ChannelOptions.ssl_options): the server encrypts every accepted
+connection; the client verifies the server certificate. The demo certs
+live next to this file (like the reference example ships cert.pem).
+
+Run:  python examples/tls_echo.py
+"""
+
+import pathlib
+import ssl
+import sys
+
+sys.path.insert(0, ".")
+
+from incubator_brpc_tpu.rpc import (  # noqa: E402
+    Channel,
+    ChannelOptions,
+    Server,
+    ServerOptions,
+)
+
+HERE = pathlib.Path(__file__).parent
+
+
+def main() -> None:
+    server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    server_ctx.load_cert_chain(HERE / "cert.pem", HERE / "key.pem")
+    server = Server(ServerOptions(ssl_context=server_ctx))
+    server.add_service("EchoService", {"Echo": lambda cntl, req: req})
+    assert server.start(0)
+    print(f"TLS EchoServer on 127.0.0.1:{server.port}")
+
+    client_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    client_ctx.load_verify_locations(HERE / "cert.pem")
+    client_ctx.check_hostname = False  # demo cert is CN=localhost, target is the IP
+    client_ctx.verify_mode = ssl.CERT_REQUIRED
+    ch = Channel()
+    assert ch.init(
+        f"127.0.0.1:{server.port}",
+        options=ChannelOptions(ssl_context=client_ctx),
+    )
+    cntl = ch.call_method("EchoService", "Echo", b"over-tls")
+    assert cntl.ok(), cntl.error_text
+    print(f"response={cntl.response_payload!r} "
+          f"(cipher negotiated, cert verified)")
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()

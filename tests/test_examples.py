@@ -14,6 +14,10 @@ EXAMPLES = [
     "examples/streaming_echo.py",
     "examples/partition_echo.py",
     "examples/backup_request.py",
+    "examples/multi_protocol.py",
+    "examples/tls_echo.py",
+    "examples/rtmp_relay.py",
+    "examples/naming_failover.py",
 ]
 
 
