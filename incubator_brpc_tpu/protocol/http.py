@@ -16,8 +16,10 @@ Progressive responses: a handler returning an iterator of byte chunks
 streams Transfer-Encoding: chunked (the ProgressiveAttachment /
 ProgressiveReader analog, progressive_attachment.{h,cpp}); the client
 decoder in ``http_call`` understands chunked bodies. Chunked *request*
-bodies and HTTP/2 remain out of scope (the reference fork has HPACK
-tables but no h2 framing either — SURVEY §2.4).
+bodies are dechunked up to the messenger's 64 KiB cut window (larger
+uploads get a loud ParseError — use Content-Length or a stream). HTTP/2
+remains out of scope (the reference fork has HPACK tables but no h2
+framing either — SURVEY §2.4).
 """
 
 from __future__ import annotations
@@ -28,12 +30,23 @@ from typing import Dict, Optional, Tuple
 from urllib.parse import parse_qsl, urlsplit
 
 from incubator_brpc_tpu.protocol.registry import Protocol, protocol_registry
-from incubator_brpc_tpu.protocol.tbus_std import ParseError
+from incubator_brpc_tpu.protocol.tbus_std import FatalParseError, ParseError
 
 logger = logging.getLogger(__name__)
 
 _METHODS = (b"GET ", b"POST ", b"PUT ", b"DELETE ", b"HEAD ", b"OPTIONS ", b"PATCH ")
 _MAX_HEADER_BYTES = 64 * 1024
+# Chunked request bodies are sized inside the messenger's deep-peek window:
+# the oversize backstop only fires if that window actually reaches it, so
+# the bound is DERIVED from the messenger's cap, not declared independently
+# (decoupled constants would reintroduce the stall-forever failure mode).
+from incubator_brpc_tpu.transport.messenger import (  # noqa: E402
+    _MAX_HEADER_PEEK as _CHUNKED_WINDOW,
+)
+
+assert _MAX_HEADER_BYTES <= _CHUNKED_WINDOW, (
+    "http header cap must not exceed the messenger peek window"
+)
 
 
 class HttpFrame:
@@ -92,6 +105,18 @@ class HttpResponseFrame:
         return f"<HttpResponseFrame {self.status} {len(self.body)}B>"
 
 
+def _transfer_encoding(headers_blob: str) -> Optional[str]:
+    """The Transfer-Encoding value, lowercased/stripped, or None. A parsed
+    predicate — substring scans over the whole blob would false-positive
+    on 'chunked' in a URL, and header VALUES keep their original case
+    (transfer-coding names are case-insensitive, RFC 9112)."""
+    for line in headers_blob.split("\r\n"):
+        k, _, v = line.partition(":")
+        if k.strip().lower() == "transfer-encoding":
+            return v.strip().lower()
+    return None
+
+
 def _content_length(headers_blob: str) -> int:
     """Extract+validate Content-Length from a raw header block. ParseError
     on malformed or negative values (the InputMessenger contract: anything
@@ -105,6 +130,39 @@ def _content_length(headers_blob: str) -> int:
                 raise ParseError(f"bad Content-Length {v!r}")
             return int(v)
     return 0
+
+
+def _dechunk(data, off: int):
+    """Walk a chunked body from ``off``. Returns (body_bytes, end_offset)
+    or None while incomplete; ParseError on malformed framing. Trailer
+    headers after the terminal 0-chunk are skipped (RFC 9112 §7.1)."""
+    out = bytearray()
+    while True:
+        nl = data.find(b"\r\n", off)
+        if nl < 0:
+            return None
+        size_token = bytes(data[off:nl]).split(b";", 1)[0].strip()
+        try:
+            size = int(size_token, 16)
+        except ValueError:
+            raise ParseError(f"bad chunk size {size_token!r}")
+        if size < 0:
+            raise ParseError("negative chunk size")
+        off = nl + 2
+        if size == 0:
+            while True:  # trailers, then one empty line
+                nl2 = data.find(b"\r\n", off)
+                if nl2 < 0:
+                    return None
+                if nl2 == off:
+                    return bytes(out), off + 2
+                off = nl2 + 2
+        if off + size + 2 > len(data):
+            return None
+        out += data[off : off + size]
+        if bytes(data[off + size : off + size + 2]) != b"\r\n":
+            raise ParseError("chunk data not CRLF-terminated")
+        off += size + 2
 
 
 def parse_header(header: bytes) -> Optional[int]:
@@ -121,12 +179,32 @@ def parse_header(header: bytes) -> Optional[int]:
             raise ParseError("http header block too large")
         return None
     blob = header[:head_end].decode("latin-1", errors="replace")
-    if "chunked" in blob.lower() and "transfer-encoding" in blob.lower():
+    te = _transfer_encoding(blob)
+    if te is not None:
         if is_resp:
             # progressive/chunked responses belong to the blocking helper
             # or streams; the channel client speaks Content-Length
             raise ParseError("chunked responses not supported on channels")
-        raise ParseError("chunked request bodies not supported")
+        if te != "chunked":
+            # 'gzip, chunked' etc.: dechunking alone would hand handlers
+            # still-encoded bytes — refuse rather than corrupt. Fatal: the
+            # protocol matched, the frame is simply unacceptable.
+            raise FatalParseError(f"unsupported transfer-encoding {te!r}")
+        # chunked REQUEST: the frame ends at the terminal 0-chunk, so the
+        # size is only known once the whole body sits in the peek window.
+        # The messenger's deep re-peek bounds that window, which bounds
+        # supported chunked uploads — beyond it, fail loudly instead of
+        # stalling the connection forever.
+        done = _dechunk(header, head_end + 4)
+        if done is not None:
+            return done[1]
+        if len(header) >= _CHUNKED_WINDOW:
+            raise FatalParseError(
+                "chunked request body exceeds the "
+                f"{_CHUNKED_WINDOW >> 10} KiB cut window; use "
+                "Content-Length or a stream for larger uploads"
+            )
+        return None
     return head_end + 4 + _content_length(blob)
 
 
@@ -181,16 +259,29 @@ def parse(buf: bytes) -> Tuple[Optional[HttpFrame], int]:
         if ":" in line:
             k, v = line.split(":", 1)
             headers[k.strip().lower()] = v.strip()
-    if "chunked" in headers.get("transfer-encoding", ""):
-        raise ParseError("chunked request bodies not supported")
-    raw_len = headers.get("content-length", "0") or "0"
-    if not raw_len.isdigit():
-        raise ParseError(f"bad Content-Length {raw_len!r}")
-    body_len = int(raw_len)
-    total = head_end + 4 + body_len
-    if len(buf) < total:
-        return None, 0
-    body = bytes(buf[head_end + 4 : total])
+    te = headers.get("transfer-encoding")
+    if te is not None:
+        te = te.strip().lower()  # same predicate as parse_header: the two
+        # MUST size identically or the messenger sees a length mismatch
+        if te != "chunked":
+            raise FatalParseError(f"unsupported transfer-encoding {te!r}")
+        done = _dechunk(buf, head_end + 4)
+        if done is None:
+            if len(buf) >= _CHUNKED_WINDOW:
+                raise FatalParseError(
+                    "chunked request body exceeds the cut window"
+                )
+            return None, 0
+        body, total = done
+    else:
+        raw_len = headers.get("content-length", "0") or "0"
+        if not raw_len.isdigit():
+            raise ParseError(f"bad Content-Length {raw_len!r}")
+        body_len = int(raw_len)
+        total = head_end + 4 + body_len
+        if len(buf) < total:
+            return None, 0
+        body = bytes(buf[head_end + 4 : total])
     parts = urlsplit(target)
     query = dict(parse_qsl(parts.query, keep_blank_values=True))
     frame = HttpFrame(method.upper(), parts.path or "/", query, headers, body)
@@ -396,10 +487,16 @@ def pack_channel_request(
         # Content-Encoding or decompress on the server: reject loudly
         # rather than hand the handler gzip bytes it can't parse
         raise ValueError("compress_type is not supported on http channels")
-    host = (meta.extra or {}).get("http_host", "") if meta else ""
-    path = f"/{meta.service}/{meta.method}" if meta else "/"
+    extra = (meta.extra or {}) if meta else {}
+    host = extra.get("http_host", "")
+    # generic requests (tools/parallel_http, restful callers) can override
+    # the gateway's POST /<service>/<method> route via request extras
+    verb = str(extra.get("http_method", "POST")).upper()
+    path = str(extra.get("http_path", "")) or (
+        f"/{meta.service}/{meta.method}" if meta else "/"
+    )
     head = (
-        f"POST {path} HTTP/1.1\r\n"
+        f"{verb} {path} HTTP/1.1\r\n"
         f"Host: {host}\r\n"
         f"Content-Length: {len(payload)}\r\n"
         "Content-Type: application/octet-stream\r\n"

@@ -135,6 +135,16 @@ class InputMessenger:
                         deeper = buf.to_bytes(min(len(buf), _MAX_HEADER_PEEK))
                         if len(deeper) > len(header):
                             total = proto.parse_header(deeper)
+                except FatalParseError as e:
+                    # the protocol MATCHED but the frame is unacceptable
+                    # (oversized chunked upload, unsupported coding): fail
+                    # with the protocol's own diagnostic instead of the
+                    # generic try-others "unparsable bytes"
+                    self._dispatch(sock, cut)
+                    sock.set_failed(
+                        ErrorCode.EREQUEST, f"{proto.name}: {e}"
+                    )
+                    return
                 except ParseError:
                     continue
                 matched = proto

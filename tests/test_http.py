@@ -520,3 +520,106 @@ class TestVarsSeries:
         assert len(s["ages_s"]) == len(s["values"])
         # newest point is recent, ages ascend toward the past
         assert s["ages_s"][-1] <= s["ages_s"][0] + 1e-6 or len(s["ages_s"]) == 1
+
+
+class TestChunkedRequests:
+    """Chunked request bodies (RFC 9112 §7.1) dechunked up to the cut
+    window — the reference accepts them via http_parser; ours bounds them."""
+
+    def _post_chunked(self, port, path, chunks, trailers=b""):
+        import socket as pysock
+
+        body = b"".join(
+            b"%x\r\n%s\r\n" % (len(c), c) for c in chunks
+        ) + b"0\r\n" + trailers + b"\r\n"
+        req = (
+            f"POST {path} HTTP/1.1\r\n"
+            "Host: t\r\n"
+            "Transfer-Encoding: chunked\r\n"
+            "Connection: close\r\n\r\n"
+        ).encode() + body
+        conn = pysock.create_connection(("127.0.0.1", port), timeout=10)
+        conn.sendall(req)
+        resp = b""
+        while True:
+            data = conn.recv(65536)
+            if not data:
+                break
+            resp += data
+        conn.close()
+        return resp
+
+    def test_chunked_post_reassembles(self, portal_server):
+        resp = self._post_chunked(
+            portal_server.port, "/demo/echo", [b"hello ", b"chunked ", b"world"]
+        )
+        assert resp.startswith(b"HTTP/1.1 200")
+        assert b"hello chunked world" in resp
+
+    def test_chunked_with_trailers(self, portal_server):
+        resp = self._post_chunked(
+            portal_server.port, "/demo/echo", [b"tail"],
+            trailers=b"X-Checksum: abc\r\n",
+        )
+        assert resp.startswith(b"HTTP/1.1 200")
+        assert b"tail" in resp
+
+    def test_malformed_chunk_size_kills_connection(self, portal_server):
+        import socket as pysock
+
+        req = (
+            b"POST /demo/echo HTTP/1.1\r\nHost: t\r\n"
+            b"Transfer-Encoding: chunked\r\n\r\n"
+            b"ZZZ\r\nnope\r\n0\r\n\r\n"
+        )
+        conn = pysock.create_connection(
+            ("127.0.0.1", portal_server.port), timeout=10
+        )
+        conn.sendall(req)
+        resp = b""
+        while True:
+            data = conn.recv(65536)
+            if not data:
+                break
+            resp += data
+        conn.close()
+        assert resp == b""  # connection failed, no response
+
+    def test_oversized_chunked_body_rejected(self):
+        from incubator_brpc_tpu.protocol import http as http_mod
+
+        huge = b"x" * http_mod._MAX_HEADER_BYTES
+        head = (
+            b"POST /a/b HTTP/1.1\r\nHost: t\r\n"
+            b"Transfer-Encoding: chunked\r\n\r\n"
+        )
+        wire = head + b"%x\r\n" % len(huge) + huge  # no terminator yet
+        from incubator_brpc_tpu.protocol.tbus_std import FatalParseError
+
+        with pytest.raises(FatalParseError):
+            http_mod.parse_header(wire[: http_mod._CHUNKED_WINDOW])
+
+    def test_mixed_case_and_multi_codings(self):
+        from incubator_brpc_tpu.protocol.tbus_std import FatalParseError
+
+        # transfer-coding names are case-insensitive: both sizing paths
+        # must agree or the messenger sees a length mismatch
+        wire = (
+            b"POST /a/b HTTP/1.1\r\nHost: t\r\n"
+            b"Transfer-Encoding: Chunked\r\n\r\n"
+            b"3\r\nabc\r\n0\r\n\r\n"
+        )
+        total = http_mod.parse_header(wire)
+        frame, consumed = http_mod.parse(wire)
+        assert total == consumed == len(wire)
+        assert frame.body == b"abc"
+        # 'gzip, chunked' would hand handlers still-encoded bytes: refuse
+        bad = (
+            b"POST /a/b HTTP/1.1\r\nHost: t\r\n"
+            b"Transfer-Encoding: gzip, chunked\r\n\r\n"
+            b"3\r\nabc\r\n0\r\n\r\n"
+        )
+        with pytest.raises(FatalParseError):
+            http_mod.parse_header(bad)
+        with pytest.raises(FatalParseError):
+            http_mod.parse(bad)

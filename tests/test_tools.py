@@ -135,3 +135,24 @@ class TestView:
         assert view_main([path]) == 0
         out = capsys.readouterr().out
         assert "v.m" in out and "hello-view" in out and "1 samples" in out
+
+
+class TestParallelHttp:
+    def test_fetches_portal_urls_concurrently(self, echo_server):
+        from tools.parallel_http import fetch_all
+
+        server, _ = echo_server
+        port = server.port
+        urls = [
+            f"http://127.0.0.1:{port}/health",
+            f"http://127.0.0.1:{port}/version",
+            f"http://127.0.0.1:{port}/vars.json",
+            f"http://127.0.0.1:{port}/does-not-exist",
+        ]
+        results = fetch_all(urls, threads=4, timeout_ms=5000)
+        by_url = {r[0]: r for r in results}
+        assert by_url[urls[0]][1] == 200
+        assert by_url[urls[1]][1] == 200
+        assert by_url[urls[2]][1] == 200 and by_url[urls[2]][2] > 2
+        # a 404 is a completed fetch with an error status, not a crash
+        assert by_url[urls[3]][1] in (None, 404)
