@@ -85,7 +85,7 @@ def bench_rpc_echo(results: dict) -> None:
     )
 
     done = threading.Event()
-    total = 32 * 1024 * 1024
+    total = 64 * 1024 * 1024
     seen = [0]
 
     class Sink(StreamHandler):
@@ -95,7 +95,7 @@ def bench_rpc_echo(results: dict) -> None:
                 done.set()
 
     def open_stream(cntl, req):
-        stream_accept(cntl, StreamOptions(handler=Sink(), max_buf_size=8 << 20))
+        stream_accept(cntl, StreamOptions(handler=Sink(), max_buf_size=32 << 20))
         return b""
 
     # echo/stream handlers never block: run them inline on the reactors
@@ -151,7 +151,7 @@ def bench_rpc_echo(results: dict) -> None:
     for _ in range(3):
         seen[0] = 0
         done.clear()
-        s = stream_create(StreamOptions(max_buf_size=8 << 20))
+        s = stream_create(StreamOptions(max_buf_size=32 << 20))
         c = ch.call_method("bench_stream", "open", b"", request_stream=s)
         assert c.ok(), c.error_text
         connected = s.wait_connected(5)
