@@ -221,6 +221,7 @@ class Server:
         self.listen_endpoint: Optional[EndPoint] = None
         self._device_socks: list = []  # transport='tpu' links we accepted
         self._native_plane = None  # NativeServerPlane when options ask for it
+        self._reap_gen = 0  # idle-reaper chain generation (see _reap_idle)
 
     # -- registration --------------------------------------------------------
 
@@ -329,6 +330,14 @@ class Server:
             self.listen_endpoint = self._acceptor.endpoint
         self._stopping = False
         self._started = True
+        if self.options.idle_timeout_s > 0:
+            if self._acceptor is not None:
+                self._reap_gen += 1
+                self._schedule_idle_reap(self._reap_gen)
+            else:
+                logger.warning(
+                    "idle_timeout_s is not enforced on native-plane ports"
+                )
         if self.options.has_builtin_services:
             from incubator_brpc_tpu.builtin import portal
 
@@ -336,12 +345,44 @@ class Server:
         logger.info("server started on %s", self.listen_endpoint)
         return True
 
+    def _schedule_idle_reap(self, gen: int) -> None:
+        from incubator_brpc_tpu.runtime.timer_thread import global_timer_thread
+        from incubator_brpc_tpu.runtime.worker_pool import global_worker_pool
+
+        # scan at half the timeout so a connection is reaped at most 1.5x
+        # late (the reference's idle-connection reaper bthread,
+        # ServerOptions.idle_timeout_sec server.cpp StartInternal). The
+        # timer callback only spawns — set_failed does syscalls and runs
+        # user on_failed hooks, too heavy for the shared TimerThread.
+        delay = max(0.05, self.options.idle_timeout_s / 2)
+        global_timer_thread().schedule(
+            lambda: global_worker_pool().spawn(self._reap_idle, gen),
+            delay=delay,
+        )
+
+    def _reap_idle(self, gen: int) -> None:
+        import time as _time
+
+        # generation gate: a stop()+start() cycle must not leave the OLD
+        # chain alive alongside the new one
+        if self._stopping or gen != self._reap_gen or self._acceptor is None:
+            return
+        cutoff = _time.monotonic() - self.options.idle_timeout_s
+        for sock in self._acceptor.connections():
+            if sock.last_active < cutoff:
+                sock.set_failed(
+                    ErrorCode.ECLOSE,
+                    f"idle for > {self.options.idle_timeout_s}s",
+                )
+        self._schedule_idle_reap(gen)
+
     def stop(self) -> None:
         """Stop accepting + fail connections; in-flight handlers finish
         (Server::Stop then Join, server.cpp)."""
         if not self._started:
             return
         self._stopping = True
+        self._reap_gen += 1  # orphan any pending idle-reap chain
         if self._acceptor is not None:
             self._acceptor.stop()
         if self._native_plane is not None:

@@ -253,6 +253,9 @@ class Socket:
         self.inline_read = inline_read
         self.on_failed: List[Callable[["Socket"], None]] = []
         self.on_revived: List[Callable[["Socket"], None]] = []
+        # last wire activity (either direction) — the idle-connection
+        # reaper's clock (reference server.cpp idle_timeout_sec reaper)
+        self.last_active = _monotonic()
 
         self._read_buf = IOBuf()
         # bytes another plane already read off this fd (the native plane's
@@ -673,6 +676,7 @@ class Socket:
                 self._release_io()
             if rc > 0:
                 out_bytes << rc
+                self.last_active = _monotonic()
                 with self._wlock:
                     self._unwritten -= rc
                 if len(front.buf) == 0:
@@ -751,6 +755,7 @@ class Socket:
         """Drain the fd to EAGAIN into the read IOBuf and run the messenger
         cut loop. Caller holds an io ref AND read ownership. Returns False
         if the socket died (EOF / read error) — it is already failed."""
+        self.last_active = _monotonic()
         if self._sslobj is not None:
             return self._ssl_read_pump()
         eof = False

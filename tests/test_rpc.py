@@ -384,3 +384,54 @@ class TestSyncDeadlineWithoutTimer:
         finally:
             srv.stop()
             srv.join(timeout=5)
+
+
+class TestIdleReaper:
+    def test_idle_connection_reaped_and_client_recovers(self):
+        """ServerOptions.idle_timeout_s (the reference's idle-connection
+        reaper): a connection with no wire activity is closed; the next
+        call redials inline and succeeds."""
+        import time
+
+        from incubator_brpc_tpu.rpc import Server, ServerOptions
+
+        srv = Server(ServerOptions(idle_timeout_s=0.4))
+        srv.add_service("svc", {"echo": lambda cntl, req: req})
+        assert srv.start(0)
+        try:
+            ch = Channel()
+            assert ch.init(f"127.0.0.1:{srv.port}")
+            assert ch.call_method("svc", "echo", b"one").ok()
+            assert len(srv._acceptor.connections()) == 1
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if not srv._acceptor.connections():
+                    break
+                time.sleep(0.05)
+            assert not srv._acceptor.connections(), "idle conn not reaped"
+            # the client's socket was closed by the server; the next call
+            # reconnects (connect_if_not) and succeeds
+            c = ch.call_method("svc", "echo", b"two")
+            assert c.ok(), c.error_text
+            assert c.response_payload == b"two"
+        finally:
+            srv.stop()
+
+    def test_active_connection_not_reaped(self):
+        import time
+
+        from incubator_brpc_tpu.rpc import Server, ServerOptions
+
+        srv = Server(ServerOptions(idle_timeout_s=0.6))
+        srv.add_service("svc", {"echo": lambda cntl, req: req})
+        assert srv.start(0)
+        try:
+            ch = Channel()
+            assert ch.init(f"127.0.0.1:{srv.port}")
+            end = time.monotonic() + 1.8  # 3x the timeout, kept busy
+            while time.monotonic() < end:
+                assert ch.call_method("svc", "echo", b"k").ok()
+                time.sleep(0.2)
+            assert len(srv._acceptor.connections()) == 1
+        finally:
+            srv.stop()
