@@ -221,7 +221,6 @@ class Server:
         self.listen_endpoint: Optional[EndPoint] = None
         self._device_socks: list = []  # transport='tpu' links we accepted
         self._native_plane = None  # NativeServerPlane when options ask for it
-        self._reap_gen = 0  # idle-reaper chain generation (see _reap_idle)
 
     # -- registration --------------------------------------------------------
 
@@ -332,8 +331,7 @@ class Server:
         self._started = True
         if self.options.idle_timeout_s > 0:
             if self._acceptor is not None:
-                self._reap_gen += 1
-                self._schedule_idle_reap(self._reap_gen)
+                self._schedule_idle_reap()
             else:
                 logger.warning(
                     "idle_timeout_s is not enforced on native-plane ports"
@@ -345,27 +343,32 @@ class Server:
         logger.info("server started on %s", self.listen_endpoint)
         return True
 
-    def _schedule_idle_reap(self, gen: int) -> None:
+    def _schedule_idle_reap(self) -> None:
         from incubator_brpc_tpu.runtime.timer_thread import global_timer_thread
         from incubator_brpc_tpu.runtime.worker_pool import global_worker_pool
 
         # scan at half the timeout so a connection is reaped at most 1.5x
         # late (the reference's idle-connection reaper bthread,
-        # ServerOptions.idle_timeout_sec server.cpp StartInternal). The
-        # timer callback only spawns — set_failed does syscalls and runs
-        # user on_failed hooks, too heavy for the shared TimerThread.
+        # Acceptor::CloseIdleConnections acceptor.cpp:111 /
+        # Socket::ReleaseReferenceIfIdle socket.cpp:887). The timer
+        # callback only spawns — set_failed does syscalls and runs user
+        # on_failed hooks, too heavy for the shared TimerThread.
         delay = max(0.05, self.options.idle_timeout_s / 2)
         global_timer_thread().schedule(
-            lambda: global_worker_pool().spawn(self._reap_idle, gen),
+            lambda: global_worker_pool().spawn(self._reap_idle),
             delay=delay,
         )
 
-    def _reap_idle(self, gen: int) -> None:
+    def _reap_idle(self) -> None:
         import time as _time
 
-        # generation gate: a stop()+start() cycle must not leave the OLD
-        # chain alive alongside the new one
-        if self._stopping or gen != self._reap_gen or self._acceptor is None:
+        # _stopping ends the chain; servers are not restartable (start()
+        # refuses a started server), so no stale-chain guard is needed.
+        # NOTE (parity): a reaped connection whose client health-checks
+        # (default on, flags health_check_interval) will be redialed and
+        # reaped again — the same cycle stock brpc has with its default-on
+        # client health checker; both knobs are the operator's tradeoff.
+        if self._stopping or self._acceptor is None:
             return
         cutoff = _time.monotonic() - self.options.idle_timeout_s
         for sock in self._acceptor.connections():
@@ -374,7 +377,7 @@ class Server:
                     ErrorCode.ECLOSE,
                     f"idle for > {self.options.idle_timeout_s}s",
                 )
-        self._schedule_idle_reap(gen)
+        self._schedule_idle_reap()
 
     def stop(self) -> None:
         """Stop accepting + fail connections; in-flight handlers finish
@@ -382,7 +385,6 @@ class Server:
         if not self._started:
             return
         self._stopping = True
-        self._reap_gen += 1  # orphan any pending idle-reap chain
         if self._acceptor is not None:
             self._acceptor.stop()
         if self._native_plane is not None:

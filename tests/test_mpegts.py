@@ -1,0 +1,192 @@
+"""MPEG-TS muxer (protocol/mpegts.py — reference ts.{h,cpp}): packet
+alignment/sync, PSI tables with MPEG CRC, PES timestamps, continuity
+counters, AVCC→Annex-B and AAC→ADTS conversion, and an FLV→TS pipe."""
+
+from __future__ import annotations
+
+import io
+import struct
+
+import pytest
+
+from incubator_brpc_tpu.protocol import mpegts as ts
+
+
+def _avc_seq_header(sps=b"\x67\x64\x00\x1e", pps=b"\x68\xee\x3c\x80"):
+    """FLV video tag: keyframe+AVC, packet type 0, cts 0, then the
+    AVCDecoderConfigurationRecord with one SPS and one PPS."""
+    record = (
+        b"\x01" + sps[1:4] + b"\xff"
+        + bytes([0xE0 | 1]) + struct.pack(">H", len(sps)) + sps
+        + bytes([1]) + struct.pack(">H", len(pps)) + pps
+    )
+    return b"\x17\x00\x00\x00\x00" + record
+
+
+def _avc_frame(key: bool, nal: bytes, cts: int = 0):
+    first = 0x17 if key else 0x27
+    return bytes([first, 1]) + cts.to_bytes(3, "big") + struct.pack(
+        ">I", len(nal)
+    ) + nal
+
+
+def _aac_seq_header(asc=b"\x12\x10"):  # AAC-LC 44.1kHz stereo
+    return b"\xaf\x00" + asc
+
+
+def _aac_frame(raw: bytes):
+    return b"\xaf\x01" + raw
+
+
+class TestPsi:
+    def test_crc32_mpeg_vector(self):
+        # classic check value for "123456789" under CRC-32/MPEG-2
+        assert ts.crc32_mpeg(b"123456789") == 0x0376E6E7
+
+    def test_pat_pmt_structure(self):
+        pat = ts.build_pat()
+        assert pat[0] == 0x00  # table id
+        assert ts.crc32_mpeg(pat[:-4]) == struct.unpack(">I", pat[-4:])[0]
+        # the single program maps to the PMT pid
+        program, pmt = struct.unpack_from(">HH", pat, 8)
+        assert program == 1 and (pmt & 0x1FFF) == ts.PID_PMT
+
+        pmt_sec = ts.build_pmt()
+        assert pmt_sec[0] == 0x02
+        assert ts.crc32_mpeg(pmt_sec[:-4]) == struct.unpack(
+            ">I", pmt_sec[-4:]
+        )[0]
+        assert bytes([ts.STREAM_TYPE_H264]) in pmt_sec
+        assert bytes([ts.STREAM_TYPE_AAC]) in pmt_sec
+
+
+class TestMux:
+    def _mux(self, writes):
+        out = io.BytesIO()
+        w = ts.TsWriter(out)
+        for kind, ts_ms, payload in writes:
+            (w.write_video if kind == "v" else w.write_audio)(ts_ms, payload)
+        return out.getvalue()
+
+    def test_packets_aligned_and_synced(self):
+        data = self._mux([
+            ("v", 0, _avc_seq_header()),
+            ("a", 0, _aac_seq_header()),
+            ("v", 0, _avc_frame(True, b"\x65" + b"k" * 1000)),
+            ("a", 23, _aac_frame(b"q" * 300)),
+            ("v", 40, _avc_frame(False, b"\x41" + b"p" * 5000)),
+        ])
+        assert len(data) % ts.TS_PACKET == 0
+        pkts = ts.demux_packets(data)
+        # first two packets are PAT then PMT
+        assert pkts[0][0] == ts.PID_PAT and pkts[0][1]
+        assert pkts[1][0] == ts.PID_PMT and pkts[1][1]
+        pids = {p for p, _, _, _ in pkts}
+        assert ts.PID_VIDEO in pids and ts.PID_AUDIO in pids
+
+    def test_continuity_counters_increment(self):
+        data = self._mux([
+            ("v", 0, _avc_seq_header()),
+            ("v", 0, _avc_frame(True, b"\x65" + b"x" * 2000)),
+            ("v", 40, _avc_frame(False, b"\x41" + b"y" * 2000)),
+        ])
+        ccs = [
+            cc for pid, _, cc, _ in ts.demux_packets(data)
+            if pid == ts.PID_VIDEO
+        ]
+        for a, b in zip(ccs, ccs[1:]):
+            assert b == (a + 1) & 0x0F
+
+    def test_keyframe_gets_sps_pps_annexb(self):
+        sps, pps = b"\x67\x64\x00\x1e", b"\x68\xee\x3c\x80"
+        data = self._mux([
+            ("v", 0, _avc_seq_header(sps, pps)),
+            ("v", 0, _avc_frame(True, b"\x65FRAME")),
+        ])
+        es = b"".join(
+            body for pid, _, _, body in ts.demux_packets(data)
+            if pid == ts.PID_VIDEO
+        )
+        assert b"\x00\x00\x00\x01" + sps in es
+        assert b"\x00\x00\x00\x01" + pps in es
+        assert b"\x00\x00\x00\x01\x65FRAME" in es
+        assert b"\x00\x00\x00\x01\x09" in es  # access unit delimiter
+
+    def test_pes_pts_dts_from_cts(self):
+        data = self._mux([
+            ("v", 0, _avc_seq_header()),
+            ("v", 100, _avc_frame(True, b"\x65z", cts=40)),
+        ])
+        es = b"".join(
+            body for pid, _, _, body in ts.demux_packets(data)
+            if pid == ts.PID_VIDEO
+        )
+        assert es[:4] == b"\x00\x00\x01\xe0"
+        flags, hlen = es[7], es[8]
+        assert flags & 0xC0 == 0xC0  # PTS+DTS (cts != 0)
+
+        def read_ts(b):
+            return (
+                ((b[0] >> 1) & 0x7) << 30
+                | b[1] << 22 | ((b[2] >> 1) & 0x7F) << 15
+                | b[3] << 7 | (b[4] >> 1) & 0x7F
+            )
+
+        pts = read_ts(es[9:14])
+        dts = read_ts(es[14:19])
+        assert dts == 100 * 90
+        assert pts == (100 + 40) * 90
+
+    def test_aac_adts_header(self):
+        data = self._mux([
+            ("a", 0, _aac_seq_header(b"\x12\x10")),
+            ("a", 0, _aac_frame(b"RAWAAC")),
+        ])
+        es = b"".join(
+            body for pid, _, _, body in ts.demux_packets(data)
+            if pid == ts.PID_AUDIO
+        )
+        # skip the PES header to the ADTS syncword
+        i = es.find(b"\xff\xf1")
+        assert i >= 0
+        adts = es[i : i + 7]
+        frame_len = ((adts[3] & 0x3) << 11) | (adts[4] << 3) | (adts[5] >> 5)
+        assert frame_len == 7 + len(b"RAWAAC")
+        assert es.endswith(b"RAWAAC")
+
+    def test_sequence_headers_emit_no_packets(self):
+        out = io.BytesIO()
+        w = ts.TsWriter(out)
+        w.write_video(0, _avc_seq_header())
+        w.write_audio(0, _aac_seq_header())
+        assert out.getvalue() == b""  # PSI waits for the first real frame
+
+    def test_demux_rejects_unaligned(self):
+        with pytest.raises(ValueError):
+            ts.demux_packets(b"\x47" * 100)
+
+
+class TestFlvToTsPipe:
+    def test_flv_tags_feed_the_ts_writer(self):
+        """The same payload bytes flow FLV→TS (the rtmp→flv→hls path the
+        reference serves)."""
+        from incubator_brpc_tpu.protocol import flv
+
+        fout = io.BytesIO()
+        fw = flv.FlvWriter(fout)
+        fw.write_video(0, _avc_seq_header())
+        fw.write_audio(0, _aac_seq_header())
+        fw.write_video(0, _avc_frame(True, b"\x65KEY"))
+        fw.write_audio(23, _aac_frame(b"AUD"))
+
+        tout = io.BytesIO()
+        tw = ts.TsWriter(tout)
+        for tag, ts_ms, payload in flv.FlvReader(fout.getvalue()):
+            if tag == flv.TAG_VIDEO:
+                tw.write_video(ts_ms, payload)
+            elif tag == flv.TAG_AUDIO:
+                tw.write_audio(ts_ms, payload)
+        pkts = ts.demux_packets(tout.getvalue())
+        assert {p for p, _, _, _ in pkts} >= {
+            ts.PID_PAT, ts.PID_PMT, ts.PID_VIDEO, ts.PID_AUDIO
+        }
