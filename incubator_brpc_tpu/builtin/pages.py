@@ -352,6 +352,14 @@ def handle(server, frame) -> Resp:
         handler = server.find_http_handler(frame.path)
         if handler is not None:
             return handler(frame)
+        # restful mappings route custom paths into the method map
+        # (ServiceOptions.restful_mappings, restful.cpp)
+        restful = server.find_restful(frame.path)
+        if restful is not None:
+            return server.invoke_for_http(
+                restful[0], restful[1], frame.body,
+                sock=getattr(frame, "sock", None),
+            )
         # http→rpc gateway: /<service>/<method> reaches the same method map
         # as the binary protocol (http_rpc_protocol.cpp's pb-over-http)
         parts = frame.path.strip("/").split("/")

@@ -209,6 +209,8 @@ class Server:
         self.options = options or ServerOptions()
         self._methods = _MethodMap()
         self._http_handlers: Dict[str, Callable] = {}
+        # restful rows: (prefix, postfix, has_wildcard, service, method)
+        self._restful: list = []
         self._acceptor: Optional[Acceptor] = None
         self._messenger = InputMessenger()
         self._stopping = False
@@ -229,21 +231,92 @@ class Server:
         name: str,
         handlers: Dict[str, Callable],
         max_concurrency: Optional[int] = None,
+        restful_mappings: str = "",
     ) -> None:
         """Register ``name.method → handler`` rows (Server::AddService builds
-        the same flat _method_map, server.cpp:1209)."""
+        the same flat _method_map, server.cpp:1209).
+
+        ``restful_mappings`` exposes methods on custom HTTP paths instead
+        of the gateway's /<service>/<method> (reference
+        ServiceOptions.restful_mappings, server.h:255-260 + restful.cpp):
+        ``"PATH1 => NAME1, PATH2 => NAME2"`` where a PATH may carry one
+        ``*`` wildcard (``/v1/*/echo``, ``*.flv``)."""
         if self._started:
             raise RuntimeError("add_service after start")
+        # validate EVERYTHING before mutating: a ValueError must leave no
+        # partially-registered service behind (methods in the map with a
+        # dead mapping, or half of a mapping list applied)
+        restful_rows = (
+            self._parse_restful_mappings(name, handlers, restful_mappings)
+            if restful_mappings else []
+        )
+        for method in handlers:
+            if f"{name}.{method}" in self._methods:
+                raise ValueError(f"method {name}.{method} already registered")
         for method, handler in handlers.items():
             full = f"{name}.{method}"
-            if full in self._methods:
-                raise ValueError(f"method {full} already registered")
             mc = (
                 max_concurrency
                 if max_concurrency is not None
                 else self.options.method_max_concurrency
             )
             self._methods.insert(full, MethodProperty(handler, MethodStatus(full, mc), full))
+        self._restful.extend(restful_rows)
+
+    def _parse_restful_mappings(
+        self, service: str, handlers: Dict[str, Callable], mappings: str
+    ) -> list:
+        rows: list = []
+        for pair in mappings.split(","):
+            pair = pair.strip()
+            if not pair:
+                continue
+            if "=>" not in pair:
+                raise ValueError(f"restful mapping {pair!r} lacks '=>'")
+            path, _, method = pair.partition("=>")
+            path, method = path.strip(), method.strip()
+            if method not in handlers:
+                raise ValueError(
+                    f"restful mapping {pair!r}: no method {method!r} in "
+                    f"service {service!r}"
+                )
+            if path.count("*") > 1:
+                raise ValueError(
+                    f"restful path {path!r} has more than one wildcard"
+                )
+            prefix, star, postfix = path.partition("*")
+            key = (prefix, postfix, bool(star))
+            for p2, q2, w2, s2, m2 in self._restful + rows:
+                if (p2, q2, w2) == key:
+                    # the reference's RestfulMap rejects conflicts at
+                    # AddService time rather than letting a dead mapping
+                    # linger (restful.cpp AddMethod)
+                    raise ValueError(
+                        f"restful path {path!r} already mapped to {s2}.{m2}"
+                    )
+            rows.append((prefix, postfix, bool(star), service, method))
+        return rows
+
+    def find_restful(self, path: str) -> Optional[tuple]:
+        """(service, method) for a restful-mapped path, most-specific
+        (longest prefix+postfix) wildcard match winning — the RestfulMap
+        ordering (restful.cpp)."""
+        best = None
+        best_len = -1
+        for prefix, postfix, wild, service, method in self._restful:
+            if not wild:
+                if path == prefix:
+                    return service, method  # exact always wins
+                continue
+            if (
+                len(path) >= len(prefix) + len(postfix)
+                and path.startswith(prefix)
+                and path.endswith(postfix)
+            ):
+                score = len(prefix) + len(postfix)
+                if score > best_len:
+                    best, best_len = (service, method), score
+        return best
 
     def add_http_handler(self, path: str, handler: Callable) -> None:
         """Register an HTTP handler ``fn(HttpFrame) -> (status, content_type,

@@ -623,3 +623,88 @@ class TestChunkedRequests:
             http_mod.parse_header(bad)
         with pytest.raises(FatalParseError):
             http_mod.parse(bad)
+
+
+class TestRestfulMappings:
+    """ServiceOptions.restful_mappings (server.h:255-260, restful.cpp):
+    methods exposed on custom paths, wildcards included."""
+
+    @pytest.fixture
+    def restful_server(self):
+        srv = Server()
+        srv.add_service(
+            "media",
+            {
+                "play": lambda cntl, req: b"play:" + req,
+                "stat": lambda cntl, req: b"stat",
+            },
+            restful_mappings="/v1/play => play, *.flv => play, "
+                             "/exact/stat => stat",
+        )
+        assert srv.start(0)
+        yield srv
+        srv.stop()
+
+    def test_exact_path(self, restful_server):
+        status, _, body = fetch(
+            restful_server, "/v1/play", method="POST", body=b"X"
+        )
+        assert status == 200 and body == b"play:X"
+
+    def test_wildcard_suffix(self, restful_server):
+        status, _, body = fetch(
+            restful_server, "/live/stream123.flv", method="POST", body=b"F"
+        )
+        assert status == 200 and body == b"play:F"
+
+    def test_no_match_404(self, restful_server):
+        status, _, _ = fetch(restful_server, "/v2/play", method="POST")
+        assert status == 404
+
+    def test_gateway_route_still_works(self, restful_server):
+        status, _, body = fetch(
+            restful_server, "/media/stat", method="POST", body=b""
+        )
+        assert status == 200 and body == b"stat"
+
+    def test_bad_mappings_rejected(self):
+        srv = Server()
+        with pytest.raises(ValueError):
+            srv.add_service(
+                "x", {"m": lambda c, r: b""}, restful_mappings="/a -> m"
+            )
+        with pytest.raises(ValueError):
+            srv.add_service(
+                "y", {"m": lambda c, r: b""}, restful_mappings="/a => nope"
+            )
+        with pytest.raises(ValueError):
+            srv.add_service(
+                "z", {"m": lambda c, r: b""}, restful_mappings="/a/*/b/* => m"
+            )
+
+    def test_failed_registration_leaves_nothing_behind(self):
+        # a bad mapping must not leave methods or earlier pairs registered
+        srv = Server()
+        with pytest.raises(ValueError):
+            srv.add_service(
+                "p",
+                {"play": lambda c, r: b""},
+                restful_mappings="/ok => play, /bad => nope",
+            )
+        assert not srv._restful
+        assert "p.play" not in srv._methods
+        # the fixed retry registers cleanly
+        srv.add_service(
+            "p", {"play": lambda c, r: b""}, restful_mappings="/ok => play"
+        )
+        assert len(srv._restful) == 1
+
+    def test_duplicate_paths_rejected(self):
+        srv = Server()
+        srv.add_service(
+            "a", {"m": lambda c, r: b""}, restful_mappings="/v1 => m"
+        )
+        with pytest.raises(ValueError):
+            srv.add_service(
+                "b", {"n": lambda c, r: b""}, restful_mappings="/v1 => n"
+            )
