@@ -24,7 +24,13 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
+import json
+import logging
+import os
+
 from incubator_brpc_tpu.utils.flags import get_flag
+
+logger = logging.getLogger(__name__)
 
 SPAN_TYPE_CLIENT = "client"
 SPAN_TYPE_SERVER = "server"
@@ -77,15 +83,83 @@ class _SpeedLimiter:
 
 
 class SpanStore:
-    """In-memory ring of finished spans, queryable by trace id / latency."""
+    """In-memory ring of finished spans, queryable by trace id / latency.
+    With ``rpcz_database_dir`` set, finished spans also append to a
+    rotated ``rpcz.jsonl`` — the durable record the reference keeps in
+    LevelDB (span.cpp:41 rpcz_database_dir); /rpcz itself serves from the
+    ring either way."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._spans: deque = deque(maxlen=int(get_flag("rpcz_max_spans")))
+        # the file has no shared invariant with the ring: its own lock, so
+        # disk flushes never stall ring submits or /rpcz queries
+        self._db_lock = threading.Lock()
+        self._db_file = None
+        self._db_path = ""
 
     def submit(self, span: Span) -> None:
         with self._lock:
             self._spans.append(span)
+        dbdir = str(get_flag("rpcz_database_dir"))
+        if dbdir:
+            self._persist(dbdir, span)
+
+    def _persist(self, dbdir: str, span: Span) -> None:
+        line = json.dumps({
+            "trace_id": span.trace_id,
+            "span_id": span.span_id,
+            "parent_span_id": span.parent_span_id,
+            "type": span.span_type,
+            "service": span.service,
+            "method": span.method,
+            "remote_side": span.remote_side,
+            "log_id": span.log_id,
+            "error_code": span.error_code,
+            "start_real_us": span.start_real_us,
+            "latency_us": span.latency_us,
+            "request_size": span.request_size,
+            "response_size": span.response_size,
+            "annotations": span.annotations,
+        }) + "\n"
+        path = os.path.join(dbdir, "rpcz.jsonl")
+        with self._db_lock:
+            try:
+                if self._db_file is None or self._db_path != path:
+                    os.makedirs(dbdir, exist_ok=True)
+                    if self._db_file is not None:
+                        self._db_file.close()
+                    self._db_file = open(path, "a", encoding="utf-8")
+                    self._db_path = path
+                self._db_file.write(line)
+                self._db_file.flush()
+                if self._db_file.tell() > int(
+                    get_flag("rpcz_database_max_bytes")
+                ):
+                    # rotate: one previous generation kept (.1), like the
+                    # dump-file rotation elsewhere in this stack
+                    self._db_file.close()
+                    self._db_file = None
+                    os.replace(path, path + ".1")
+            except OSError:
+                logger.warning("rpcz persistence failed", exc_info=True)
+                try:
+                    if self._db_file is not None:
+                        self._db_file.close()
+                except OSError:
+                    pass
+                self._db_file = None
+
+    def close_db(self) -> None:
+        """Close the persistence file (tests / reconfiguration)."""
+        with self._db_lock:
+            if self._db_file is not None:
+                try:
+                    self._db_file.close()
+                except OSError:
+                    pass
+                self._db_file = None
+                self._db_path = ""
 
     def recent(self, limit: int = 100) -> List[Span]:
         with self._lock:

@@ -109,7 +109,8 @@ class RedisClient:
     """Pipelined redis client over one Socket. ``execute`` is synchronous;
     ``pipeline`` sends a batch and collects replies in order."""
 
-    def __init__(self, remote: str, timeout: float = 5.0):
+    def __init__(self, remote: str, timeout: float = 5.0,
+                 password: Optional[str] = None):
         from incubator_brpc_tpu.transport.sock import Socket
 
         self._pending: List[_Pending] = []
@@ -124,6 +125,20 @@ class RedisClient:
         # and consume the socket's read buffer directly
         self._sock.messenger = self
         self._sock.on_failed.append(self._on_socket_failed)
+        if password is not None:
+            # the RedisAuthenticator contract: AUTH is the FIRST command on
+            # the connection (policy/redis_authenticator.cpp
+            # GenerateCredential packs "AUTH <passwd>"); a rejected or
+            # timed-out credential fails the client loudly at construction
+            # WITHOUT leaking the connected socket + reader fiber
+            try:
+                reply = self.execute("AUTH", password, timeout=timeout)
+            except (RespError, TimeoutError):
+                self._sock.recycle()
+                raise
+            if reply != "OK":  # simple strings parse to str
+                self._sock.recycle()
+                raise RespError(f"AUTH rejected: {reply!r}")
 
     # InputMessenger duck-type: called by the reader fiber with the socket
     def process(self, sock) -> None:
@@ -226,11 +241,12 @@ class MockRedisServer:
     against hand-built buffers + a real server; SURVEY §4's loopback
     shape)."""
 
-    def __init__(self):
+    def __init__(self, password: Optional[str] = None):
         self._data = {}
         self._lock = threading.Lock()
         self._acceptor = None
         self.port = 0
+        self.password = password
 
     def start(self) -> bool:
         from incubator_brpc_tpu.transport.acceptor import Acceptor
@@ -247,9 +263,18 @@ class MockRedisServer:
         if self._acceptor is not None:
             self._acceptor.stop()
 
-    def handle(self, cmd: List[bytes]) -> bytes:
+    def handle(self, cmd: List[bytes], ctx: Optional[dict] = None) -> bytes:
         name = cmd[0].decode().upper() if cmd else ""
         args = cmd[1:]
+        if self.password is not None:
+            if name == "AUTH":
+                if args and args[0].decode() == self.password:
+                    if ctx is not None:
+                        ctx["redis_authed"] = True
+                    return b"+OK\r\n"
+                return b"-ERR invalid password\r\n"
+            if ctx is None or not ctx.get("redis_authed"):
+                return b"-NOAUTH Authentication required.\r\n"
         with self._lock:
             if name == "PING":
                 return b"+PONG\r\n"
@@ -299,7 +324,11 @@ class _MockMessenger:
                 break
             consumed_total = nxt
             if isinstance(cmd, list):
-                out.append(self._server.handle([bytes(c) for c in cmd]))
+                out.append(
+                    self._server.handle(
+                        [bytes(c) for c in cmd], ctx=sock.context
+                    )
+                )
             else:
                 out.append(b"-ERR expected array\r\n")
         if consumed_total:

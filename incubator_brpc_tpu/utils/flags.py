@@ -124,6 +124,19 @@ define_flag(
     lambda v: v > 0,
 )
 define_flag(
+    "rpcz_database_dir",
+    "",
+    "persist finished spans as JSON lines under this directory "
+    "(reference span.cpp:41 LevelDB persistence); empty = memory only",
+    lambda v: isinstance(v, str),
+)
+define_flag(
+    "rpcz_database_max_bytes",
+    64 * 1024 * 1024,
+    "rotate the span database file past this size",
+    lambda v: v > 0,
+)
+define_flag(
     "ns_refresh_interval_s",
     1.0,
     "polling period of periodic naming services (reference -ns_access_interval)",

@@ -154,3 +154,54 @@ class TestHttpGateway:
             "127.0.0.1", dual_server.port, "/svc/later", method="POST", body=b"hi"
         )
         assert status == 200 and body == b"async:hi"
+
+
+class TestRedisAuth:
+    """RedisAuthenticator semantics (policy/redis_authenticator.cpp): AUTH
+    is the first command on the connection; unauthenticated commands are
+    refused."""
+
+    def test_auth_then_commands(self):
+        from incubator_brpc_tpu.protocol.resp import MockRedisServer, RedisClient
+
+        srv = MockRedisServer(password="s3cret")
+        assert srv.start()
+        try:
+            c = RedisClient(f"127.0.0.1:{srv.port}", password="s3cret")
+            assert c.execute("SET", "k", "v") in (b"OK", "OK")
+            assert c.execute("GET", "k") == b"v"
+            c.close()
+        finally:
+            srv.stop()
+
+    def test_wrong_password_fails_loudly(self):
+        from incubator_brpc_tpu.protocol.resp import (
+            MockRedisServer,
+            RedisClient,
+            RespError,
+        )
+
+        srv = MockRedisServer(password="s3cret")
+        assert srv.start()
+        try:
+            with pytest.raises(RespError):
+                RedisClient(f"127.0.0.1:{srv.port}", password="wrong")
+        finally:
+            srv.stop()
+
+    def test_unauthenticated_commands_refused(self):
+        from incubator_brpc_tpu.protocol.resp import (
+            MockRedisServer,
+            RedisClient,
+            RespError,
+        )
+
+        srv = MockRedisServer(password="s3cret")
+        assert srv.start()
+        try:
+            c = RedisClient(f"127.0.0.1:{srv.port}")  # no AUTH
+            with pytest.raises(RespError):
+                c.execute("GET", "k")
+            c.close()
+        finally:
+            srv.stop()

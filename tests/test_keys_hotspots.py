@@ -134,3 +134,40 @@ class TestHotspots:
             assert b"contended acquires" in body
         finally:
             s.stop()
+
+
+class TestRpczPersistence:
+    """rpcz_database_dir (reference span.cpp:41 LevelDB persistence):
+    finished spans append durably as JSON lines."""
+
+    def test_spans_persist_and_survive_ring_eviction(self, tmp_path):
+        import json
+
+        from incubator_brpc_tpu.builtin.rpcz import span_store
+        from incubator_brpc_tpu.rpc import Channel, Server
+        from incubator_brpc_tpu.utils.flags import set_flag
+
+        assert set_flag("enable_rpcz", True)
+        assert set_flag("rpcz_database_dir", str(tmp_path))
+        try:
+            srv = Server()
+            srv.add_service("persist", {"echo": lambda cntl, req: req})
+            assert srv.start(0)
+            try:
+                ch = Channel()
+                assert ch.init(f"127.0.0.1:{srv.port}")
+                for _ in range(3):
+                    assert ch.call_method("persist", "echo", b"traced").ok()
+            finally:
+                srv.stop()
+            db = tmp_path / "rpcz.jsonl"
+            assert db.exists()
+            rows = [json.loads(ln) for ln in db.read_text().splitlines()]
+            mine = [r for r in rows if r["service"] == "persist"]
+            assert len(mine) >= 3  # client + server spans for 3 calls
+            assert any(r["type"] == "server" for r in mine)
+            assert all(r["latency_us"] >= 0 for r in mine)
+        finally:
+            set_flag("enable_rpcz", False)
+            set_flag("rpcz_database_dir", "")
+            span_store.close_db()
