@@ -205,3 +205,88 @@ class TestRedisAuth:
             c.close()
         finally:
             srv.stop()
+
+
+class TestMemcacheBinary:
+    """The binary memcache wire (policy/memcache_binary_protocol.cpp):
+    header fixtures, opaque correlation, full op coverage, SASL auth."""
+
+    def test_header_fixture(self):
+        from incubator_brpc_tpu.protocol import memcache_binary as mb
+
+        wire = mb.pack_request(mb.OP_SET, b"k", b"v",
+                               extras=b"\x00" * 8, opaque=7)
+        assert wire[0] == 0x80 and wire[1] == mb.OP_SET
+        assert wire[2:4] == b"\x00\x01"          # key length
+        assert wire[4] == 8                       # extras length
+        import struct as s
+        assert s.unpack_from(">I", wire, 8)[0] == 8 + 1 + 1  # total body
+        assert wire[12:16] == b"\x00\x00\x00\x07"  # opaque
+        assert wire[24:32] == b"\x00" * 8          # extras
+        assert wire[32:33] == b"k" and wire[33:34] == b"v"
+
+    @pytest.fixture
+    def binary_server(self):
+        from incubator_brpc_tpu.protocol.memcache_binary import (
+            MockMemcacheBinaryServer,
+        )
+
+        srv = MockMemcacheBinaryServer()
+        assert srv.start()
+        yield srv
+        srv.stop()
+
+    def test_full_op_matrix(self, binary_server):
+        from incubator_brpc_tpu.protocol.memcache_binary import (
+            MemcacheBinaryClient,
+        )
+
+        c = MemcacheBinaryClient(f"127.0.0.1:{binary_server.port}")
+        assert c.set("k", b"v1", flags=42)
+        assert c.get("k") == b"v1"
+        assert c.get("missing") is None
+        assert not c.add("k", b"nope")        # exists
+        assert c.add("k2", b"fresh")
+        assert c.replace("k", b"v2")
+        assert not c.replace("ghost", b"x")   # missing
+        assert c.append("k", b"+tail")
+        assert c.prepend("k", b"head+")
+        assert c.get("k") == b"head+v2+tail"
+        assert c.set("n", b"10")
+        assert c.incr("n", 5) == 15
+        assert c.decr("n", 3) == 12
+        assert c.incr("missing") is None
+        assert c.delete("k") and not c.delete("k")
+        assert "tbrpc" in c.version()
+        got = c.get_multi("k2", "n", "missing")
+        assert got == {"k2": b"fresh", "n": b"12"}
+        assert c.flush_all()
+        assert c.get("k2") is None
+        c.close()
+
+    def test_sasl_auth(self):
+        from incubator_brpc_tpu.protocol.memcache_binary import (
+            MemcacheBinaryClient,
+            MemcacheBinaryError,
+            MockMemcacheBinaryServer,
+        )
+
+        srv = MockMemcacheBinaryServer(password="hunter2")
+        assert srv.start()
+        try:
+            c = MemcacheBinaryClient(
+                f"127.0.0.1:{srv.port}", password="hunter2"
+            )
+            assert c.set("a", b"1") and c.get("a") == b"1"
+            c.close()
+            with pytest.raises(MemcacheBinaryError):
+                MemcacheBinaryClient(
+                    f"127.0.0.1:{srv.port}", password="wrong"
+                )
+            # unauthenticated commands refused
+            plain = MemcacheBinaryClient(f"127.0.0.1:{srv.port}")
+            with pytest.raises(MemcacheBinaryError):
+                plain.get("a")
+            plain.close()
+        finally:
+            srv.stop()
