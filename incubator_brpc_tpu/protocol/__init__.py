@@ -54,6 +54,10 @@ from incubator_brpc_tpu.protocol import nshead as _nshead  # noqa: E402,F401
 # servers that registered one (policy/mongo_protocol.cpp)
 from incubator_brpc_tpu.protocol import mongo as _mongo  # noqa: E402,F401
 
+# thrift: framed-thrift server behind ServerOptions.thrift_service
+# (policy/thrift_protocol.cpp) — the client half lives in the same module
+from incubator_brpc_tpu.protocol import thrift as _thrift  # noqa: E402,F401
+
 # rtmp: stateful media protocol behind an RtmpService — the extension
 # ceiling of the shared-port registry (policy/rtmp_protocol.cpp)
 from incubator_brpc_tpu.protocol import rtmp as _rtmp  # noqa: E402,F401

@@ -16,11 +16,15 @@ Reply matching uses seqid, not FIFO: thrift brokers may reorder.
 from __future__ import annotations
 
 import itertools
+import logging
 import struct
 import threading
 from typing import Dict, Optional, Tuple
 
 from incubator_brpc_tpu.protocol.resp import _Pending  # same future shape
+from incubator_brpc_tpu.protocol.tbus_std import ParseError
+
+logger = logging.getLogger(__name__)
 
 VERSION_1 = 0x80010000
 T_CALL, T_REPLY, T_EXCEPTION = 1, 2, 3
@@ -290,3 +294,119 @@ class _MockMessenger:
             sock._read_buf.popn(consumed)
         if out:
             sock.write(b"".join(out))
+
+
+# ---------------------------------------------------------------------------
+# server side — ServerOptions(thrift_service=...) serves framed thrift on
+# the shared port (reference ThriftService / thrift_service.cpp,
+# ProcessThriftRequest thrift_protocol.cpp:314: one handler object receives
+# (method, args) and fills the result; here the handler is
+# ``fn(cntl, method: str, payload: bytes) -> bytes`` with the args/result
+# carried as the binary-field convention this module's client speaks)
+# ---------------------------------------------------------------------------
+
+
+class ThriftRequestFrame:
+    __slots__ = ("method", "seqid", "payload")
+
+    is_response = False
+    is_stream = False
+    process_inline = False
+    correlation_id = 0
+    meta = None
+    wire_protocol = "thrift"
+
+    def __init__(self, method: str, seqid: int, payload: bytes):
+        self.method = method
+        self.seqid = seqid
+        self.payload = payload
+
+
+def _server_parse_header(header: bytes):
+    # framed thrift: i32 length then the 0x8001 version word — the version
+    # bytes at offset 4..6 classify; fewer than 6 bytes cannot (the
+    # enabled_for gate keeps this protocol off servers without a
+    # thrift_service, like nshead's deep-magic discipline)
+    if len(header) < 6:
+        return None
+    if header[4] != 0x80 or header[5] != 0x01:
+        raise ParseError("not thrift")
+    (flen,) = struct.unpack_from(">i", header)
+    if flen <= 0 or flen > (64 << 20):
+        raise ParseError(f"bad thrift frame length {flen}")
+    return 4 + flen
+
+
+def _server_try_parse(buf: bytes):
+    try:
+        msg, consumed = parse_frame(buf)
+    except ThriftError as e:
+        raise ParseError(str(e)) from None
+    if msg is None:
+        return None, 0
+    if msg["type"] != T_CALL:
+        raise ParseError(f"unexpected thrift message type {msg['type']}")
+    return (
+        ThriftRequestFrame(msg["method"], msg["seqid"], msg.get("payload", b"")),
+        consumed,
+    )
+
+
+def _server_process_request(sock, frame: ThriftRequestFrame) -> None:
+    from incubator_brpc_tpu.rpc.controller import Controller
+    from incubator_brpc_tpu.utils.status import ErrorCode
+
+    server = sock.context.get("server")
+    handler = (
+        getattr(server.options, "thrift_service", None) if server else None
+    )
+    if handler is None:
+        sock.set_failed(ErrorCode.EREQUEST, "no thrift service")
+        return
+    cntl = Controller()
+    cntl._server = server
+    cntl.remote_side = sock.remote
+    cntl._sock = sock
+    cntl._mark_start()
+    try:
+        reply = handler(cntl, frame.method, frame.payload)
+    except Exception as e:
+        logger.exception("thrift service raised")
+        cntl.set_failed(ErrorCode.EINTERNAL, f"handler raised: {e!r}")
+        reply = None
+    cntl._mark_end()
+    if cntl.error_code:
+        # INTERNAL_ERROR(6) unless the handler chose UNKNOWN_METHOD-style
+        # codes via cntl.error_code mapping is deliberate-simple here
+        wire = pack_exception(
+            frame.method, cntl.error_text or "error", frame.seqid,
+            type_id=1 if cntl.error_code == ErrorCode.ENOMETHOD else 6,
+        )
+    else:
+        wire = pack_reply(frame.method, reply or b"", frame.seqid)
+    sock.write(wire)
+
+
+def _server_enabled(sock) -> bool:
+    server = sock.context.get("server") if sock.context else None
+    return (
+        server is not None
+        and getattr(server.options, "thrift_service", None) is not None
+    )
+
+
+from incubator_brpc_tpu.protocol.registry import (  # noqa: E402
+    Protocol,
+    protocol_registry,
+)
+
+THRIFT_SERVER = Protocol(
+    name="thrift",
+    parse=_server_try_parse,
+    parse_header=_server_parse_header,
+    process_request=_server_process_request,
+    enabled_for=_server_enabled,
+)
+
+if "thrift" not in protocol_registry:
+    protocol_registry.register(THRIFT_SERVER)

@@ -157,6 +157,7 @@ class ServerOptions:
         usercode_inline: bool = False,
         device_index: Optional[int] = None,
         nshead_service=None,
+        thrift_service=None,
         mongo_service_adaptor=None,
         rtmp_service=None,
         ssl_context=None,
@@ -182,6 +183,9 @@ class ServerOptions:
         # fn(cntl, head: dict, body: bytes) -> bytes — the single legacy
         # nshead handler (reference ServerOptions.nshead_service)
         self.nshead_service = nshead_service
+        # fn(cntl, method: str, payload: bytes) -> bytes — serves framed
+        # thrift on this port (reference ServerOptions.thrift_service)
+        self.thrift_service = thrift_service
         # protocol/mongo.MongoServiceAdaptor — enables the mongo wire
         # protocol on this server's port (reference
         # ServerOptions.mongo_service_adaptor)

@@ -141,3 +141,76 @@ class TestMalformedFrames:
 
         with _pytest.raises(ThriftError):
             parse_frame(buf)
+
+
+class TestThriftServer:
+    """ServerOptions.thrift_service (reference ThriftService +
+    ProcessThriftRequest thrift_protocol.cpp:314): framed thrift served on
+    the shared port next to tbus_std."""
+
+    @pytest.fixture
+    def thrift_server(self):
+        from incubator_brpc_tpu.rpc import Server, ServerOptions
+        from incubator_brpc_tpu.utils.status import ErrorCode
+
+        def service(cntl, method, payload):
+            if method == "echo":
+                return payload
+            if method == "upper":
+                return payload.upper()
+            cntl.set_failed(ErrorCode.ENOMETHOD, f"unknown method {method}")
+            return b""
+
+        srv = Server(ServerOptions(usercode_inline=True,
+                                   thrift_service=service))
+        srv.add_service("svc", {"echo": lambda cntl, req: req})
+        assert srv.start(0)
+        yield srv
+        srv.stop()
+
+    def test_call_through_real_server(self, thrift_server):
+        c = tt.ThriftClient(f"127.0.0.1:{thrift_server.port}")
+        assert c.call("echo", b"framed") == b"framed"
+        assert c.call("upper", b"abc") == b"ABC"
+        c.close()
+
+    def test_unknown_method_maps_to_exception(self, thrift_server):
+        c = tt.ThriftClient(f"127.0.0.1:{thrift_server.port}")
+        with pytest.raises(tt.TApplicationException) as ei:
+            c.call("nope", b"x")
+        assert ei.value.type_id == 1  # UNKNOWN_METHOD
+        c.close()
+
+    def test_thrift_and_tbus_share_the_port(self, thrift_server):
+        from incubator_brpc_tpu.rpc import Channel
+
+        c = tt.ThriftClient(f"127.0.0.1:{thrift_server.port}")
+        assert c.call("echo", b"t") == b"t"
+        ch = Channel()
+        assert ch.init(f"127.0.0.1:{thrift_server.port}")
+        r = ch.call_method("svc", "echo", b"b")
+        assert r.ok() and r.response_payload == b"b"
+        c.close()
+
+    def test_no_service_rejects_thrift_bytes(self):
+        from incubator_brpc_tpu.rpc import Server, ServerOptions
+
+        srv = Server(ServerOptions(usercode_inline=True))
+        srv.add_service("svc", {"echo": lambda cntl, req: req})
+        assert srv.start(0)
+        try:
+            c = tt.ThriftClient(f"127.0.0.1:{srv.port}")
+            with pytest.raises((tt.ThriftError, TimeoutError)):
+                c.call("echo", b"x", timeout=2)
+            c.close()
+        finally:
+            srv.stop()
+
+    def test_registered_without_explicit_import(self):
+        # the package __init__ must register the server protocol: apps
+        # construct ServerOptions(thrift_service=...) without importing
+        # protocol.thrift themselves
+        import incubator_brpc_tpu.protocol  # noqa: F401 — the registrar
+        from incubator_brpc_tpu.protocol.registry import protocol_registry
+
+        assert "thrift" in protocol_registry
