@@ -384,3 +384,86 @@ class TestNovaSnappy:
             assert out.response_payload == b"N" * 2048
         finally:
             srv.stop()
+
+
+class TestMultiProtocolStress:
+    def test_four_protocols_hammer_one_port(self, echo_server):
+        """The per-connection protocol scan under concurrency: tbus_std,
+        baidu_std, hulu and sofa clients all hit ONE listener at once;
+        every reply must come back on the right connection with the right
+        payload (the reference's shared-port contract, global.cpp scan)."""
+        import threading
+
+        port = echo_server.port
+        errs = []
+
+        def hammer(proto, tid):
+            try:
+                ch = Channel()
+                assert ch.init(
+                    f"127.0.0.1:{port}",
+                    options=ChannelOptions(protocol=proto, timeout_ms=15000),
+                )
+                for i in range(25):
+                    want = f"{proto}:{tid}:{i}".encode()
+                    c = ch.call_method("svc", "echo", want)
+                    if not c.ok() or c.response_payload != want:
+                        errs.append((proto, tid, i, c.error_text))
+                        return
+            except Exception as e:  # noqa: BLE001 — recorded for the assert
+                errs.append((proto, tid, repr(e)))
+
+        protos = ["tbus_std", "baidu_std", "hulu_pbrpc", "sofa_pbrpc"]
+        threads = [
+            threading.Thread(target=hammer, args=(p, t))
+            for p in protos for t in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs[:5]
+
+
+class TestLegacyPooledConnections:
+    def test_nova_over_pooled_connections(self):
+        """CONNECTION_TYPE_POOLED_AND_SHORT is the reference contract for
+        the nshead family: exclusive connection per in-flight call."""
+        import threading
+
+        srv = Server(
+            ServerOptions(
+                usercode_inline=True, nshead_service=lp.NovaServiceAdaptor
+            )
+        )
+        srv.add_service("svc", {"echo": lambda cntl, req: req})
+        assert srv.start(0)
+        try:
+            ch = Channel()
+            assert ch.init(
+                f"127.0.0.1:{srv.port}",
+                options=ChannelOptions(
+                    protocol="nova_pbrpc",
+                    connection_type="pooled",
+                    timeout_ms=15000,
+                ),
+            )
+            errs = []
+
+            def worker(tid):
+                for i in range(10):
+                    want = b"%d:%d" % (tid, i)
+                    c = ch.call_method("svc", "echo", want)
+                    if not c.ok() or c.response_payload != want:
+                        errs.append((tid, i, c.error_text))
+
+            threads = [
+                threading.Thread(target=worker, args=(t,)) for t in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errs, errs[:5]
+        finally:
+            srv.stop()
