@@ -36,15 +36,28 @@ def _version(server, frame) -> Resp:
     return 200, "text/plain", getattr(incubator_brpc_tpu, "__version__", "0.2").encode()
 
 
-def _vars(server, frame) -> Resp:
-    """vars_service.cpp: one 'name : value' line per exposed bvar; an
-    optional path/query prefix filters."""
+def _dump_vars(prefix: str) -> dict:
+    """Exposed bvars + flags mirrored as ``flag_<name>`` rows (the
+    reference registers every gflag as a bvar, bvar/gflag.cpp) — the ONE
+    source both the text and JSON dumps serve, so they cannot disagree."""
     from incubator_brpc_tpu.bvar.variable import dump_exposed
+    from incubator_brpc_tpu.utils.flags import flag_registry
 
+    dumped = dump_exposed(prefix=prefix)
+    for name, f in flag_registry.items():
+        row = f"flag_{name}"
+        if row.startswith(prefix):
+            dumped[row] = f.value
+    return dumped
+
+
+def _vars(server, frame) -> Resp:
+    """vars_service.cpp: one 'name : value' line per exposed bvar (and
+    mirrored flag); an optional path/query prefix filters."""
     prefix = frame.query.get("prefix", "")
     if frame.path.startswith("/vars/"):
         prefix = frame.path[len("/vars/") :]
-    dumped = dump_exposed(prefix=prefix)
+    dumped = _dump_vars(prefix)
     body = "".join(f"{k} : {v}\n" for k, v in sorted(dumped.items()))
     return 200, "text/plain", body.encode()
 
@@ -274,12 +287,10 @@ def _ids(server, frame) -> Resp:
 
 
 def _vars_json(server, frame) -> Resp:
-    from incubator_brpc_tpu.bvar.variable import dump_exposed
-
     return (
         200,
         "application/json",
-        json.dumps(dump_exposed(prefix=frame.query.get("prefix", ""))).encode(),
+        json.dumps(_dump_vars(frame.query.get("prefix", ""))).encode(),
     )
 
 

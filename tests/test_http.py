@@ -708,3 +708,22 @@ class TestRestfulMappings:
             srv.add_service(
                 "b", {"n": lambda c, r: b""}, restful_mappings="/v1 => n"
             )
+
+
+class TestFlagVars:
+    def test_flags_mirror_into_vars(self, portal_server):
+        """The reference registers every gflag as a bvar (bvar/gflag.cpp):
+        /vars shows flag_<name> rows next to the counters."""
+        status, _, body = fetch(portal_server, "/vars?prefix=flag_")
+        assert status == 200
+        text = body.decode()
+        assert "flag_max_body_size : " in text
+        assert "flag_health_check_interval : " in text
+        assert "socket_in_bytes" not in text  # prefix filter still applies
+        # the JSON dump serves from the same source: no disagreement
+        import json as _json
+
+        status, _, body = fetch(portal_server, "/vars.json?prefix=flag_")
+        assert status == 200
+        obj = _json.loads(body)
+        assert "flag_max_body_size" in obj
