@@ -18,6 +18,7 @@ EXAMPLES = [
     "examples/tls_echo.py",
     "examples/rtmp_relay.py",
     "examples/naming_failover.py",
+    "examples/cache_clients.py",
 ]
 
 
