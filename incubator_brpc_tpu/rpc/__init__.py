@@ -7,6 +7,7 @@ collectives when all parties share one device mesh (parallel/collective.py).
 """
 
 from incubator_brpc_tpu.rpc.channel import Channel, ChannelOptions
+from incubator_brpc_tpu.rpc.channel import start_cancel
 from incubator_brpc_tpu.rpc.controller import Controller
 from incubator_brpc_tpu.rpc.server import (
     MethodStatus,
@@ -44,6 +45,7 @@ __all__ = [
     "SharedSecretAuthenticator",
     "ChannelOptions",
     "Controller",
+    "start_cancel",
     "ParallelChannel",
     "PartitionChannel",
     "PartitionParser",

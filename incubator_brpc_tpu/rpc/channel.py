@@ -43,6 +43,16 @@ _client_messenger = InputMessenger()
 _client_socket_map = SocketMap(messenger=_client_messenger)
 
 
+def start_cancel(call_id: int) -> None:
+    """Cancel an in-flight RPC by its call id from ANY thread — the
+    reference's brpc::StartCancel(CallId) (controller.cpp:699, routed
+    through bthread_id_error): the id's error hook runs under the id
+    lock, fails the call with ECANCELED (never retried), wakes joiners
+    and runs the done callback. A no-op once the call has settled (the
+    versioned id is dead and the error call is dropped)."""
+    call_id_space.error(call_id, ErrorCode.ECANCELED, "canceled by caller")
+
+
 class NoServerError(ConnectionError):
     """LB selection failed: every candidate excluded or the cluster is
     empty (reference ExcludedServers -> EHOSTDOWN)."""

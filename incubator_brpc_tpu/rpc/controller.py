@@ -109,6 +109,33 @@ class Controller:
     def ok(self) -> bool:
         return self.error_code == 0
 
+    def start_cancel(self) -> None:
+        """Cancel this in-flight RPC from any thread (reference
+        Controller::StartCancel / brpc::StartCancel(CallId),
+        controller.cpp:699): the call fails with ECANCELED — joiners wake,
+        the done callback runs, and any late response is dropped at the
+        dead id. Asynchronous: the RPC may still complete first; no-op
+        when the call already settled.
+
+        Client-side only. A server-side Controller's call_id is the PEER's
+        wire id — erroring it against the local client id space could
+        cancel an unrelated outgoing call in a proxy process, so it is
+        refused here. Calls on the native fast path carry no Python call
+        id (the native channel correlates in C++) and are likewise not
+        cancelable."""
+        if self._server is not None:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "start_cancel on a server-side Controller is a no-op"
+            )
+            return
+        if not self.call_id:
+            return  # settled-or-native: nothing registered to cancel
+        from incubator_brpc_tpu.rpc.channel import start_cancel
+
+        start_cancel(self.call_id)
+
     # -- internals -----------------------------------------------------------
 
     def _reset_for_retry(self) -> None:

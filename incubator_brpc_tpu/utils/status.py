@@ -38,6 +38,7 @@ class ErrorCode(enum.IntEnum):
     # System errno reused verbatim (the reference raises the POSIX value
     # from LB selection failure, controller.cpp SelectServer paths)
     EHOSTDOWN = 112  # no available server (all excluded / empty cluster)
+    ECANCELED = 125  # RPC canceled by the caller (StartCancel)
 
     # Errno caused by server
     EINTERNAL = 2001  # server internal error

@@ -435,3 +435,123 @@ class TestIdleReaper:
             assert len(srv._acceptor.connections()) == 1
         finally:
             srv.stop()
+
+
+class TestStartCancel:
+    """Controller.start_cancel / brpc::StartCancel(CallId)
+    (controller.cpp:699): cancel an in-flight RPC from any thread."""
+
+    def _slow_server(self, delay=2.0):
+        import time as _t
+
+        from incubator_brpc_tpu.rpc import Server
+
+        srv = Server()
+
+        def slow(cntl, req):
+            _t.sleep(delay)
+            return req
+
+        srv.add_service("svc", {"slow": slow, "fast": lambda c, r: r})
+        assert srv.start(0)
+        return srv
+
+    def test_sync_call_canceled_from_another_thread(self):
+        import threading
+        import time as _t
+
+        from incubator_brpc_tpu.rpc import Channel, Controller
+        from incubator_brpc_tpu.utils.status import ErrorCode
+
+        srv = self._slow_server()
+        try:
+            ch = Channel()
+            assert ch.init(f"127.0.0.1:{srv.port}")
+            cntl = Controller(timeout_ms=30000, max_retry=0)
+            t = threading.Thread(
+                target=lambda: (_t.sleep(0.15), cntl.start_cancel())
+            )
+            t.start()
+            t0 = _t.monotonic()
+            out = ch.call_method("svc", "slow", b"x", cntl=cntl)
+            dt = _t.monotonic() - t0
+            t.join()
+            assert out.failed()
+            assert out.error_code == ErrorCode.ECANCELED
+            assert dt < 2.0, f"cancel did not interrupt the join ({dt:.2f}s)"
+            # the channel still works; a late response to the dead id
+            # drops. The slow handler is still running on that
+            # connection's reader fiber for ~2s, so give the follow-up
+            # call time to queue behind it.
+            ok = ch.call_method(
+                "svc", "fast", b"still-alive",
+                cntl=Controller(timeout_ms=15000, max_retry=0),
+            )
+            assert ok.ok() and ok.response_payload == b"still-alive"
+        finally:
+            srv.stop()
+
+    def test_async_done_runs_with_ecanceled(self):
+        import threading
+
+        from incubator_brpc_tpu.rpc import Channel, Controller
+        from incubator_brpc_tpu.utils.status import ErrorCode
+
+        srv = self._slow_server()
+        try:
+            ch = Channel()
+            assert ch.init(f"127.0.0.1:{srv.port}")
+            done = threading.Event()
+            seen = []
+
+            def on_done(c):
+                seen.append(c.error_code)
+                done.set()
+
+            cntl = Controller(timeout_ms=30000, max_retry=0)
+            ch.call_method("svc", "slow", b"x", cntl=cntl, done=on_done)
+            cntl.start_cancel()
+            assert done.wait(5)
+            assert seen == [ErrorCode.ECANCELED]
+        finally:
+            srv.stop()
+
+    def test_cancel_after_completion_is_noop(self):
+        from incubator_brpc_tpu.rpc import Channel
+
+        srv = self._slow_server()
+        try:
+            ch = Channel()
+            assert ch.init(f"127.0.0.1:{srv.port}")
+            cntl = ch.call_method("svc", "fast", b"y")
+            assert cntl.ok()
+            cntl.start_cancel()  # settled: dead id, silently dropped
+            assert cntl.ok() and cntl.response_payload == b"y"
+        finally:
+            srv.stop()
+
+    def test_server_side_cancel_refused(self):
+        # a proxy's handler must not be able to cancel an unrelated
+        # outgoing call via the peer's wire id
+        import threading
+
+        from incubator_brpc_tpu.rpc import Channel, Server
+
+        saw = []
+        srv = Server()
+
+        def handler(cntl, req):
+            cntl.start_cancel()  # must be a guarded no-op
+            saw.append(cntl.call_id)
+            return req
+
+        srv.add_service("svc", {"m": handler})
+        assert srv.start(0)
+        try:
+            ch = Channel()
+            assert ch.init(f"127.0.0.1:{srv.port}")
+            out = ch.call_method("svc", "m", b"p")
+            assert out.ok() and out.response_payload == b"p"
+            assert saw  # handler ran and the guard did not raise
+        finally:
+            srv.stop()
