@@ -124,6 +124,7 @@ class ChannelOptions:
         native_plane: bool = False,
         ssl_context=None,
         ssl_server_hostname=None,
+        retry_policy=None,
     ):
         self.timeout_ms = timeout_ms
         self.max_retry = max_retry
@@ -160,6 +161,11 @@ class ChannelOptions:
         # in src/tbnet).
         self.ssl_context = ssl_context
         self.ssl_server_hostname = ssl_server_hostname
+        # fn(cntl) -> bool: should THIS failed attempt retry? (reference
+        # RetryPolicy::DoRetry, retry_policy.h:26 — cntl.error_code is the
+        # attempt's error; None = the default retriable-code set). Retry
+        # budget (max_retry) is enforced regardless.
+        self.retry_policy = retry_policy
 
 
 class Channel:
@@ -850,7 +856,7 @@ class Channel:
                     cntl._excluded_sockets.add(cntl._sent_sockets[-1].id)
                 self._issue_rpc(cntl)
             return
-        if code in RETRIABLE and cntl.retried_count < cntl.max_retry:
+        if self._should_retry(cntl, code) and cntl.retried_count < cntl.max_retry:
             cntl.retried_count += 1
             if cntl._sent_sockets:
                 cntl._excluded_sockets.add(cntl._sent_sockets[-1].id)
@@ -860,9 +866,31 @@ class Channel:
         cntl.set_failed(code, text)
         self._end_rpc(cntl)
 
+    def _should_retry(self, cntl: Controller, code: int) -> bool:
+        """RetryPolicy::DoRetry (retry_policy.h): the channel's custom
+        policy sees the attempt's error on the controller; default = the
+        retriable-code set. ECANCELED never retries — a cancel is the
+        caller's decision, not a transient."""
+        if code == ErrorCode.ECANCELED:
+            return False
+        policy = self._options.retry_policy
+        if policy is None:
+            return code in RETRIABLE
+        saved = cntl.error_code
+        cntl.error_code = code  # DoRetry reads cntl->ErrorCode()
+        try:
+            return bool(policy(cntl))
+        except Exception:
+            logger.exception("retry_policy raised; not retrying")
+            return False
+        finally:
+            cntl.error_code = saved  # probing must not settle the call
+
     def _on_rpc_returned(self, cntl: Controller, frame: ParsedFrame, sock) -> None:
         """Response arrived (id locked by process_response)."""
-        if frame.error_code != 0 and frame.error_code in RETRIABLE and (
+        if frame.error_code != 0 and self._should_retry(
+            cntl, frame.error_code
+        ) and (
             cntl.retried_count < cntl.max_retry
         ):
             cntl.retried_count += 1

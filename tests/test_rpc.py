@@ -555,3 +555,63 @@ class TestStartCancel:
             assert saw  # handler ran and the guard did not raise
         finally:
             srv.stop()
+
+
+class TestRetryPolicy:
+    """ChannelOptions.retry_policy (reference RetryPolicy::DoRetry,
+    retry_policy.h:26): the caller decides which errors retry."""
+
+    def test_custom_policy_retries_a_server_error(self):
+        from incubator_brpc_tpu.rpc import Channel, ChannelOptions, Server
+        from incubator_brpc_tpu.utils.status import ErrorCode
+
+        calls = []
+        srv = Server()
+
+        def flaky(cntl, req):
+            calls.append(1)
+            if len(calls) < 3:
+                cntl.set_failed(ErrorCode.EINTERNAL, "transient")
+                return b""
+            return req
+
+        srv.add_service("svc", {"m": flaky})
+        assert srv.start(0)
+        try:
+            # default policy: EINTERNAL is NOT retriable -> fails
+            ch = Channel()
+            assert ch.init(f"127.0.0.1:{srv.port}")
+            out = ch.call_method("svc", "m", b"a")
+            assert out.failed() and out.error_code == ErrorCode.EINTERNAL
+            # custom policy: retry EINTERNAL within the budget -> succeeds
+            calls.clear()
+            ch2 = Channel()
+            assert ch2.init(
+                f"127.0.0.1:{srv.port}",
+                options=ChannelOptions(
+                    max_retry=3,
+                    retry_policy=lambda c: c.error_code
+                    == ErrorCode.EINTERNAL,
+                ),
+            )
+            out = ch2.call_method("svc", "m", b"b")
+            assert out.ok(), out.error_text
+            assert len(calls) == 3
+        finally:
+            srv.stop()
+
+    def test_policy_can_refuse_default_retriables(self):
+        from incubator_brpc_tpu.rpc import Channel, ChannelOptions, Controller
+
+        # no server listening: connect fails (normally retriable); a
+        # never-retry policy must fail on the FIRST attempt
+        ch = Channel()
+        assert ch.init(
+            "127.0.0.1:1",  # reserved port: refuses immediately
+            options=ChannelOptions(
+                max_retry=3, retry_policy=lambda c: False, timeout_ms=5000
+            ),
+        )
+        out = ch.call_method("svc", "m", b"x", cntl=Controller(timeout_ms=5000))
+        assert out.failed()
+        assert out.retried_count == 0
