@@ -1,15 +1,17 @@
 #!/usr/bin/env python
 """tls_echo — encrypted echo (reference example/http_c++ ssl options /
 ChannelOptions.ssl_options): the server encrypts every accepted
-connection; the client verifies the server certificate. The demo certs
-live next to this file (like the reference example ships cert.pem).
+connection; the client verifies the server certificate. A throwaway
+key/cert pair is generated at runtime — never commit private keys next
+to example code.
 
 Run:  python examples/tls_echo.py
 """
 
-import pathlib
 import ssl
+import subprocess
 import sys
+import tempfile
 
 sys.path.insert(0, ".")
 
@@ -20,19 +22,34 @@ from incubator_brpc_tpu.rpc import (  # noqa: E402
     ServerOptions,
 )
 
-HERE = pathlib.Path(__file__).parent
+
+def make_throwaway_cert(tmpdir: str) -> tuple:
+    """Self-signed localhost cert valid for one day, in a temp dir."""
+    cert, key = f"{tmpdir}/cert.pem", f"{tmpdir}/key.pem"
+    subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048",
+            "-keyout", key, "-out", cert, "-days", "1", "-nodes",
+            "-subj", "/CN=localhost",
+        ],
+        check=True,
+        capture_output=True,
+    )
+    return cert, key
 
 
 def main() -> None:
+    tmp = tempfile.TemporaryDirectory(prefix="tls_echo_")
+    cert, key = make_throwaway_cert(tmp.name)
     server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-    server_ctx.load_cert_chain(HERE / "cert.pem", HERE / "key.pem")
+    server_ctx.load_cert_chain(cert, key)
     server = Server(ServerOptions(ssl_context=server_ctx))
     server.add_service("EchoService", {"Echo": lambda cntl, req: req})
     assert server.start(0)
     print(f"TLS EchoServer on 127.0.0.1:{server.port}")
 
     client_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
-    client_ctx.load_verify_locations(HERE / "cert.pem")
+    client_ctx.load_verify_locations(cert)
     client_ctx.check_hostname = False  # demo cert is CN=localhost, target is the IP
     client_ctx.verify_mode = ssl.CERT_REQUIRED
     ch = Channel()

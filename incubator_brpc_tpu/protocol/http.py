@@ -36,12 +36,13 @@ logger = logging.getLogger(__name__)
 
 _METHODS = (b"GET ", b"POST ", b"PUT ", b"DELETE ", b"HEAD ", b"OPTIONS ", b"PATCH ")
 _MAX_HEADER_BYTES = 64 * 1024
-# Chunked request bodies are sized inside the messenger's deep-peek window:
+# Chunked request bodies are sized inside the shared deep-peek window:
 # the oversize backstop only fires if that window actually reaches it, so
-# the bound is DERIVED from the messenger's cap, not declared independently
-# (decoupled constants would reintroduce the stall-forever failure mode).
-from incubator_brpc_tpu.transport.messenger import (  # noqa: E402
-    _MAX_HEADER_PEEK as _CHUNKED_WINDOW,
+# the bound is DERIVED from the same constant the messenger uses, not
+# declared independently (decoupled constants would reintroduce the
+# stall-forever failure mode).
+from incubator_brpc_tpu.protocol.registry import (  # noqa: E402
+    MAX_HEADER_PEEK as _CHUNKED_WINDOW,
 )
 
 assert _MAX_HEADER_BYTES <= _CHUNKED_WINDOW, (

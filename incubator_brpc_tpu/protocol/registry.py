@@ -10,6 +10,13 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
+# Deep-peek window shared by the transport cut loop and variable-length
+# header protocols (HTTP): protocols that size their frames inside this
+# window derive their caps from it, and InputMessenger bounds how many
+# bytes it will copy for a header probe. Lives here — the one module both
+# layers already import — so protocol code never reaches up into transport.
+MAX_HEADER_PEEK = 64 * 1024
+
 
 @dataclass
 class Protocol:

@@ -390,9 +390,21 @@ class Server:
             if not np_mod.NET_AVAILABLE:
                 use_native = False
         if use_native:
+            # the C++ listener is AF_INET-only: fall back to the Python
+            # acceptor for anything its inet_pton cannot parse (IPv6,
+            # hostnames) instead of surfacing an OSError from Server.start
             plane = np_mod.NativeServerPlane(self, self.options.native_loops)
-            plane.register_methods()
-            port = plane.listen(ep.ip, ep.port)
+            try:
+                plane.register_methods()
+                port = plane.listen(ep.ip, ep.port)
+            except OSError as e:
+                logger.warning(
+                    "native plane cannot listen on %s (%s); "
+                    "falling back to the Python acceptor", ep, e
+                )
+                plane.stop()
+                use_native = False
+        if use_native:
             self._native_plane = plane
             self.listen_endpoint = EndPoint(ip=ep.ip, port=port)
         else:

@@ -17,7 +17,11 @@ from __future__ import annotations
 import logging
 from typing import List, Optional, Tuple
 
-from incubator_brpc_tpu.protocol.registry import Protocol, protocol_registry
+from incubator_brpc_tpu.protocol.registry import (
+    MAX_HEADER_PEEK,
+    Protocol,
+    protocol_registry,
+)
 from incubator_brpc_tpu.protocol.tbus_std import FatalParseError, ParseError
 from incubator_brpc_tpu.runtime.worker_pool import global_worker_pool
 from incubator_brpc_tpu.utils.flags import get_flag
@@ -28,7 +32,7 @@ logger = logging.getLogger(__name__)
 _HEADER_PEEK = 64  # covers every registered protocol's fixed header
 # variable-length headers (HTTP) may need a deeper look before they can
 # size the frame; bounded so a hostile peer can't make us copy the world
-_MAX_HEADER_PEEK = 64 * 1024
+_MAX_HEADER_PEEK = MAX_HEADER_PEEK
 
 
 class InputMessenger:

@@ -79,6 +79,7 @@ class NativeConnSock:
         self.error_code = 0
         self.error_text = ""
         self.state = 0  # transport/sock.CONNECTED
+        self._state_lock = threading.Lock()  # set_failed vs _mark_closed race
         self.preferred_protocol = None
         self.user_message_handler = None
         ip = ctypes.create_string_buffer(64)
@@ -105,21 +106,33 @@ class NativeConnSock:
         return 0
 
     def set_failed(self, code: int = ErrorCode.EFAILEDSOCKET, reason: str = "") -> bool:
-        if self.state != 0:
-            return False
-        self.error_code = code
-        self.error_text = reason
+        # Fail IMMEDIATELY, like Socket.set_failed: flip state and run the
+        # failure hooks inline rather than waiting for the C++ loop to
+        # observe EPOLLHUP and call back — writes after this report failure
+        # and stream failure callbacks fire without a reactor round trip.
+        with self._state_lock:
+            if self.state != 0:
+                return False
+            self.state = 1  # FAILED
+            self.error_code = code
+            self.error_text = reason
         LIB.tb_conn_close(self.token)
+        for cb in list(self.on_failed):
+            try:
+                cb(self)
+            except Exception:
+                logger.exception("on_failed callback raised")
         return True
 
     def _mark_closed(self) -> None:
         """tbnet says the connection died: run failure hooks (streams)."""
-        if self.state != 0:
-            return
-        self.state = 1  # FAILED
-        if not self.error_code:
-            self.error_code = ErrorCode.EEOF
-            self.error_text = "native conn closed"
+        with self._state_lock:
+            if self.state != 0:
+                return
+            self.state = 1  # FAILED
+            if not self.error_code:
+                self.error_code = ErrorCode.EEOF
+                self.error_text = "native conn closed"
         for cb in list(self.on_failed):
             try:
                 cb(self)
