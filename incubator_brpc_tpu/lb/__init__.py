@@ -267,10 +267,14 @@ class LocalityAwareLB(_SnapshotLB):
     PUNISH_FACTOR = 10.0  # error = 10× current average latency sample
     DEFAULT_LATENCY_US = 1000.0
 
-    def __init__(self) -> None:
+    def __init__(self, rng: Optional[random.Random] = None) -> None:
+        """``rng`` makes the weighted pick injectable: tests seed it so
+        distribution assertions are deterministic instead of riding the
+        process-global random stream (the round-3 flake)."""
         super().__init__()
         self._stats: Dict[EndPoint, _LAStat] = {}
         self._stats_lock = threading.Lock()
+        self._rng = rng if rng is not None else random.Random()
 
     def _stat(self, ep: EndPoint) -> _LAStat:
         with self._stats_lock:
@@ -293,7 +297,7 @@ class LocalityAwareLB(_SnapshotLB):
             return None
         weights = [self._weight(ep) for ep in cand]
         total = sum(weights)
-        r = random.random() * total
+        r = self._rng.random() * total
         chosen = cand[-1]
         for ep, w in zip(cand, weights):
             r -= w

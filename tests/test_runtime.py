@@ -22,6 +22,17 @@ from incubator_brpc_tpu.runtime import (
 )
 
 
+def wait_until(cond, timeout=5.0):
+    """Poll until ``cond()`` — deadline-bounded, never a bare sleep whose
+    margin a loaded host can blow through."""
+    deadline = time.monotonic() + timeout
+    while not cond():
+        if time.monotonic() > deadline:
+            return False
+        time.sleep(0.002)
+    return True
+
+
 # ---------------------------------------------------------------- butex ----
 
 def test_butex_wake_before_wait_returns_ewouldblock():
@@ -91,7 +102,7 @@ def test_timer_schedule_and_order():
         fired = []
         tt.schedule(lambda: fired.append("b"), delay=0.04)
         tt.schedule(lambda: fired.append("a"), delay=0.01)
-        time.sleep(0.2)
+        assert wait_until(lambda: len(fired) == 2)
         assert fired == ["a", "b"]
     finally:
         tt.stop_and_join()
@@ -117,7 +128,7 @@ def test_timer_earlier_schedule_preempts():
         fired = []
         tt.schedule(lambda: fired.append("late"), delay=5.0)
         tt.schedule(lambda: fired.append("early"), delay=0.02)
-        time.sleep(0.2)
+        assert wait_until(lambda: fired == ["early"])
         assert fired == ["early"]  # did not wait behind the 5s head
     finally:
         tt.stop_and_join()
