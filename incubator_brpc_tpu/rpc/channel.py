@@ -120,7 +120,7 @@ class ChannelOptions:
         transport: str = "tcp",
         device_index: int = 0,
         link_slot_words: int = 16384,
-        link_window: int = 4,
+        link_window: int = 8,
         native_plane: bool = False,
         ssl_context=None,
         ssl_server_hostname=None,
