@@ -182,8 +182,8 @@ class Channel:
         self._lb = None  # LoadBalancerWithNaming (lb/__init__.py), task #5
         self._socket_map = _client_socket_map
         self._init_done = False
-        self._device_sock = None  # transport="tpu": the established link
-        self._device_lock = threading.Lock()
+        self._device_sock = None  # transport="tpu": last-used link (the
+        # links themselves live in the process-wide DeviceLinkMap)
         self._native_ch = None  # NativeClientChannel (lazy; native_plane)
         self._native_lock = threading.Lock()
         self._native_tls = threading.local()  # pooled: one conn per thread
@@ -199,12 +199,9 @@ class Channel:
         if isinstance(target, EndPoint):
             self._single_server = target
         elif "://" in str(target) and not str(target).startswith("unix://"):
-            if self._options.transport == "tpu":
-                raise ValueError(
-                    "transport='tpu' requires a single-server target (the "
-                    "link binds one device pair; LB fan-out lowers to "
-                    "collectives via ParallelChannel instead)"
-                )
+            # transport='tpu' works for LB targets too: the LB picks the
+            # peer, the DeviceLinkMap resolves it to an established link
+            # (one per peer device — the N-party fabric star)
             from incubator_brpc_tpu.lb import LoadBalancerWithNaming
 
             self._lb = LoadBalancerWithNaming(
@@ -592,36 +589,53 @@ class Channel:
         cntl._force_host = True
         return self.call_method(service, method, request, cntl=cntl)
 
-    def _get_device_socket(self, cntl: Controller):
-        """transport='tpu': the established DeviceSocket, re-handshaking a
-        dead link (the host socket below it reconnects via its own paths)."""
-        from incubator_brpc_tpu.transport.device_link import establish_device_link
-        from incubator_brpc_tpu.transport.sock import CONNECTED
+    def _get_device_socket(self, cntl: Controller, ep: Optional[EndPoint] = None):
+        """transport='tpu': the established DeviceSocket for the target
+        endpoint, from the process-wide DeviceLinkMap (re-handshaking a
+        dead link; the host socket below it reconnects via its own paths).
+        Links are shared across channels — the SocketMap dedupe semantics
+        on the device plane."""
+        from incubator_brpc_tpu.transport.device_link import device_link_map
 
-        with self._device_lock:
-            ds = self._device_sock
-            if ds is not None and ds.state == CONNECTED:
-                return ds
-            if ds is not None:
-                ds.recycle()  # free the dead link's registry slot
-            ds = establish_device_link(
-                self,
-                device_index=self._options.device_index,
-                slot_words=self._options.link_slot_words,
-                window=self._options.link_window,
-                timeout_ms=cntl.timeout_ms or 60000,
-            )
-            self._device_sock = ds
-            return ds
+        target = ep if ep is not None else self._single_server
+        ds = device_link_map.get_or_create(
+            target,
+            device_index=self._options.device_index,
+            slot_words=self._options.link_slot_words,
+            window=self._options.link_window,
+            timeout_ms=cntl.timeout_ms or 60000,
+            auth=self._options.auth,
+            ssl_context=self._options.ssl_context,
+            ssl_server_hostname=self._options.ssl_server_hostname,
+        )
+        self._device_sock = ds  # last-used link (introspection/tests)
+        return ds
 
     def _pick_socket(self, cntl: Controller):
         ctype = self._options.connection_type
         if self._options.transport == "tpu" and not getattr(
             cntl, "_force_host", False
         ):
-            if self._single_server is None:
-                raise ConnectionError("transport='tpu' requires a single server")
-            return self._get_device_socket(cntl)
+            if self._single_server is not None:
+                return self._get_device_socket(cntl)
+            # LB target: the LB resolves a healthy host socket (health
+            # checks and exclusion run on the host plane), then the link
+            # map supplies the device link to that peer
+            host = self._lb.select_server(excluded=cntl._excluded_sockets)
+            if host is None:
+                raise NoServerError("no available server (all excluded or empty)")
+            try:
+                ds = self._get_device_socket(cntl, ep=host.remote)
+            except (OSError, ConnectionError):
+                # settle the LB's pick (la charges in-flight on select):
+                # an un-settled failed handshake would depress the peer's
+                # weight forever
+                self._lb.feedback(host, 0.0, ErrorCode.EFAILEDSOCKET)
+                raise
+            reg = getattr(self._lb, "register_socket", None)
+            if reg is not None:
+                reg(ds, host.remote)  # feedback/exclusion track the link
+            return ds
         if self._single_server is not None:
             if ctype == "single":
                 sock = self._socket_map.get_or_create(

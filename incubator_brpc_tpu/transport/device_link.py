@@ -550,6 +550,9 @@ class DeviceSocket:
     def recycle(self) -> None:
         from incubator_brpc_tpu.transport.sock import RECYCLED, _registry
 
+        if getattr(self, "_recycled", False):
+            return  # idempotent: the link map and channels may both settle us
+        self._recycled = True
         self.set_failed(ErrorCode.ECLOSE, "recycled")
         self.state = RECYCLED
         _registry.recycle(self.id)
@@ -617,6 +620,117 @@ class LinkHub:
 
 link_hub = LinkHub()
 _cookie_counter = itertools.count(1)
+
+
+class DeviceLinkMap:
+    """Client-side dedup of established device links keyed by
+    (endpoint, local device, geometry) — the SocketMap analog for the
+    device plane (reference socket_map.h:35 keys connections by
+    {EndPoint, rdma, ssl, auth}; rdma_endpoint.h:42-213 runs one QP per
+    peer, unbounded peers). Every Channel — single-server, LB-resolved,
+    or a PartitionChannel sub-channel — shares ONE link per peer+geometry;
+    a dead link is recycled and re-handshaken on the next get. This is
+    what turns the two-party DeviceLink into an N-party fabric: a client
+    device holds a star of links, one per peer device."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._links: Dict[tuple, "DeviceSocket"] = {}
+        # per-endpoint establishment locks; never deleted (deleting a lock
+        # another thread holds would let two handshakes race on one key) —
+        # bounded by the distinct peers this process ever contacts
+        self._key_locks: Dict[tuple, threading.Lock] = {}
+
+    def _key_lock(self, key: tuple) -> threading.Lock:
+        with self._lock:
+            lk = self._key_locks.get(key)
+            if lk is None:
+                lk = self._key_locks[key] = threading.Lock()
+            return lk
+
+    def get_or_create(
+        self,
+        ep: EndPoint,
+        device_index: int = 0,
+        slot_words: int = 16384,
+        window: int = 8,
+        timeout_ms: float = 60000,
+        auth=None,
+        ssl_context=None,
+        ssl_server_hostname=None,
+    ) -> "DeviceSocket":
+        """``auth``/``ssl_*`` are the calling channel's credentials: the
+        handshake must present them (an auth-requiring or TLS server
+        rejects a bare bootstrap), and they are part of the link identity —
+        channels with different credentials never share a link (the
+        reference keys SocketMap by {EndPoint, rdma, ssl, auth},
+        socket_map.h:35)."""
+        from incubator_brpc_tpu.transport.sock import CONNECTED
+
+        ident = (
+            f"auth-{id(auth):x}" if auth is not None else "",
+            f"ssl-{id(ssl_context):x}" if ssl_context is not None else "",
+        )
+        key = (ep.ip, ep.port, device_index, slot_words, window, ident)
+        # per-key lock: a thundering herd to one peer produces ONE
+        # handshake, while links to OTHER peers establish concurrently
+        with self._key_lock(key):
+            with self._lock:
+                ds = self._links.get(key)
+            if ds is not None and ds.state == CONNECTED:
+                return ds
+            if ds is not None:
+                ds.recycle()  # free the dead link's registry slot
+                with self._lock:
+                    self._links.pop(key, None)
+            # The handshake rides a fresh host channel to the peer (the
+            # reference's TCP-piggybacked magic+cookie) carrying the
+            # caller's credentials; the global client socket map dedupes
+            # the underlying TCP connection, so the channel object itself
+            # is throwaway — built per establishment, never cached (a
+            # cached one would freeze the first caller's timeout forever).
+            from incubator_brpc_tpu.rpc.channel import Channel, ChannelOptions
+
+            boot = Channel()
+            if not boot.init(
+                EndPoint(ip=ep.ip, port=ep.port),
+                options=ChannelOptions(
+                    timeout_ms=timeout_ms,
+                    auth=auth,
+                    ssl_context=ssl_context,
+                    ssl_server_hostname=ssl_server_hostname,
+                ),
+            ):
+                raise ConnectionError(
+                    f"device-link bootstrap channel init failed for {ep}"
+                )
+            ds = establish_device_link(
+                boot,
+                device_index=device_index,
+                slot_words=slot_words,
+                window=window,
+                timeout_ms=timeout_ms,
+            )
+            with self._lock:
+                # opportunistic sweep: recycle dead entries so a long-lived
+                # process contacting many ephemeral peers does not
+                # accumulate dead sockets in the registry
+                for k, old in [
+                    (k, v) for k, v in self._links.items() if v.state != CONNECTED
+                ]:
+                    old.recycle()
+                    del self._links[k]
+                self._links[key] = ds
+            return ds
+
+    def live_links(self) -> List["DeviceSocket"]:
+        from incubator_brpc_tpu.transport.sock import CONNECTED
+
+        with self._lock:
+            return [ds for ds in self._links.values() if ds.state == CONNECTED]
+
+
+device_link_map = DeviceLinkMap()
 
 
 def make_handshake_handler(server):

@@ -74,8 +74,9 @@ class TestConnectionTypes:
         assert server.connection_count() == 1
 
     def test_lb_target_accepts_non_single(self, server):
-        # pooled/short now work with naming+LB (secondaries hang off each
-        # endpoint's map entry); transport='tpu' still requires single-server
+        # pooled/short work with naming+LB (secondaries hang off each
+        # endpoint's map entry); transport='tpu' resolves LB picks through
+        # the DeviceLinkMap (one link per peer — the N-party fabric)
         ch = Channel()
         assert ch.init(
             f"list://127.0.0.1:{server.port}",
@@ -84,12 +85,12 @@ class TestConnectionTypes:
         )
         assert ch.call_method("ct", "echo", b"via-short-lb").ok()
         ch2 = Channel()
-        with pytest.raises(ValueError):
-            ch2.init(
-                f"list://127.0.0.1:{server.port}",
-                "rr",
-                options=ChannelOptions(transport="tpu"),
-            )
+        assert ch2.init(
+            f"list://127.0.0.1:{server.port}",
+            "rr",
+            options=ChannelOptions(transport="tpu", timeout_ms=60000),
+        )
+        assert ch2.call_method("ct", "echo", b"via-tpu-lb").ok()
 
     def test_backup_request_keeps_original_connection(self):
         """A backup attempt must NOT settle the original attempt's

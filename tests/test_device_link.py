@@ -260,6 +260,105 @@ class TestHostLoopbackFastPath:
         assert gbps > 0.2, f"loopback link moved only {gbps:.3f} GB/s"
 
 
+class TestNPartyFabric:
+    """The SocketMap-analog link manager: N peers, one link per peer device,
+    partitioned RPC over the device plane (VERDICT r3 item 3)."""
+
+    def _start_partition_servers(self, n=4):
+        from incubator_brpc_tpu.rpc import Server, ServerOptions
+
+        servers = []
+        for i in range(n):
+            # each partition's server binds its own mesh device (1..n);
+            # the client side of every link is device 0 — a star fabric
+            s = Server(ServerOptions(device_index=i + 1, usercode_inline=True))
+            s.add_service(
+                "part", {"get": (lambda cntl, req, _i=i: f"p{_i}:".encode() + req)}
+            )
+            assert s.start(0)
+            servers.append(s)
+        return servers
+
+    def test_partition_channel_over_device_links(self):
+        import jax
+
+        from incubator_brpc_tpu.rpc.combo import PartitionChannel
+
+        if len(jax.devices()) < 5:
+            pytest.skip("needs a 5+ device mesh")
+        servers = self._start_partition_servers(4)
+        try:
+            url = "list://" + ",".join(
+                f"127.0.0.1:{s.port} {i}/4" for i, s in enumerate(servers)
+            )
+            pc = PartitionChannel()
+            assert pc.init(
+                url,
+                partition_count=4,
+                options=ChannelOptions(transport="tpu", timeout_ms=60000),
+            )
+            cntl = pc.call_method("part", "get", b"X")
+            assert cntl.ok(), cntl.error_text
+            # default merger concatenates in channel (partition) order
+            assert cntl.response_payload == b"p0:Xp1:Xp2:Xp3:X"
+            # every sub-channel rides a device link, each to a DIFFERENT
+            # server device, all sharing the client device — a 5-party star
+            links = [sub[0]._device_sock.link for sub in pc._subs]
+            assert all(link._mesh is not None for link in links)
+            client_devs = {str(link.devices[0]) for link in links}
+            server_devs = [str(link.devices[1]) for link in links]
+            assert len(client_devs) == 1
+            assert len(set(server_devs)) == 4
+            assert client_devs.isdisjoint(server_devs)
+            pc.stop()
+        finally:
+            for s in servers:
+                s.stop()
+                s.join(timeout=5)
+
+    def test_link_map_dedupes_links_across_channels(self):
+        from incubator_brpc_tpu.rpc import Server, ServerOptions
+
+        srv = Server(ServerOptions(device_index=1))
+        srv.add_service("EchoService", {"Echo": lambda cntl, req: req})
+        assert srv.start(0)
+        try:
+            ch1 = _tpu_channel(srv)
+            ch2 = _tpu_channel(srv)
+            assert ch1.call_method("EchoService", "Echo", b"a").ok()
+            assert ch2.call_method("EchoService", "Echo", b"b").ok()
+            # one handshake, one link: both channels share the map entry
+            assert ch1._device_sock is ch2._device_sock
+        finally:
+            srv.stop()
+            srv.join(timeout=5)
+
+    def test_lb_target_with_tpu_transport(self):
+        from incubator_brpc_tpu.rpc import Server, ServerOptions
+
+        s1 = Server(ServerOptions(device_index=1))
+        s2 = Server(ServerOptions(device_index=2))
+        for i, s in enumerate((s1, s2)):
+            s.add_service("svc", {"who": (lambda cntl, req, _i=i: f"s{_i}".encode())})
+            assert s.start(0)
+        try:
+            ch = Channel()
+            assert ch.init(
+                f"list://127.0.0.1:{s1.port},127.0.0.1:{s2.port}",
+                "rr",
+                options=ChannelOptions(transport="tpu", timeout_ms=60000),
+            )
+            seen = set()
+            for _ in range(6):
+                cntl = ch.call_method("svc", "who", b"")
+                assert cntl.ok(), cntl.error_text
+                seen.add(cntl.response_payload)
+            assert seen == {b"s0", b"s1"}  # rr rotated across both peers
+        finally:
+            s1.stop()
+            s2.stop()
+
+
 class TestZeroCopyDelivery:
     def test_received_blocks_reference_step_output_memory(self, echo_server):
         # The receive path must wrap the link step's output buffer as an
