@@ -51,6 +51,12 @@ class LoadBalancer:
         """Called after each RPC completes (Controller Call::OnComplete →
         LoadBalancer::Feedback). Default: ignore."""
 
+    def settle(self, ep: EndPoint) -> None:
+        """Release a selection that never became an RPC (e.g. a fused
+        collective dispatch probed the pick then went another way) WITHOUT
+        recording a latency sample. Default: ignore; la undoes its
+        in-flight charge."""
+
     def servers(self) -> List[EndPoint]:
         raise NotImplementedError
 
@@ -327,6 +333,12 @@ class LocalityAwareLB(_SnapshotLB):
                     self.DECAY * st.ewma_latency_us + (1 - self.DECAY) * latency_us
                 )
 
+    def settle(self, ep: EndPoint) -> None:
+        st = self._stat(ep)
+        with st.lock:
+            if st.inflight > 0:
+                st.inflight -= 1
+
     def expected_latency_us(self, ep: EndPoint) -> float:
         st = self._stat(ep)
         with st.lock:
@@ -465,6 +477,12 @@ class LoadBalancerWithNaming:
             ep = self._ep_by_sid.get(sock.id)
         if ep is not None:
             self.lb.feedback(ep, latency_us, error_code)
+
+    def settle(self, sock) -> None:
+        with self._map_lock:
+            ep = self._ep_by_sid.get(sock.id)
+        if ep is not None:
+            self.lb.settle(ep)
 
     def servers(self) -> List[EndPoint]:
         return self.lb.servers()

@@ -28,6 +28,7 @@ from incubator_brpc_tpu.rpc.combo import (
     SelectiveChannel,
     SubCall,
 )
+from incubator_brpc_tpu.rpc.device_method import DeviceMethod, device_method
 from incubator_brpc_tpu.rpc.stream import (
     Stream,
     StreamHandler,
@@ -58,6 +59,8 @@ __all__ = [
     "Stream",
     "StreamHandler",
     "StreamOptions",
+    "DeviceMethod",
+    "device_method",
     "native_echo",
     "native_nop",
     "stream_accept",

@@ -80,11 +80,25 @@ class ResponseMerger:
 
 
 class ParallelChannel:
-    """Scatter/gather across sub-channels (parallel_channel.cpp)."""
+    """Scatter/gather across sub-channels (parallel_channel.cpp).
 
-    def __init__(self, fail_limit: int = -1):
+    When every non-skipped sub-channel rides a device link (transport=
+    'tpu') to a DISTINCT mesh device and the target method is a registered
+    device method (rpc/device_method.py), the whole scatter → execute →
+    gather fuses into ONE shard_map dispatch: each server device runs the
+    method kernel on its sub-request shard and an all-gather
+    (parallel/collective.fanout) returns every response in a single
+    collective — the SURVEY §2.5 lowering of this row ("ParallelChannel
+    fan-out/merge → all-gather across pod replicas"; BASELINE configs
+    #3/#4). Both paths run the same jitted kernel, so fused and host
+    fan-out produce byte-identical merged responses; any precondition
+    miss or dispatch failure falls back to the host path silently."""
+
+    def __init__(self, fail_limit: int = -1, fuse_device_calls: bool = True):
         self.fail_limit = fail_limit
+        self.fuse_device_calls = fuse_device_calls
         self._subs: List[Tuple[Channel, CallMapper, ResponseMerger]] = []
+        self._fused_cache: dict = {}  # (dm id, devices) -> compiled dispatch
 
     def add_channel(
         self,
@@ -127,6 +141,15 @@ class ParallelChannel:
             if done:
                 done(cntl)
             return cntl
+        if self.fuse_device_calls and ndone >= 2:
+            fused = self._maybe_fused_device_call(service, method, request, plan, cntl)
+            if fused is not None:
+                cntl.response_payload = fused
+                cntl.collective_fused = True
+                if done is not None:
+                    done(cntl)
+                return cntl
+
         # 1 <= fail_limit <= ndone (parallel_channel.cpp:625-637)
         fail_limit = self.fail_limit
         if fail_limit < 0:
@@ -206,6 +229,158 @@ class ParallelChannel:
         return cntl
 
     call = call_method
+
+    # -- the ICI collective lowering (SURVEY §2.5; BASELINE #3/#4) -----------
+
+    def _maybe_fused_device_call(
+        self, service, method, request, plan, cntl
+    ) -> Optional[bytes]:
+        """One shard_map dispatch over the sub-channels' server devices, or
+        None when the preconditions don't hold (host fan-out runs instead).
+
+        Preconditions: the method has a registered device kernel; every
+        non-skipped sub-channel uses transport='tpu' and resolves a live
+        device link; the links' server devices are pairwise distinct (they
+        form the mesh axis); every sub-request fits the kernel row width.
+        """
+        import time as _time
+
+        from incubator_brpc_tpu.rpc.device_method import lookup_device_method
+
+        dm = lookup_device_method(service, method)
+        if dm is None:
+            return None
+        full = f"{service}.{method}"
+        fp = dm.fingerprint()
+        subs = [(i, p) for i, p in enumerate(plan) if p is not None]
+        requests: List[bytes] = []
+        devices = []
+        probed: List[tuple] = []  # (channel, device socket) picks to settle
+
+        def _settle_probes() -> None:
+            # release LB picks that never became an RPC (la charges
+            # in-flight on select; an un-settled probe would depress the
+            # peer's weight forever) — no latency sample is recorded
+            for pch, pds in probed:
+                if pch._lb is not None:
+                    pch._lb.settle(pds)
+
+        for _i, (ch, _merger, sub) in subs:
+            if sub.service is not None or sub.method is not None:
+                # a mapper that redirects a sub-call to a different method
+                # must run on the host path (the fused program compiles ONE
+                # kernel for the whole axis)
+                _settle_probes()
+                return None
+            if getattr(ch._options, "transport", "") != "tpu":
+                _settle_probes()
+                return None
+            req = request if sub.request is None else sub.request
+            if len(req) > dm.width:
+                _settle_probes()
+                return None
+            requests.append(req)
+            try:
+                ds = ch._pick_socket(Controller(timeout_ms=cntl.timeout_ms))
+            except Exception:
+                _settle_probes()
+                return None  # cannot resolve a link: host path arbitrates
+            probed.append((ch, ds))
+            link = getattr(ds, "link", None)
+            if link is None or link._mesh is None:
+                _settle_probes()
+                return None  # not a device link (or loopback geometry)
+            if getattr(ds, "device_methods", {}).get(full) != fp:
+                # the peer did not advertise THIS kernel under this name —
+                # fusing would run a kernel the server never registered
+                _settle_probes()
+                return None
+            devices.append(link.devices[1])
+        ids = [getattr(d, "id", None) for d in devices]
+        if len(set(ids)) != len(ids):
+            _settle_probes()
+            return None  # shared devices cannot form the collective axis
+        t0 = _time.perf_counter()
+        try:
+            rows_out, ns_out = self._fused_dispatch(dm, devices, requests)
+        except Exception:
+            logger.exception(
+                "fused collective dispatch failed; using host fan-out"
+            )
+            _settle_probes()
+            return None
+        # the servers DID serve this dispatch: settle each LB pick with the
+        # real fused latency (the host path's per-sub feedback analog)
+        latency_us = (_time.perf_counter() - t0) * 1e6
+        for pch, pds in probed:
+            if pch._lb is not None:
+                pch._lb.feedback(pds, latency_us, 0)
+        # merge in channel-index order with each sub's merger — the exact
+        # host-path semantics, so the merged bytes are identical
+        merged = b""
+        for pos, (_i, (ch, merger, _sub)) in enumerate(subs):
+            merged = merger.merge(merged, dm.unpack(rows_out[pos], ns_out[pos]))
+        return merged
+
+    def _fused_dispatch(self, dm, devices, requests: List[bytes]):
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        try:
+            from jax import shard_map  # JAX >= 0.8
+        except ImportError:  # pragma: no cover — older JAX
+            from jax.experimental.shard_map import shard_map
+
+        from incubator_brpc_tpu.parallel import collective
+
+        n = len(devices)
+        key = (
+            dm.fingerprint(),
+            tuple(getattr(d, "id", i) for i, d in enumerate(devices)),
+        )
+        cached = self._fused_cache.get(key)
+        if cached is not None and cached[3] is not dm:
+            cached = None  # same name re-registered with a new DeviceMethod
+        if cached is None:
+            mesh = Mesh(np.asarray(devices), ("par",))
+            data_sh = NamedSharding(mesh, P("par"))
+
+            def body(data, ns):
+                # per-partition service execution on this shard's device...
+                out, m = dm.kernel(data[0], ns[0])
+                # ...then ONE all-gather returns every response everywhere
+                # (parallel/collective.fanout — the ParallelChannel merge
+                # side lowered to the ICI collective)
+                return collective.fanout(out, "par"), collective.fanout(m, "par")
+
+            sm_kwargs = dict(
+                mesh=mesh, in_specs=(P("par"), P("par")), out_specs=(P(), P())
+            )
+            try:
+                # the all_gather makes outputs replicated, but newer JAX
+                # cannot statically infer that — disable the check
+                wrapped = shard_map(body, check_vma=False, **sm_kwargs)
+            except TypeError:  # older JAX: no check_vma kwarg
+                wrapped = shard_map(body, **sm_kwargs)
+            fused = jax.jit(wrapped)
+            cached = (fused, data_sh, mesh, dm)
+            self._fused_cache[key] = cached
+        fused, data_sh, mesh, _ = cached
+        rows = np.stack([dm.pack(r)[0] for r in requests])
+        ns = np.asarray([len(r) for r in requests], dtype=np.int32)
+        data = jax.make_array_from_single_device_arrays(
+            (n, dm.width),
+            data_sh,
+            [jax.device_put(rows[i : i + 1], devices[i]) for i in range(n)],
+        )
+        ns_sharded = jax.make_array_from_single_device_arrays(
+            (n,),
+            data_sh,
+            [jax.device_put(ns[i : i + 1], devices[i]) for i in range(n)],
+        )
+        g, gm = fused(data, ns_sharded)
+        return np.asarray(g), np.asarray(gm)
 
 
 # -- SelectiveChannel --------------------------------------------------------

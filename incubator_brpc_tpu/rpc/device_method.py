@@ -1,0 +1,125 @@
+"""Device methods — RPC methods with a jittable device kernel, the seam
+through which combo channels lower to ICI collectives.
+
+The reference's ParallelChannel fans one call out over N sub-channels and
+merges the replies on the caller (parallel_channel.cpp:36-101); SURVEY
+§2.5 maps that row to an all-gather over the device mesh, and BASELINE
+configs #3/#4 name the lowering ("parallel_echo/partition_echo lowered to
+ICI all-gather/all-to-all"). The lowering is only sound when the method's
+server-side work is a pure device function — so services DECLARE it:
+
+    kernel(data: uint8[width], n: int32) -> (uint8[width], int32)
+
+``device_method(kernel)`` wraps that kernel into an ordinary host handler
+(the server runs the same jitted kernel on its own device for point-to-
+point calls), and registers it so a ParallelChannel/PartitionChannel whose
+sub-channels all ride device links can fuse the whole scatter→execute→
+gather into ONE shard_map dispatch (rpc/combo.py). Both paths execute the
+same compiled kernel, so fused and host fan-out produce byte-identical
+merged responses.
+
+Registering a device method is an explicit contract: the kernel sees only
+request bytes (no Controller, no auth fight, no per-request admission), so
+it must be pure — exactly the class of method the reference would have
+made an RDMA-side fast path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+DEFAULT_WIDTH = 4096
+
+
+class DeviceMethod:
+    """A jittable bytes-in/bytes-out kernel with fixed row geometry."""
+
+    def __init__(self, kernel: Callable, width: int = DEFAULT_WIDTH):
+        self.kernel = kernel
+        self.width = width
+        self._jitted = None
+        self._lock = threading.Lock()
+        self._fingerprint: Optional[str] = None
+
+    def fingerprint(self) -> str:
+        """Stable identity of the kernel+geometry, advertised by servers in
+        the device-link handshake and checked by the fused dispatch: the
+        client only lowers a call when the peer registered the SAME kernel
+        under that name (a name collision across servers must kill fusion,
+        not silently diverge from the host path). Source text is included
+        when obtainable so same-name/different-body kernels differ."""
+        if self._fingerprint is None:
+            import hashlib
+            import inspect
+
+            ident = (
+                f"{getattr(self.kernel, '__module__', '')}."
+                f"{getattr(self.kernel, '__qualname__', repr(self.kernel))}"
+                f":{self.width}"
+            )
+            try:
+                ident += ":" + inspect.getsource(self.kernel)
+            except (OSError, TypeError):
+                pass
+            self._fingerprint = hashlib.sha1(ident.encode()).hexdigest()[:16]
+        return self._fingerprint
+
+    def jitted(self):
+        import jax
+
+        with self._lock:
+            if self._jitted is None:
+                self._jitted = jax.jit(self.kernel)
+            return self._jitted
+
+    def pack(self, request: bytes) -> Tuple[np.ndarray, np.int32]:
+        if len(request) > self.width:
+            raise ValueError(
+                f"request of {len(request)}B exceeds device-method width "
+                f"{self.width}"
+            )
+        row = np.zeros(self.width, dtype=np.uint8)
+        row[: len(request)] = np.frombuffer(request, dtype=np.uint8)
+        return row, np.int32(len(request))
+
+    def unpack(self, row, n) -> bytes:
+        n = int(n)
+        return bytes(np.asarray(row[:n], dtype=np.uint8))
+
+
+# (service, method) -> DeviceMethod; filled by Server.add_service when a
+# handler carries ._device_method (process-global, like the reference's
+# method map being reachable from the protocol layer)
+_registry: Dict[Tuple[str, str], DeviceMethod] = {}
+_registry_lock = threading.Lock()
+
+
+def register_device_method(service: str, method: str, dm: DeviceMethod) -> None:
+    with _registry_lock:
+        _registry[(service, method)] = dm
+
+
+def lookup_device_method(service: str, method: str) -> Optional[DeviceMethod]:
+    with _registry_lock:
+        return _registry.get((service, method))
+
+
+def device_method(kernel: Callable, width: int = DEFAULT_WIDTH) -> Callable:
+    """Wrap a device kernel into a host RPC handler.
+
+    The handler runs the SAME jitted kernel the fused collective path
+    runs, on this process's default device — point-to-point calls and the
+    fused ParallelChannel dispatch therefore return identical bytes.
+    """
+    dm = DeviceMethod(kernel, width=width)
+
+    def handler(cntl, request: bytes) -> bytes:
+        row, n = dm.pack(request)
+        out_row, out_n = dm.jitted()(row, n)
+        return dm.unpack(np.asarray(out_row), out_n)
+
+    handler._device_method = dm
+    return handler

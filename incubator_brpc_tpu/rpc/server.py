@@ -226,6 +226,7 @@ class Server:
         self.nerror = Adder(name=None)
         self.listen_endpoint: Optional[EndPoint] = None
         self._device_socks: list = []  # transport='tpu' links we accepted
+        self._device_methods: dict = {}  # full name -> DeviceMethod (fused)
         self._native_plane = None  # NativeServerPlane when options ask for it
 
     # -- registration --------------------------------------------------------
@@ -265,6 +266,21 @@ class Server:
                 else self.options.method_max_concurrency
             )
             self._methods.insert(full, MethodProperty(handler, MethodStatus(full, mc), full))
+            dm = getattr(handler, "_device_method", None)
+            if dm is not None:
+                # device-kernel methods publish to the collective-lowering
+                # registry: combo channels whose sub-channels all ride
+                # device links fuse calls to this method into one shard_map
+                # dispatch (rpc/device_method.py, rpc/combo.py). The
+                # per-server table feeds the handshake's fingerprint
+                # advertisement so a client never fuses against a peer
+                # serving a DIFFERENT kernel under the same name.
+                from incubator_brpc_tpu.rpc.device_method import (
+                    register_device_method,
+                )
+
+                register_device_method(name, method, dm)
+                self._device_methods[full] = dm
         self._restful.extend(restful_rows)
 
     def _parse_restful_mappings(

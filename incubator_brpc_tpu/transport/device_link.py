@@ -789,7 +789,18 @@ def make_handshake_handler(server):
 
         ds.on_failed.append(_forget)
         return json.dumps(
-            {"device": server_dev, "slot_words": slot_words, "window": window}
+            {
+                "device": server_dev,
+                "slot_words": slot_words,
+                "window": window,
+                # fingerprints of this server's device-kernel methods: the
+                # client's fused combo dispatch only lowers a call when the
+                # peer advertises the SAME kernel under that name
+                "device_methods": {
+                    full: dm.fingerprint()
+                    for full, dm in getattr(server, "_device_methods", {}).items()
+                },
+            }
         ).encode()
 
     return handshake
@@ -827,10 +838,19 @@ def establish_device_link(
     link = link_hub.take(cookie)
     if link is None:
         raise ConnectionError("device handshake succeeded but link not found")
+    try:
+        advertised = json.loads(cntl.response_payload.decode()).get(
+            "device_methods", {}
+        )
+    except (ValueError, AttributeError):
+        advertised = {}
     from incubator_brpc_tpu.rpc import channel as channel_mod
 
-    return DeviceSocket(
+    ds = DeviceSocket(
         link,
         side=0,
         messenger=channel_mod._client_messenger,
     )
+    # the peer's device-kernel fingerprints gate the fused combo dispatch
+    ds.device_methods = advertised
+    return ds
