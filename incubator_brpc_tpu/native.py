@@ -189,6 +189,15 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
             ctypes.c_int,
             [b, ctypes.c_char_p, ctypes.c_int, ctypes.c_uint32],
         ),
+        # user C callback methods: int (*)(void* ud, const char* req,
+        # size_t len, char** resp, size_t* resp_len) — the fn pointer is
+        # passed as a raw void* (dlsym'd from a user .so, or a ctypes
+        # CFUNCTYPE cast down)
+        "tb_server_register_native_fn": (
+            ctypes.c_int,
+            [b, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p,
+             ctypes.c_uint32],
+        ),
         "tb_server_listen": (ctypes.c_int, [b, ctypes.c_char_p, ctypes.c_int]),
         "tb_server_port": (ctypes.c_int, [b]),
         "tb_server_stop": (None, [b]),

@@ -48,6 +48,41 @@ def _native_kind(handler) -> Optional[int]:
     return getattr(handler, "_native_kind", None)
 
 
+# int (*)(void* ud, const char* req, size_t len, char** resp, size_t* n)
+NATIVE_METHOD_FN = ctypes.CFUNCTYPE(
+    ctypes.c_int,
+    ctypes.c_void_p,
+    ctypes.c_char_p,
+    ctypes.c_size_t,
+    ctypes.POINTER(ctypes.c_char_p),
+    ctypes.POINTER(ctypes.c_size_t),
+)
+
+
+def native_method_lib(lib_path: str, symbol: str, fallback) -> "object":
+    """Tag ``fallback`` (the ordinary Python handler, used when the native
+    plane is off) with a shared-library implementation of the same method:
+    ``symbol`` in ``lib_path`` must be a ``tb_native_fn``
+    (src/tbnet/tbnet.h). When the server runs on the native plane, requests
+    to this method are answered entirely on the C++ loop thread — the
+    generalization of the built-in echo/nop kinds to USER code (the
+    reference's whole request path is native user code,
+    baidu_rpc_protocol.cpp:307-503).
+
+    The two implementations must agree: the Python fallback is the
+    method's portable semantics, the .so its native fast path."""
+    try:
+        fallback._native_lib = (lib_path, symbol)
+        return fallback
+    except AttributeError:  # bound methods can't carry attributes: wrap
+
+        def handler(cntl, request, _fb=fallback):
+            return _fb(cntl, request)
+
+        handler._native_lib = (lib_path, symbol)
+        return handler
+
+
 def native_echo(cntl, request: bytes) -> bytes:
     """Echo handler the native plane can run without the interpreter; works
     identically as a plain Python handler when the plane is off."""
@@ -164,6 +199,7 @@ class NativeServerPlane:
         self._socks: Dict[int, NativeConnSock] = {}
         self._socks_lock = threading.Lock()
         self._handoff_socks: set = set()  # live handed-off Python Sockets
+        self._user_libs: list = []  # dlopened user-method libraries
         self._stopped = False
         self.port = 0
 
@@ -187,6 +223,35 @@ class NativeServerPlane:
                 LIB.tb_server_register_native(
                     self._srv, full.encode(), kind, prop.status.max_concurrency
                 )
+                continue
+            lib_spec = getattr(prop.handler, "_native_lib", None)
+            if lib_spec is not None:
+                # user method from a shared library: dlopen + dlsym, then
+                # hand the raw fn pointer to tbnet — requests to it never
+                # touch the interpreter (the dlopen handle stays alive for
+                # the plane's lifetime)
+                path, symbol = lib_spec
+                try:
+                    dll = ctypes.CDLL(path)
+                    fn = ctypes.cast(getattr(dll, symbol), ctypes.c_void_p)
+                except (OSError, AttributeError) as e:
+                    logger.warning(
+                        "native method lib %s:%s unavailable (%s); "
+                        "%s stays on the Python route", path, symbol, e, full
+                    )
+                    continue
+                rc = LIB.tb_server_register_native_fn(
+                    self._srv, full.encode(), fn, None,
+                    prop.status.max_concurrency,
+                )
+                if rc == 0:
+                    self._user_libs.append(dll)  # keepalive
+                else:
+                    logger.warning(
+                        "native registration of %s rejected (duplicate or "
+                        "method-key collision); it stays on the Python "
+                        "route", full
+                    )
 
     def listen(self, ip: str, port: int) -> int:
         rc = LIB.tb_server_listen(self._srv, ip.encode(), port)

@@ -52,6 +52,7 @@ constexpr size_t kHeader = 32;
 
 constexpr int kKindEcho = 1;
 constexpr int kKindNop = 2;
+constexpr int kKindCallback = 3;  // user C fn: tb_server_register_native_fn
 
 uint64_t now_ms() {
   return static_cast<uint64_t>(
@@ -327,6 +328,8 @@ struct NativeMethod {
   std::atomic<uint64_t> nreq{0};
   std::atomic<uint64_t> nerr{0};
   std::string full_name;
+  tb_native_fn fn = nullptr;  // kKindCallback
+  void* ud = nullptr;
 };
 
 struct Listener : PollObj {
@@ -449,38 +452,37 @@ void conn_destroy(NetConn* c, bool close_fd) {
 
 // ---- server-side frame dispatch ----
 
-void respond_error(NetConn* c, uint32_t cid_lo, uint32_t cid_hi, uint32_t code,
-                   const char* text) {
+// append an error response frame into `out` (flushed with the batch)
+void append_error(tb_iobuf* out, uint32_t cid_lo, uint32_t cid_hi,
+                  uint32_t code, const char* text) {
   char meta[256];
   int n = snprintf(meta, sizeof meta, "{\"error_text\":\"%s\"}", text);
   if (n < 0) n = 0;
-  tb_iobuf* out = tb_iobuf_create();
   pack_flat(out, meta, static_cast<size_t>(n), nullptr, 0, nullptr, 0, cid_lo,
             cid_hi, kFlagResponse, code);
-  conn_queue_iobuf(c, out);
-  tb_iobuf_destroy(out);
 }
 
-// echo/nop native kinds: the response is built and queued without ever
-// leaving C++ — the whole ProcessRpcRequest/SendRpcResponse round
-// (baidu_rpc_protocol.cpp:307,136) for these methods is native
+// Native method kinds: the response is built and appended into the burst's
+// batch without ever leaving C++ — the whole ProcessRpcRequest/user code/
+// SendRpcResponse round (baidu_rpc_protocol.cpp:307-503,136) for these
+// methods is native.  `out` collects every response of one readable burst;
+// the caller queues it once (one writev per burst, not per request).
 void run_native(NetConn* c, NativeMethod* nm, const tb_tbus_hdr* hdr,
-                const MetaLite& ml, tb_iobuf* body) {
+                const MetaLite& ml, tb_iobuf* body, tb_iobuf* out) {
   nm->nreq.fetch_add(1, std::memory_order_relaxed);
   c->srv->native_reqs.fetch_add(1, std::memory_order_relaxed);
   if (nm->max_concurrency &&
       nm->nprocessing.fetch_add(1) >= nm->max_concurrency) {
     nm->nprocessing.fetch_sub(1);
     nm->nerr.fetch_add(1, std::memory_order_relaxed);
-    respond_error(c, hdr->cid_lo, hdr->cid_hi, c->srv->errs.elimit,
-                  "concurrency limit reached");
+    append_error(out, hdr->cid_lo, hdr->cid_hi, c->srv->errs.elimit,
+                 "concurrency limit reached");
     tb_iobuf_destroy(body);
     return;
   }
   uint32_t flags = kFlagResponse | (hdr->flags & kFlagBodyCrc);
   char meta[64];
   size_t meta_len = 0;
-  tb_iobuf* out = tb_iobuf_create();
   if (nm->kind == kKindEcho) {
     if (ml.attachment > 0) {
       int n = snprintf(meta, sizeof meta, "{\"attachment_size\":%ld}",
@@ -494,12 +496,41 @@ void run_native(NetConn* c, NativeMethod* nm, const tb_tbus_hdr* hdr,
     append_header(out, meta, meta_len, blen, crc, hdr->cid_lo, hdr->cid_hi,
                   flags, 0);
     tb_iobuf_append_iobuf(out, body);  // zero-copy: request refs shared
-  } else {                             // nop
+  } else if (nm->kind == kKindCallback) {
+    // contiguous request for the C ABI (stack buffer for small bodies)
+    size_t blen = tb_iobuf_size(body);
+    char stackbuf[4096];
+    char* req = blen <= sizeof stackbuf ? stackbuf
+                                        : static_cast<char*>(malloc(blen));
+    if (req == nullptr) {  // OOM on a huge body: an error response, not a crash
+      nm->nerr.fetch_add(1, std::memory_order_relaxed);
+      append_error(out, hdr->cid_lo, hdr->cid_hi, c->srv->errs.erequest,
+                   "request too large to stage");
+      tb_iobuf_destroy(body);
+      if (nm->max_concurrency) nm->nprocessing.fetch_sub(1);
+      return;
+    }
+    if (blen) tb_iobuf_copy_to(body, req, blen, 0);
+    char* resp = nullptr;
+    size_t resp_len = 0;
+    int rc = nm->fn(nm->ud, req, blen, &resp, &resp_len);
+    if (req != stackbuf) free(req);
+    if (rc != 0) {
+      nm->nerr.fetch_add(1, std::memory_order_relaxed);
+      append_error(out, hdr->cid_lo, hdr->cid_hi, static_cast<uint32_t>(rc),
+                   "native method failed");
+    } else {
+      uint32_t crc = tb_crc32c(0, nullptr, 0);
+      if (flags & kFlagBodyCrc) crc = tb_crc32c(crc, resp, resp_len);
+      append_header(out, nullptr, 0, resp_len, crc, hdr->cid_lo, hdr->cid_hi,
+                    flags, 0);
+      if (resp_len) tb_iobuf_append(out, resp, resp_len);
+    }
+    free(resp);
+  } else {  // nop
     append_header(out, nullptr, 0, 0, tb_crc32c(0, nullptr, 0), hdr->cid_lo,
                   hdr->cid_hi, flags, 0);
   }
-  conn_queue_iobuf(c, out);
-  tb_iobuf_destroy(out);
   tb_iobuf_destroy(body);
   if (nm->max_concurrency) nm->nprocessing.fetch_sub(1);
 }
@@ -536,26 +567,51 @@ FrameStatus process_frames(NetConn* c) {
     }
     c->sniffed = true;
   }
+  // One response batch per readable burst: native responses append here
+  // and flush with ONE conn_queue_iobuf (one writev) at every exit —
+  // the per-request syscall was the dominant cost of the old shape.
+  tb_iobuf* batch = tb_iobuf_create();
+  auto flush = [&](FrameStatus st) {
+    // every exit flushes: even a killed connection sends the responses of
+    // the frames that parsed cleanly before the bad one
+    if (tb_iobuf_size(batch) > 0) conn_queue_iobuf(c, batch);
+    tb_iobuf_destroy(batch);
+    return st;
+  };
   for (;;) {
     tb_tbus_hdr hdr;
     int rc = tb_tbus_peek(c->rbuf, &hdr);
-    if (rc == 1) return FrameStatus::kOk;
+    if (rc == 1) return flush(FrameStatus::kOk);
     if (rc == -1 || hdr.meta_len > hdr.body_len || hdr.body_len > s->max_body) {
+      flush(FrameStatus::kKilled);  // earlier valid responses go out
       conn_destroy(c, true);
       return FrameStatus::kKilled;
     }
-    if (tb_iobuf_size(c->rbuf) < kHeader + hdr.body_len) return FrameStatus::kOk;
-    std::string meta(hdr.meta_len, '\0');
+    if (tb_iobuf_size(c->rbuf) < kHeader + hdr.body_len)
+      return flush(FrameStatus::kOk);
+    char mstack[4096];
+    std::string mheap;
+    char* mptr = nullptr;
+    if (hdr.meta_len > 0) {
+      if (hdr.meta_len <= sizeof mstack) {
+        mptr = mstack;
+      } else {
+        mheap.resize(hdr.meta_len);
+        mptr = &mheap[0];
+      }
+    }
     tb_iobuf* body = tb_iobuf_create();
-    rc = tb_tbus_cut(c->rbuf, &hdr, meta.empty() ? nullptr : &meta[0], body);
+    rc = tb_tbus_cut(c->rbuf, &hdr, mptr, body);
     if (rc != 0) {  // crc mismatch / malformed: the stream can't re-sync
       tb_iobuf_destroy(body);
+      flush(FrameStatus::kKilled);
       conn_destroy(c, true);
       return FrameStatus::kKilled;
     }
+    const char* cb_meta = mptr != nullptr ? mptr : mstack;  // never null
     // native fast path: plain request frame whose meta is fully understood
     if ((hdr.flags & (kFlagResponse | kFlagStream)) == 0) {
-      MetaLite ml = scan_meta(meta.data(), meta.size());
+      MetaLite ml = scan_meta(cb_meta, hdr.meta_len);
       if (ml.ok && !ml.to_python &&
           ml.attachment <= static_cast<long>(tb_iobuf_size(body))) {
         char full[256];
@@ -568,7 +624,7 @@ FrameStatus process_frames(NetConn* c) {
                              method_key(full, static_cast<size_t>(fn)),
                              &idx) == 1 &&
               s->native_methods[idx]->full_name == full) {
-            run_native(c, s->native_methods[idx], &hdr, ml, body);
+            run_native(c, s->native_methods[idx], &hdr, ml, body, batch);
             continue;
           }
         }
@@ -579,13 +635,13 @@ FrameStatus process_frames(NetConn* c) {
     s->cb_frames.fetch_add(1, std::memory_order_relaxed);
     if (s->frame_cb == nullptr) {
       if ((hdr.flags & kFlagResponse) == 0)
-        respond_error(c, hdr.cid_lo, hdr.cid_hi, s->errs.enomethod,
-                      "no such method");
+        append_error(batch, hdr.cid_lo, hdr.cid_hi, s->errs.enomethod,
+                     "no such method");
       tb_iobuf_destroy(body);
       continue;
     }
     s->frame_cb(s->frame_ctx, c->token, hdr.cid_lo, hdr.cid_hi, hdr.flags,
-                hdr.error_code, meta.data(), meta.size(), body);
+                hdr.error_code, cb_meta, hdr.meta_len, body);
   }
 }
 
@@ -709,20 +765,41 @@ void tb_server_set_closed_cb(tb_server* s, tb_closed_fn cb, void* ctx) {
 
 void tb_server_set_max_body(tb_server* s, size_t bytes) { s->max_body = bytes; }
 
-int tb_server_register_native(tb_server* s, const char* full_name, int kind,
-                              uint32_t max_concurrency) {
-  if (kind != kKindEcho && kind != kKindNop) return -1;
+namespace {
+
+int register_native_common(tb_server* s, const char* full_name, int kind,
+                           tb_native_fn fn, void* ud,
+                           uint32_t max_concurrency) {
   uint64_t key = method_key(full_name, strlen(full_name));
   uint64_t existing = 0;
   if (tb_flatmap_get(s->methods, key, &existing) == 1)
     return -1;  // double registration / key collision: keep the Python route
   NativeMethod* nm = new NativeMethod();
   nm->kind = kind;
+  nm->fn = fn;
+  nm->ud = ud;
   nm->max_concurrency = max_concurrency;
   nm->full_name = full_name;
   s->native_methods.push_back(nm);
   tb_flatmap_insert(s->methods, key, s->native_methods.size() - 1);
   return 0;
+}
+
+}  // namespace
+
+int tb_server_register_native(tb_server* s, const char* full_name, int kind,
+                              uint32_t max_concurrency) {
+  if (kind != kKindEcho && kind != kKindNop) return -1;
+  return register_native_common(s, full_name, kind, nullptr, nullptr,
+                                max_concurrency);
+}
+
+int tb_server_register_native_fn(tb_server* s, const char* full_name,
+                                 tb_native_fn fn, void* ud,
+                                 uint32_t max_concurrency) {
+  if (fn == nullptr) return -1;
+  return register_native_common(s, full_name, kKindCallback, fn, ud,
+                                max_concurrency);
 }
 
 int tb_server_listen(tb_server* s, const char* ip, int port) {
@@ -1212,23 +1289,24 @@ long tb_channel_pump(tb_channel* ch, const void* meta, size_t meta_len,
   long result = 0;
   auto t0 = std::chrono::steady_clock::now();
   while (done < n && result == 0) {
-    // fill the window
+    // fill the window: pack EVERY frame the window allows, then flush the
+    // whole batch with as few writev calls as the kernel accepts (one
+    // syscall per window refill, not per request)
     while (outstanding < inflight && sent < n) {
       uint64_t cid = ch->next_cid.fetch_add(1, std::memory_order_relaxed);
       pack_flat(frame, meta, meta_len, payload, payload_len, nullptr, 0,
                 static_cast<uint32_t>(cid), static_cast<uint32_t>(cid >> 32),
                 0, 0);
-      while (tb_iobuf_size(frame) > 0) {
-        long rc = tb_iobuf_cut_into_fd(frame, ch->fd, 4u << 20);
-        if (rc > 0) continue;
-        if (rc == -EINTR) continue;
-        if (rc == 0 || rc == -EAGAIN || rc == -EWOULDBLOCK) break;
-        result = rc;  // hard write error
-        break;
-      }
       ++sent;
       ++outstanding;
-      if (result != 0 || tb_iobuf_size(frame) > 0) break;  // kernel full
+    }
+    while (tb_iobuf_size(frame) > 0) {
+      long rc = tb_iobuf_cut_into_fd(frame, ch->fd, 4u << 20);
+      if (rc > 0) continue;
+      if (rc == -EINTR) continue;
+      if (rc == 0 || rc == -EAGAIN || rc == -EWOULDBLOCK) break;  // kernel full
+      result = rc;  // hard write error
+      break;
     }
     if (result != 0) break;
     // drain completions (and finish any partial write while waiting)

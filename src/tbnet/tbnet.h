@@ -60,6 +60,22 @@ void tb_server_set_max_body(tb_server* s, size_t bytes);
 // max_concurrency 0 = unlimited; exceeding it answers ELIMIT natively.
 int tb_server_register_native(tb_server* s, const char* full_name, int kind,
                               uint32_t max_concurrency);
+// User native method: bytes-in/bytes-out C callback, run entirely on the
+// loop thread — the request never crosses into Python (the reference's
+// whole ProcessRpcRequest/user-code/SendRpcResponse round is native,
+// baidu_rpc_protocol.cpp:307-503; this is that generality for tbnet).
+// Contract: `req` is the contiguous request payload (attachment included,
+// valid only during the call); on success (return 0) the callee mallocs
+// *resp (may be NULL when *resp_len==0) and tbnet free()s it after the
+// response is queued.  A nonzero return becomes the response error_code.
+// Must not block — it runs on the connection's event loop — and MUST be
+// thread-safe: connections are round-robined across loops, so the same
+// callback runs concurrently on multiple loop threads.
+typedef int (*tb_native_fn)(void* ud, const char* req, size_t req_len,
+                            char** resp, size_t* resp_len);
+int tb_server_register_native_fn(tb_server* s, const char* full_name,
+                                 tb_native_fn fn, void* ud,
+                                 uint32_t max_concurrency);
 // listen on ip:port (port 0 = ephemeral); returns the bound port or -errno.
 int tb_server_listen(tb_server* s, const char* ip, int port);
 int tb_server_port(const tb_server* s);

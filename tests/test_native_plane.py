@@ -253,6 +253,118 @@ class TestInterop:
         assert c.failed()  # recovered into a clean failure, no hang
 
 
+class TestUserNativeMethods:
+    """tb_server_register_native_fn: user bytes-in/bytes-out C methods run
+    entirely on the loop thread (VERDICT r3 item 4a — the generalization
+    of the built-in echo/nop kinds)."""
+
+    SRC = r"""
+    #include <stdlib.h>
+    #include <string.h>
+    extern "C" int reverse_method(void* ud, const char* req, size_t n,
+                                  char** resp, size_t* resp_len) {
+      (void)ud;
+      char* out = (char*)malloc(n ? n : 1);
+      for (size_t i = 0; i < n; ++i) out[i] = req[n - 1 - i];
+      *resp = out;
+      *resp_len = n;
+      return 0;
+    }
+    extern "C" int failing_method(void* ud, const char* req, size_t n,
+                                  char** resp, size_t* resp_len) {
+      (void)ud; (void)req; (void)n; (void)resp; (void)resp_len;
+      return 1008;  /* an application error code */
+    }
+    """
+
+    @pytest.fixture(scope="class")
+    def method_lib(self, tmp_path_factory):
+        import subprocess
+
+        d = tmp_path_factory.mktemp("native_methods")
+        src = d / "methods.cc"
+        so = d / "libmethods.so"
+        src.write_text(self.SRC)
+        subprocess.run(
+            ["g++", "-O2", "-fPIC", "-shared", "-o", str(so), str(src)],
+            check=True,
+            capture_output=True,
+        )
+        return str(so)
+
+    def _py_reverse(self, cntl, req):
+        return req[::-1]
+
+    def test_so_method_never_crosses_into_python(self, native_server, method_lib):
+        from incubator_brpc_tpu.transport.native_plane import native_method_lib
+
+        srv = native_server(
+            services={
+                "user": {
+                    "reverse": native_method_lib(
+                        method_lib, "reverse_method", self._py_reverse
+                    )
+                }
+            }
+        )
+        port = _start(srv)
+        ch = Channel()
+        assert ch.init(
+            f"127.0.0.1:{port}", options=ChannelOptions(native_plane=True)
+        )
+        before = srv._native_plane.stats()
+        for payload in (b"abc", b"", b"x" * 10000):
+            cntl = ch.call_method("user", "reverse", payload)
+            assert cntl.ok(), cntl.error_text
+            assert cntl.response_payload == payload[::-1]
+        after = srv._native_plane.stats()
+        assert after["native_reqs"] - before["native_reqs"] == 3
+        assert after["cb_frames"] == before["cb_frames"]  # zero Python frames
+
+    def test_so_method_error_code_surfaces(self, native_server, method_lib):
+        from incubator_brpc_tpu.transport.native_plane import native_method_lib
+
+        srv = native_server(
+            services={
+                "user": {
+                    "boom": native_method_lib(
+                        method_lib, "failing_method", self._py_reverse
+                    )
+                }
+            }
+        )
+        port = _start(srv)
+        ch = Channel()
+        assert ch.init(
+            f"127.0.0.1:{port}", options=ChannelOptions(native_plane=True)
+        )
+        cntl = ch.call_method("user", "boom", b"q")
+        assert cntl.failed()
+        assert cntl.error_code == 1008
+
+    def test_missing_symbol_falls_back_to_python_route(self, native_server, method_lib):
+        from incubator_brpc_tpu.transport.native_plane import native_method_lib
+
+        srv = native_server(
+            services={
+                "user": {
+                    "reverse": native_method_lib(
+                        method_lib, "no_such_symbol", self._py_reverse
+                    )
+                }
+            }
+        )
+        port = _start(srv)
+        ch = Channel()
+        assert ch.init(
+            f"127.0.0.1:{port}", options=ChannelOptions(native_plane=True)
+        )
+        cntl = ch.call_method("user", "reverse", b"abc")
+        assert cntl.ok(), cntl.error_text
+        assert cntl.response_payload == b"cba"  # the Python fallback served
+        assert srv._native_plane.stats()["cb_frames"] > 0
+
+
 class TestStreamsOverNative:
     def test_stream_over_native_conn(self, native_server):
         got = []
