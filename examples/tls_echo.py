@@ -62,6 +62,7 @@ def main() -> None:
     print(f"response={cntl.response_payload!r} "
           f"(cipher negotiated, cert verified)")
     server.stop()
+    tmp.cleanup()  # remove the throwaway key material promptly
 
 
 if __name__ == "__main__":

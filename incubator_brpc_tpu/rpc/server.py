@@ -213,6 +213,7 @@ class Server:
         self.options = options or ServerOptions()
         self._methods = _MethodMap()
         self._http_handlers: Dict[str, Callable] = {}
+        self._http_progressive: set = set()  # routes streaming chunked bodies
         # restful rows: (prefix, postfix, has_wildcard, service, method)
         self._restful: list = []
         self._acceptor: Optional[Acceptor] = None
@@ -338,14 +339,25 @@ class Server:
                     best, best_len = (service, method), score
         return best
 
-    def add_http_handler(self, path: str, handler: Callable) -> None:
+    def add_http_handler(
+        self, path: str, handler: Callable, progressive: bool = False
+    ) -> None:
         """Register an HTTP handler ``fn(HttpFrame) -> (status, content_type,
         body_bytes)`` at an exact path or a prefix ending in '/'. Builtin
         portal pages win on conflicts (the reference forbids shadowing
-        builtins too, server.cpp AddBuiltinServices)."""
+        builtins too, server.cpp AddBuiltinServices).
+
+        ``progressive=True``: chunked uploads to this route dispatch the
+        handler at header time with ``frame.body`` set to a
+        ``protocol.http.ProgressiveReader`` — the handler consumes the
+        body while it is still arriving (the reference's ProgressiveReader,
+        progressive_reader.h). Content-Length requests to the same route
+        still deliver plain bytes."""
         if self._started:
             raise RuntimeError("add_http_handler after start")
         self._http_handlers[path] = handler
+        if progressive:
+            self._http_progressive.add(path)
 
     def find_http_handler(self, path: str) -> Optional[Callable]:
         h = self._http_handlers.get(path)
@@ -355,6 +367,14 @@ class Server:
             if prefix.endswith("/") and path.startswith(prefix):
                 return handler
         return None
+
+    def is_progressive_route(self, path: str) -> bool:
+        """Does a chunked upload to ``path`` stream to its handler?"""
+        if path in self._http_progressive:
+            return True
+        return any(
+            p.endswith("/") and path.startswith(p) for p in self._http_progressive
+        )
 
     def method_status(self, service: str, method: str) -> Optional[MethodStatus]:
         prop = self._methods.get(f"{service}.{method}")

@@ -161,6 +161,13 @@ class InputMessenger:
                 continue  # fallback path already cut one frame
             sock.preferred_protocol = matched
             if total is None:
+                if matched.parse_conn is not None:
+                    # a stateful protocol signalled takeover (e.g. an HTTP
+                    # chunked request whose size is unknowable up front):
+                    # loop so parse_conn sees the already-buffered bytes —
+                    # a plain break could stall forever if the client has
+                    # sent everything and is waiting on us
+                    continue
                 break  # header itself incomplete
             # flag bounds the *body*; allow any registered header on top
             if total > max_body + _MAX_HEADER_PEEK:
@@ -199,6 +206,20 @@ class InputMessenger:
         # Everything else gets the N-1-fibers + last-inline treatment.
         rest = []
         for proto, frame in cut:
+            pre = getattr(frame, "pre_dispatch", None)
+            if pre is not None:
+                # ordering hooks (HTTP response-order gates) run at
+                # dispatch time, in wire order — never at cut time, where
+                # earlier frames of the same burst would observe them
+                pre(sock)
+            if getattr(frame, "force_worker", False):
+                # e.g. a progressive-upload handler: it blocks reading a
+                # body THIS fiber feeds — running it inline would deadlock,
+                # and it must spawn IN WIRE ORDER (a later inline frame may
+                # park on its completion gate; spawning late would wedge
+                # the reader fiber behind a handler that never started)
+                global_worker_pool().spawn(self._process_one, sock, proto, frame)
+                continue
             inline = getattr(frame, "process_inline", False) or (
                 getattr(frame, "is_stream", False)
                 and proto.process_stream is not None

@@ -60,6 +60,17 @@ class TestParse:
         assert consumed + consumed2 == len(raw)
 
 
+def _len_handler(frame):
+    import hashlib
+
+    body = frame.body
+    return (
+        200,
+        "text/plain",
+        f"{len(body)}:{hashlib.sha1(body).hexdigest()}".encode(),
+    )
+
+
 @pytest.fixture
 def portal_server():
     server = Server()
@@ -67,6 +78,7 @@ def portal_server():
     server.add_http_handler(
         "/custom", lambda frame: (200, "text/plain", b"custom-page")
     )
+    server.add_http_handler("/demo/len", _len_handler)
     assert server.start(0)
     yield server
     server.stop()
@@ -585,34 +597,26 @@ class TestChunkedRequests:
         conn.close()
         assert resp == b""  # connection failed, no response
 
-    def test_oversized_chunked_body_rejected(self):
+    def test_chunked_header_signals_stateful_takeover(self):
         from incubator_brpc_tpu.protocol import http as http_mod
 
-        huge = b"x" * http_mod._MAX_HEADER_BYTES
-        head = (
-            b"POST /a/b HTTP/1.1\r\nHost: t\r\n"
-            b"Transfer-Encoding: chunked\r\n\r\n"
-        )
-        wire = head + b"%x\r\n" % len(huge) + huge  # no terminator yet
-        from incubator_brpc_tpu.protocol.tbus_std import FatalParseError
-
-        with pytest.raises(FatalParseError):
-            http_mod.parse_header(wire[: http_mod._CHUNKED_WINDOW])
-
-    def test_mixed_case_and_multi_codings(self):
-        from incubator_brpc_tpu.protocol.tbus_std import FatalParseError
-
-        # transfer-coding names are case-insensitive: both sizing paths
-        # must agree or the messenger sees a length mismatch
+        # parse_header returns None for chunked requests — the messenger
+        # pins the protocol and parse_conn resumes dechunking statefully
+        # (bounded by max_body_size, NOT the peek window)
         wire = (
             b"POST /a/b HTTP/1.1\r\nHost: t\r\n"
             b"Transfer-Encoding: Chunked\r\n\r\n"
             b"3\r\nabc\r\n0\r\n\r\n"
         )
-        total = http_mod.parse_header(wire)
-        frame, consumed = http_mod.parse(wire)
-        assert total == consumed == len(wire)
+        assert http_mod.parse_header(wire) is None
+        frame, consumed = http_mod.parse(wire)  # inline path still cuts
+        assert consumed == len(wire)
         assert frame.body == b"abc"
+
+    def test_mixed_case_and_multi_codings(self):
+        from incubator_brpc_tpu.protocol import http as http_mod
+        from incubator_brpc_tpu.protocol.tbus_std import FatalParseError
+
         # 'gzip, chunked' would hand handlers still-encoded bytes: refuse
         bad = (
             b"POST /a/b HTTP/1.1\r\nHost: t\r\n"
@@ -623,6 +627,155 @@ class TestChunkedRequests:
             http_mod.parse_header(bad)
         with pytest.raises(FatalParseError):
             http_mod.parse(bad)
+
+    def test_10mb_chunked_upload(self, portal_server):
+        # far beyond the 64 KiB peek window: the stateful parse_conn decode
+        # must reassemble it (VERDICT r3 item 7's acceptance test)
+        blob = bytes(range(256)) * 4096 * 10  # 10 MiB
+        chunks = [blob[i : i + 57_000] for i in range(0, len(blob), 57_000)]
+        resp = self._post_chunked(portal_server.port, "/demo/len", chunks)
+        assert resp.startswith(b"HTTP/1.1 200")
+        import hashlib
+
+        expect = f"{len(blob)}:{hashlib.sha1(blob).hexdigest()}".encode()
+        assert expect in resp
+
+    def test_chunked_body_over_max_body_size_kills_conn(self, portal_server):
+        import socket as pysock
+
+        from incubator_brpc_tpu.utils.flags import get_flag, set_flag_unchecked
+
+        old = get_flag("max_body_size")
+        set_flag_unchecked("max_body_size", 100_000)
+        try:
+            conn = pysock.create_connection(
+                ("127.0.0.1", portal_server.port), timeout=10
+            )
+            head = (
+                b"POST /demo/len HTTP/1.1\r\nHost: t\r\n"
+                b"Transfer-Encoding: chunked\r\n\r\n"
+            )
+            chunk = b"x" * 60_000
+            conn.sendall(head + b"%x\r\n%s\r\n" % (len(chunk), chunk))
+            conn.sendall(b"%x\r\n%s\r\n" % (len(chunk), chunk))  # > 100 KB
+            resp = b""
+            while True:
+                try:
+                    data = conn.recv(65536)
+                except OSError:
+                    break
+                if not data:
+                    break
+                resp += data
+            conn.close()
+            assert resp == b""  # the connection was failed, not wedged
+        finally:
+            set_flag_unchecked("max_body_size", old)
+
+
+class TestProgressiveReader:
+    """add_http_handler(progressive=True): the handler consumes the body
+    WHILE chunks arrive (reference progressive_reader.h +
+    input_messenger.cpp:343-351)."""
+
+    def test_handler_streams_while_uploading(self):
+        import hashlib
+        import socket as pysock
+        import threading as _threading
+
+        seen_progressive = []
+
+        def upload(frame):
+            from incubator_brpc_tpu.protocol.http import ProgressiveReader
+
+            body = frame.body
+            if isinstance(body, ProgressiveReader):
+                seen_progressive.append(True)
+                h = hashlib.sha1()
+                n = 0
+                while True:
+                    piece = body.read(timeout=20)
+                    if not piece:
+                        break
+                    h.update(piece)
+                    n += len(piece)
+                return 200, "text/plain", f"{n}:{h.hexdigest()}".encode()
+            return 200, "text/plain", b"buffered"
+
+        srv = Server()
+        srv.add_http_handler("/up", upload, progressive=True)
+        assert srv.start(0)
+        try:
+            blob = b"progressive!" * 100_000  # 1.2 MB
+            conn = pysock.create_connection(("127.0.0.1", srv.port), timeout=10)
+            conn.sendall(
+                b"POST /up HTTP/1.1\r\nHost: t\r\n"
+                b"Transfer-Encoding: chunked\r\nConnection: close\r\n\r\n"
+            )
+            # dribble the chunks so the handler demonstrably runs mid-upload
+            for i in range(0, len(blob), 200_000):
+                c = blob[i : i + 200_000]
+                conn.sendall(b"%x\r\n%s\r\n" % (len(c), c))
+            conn.sendall(b"0\r\n\r\n")
+            resp = b""
+            while True:
+                data = conn.recv(65536)
+                if not data:
+                    break
+                resp += data
+            conn.close()
+            assert seen_progressive, "handler did not get a ProgressiveReader"
+            import hashlib as _h
+
+            expect = f"{len(blob)}:{_h.sha1(blob).hexdigest()}".encode()
+            assert resp.startswith(b"HTTP/1.1 200")
+            assert expect in resp
+        finally:
+            srv.stop()
+
+    def test_pipelined_request_waits_for_progressive_response(self):
+        import socket as pysock
+
+        order = []
+
+        def upload(frame):
+            body = frame.body.read_all(timeout=20)
+            import time as _t
+
+            _t.sleep(0.2)  # let the pipelined GET race if ordering is broken
+            order.append("upload")
+            return 200, "text/plain", b"U:%d" % len(body)
+
+        def ping(frame):
+            order.append("ping")
+            return 200, "text/plain", b"PONG"
+
+        srv = Server()
+        srv.add_http_handler("/up", upload, progressive=True)
+        srv.add_http_handler("/ping", ping)
+        assert srv.start(0)
+        try:
+            conn = pysock.create_connection(("127.0.0.1", srv.port), timeout=10)
+            # chunked upload + pipelined GET in one burst
+            conn.sendall(
+                b"POST /up HTTP/1.1\r\nHost: t\r\n"
+                b"Transfer-Encoding: chunked\r\n\r\n"
+                b"5\r\nhello\r\n0\r\n\r\n"
+                b"GET /ping HTTP/1.1\r\nHost: t\r\nConnection: close\r\n\r\n"
+            )
+            resp = b""
+            while True:
+                data = conn.recv(65536)
+                if not data:
+                    break
+                resp += data
+            conn.close()
+            # responses in request order: upload's first, then the ping
+            u = resp.find(b"U:5")
+            p = resp.find(b"PONG")
+            assert u >= 0 and p >= 0 and u < p, resp[:200]
+        finally:
+            srv.stop()
 
 
 class TestRestfulMappings:
