@@ -324,6 +324,159 @@ def _vars_series(server, frame) -> Resp:
     return 200, "application/json", json.dumps(out).encode()
 
 
+def _protobufs(server, frame) -> Resp:
+    """list_service.cpp / /protobufs: every registered service and method
+    with its contract details. The reference dumps protobuf descriptors;
+    our methods are bytes→bytes handlers, so the schema rows are the
+    handler identity plus any declared structure: device-kernel geometry
+    (fused collective contract), native kinds, restful routes."""
+    from incubator_brpc_tpu.builtin.portal import running_servers
+
+    servers = [server] if server is not None else []
+    for s in running_servers():
+        if s not in servers:
+            servers.append(s)
+    want = ""
+    if frame.path.startswith("/protobufs/"):
+        want = frame.path[len("/protobufs/") :]
+    lines = []
+    for s in servers:
+        lines.append(f"server {s.listen_endpoint}")
+        for full, prop in sorted(s.methods().items()):
+            if want and want not in full:
+                continue
+            h = prop.handler
+            fn = getattr(h, "__qualname__", type(h).__name__)
+            mod = getattr(h, "__module__", "")
+            attrs = []
+            if prop.status.max_concurrency:
+                attrs.append(f"max_concurrency={prop.status.max_concurrency}")
+            kind = getattr(h, "_native_kind", None)
+            if kind is not None:
+                attrs.append(f"native_kind={kind}")
+            lib = getattr(h, "_native_lib", None)
+            if lib is not None:
+                attrs.append(f"native_lib={lib[0]}:{lib[1]}")
+            dm = getattr(h, "_device_method", None)
+            if dm is not None:
+                attrs.append(
+                    f"device_kernel=fp:{dm.fingerprint()} width={dm.width}"
+                )
+            lines.append(
+                f"  {full}  handler={mod}.{fn}"
+                + (("  " + " ".join(attrs)) if attrs else "")
+            )
+        for row in getattr(s, "_restful", []):
+            lines.append(f"  restful {row}")
+    return 200, "text/plain", ("\n".join(lines) + "\n").encode()
+
+
+def _dir(server, frame) -> Resp:
+    """dir_service.cpp: browse the filesystem from the portal (an admin
+    surface, like the reference — it serves arbitrary paths too). /dir
+    lists the working directory; /dir/<path> lists a directory or returns
+    a file (capped at 1 MiB)."""
+    import html
+    import os
+    import stat as stat_mod
+
+    rel = ""
+    if frame.path.startswith("/dir/"):
+        rel = frame.path[len("/dir/") :]
+    if rel.startswith("/"):
+        path = rel  # /dir//abs/path — absolute (admin surface)
+    elif rel:
+        path = os.path.join(os.getcwd(), rel)
+    else:
+        path = os.getcwd()
+    path = os.path.normpath(path)
+    if not os.path.exists(path):
+        return 404, "text/plain", f"no such path {path}\n".encode()
+    if os.path.isfile(path):
+        try:
+            with open(path, "rb") as f:
+                data = f.read(1 << 20)
+        except OSError as e:
+            return 403, "text/plain", f"cannot read {path}: {e}\n".encode()
+        return 200, "application/octet-stream", data
+    try:
+        entries = sorted(os.listdir(path))
+    except OSError as e:
+        return 403, "text/plain", f"cannot list {path}: {e}\n".encode()
+    rows = []
+    for name in entries:
+        full = os.path.join(path, name)
+        try:
+            st = os.stat(full)
+            size = st.st_size
+            is_dir = stat_mod.S_ISDIR(st.st_mode)
+        except OSError:
+            size, is_dir = 0, False
+        from urllib.parse import quote
+
+        link = f"/dir/{quote(full)}"  # absolute target: /dir//abs/path
+        rows.append(
+            f'<tr><td><a href="{html.escape(link)}">{html.escape(name)}'
+            f'{"/" if is_dir else ""}</a></td><td>{size}</td></tr>'
+        )
+    body = (
+        f"<html><body><h2>{html.escape(path)}</h2>"
+        f"<table>{''.join(rows)}</table></body></html>"
+    )
+    return 200, "text/html", body.encode()
+
+
+def _threads(server, frame) -> Resp:
+    """threads_service.cpp (pstack): a live stack dump of every thread —
+    worker fibers, reactors, CQ watchers, timer thread — straight from the
+    interpreter (sys._current_frames), no external pstack needed."""
+    import sys
+    import threading as _threading
+    import traceback
+
+    names = {t.ident: t.name for t in _threading.enumerate()}
+    lines = []
+    for tid, frm in sorted(sys._current_frames().items()):
+        lines.append(f"-- thread {names.get(tid, '?')} (tid={tid}) --")
+        lines.extend(
+            ln.rstrip("\n") for ln in traceback.format_stack(frm)
+        )
+        lines.append("")
+    return 200, "text/plain", ("\n".join(lines) + "\n").encode()
+
+
+def _vlog(server, frame) -> Resp:
+    """vlog_service.cpp: the reference lists VLOG call sites and their
+    levels; our analog lists every live logger with its effective level,
+    and /vlog?set=<logger>:<LEVEL> retunes one at runtime (the reloadable
+    verbosity knob)."""
+    import logging as _logging
+
+    if "set" in frame.query:
+        spec = frame.query["set"]
+        name, _, level = spec.rpartition(":")
+        if not name or not level:
+            return 400, "text/plain", b"use ?set=<logger>:<LEVEL>\n"
+        lv = _logging.getLevelName(level.upper())
+        if not isinstance(lv, int):
+            return 400, "text/plain", f"unknown level {level!r}\n".encode()
+        _logging.getLogger(name).setLevel(lv)
+        return 200, "text/plain", f"{name} set to {level.upper()}\n".encode()
+    root = _logging.getLogger()
+    lines = [f"<root> {_logging.getLevelName(root.getEffectiveLevel())}"]
+    for name in sorted(root.manager.loggerDict):
+        lg = root.manager.loggerDict[name]
+        if isinstance(lg, _logging.PlaceHolder):
+            continue
+        own = (
+            _logging.getLevelName(lg.level) if lg.level else "(inherit)"
+        )
+        lines.append(
+            f"{name} {_logging.getLevelName(lg.getEffectiveLevel())} {own}"
+        )
+    return 200, "text/plain", ("\n".join(lines) + "\n").encode()
+
+
 _PAGES: Dict[str, object] = {
     "/": _index,
     "/index": _index,
@@ -345,15 +498,22 @@ _PAGES: Dict[str, object] = {
     "/pprof/profile": _hotspots,
     "/pprof/contention": _hotspots,
     "/pprof/heap": _hotspots,
+    "/protobufs": _protobufs,
+    "/dir": _dir,
+    "/threads": _threads,
+    "/vlog": _vlog,
 }
 
 
 def handle(server, frame) -> Resp:
     """Dispatch: exact builtin page, prefixed builtin (/vars/x, /flags/x),
     then the owning server's registered http handlers."""
-    fn = _PAGES.get(frame.path)
-    if fn is None:
-        for prefix in ("/vars/", "/flags/"):
+    builtins_on = server is None or getattr(
+        server.options, "has_builtin_services", True
+    )
+    fn = _PAGES.get(frame.path) if builtins_on else None
+    if fn is None and builtins_on:
+        for prefix in ("/vars/", "/flags/", "/dir/", "/protobufs/"):
             if frame.path.startswith(prefix):
                 fn = _PAGES[prefix[:-1]]
                 break

@@ -340,6 +340,68 @@ class TestPortal:
         assert status == 200 and body == b"OK"
 
 
+class TestPortalCompleteness:
+    """Round-4 pages: /protobufs /dir /threads /vlog (reference
+    builtin/list_service, dir_service, threads_service, vlog_service)."""
+
+    def test_protobufs_lists_method_schemas(self, portal_server):
+        status, _, body = fetch(portal_server, "/protobufs")
+        assert status == 200
+        assert b"demo.echo" in body and b"handler=" in body
+        # filtered view
+        status, _, body = fetch(portal_server, "/protobufs/demo")
+        assert status == 200 and b"demo.echo" in body
+
+    def test_protobufs_shows_device_kernel_contract(self):
+        from incubator_brpc_tpu.rpc import Server, device_method
+
+        srv = Server()
+        srv.add_service(
+            "dsvc", {"k": device_method(lambda d, n: (d, n), width=128)}
+        )
+        assert srv.start(0)
+        try:
+            status, _, body = fetch(srv, "/protobufs")
+            assert status == 200
+            assert b"device_kernel=fp:" in body and b"width=128" in body
+        finally:
+            srv.stop()
+
+    def test_dir_lists_and_serves_files(self, portal_server, tmp_path):
+        f = tmp_path / "hello.txt"
+        f.write_text("dir-page-payload")
+        status, ctype, body = fetch(portal_server, f"/dir/{tmp_path}")
+        assert status == 200 and b"hello.txt" in body
+        status, _, body = fetch(portal_server, f"/dir/{f}")
+        assert status == 200 and body == b"dir-page-payload"
+        status, _, _ = fetch(portal_server, "/dir/no/such/path")
+        assert status == 404
+
+    def test_threads_dumps_live_stacks(self, portal_server):
+        status, _, body = fetch(portal_server, "/threads")
+        assert status == 200
+        assert b"-- thread " in body
+        # the reactor and worker threads appear with real frames
+        assert b"File \"" in body
+
+    def test_vlog_lists_and_sets_levels(self, portal_server):
+        status, _, body = fetch(portal_server, "/vlog")
+        assert status == 200
+        assert b"incubator_brpc_tpu" in body
+        status, _, body = fetch(
+            portal_server, "/vlog?set=incubator_brpc_tpu.test_vlog:DEBUG"
+        )
+        assert status == 200 and b"DEBUG" in body
+        import logging as _logging
+
+        assert (
+            _logging.getLogger("incubator_brpc_tpu.test_vlog").level
+            == _logging.DEBUG
+        )
+        status, _, _ = fetch(portal_server, "/vlog?set=bad-spec")
+        assert status == 400
+
+
 class TestPortalDepth:
     """Round-3 portal pages: /sockets /fibers /ids + pprof folded output
     (reference builtin/sockets_service, /bthreads, /ids, pprof_service)."""

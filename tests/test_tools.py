@@ -137,7 +137,46 @@ class TestView:
         path = os.path.join(str(tmp_path), sorted(os.listdir(tmp_path))[0])
         assert view_main([path]) == 0
         out = capsys.readouterr().out
-        assert "v.m" in out and "hello-view" in out and "1 samples" in out
+        assert "v.m" in out and "hello-view" in out and "1/1 samples" in out
+
+    def test_view_filters_and_json(self, tmp_path, capsys):
+        from incubator_brpc_tpu.protocol.tbus_std import Meta
+
+        d = RpcDumper(directory=str(tmp_path))
+        assert d.sample(Meta(service="a", method="m1"), b"one")
+        assert d.sample(Meta(service="b", method="m2"), b"two")
+        d.close()
+        from tools.rpc_view import main as view_main
+
+        path = os.path.join(str(tmp_path), sorted(os.listdir(tmp_path))[0])
+        assert view_main(["--service", "b", "--json", path]) == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        import json as _json
+
+        rows = [_json.loads(line) for line in out]
+        assert len(rows) == 1 and rows[0]["service"] == "b"
+
+    def test_view_proxies_target_portal(self, echo_server):
+        # the reference rpc_view shape: a front server relaying every path
+        # to the target's builtin portal (rpc_view.cpp)
+        from incubator_brpc_tpu.protocol.http import http_call
+        from tools.rpc_view import make_proxy_server, serve_proxy
+
+        target_server, _ = echo_server
+        assert make_proxy_server("not-a-target") is None
+        assert serve_proxy(0, "not-a-target") == 2
+        front = make_proxy_server(f"127.0.0.1:{target_server.port}")
+        assert front is not None and front.start(0)
+        try:
+            status, _, body = http_call("127.0.0.1", front.port, "/health")
+            assert status == 200
+            assert b"OK" in body and b"rpc_view of" in body  # tagged relay
+            status, _, body = http_call("127.0.0.1", front.port, "/vars")
+            assert status == 200 and b"socket_in_bytes" in body
+            status, _, body = http_call("127.0.0.1", front.port, "/")
+            assert status == 200 and b"rpc_view of" in body  # html tag
+        finally:
+            front.stop()
 
 
 class TestParallelHttp:

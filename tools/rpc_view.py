@@ -1,38 +1,149 @@
 #!/usr/bin/env python
-"""rpc_view — print the contents of rpc_dump sample files (reference
-tools/rpc_view).
+"""rpc_view — inspect a server or rpc_dump samples (reference
+tools/rpc_view: a proxy server that forwards any path to the target's
+builtin portal and annotates the rendering, rpc_view.cpp:23-60; plus the
+dump-file printer role of rpc_replay's sibling tooling).
 
-Usage:
+Two modes:
+
+  Proxy a live server's portal (the reference tool's shape):
+    python tools/rpc_view.py --serve 8888 --target 127.0.0.1:8000
+    # then browse http://127.0.0.1:8888/status /vars /rpcz /protobufs ...
+
+  Print rpc_dump sample files:
     python tools/rpc_view.py ./rpc_dump/requests.1234.0000
+    python tools/rpc_view.py --service users --method get --json dump.0000
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
+
+
+def make_proxy_server(target: str):
+    """Build (but do not start) the rpc_view front server: every path
+    relays to the target's portal, renderings are tagged with the origin
+    (rpc_view.cpp:52-60). Returns the Server, or None on a bad target."""
+    from incubator_brpc_tpu.protocol.http import http_call
+    from incubator_brpc_tpu.rpc import Server, ServerOptions
+
+    host, _, tport = target.rpartition(":")
+    if not host or not tport.isdigit():
+        return None
+
+    def relay(frame):
+        from urllib.parse import urlencode
+
+        path = frame.path
+        if frame.query:
+            # values arrived URL-decoded (parse_qsl): re-encode, or spaces
+            # and '&'/'=' inside values would corrupt the target's request
+            path = f"{path}?{urlencode(frame.query)}"
+        try:
+            status, headers, body = http_call(
+                host, int(tport), path, method=frame.method,
+                body=frame.body if isinstance(frame.body, bytes) else b"",
+                timeout=15,
+            )
+        except OSError as e:
+            return 502, "text/plain", (
+                f"rpc_view: target {target} unreachable: {e}\n".encode()
+            )
+        ctype = headers.get("content-type", "text/plain")
+        # visually tag HUMAN renderings with the target (rpc_view.cpp:52-60)
+        # — never binary or machine-parsed payloads (/dir files, pprof
+        # folded output), which must relay byte-identical
+        if "html" in ctype and b"</body>" in body:
+            tag = f"<hr><i>rpc_view of {target}</i>".encode()
+            body = body.replace(b"</body>", tag + b"</body>", 1)
+        elif ctype.startswith("text/plain") and not path.startswith("/pprof"):
+            body = f"# rpc_view of {target}{path}\n".encode() + body
+        return status, ctype, body
+
+    # no builtin pages on the front: the whole point is viewing the
+    # TARGET's portal, so every path — /status, /vars, /rpcz — relays
+    srv = Server(ServerOptions(has_builtin_services=False))
+    srv.add_http_handler("/", relay)  # prefix: every path relays
+    return srv
+
+
+def serve_proxy(port: int, target: str) -> int:
+    srv = make_proxy_server(target)
+    if srv is None:
+        print(f"bad --target {target!r} (want host:port)", file=sys.stderr)
+        return 2
+    if not srv.start(port):
+        print(f"cannot listen on {port}", file=sys.stderr)
+        return 1
+    print(f"rpc_view of {target} on http://127.0.0.1:{srv.port}/  (Ctrl-C stops)")
+    try:
+        import time
+
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+def print_dumps(args) -> int:
+    from incubator_brpc_tpu.rpc.dump import load_dump_file
+
+    n = shown = 0
+    for path in args.paths:
+        for meta, payload, attachment in load_dump_file(path):
+            n += 1
+            if args.service and meta.service != args.service:
+                continue
+            if args.method and meta.method != args.method:
+                continue
+            shown += 1
+            if args.json:
+                print(
+                    json.dumps(
+                        {
+                            "service": meta.service,
+                            "method": meta.method,
+                            "payload_len": len(payload),
+                            "attachment_len": len(attachment),
+                            "compress": meta.compress,
+                            "log_id": meta.log_id,
+                            "payload_head": payload[: args.max_payload].hex(),
+                        }
+                    )
+                )
+            else:
+                preview = payload[: args.max_payload]
+                print(
+                    f"[{shown - 1}] {meta.service}.{meta.method} "
+                    f"payload={len(payload)}B attachment={len(attachment)}B "
+                    f"compress={meta.compress or '-'} log_id={meta.log_id} "
+                    f"| {preview!r}"
+                )
+    print(f"{shown}/{n} samples", file=sys.stderr if args.json else sys.stdout)
+    return 0
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("paths", nargs="+", help="dump files")
+    p.add_argument("paths", nargs="*", help="dump files (dump mode)")
     p.add_argument("--max-payload", type=int, default=64, help="bytes shown")
+    p.add_argument("--service", help="only samples of this service")
+    p.add_argument("--method", help="only samples of this method")
+    p.add_argument("--json", action="store_true", help="one JSON line per sample")
+    p.add_argument("--serve", type=int, help="proxy mode: listen on this port")
+    p.add_argument("--target", help="proxy mode: host:port of the server to view")
     args = p.parse_args(argv)
 
-    from incubator_brpc_tpu.rpc.dump import load_dump_file
-
-    n = 0
-    for path in args.paths:
-        for meta, payload, attachment in load_dump_file(path):
-            preview = payload[: args.max_payload]
-            print(
-                f"[{n}] {meta.service}.{meta.method} "
-                f"payload={len(payload)}B attachment={len(attachment)}B "
-                f"compress={meta.compress or '-'} log_id={meta.log_id} "
-                f"| {preview!r}"
-            )
-            n += 1
-    print(f"{n} samples")
-    return 0
+    if args.serve is not None:
+        if not args.target:
+            p.error("--serve requires --target host:port")
+        return serve_proxy(args.serve, args.target)
+    if not args.paths:
+        p.error("give dump files, or --serve PORT --target HOST:PORT")
+    return print_dumps(args)
 
 
 if __name__ == "__main__":
