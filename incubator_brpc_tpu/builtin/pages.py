@@ -380,9 +380,13 @@ def _dir(server, frame) -> Resp:
     import os
     import stat as stat_mod
 
+    from urllib.parse import unquote
+
     rel = ""
     if frame.path.startswith("/dir/"):
-        rel = frame.path[len("/dir/") :]
+        # links below are emitted percent-encoded (quote); decode on the
+        # way back in or our own links to 'my file.txt' would 404
+        rel = unquote(frame.path[len("/dir/") :])
     if rel.startswith("/"):
         path = rel  # /dir//abs/path — absolute (admin surface)
     elif rel:

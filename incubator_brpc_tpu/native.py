@@ -179,6 +179,32 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
         "tb_flatmap_erase": (ctypes.c_int, [b, ctypes.c_uint64]),
         "tb_flatmap_size": (ctypes.c_size_t, [b]),
         "tb_flatmap_capacity": (ctypes.c_size_t, [b]),
+        "tb_cimap_create": (b, [ctypes.c_size_t]),
+        "tb_cimap_destroy": (None, [b]),
+        "tb_cimap_set": (
+            ctypes.c_int,
+            [b, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+             ctypes.c_size_t],
+        ),
+        "tb_cimap_get": (
+            ctypes.c_long,
+            [b, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+             ctypes.c_size_t],
+        ),
+        "tb_cimap_erase": (ctypes.c_int, [b, ctypes.c_char_p, ctypes.c_size_t]),
+        "tb_cimap_size": (ctypes.c_size_t, [b]),
+        "tb_cimap_key_at": (
+            ctypes.c_long,
+            [b, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t],
+        ),
+        "tb_mru_create": (b, [ctypes.c_size_t]),
+        "tb_mru_destroy": (None, [b]),
+        "tb_mru_put": (ctypes.c_int, [b, ctypes.c_uint64, ctypes.c_uint64]),
+        "tb_mru_get": (
+            ctypes.c_int,
+            [b, ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64)],
+        ),
+        "tb_mru_size": (ctypes.c_size_t, [b]),
         # ---- tbnet (src/tbnet): native network plane ----
         "tb_server_create": (b, [ctypes.c_int]),
         "tb_server_set_frame_cb": (None, [b, FRAME_FN, ctypes.c_void_p]),
@@ -511,3 +537,119 @@ class FlatMap:
         m, self._m = getattr(self, "_m", None), None
         if m and LIB is not None:
             LIB.tb_flatmap_destroy(m)
+
+
+class CaseIgnoredMap:
+    """Native case-ignored string map (src/tbutil tb_cimap; reference
+    CaseIgnoredFlatMap, containers/case_ignored_flat_map.h — the HTTP
+    header table type). Keys compare case-insensitively; stored keys keep
+    their original spelling."""
+
+    def __init__(self, initial_capacity: int = 16):
+        if LIB is None:
+            raise RuntimeError("native runtime unavailable")
+        self._m = LIB.tb_cimap_create(initial_capacity)
+        if not self._m:
+            raise MemoryError("tb_cimap_create failed")
+
+    @staticmethod
+    def _b(s) -> bytes:
+        return s.encode("latin-1") if isinstance(s, str) else bytes(s)
+
+    def __setitem__(self, key, value) -> None:
+        k, v = self._b(key), self._b(value)
+        if LIB.tb_cimap_set(self._m, k, len(k), v, len(v)) < 0:
+            raise MemoryError("cimap set failed")
+
+    def get(self, key, default=None):
+        k = self._b(key)
+        n = LIB.tb_cimap_get(self._m, k, len(k), None, 0)
+        while True:
+            if n < 0:
+                return default
+            if n == 0:
+                return ""
+            buf = ctypes.create_string_buffer(n)
+            m = LIB.tb_cimap_get(self._m, k, len(k), buf, n)
+            if m == n:
+                return buf.raw.decode("latin-1")
+            n = m  # value replaced between the probe and the copy: retry
+
+    def __getitem__(self, key):
+        v = self.get(key)
+        if v is None:
+            raise KeyError(key)
+        return v
+
+    def __contains__(self, key) -> bool:
+        k = self._b(key)
+        return LIB.tb_cimap_get(self._m, k, len(k), None, 0) >= 0
+
+    def __delitem__(self, key) -> None:
+        k = self._b(key)
+        if not LIB.tb_cimap_erase(self._m, k, len(k)):
+            raise KeyError(key)
+
+    def __len__(self) -> int:
+        return LIB.tb_cimap_size(self._m)
+
+    def keys(self):
+        out = []
+        i = 0
+        buf = ctypes.create_string_buffer(256)
+        while True:
+            n = LIB.tb_cimap_key_at(self._m, i, buf, 256)
+            if n < 0:
+                return out
+            if n <= 256:
+                out.append(buf.raw[:n].decode("latin-1"))
+            else:  # key longer than the scratch: refetch until stable
+                while True:
+                    big = ctypes.create_string_buffer(n)
+                    m = LIB.tb_cimap_key_at(self._m, i, big, n)
+                    if m < 0:
+                        break  # entry vanished mid-iteration
+                    if m <= n:
+                        out.append(big.raw[:m].decode("latin-1"))
+                        break
+                    n = m
+            i += 1
+
+    def __del__(self):
+        m, self._m = getattr(self, "_m", None), None
+        if m and LIB is not None:
+            LIB.tb_cimap_destroy(m)
+
+
+class MRUCache:
+    """Native bounded u64→u64 MRU cache (src/tbutil tb_mru; reference
+    MRUCache, containers/mru_cache.h): get/put freshen the entry, inserts
+    past capacity evict the least-recently-used one."""
+
+    def __init__(self, capacity: int):
+        if LIB is None:
+            raise RuntimeError("native runtime unavailable")
+        self._m = LIB.tb_mru_create(capacity)
+        if not self._m:
+            raise MemoryError("tb_mru_create failed")
+
+    def put(self, key: int, value: int) -> bool:
+        """True when the key already existed (value replaced)."""
+        return LIB.tb_mru_put(self._m, key, value) == 1
+
+    def get(self, key: int, default=None):
+        out = ctypes.c_uint64()
+        if LIB.tb_mru_get(self._m, key, ctypes.byref(out)):
+            return out.value
+        return default
+
+    def __contains__(self, key: int) -> bool:
+        return LIB.tb_mru_get(self._m, key, None) == 1
+
+    def __len__(self) -> int:
+        return LIB.tb_mru_size(self._m)
+
+    def __del__(self):
+        m, self._m = getattr(self, "_m", None), None
+        if m and LIB is not None:
+            LIB.tb_mru_destroy(m)

@@ -493,9 +493,18 @@ class DeviceSocket:
 
     # -- write path ----------------------------------------------------------
 
-    def write(self, data, on_error=None, timeout: Optional[float] = None) -> int:
+    def write(
+        self,
+        data,
+        on_error=None,
+        timeout: Optional[float] = None,
+        drain_inline: bool = False,
+    ) -> int:
         from incubator_brpc_tpu.transport.sock import CONNECTED
 
+        # drain_inline is the TCP writer's caller-driven-drain fast path;
+        # the link always drains via its own single-drainer step loop, so
+        # the hint is accepted (stream writers pass it) and ignored
         if self.state != CONNECTED:
             return ErrorCode.EFAILEDSOCKET
         # bytes and IOBufs both queue zero-copy (the link keeps the IOBuf

@@ -13,8 +13,11 @@
 #include <atomic>
 #include <cstdlib>
 #include <deque>
+#include <list>
 #include <mutex>
 #include <new>
+#include <string>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -473,24 +476,38 @@ int tb_iobuf_block_shared_count(const tb_iobuf* b, size_t i) {
 }
 
 long tb_iobuf_cut_into_fd(tb_iobuf* b, int fd, size_t max_bytes) {
+  // Continuation loop over the 256-iovec writev ceiling: a multi-MB
+  // backlog of small blocks (256 × 8 KB = 2 MB per writev) keeps writing
+  // until max_bytes, a short write (kernel buffer full), or an error —
+  // callers see ONE call drain what the kernel will take instead of
+  // bouncing through the ctypes boundary once per 2 MB.
   constexpr int kMaxIov = 256;
   struct iovec iov[kMaxIov];
-  int niov = 0;
-  size_t total = 0;
-  for (const BlockRef& r : b->refs) {
-    if (niov >= kMaxIov || total >= max_bytes) break;
-    size_t len = r.length;
-    if (total + len > max_bytes) len = max_bytes - total;
-    iov[niov].iov_base = r.block->data + r.offset;
-    iov[niov].iov_len = len;
-    total += len;
-    ++niov;
+  long written_total = 0;
+  while (static_cast<size_t>(written_total) < max_bytes) {
+    int niov = 0;
+    size_t total = 0;
+    size_t budget = max_bytes - static_cast<size_t>(written_total);
+    for (const BlockRef& r : b->refs) {
+      if (niov >= kMaxIov || total >= budget) break;
+      size_t len = r.length;
+      if (total + len > budget) len = budget - total;
+      iov[niov].iov_base = r.block->data + r.offset;
+      iov[niov].iov_len = len;
+      total += len;
+      ++niov;
+    }
+    if (niov == 0) break;
+    ssize_t nw = ::writev(fd, iov, niov);
+    if (nw < 0) {
+      if (errno == EINTR) continue;
+      return written_total > 0 ? written_total : -errno;
+    }
+    tb_iobuf_popn(b, static_cast<size_t>(nw));
+    written_total += nw;
+    if (static_cast<size_t>(nw) < total) break;  // short write: kernel full
   }
-  if (niov == 0) return 0;
-  ssize_t nw = ::writev(fd, iov, niov);
-  if (nw < 0) return -errno;
-  tb_iobuf_popn(b, static_cast<size_t>(nw));
-  return nw;
+  return written_total;
 }
 
 // iovec budget per readv: 64 default blocks = 512KB/burst — the bytes-
@@ -1143,6 +1160,227 @@ size_t tb_flatmap_size(const tb_flatmap* m) {
 size_t tb_flatmap_capacity(const tb_flatmap* m) {
   std::lock_guard<std::mutex> lk(m->mu);
   return m->keys.size();
+}
+
+// ---------------------------------------------------------------------------
+// tb_cimap — case-ignored string map (reference CaseIgnoredFlatMap,
+// containers/case_ignored_flat_map.h).  Open addressing, case-folded FNV
+// hash, case-insensitive equality; stored keys keep original spelling.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+inline char ci_lower(char c) {
+  return (c >= 'A' && c <= 'Z') ? static_cast<char>(c + 32) : c;
+}
+
+inline uint64_t ci_hash(const char* s, size_t n) {
+  uint64_t h = 1469598103934665603ull;  // FNV-1a over folded bytes
+  for (size_t i = 0; i < n; ++i) {
+    h ^= static_cast<uint8_t>(ci_lower(s[i]));
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+inline bool ci_equal(const std::string& a, const char* b, size_t n) {
+  if (a.size() != n) return false;
+  for (size_t i = 0; i < n; ++i)
+    if (ci_lower(a[i]) != ci_lower(b[i])) return false;
+  return true;
+}
+
+}  // namespace
+
+struct tb_cimap {
+  enum : uint8_t { EMPTY = 0, FULL = 1, TOMB = 2 };
+  mutable std::mutex mu;
+  std::vector<std::string> keys;
+  std::vector<std::string> vals;
+  std::vector<uint8_t> states;
+  size_t nfull = 0;
+  size_t noccupied = 0;
+
+  void rehash(size_t newcap) {
+    std::vector<std::string> ok = std::move(keys), ov = std::move(vals);
+    std::vector<uint8_t> os = std::move(states);
+    keys.assign(newcap, {});
+    vals.assign(newcap, {});
+    states.assign(newcap, EMPTY);
+    nfull = noccupied = 0;
+    for (size_t i = 0; i < os.size(); ++i) {
+      if (os[i] != FULL) continue;
+      size_t mask = keys.size() - 1;
+      size_t j = ci_hash(ok[i].data(), ok[i].size()) & mask;
+      while (states[j] == FULL) j = (j + 1) & mask;
+      keys[j] = std::move(ok[i]);
+      vals[j] = std::move(ov[i]);
+      states[j] = FULL;
+      ++nfull;
+      ++noccupied;
+    }
+  }
+
+  // slot of the key (FULL) or of the first insertable slot; found tells
+  long probe(const char* key, size_t n, bool* found) const {
+    size_t mask = keys.size() - 1;
+    size_t j = ci_hash(key, n) & mask;
+    long first_free = -1;
+    for (size_t step = 0; step < keys.size(); ++step, j = (j + 1) & mask) {
+      if (states[j] == EMPTY) {
+        *found = false;
+        return first_free >= 0 ? first_free : static_cast<long>(j);
+      }
+      if (states[j] == TOMB) {
+        if (first_free < 0) first_free = static_cast<long>(j);
+        continue;
+      }
+      if (ci_equal(keys[j], key, n)) {
+        *found = true;
+        return static_cast<long>(j);
+      }
+    }
+    *found = false;
+    return first_free;
+  }
+};
+
+tb_cimap* tb_cimap_create(size_t initial_capacity) {
+  size_t cap = 16;
+  while (cap < initial_capacity) cap <<= 1;
+  tb_cimap* m = new (std::nothrow) tb_cimap();
+  if (m == nullptr) return nullptr;
+  m->keys.assign(cap, {});
+  m->vals.assign(cap, {});
+  m->states.assign(cap, tb_cimap::EMPTY);
+  return m;
+}
+
+void tb_cimap_destroy(tb_cimap* m) { delete m; }
+
+int tb_cimap_set(tb_cimap* m, const char* key, size_t klen, const char* val,
+                 size_t vlen) {
+  std::lock_guard<std::mutex> lk(m->mu);
+  if ((m->noccupied + 1) * 4 >= m->keys.size() * 3) {
+    // grow only when LIVE entries justify it; a tombstone-dominated table
+    // rehashes in place (same capacity), so insert/erase churn with a
+    // small live set cannot grow memory without bound
+    size_t newcap = m->keys.size();
+    if ((m->nfull + 1) * 4 >= newcap * 3) newcap *= 2;
+    m->rehash(newcap);
+  }
+  bool found = false;
+  long j = m->probe(key, klen, &found);
+  if (j < 0) return -1;
+  if (found) {
+    m->vals[j].assign(val, vlen);
+    return 1;
+  }
+  if (m->states[j] == tb_cimap::EMPTY) ++m->noccupied;
+  m->keys[j].assign(key, klen);
+  m->vals[j].assign(val, vlen);
+  m->states[j] = tb_cimap::FULL;
+  ++m->nfull;
+  return 0;
+}
+
+long tb_cimap_get(const tb_cimap* m, const char* key, size_t klen, char* out,
+                  size_t cap) {
+  std::lock_guard<std::mutex> lk(m->mu);
+  bool found = false;
+  long j = m->probe(key, klen, &found);
+  if (!found || j < 0) return -1;
+  const std::string& v = m->vals[j];
+  size_t n = v.size() < cap ? v.size() : cap;
+  if (out != nullptr && n > 0) memcpy(out, v.data(), n);
+  return static_cast<long>(v.size());
+}
+
+int tb_cimap_erase(tb_cimap* m, const char* key, size_t klen) {
+  std::lock_guard<std::mutex> lk(m->mu);
+  bool found = false;
+  long j = m->probe(key, klen, &found);
+  if (!found || j < 0) return 0;
+  m->keys[j].clear();
+  m->vals[j].clear();
+  m->states[j] = tb_cimap::TOMB;
+  --m->nfull;
+  return 1;
+}
+
+size_t tb_cimap_size(const tb_cimap* m) {
+  std::lock_guard<std::mutex> lk(m->mu);
+  return m->nfull;
+}
+
+long tb_cimap_key_at(const tb_cimap* m, size_t i, char* out, size_t cap) {
+  std::lock_guard<std::mutex> lk(m->mu);
+  size_t seen = 0;
+  for (size_t j = 0; j < m->keys.size(); ++j) {
+    if (m->states[j] != tb_cimap::FULL) continue;
+    if (seen++ == i) {
+      const std::string& k = m->keys[j];
+      size_t n = k.size() < cap ? k.size() : cap;
+      if (out != nullptr && n > 0) memcpy(out, k.data(), n);
+      return static_cast<long>(k.size());
+    }
+  }
+  return -1;
+}
+
+// ---------------------------------------------------------------------------
+// tb_mru — MRU cache (reference MRUCache, containers/mru_cache.h): a
+// doubly-linked recency list over a hash index; puts past capacity evict
+// the least-recently-used entry.
+// ---------------------------------------------------------------------------
+
+struct tb_mru {
+  mutable std::mutex mu;
+  size_t capacity;
+  std::list<std::pair<uint64_t, uint64_t>> order;  // front = most recent
+  std::unordered_map<uint64_t,
+                     std::list<std::pair<uint64_t, uint64_t>>::iterator>
+      index;
+};
+
+tb_mru* tb_mru_create(size_t capacity) {
+  tb_mru* c = new (std::nothrow) tb_mru();
+  if (c == nullptr) return nullptr;
+  c->capacity = capacity < 1 ? 1 : capacity;
+  return c;
+}
+
+void tb_mru_destroy(tb_mru* c) { delete c; }
+
+int tb_mru_put(tb_mru* c, uint64_t key, uint64_t value) {
+  std::lock_guard<std::mutex> lk(c->mu);
+  auto it = c->index.find(key);
+  if (it != c->index.end()) {
+    it->second->second = value;
+    c->order.splice(c->order.begin(), c->order, it->second);
+    return 1;
+  }
+  c->order.emplace_front(key, value);
+  c->index[key] = c->order.begin();
+  if (c->order.size() > c->capacity) {
+    c->index.erase(c->order.back().first);
+    c->order.pop_back();
+  }
+  return 0;
+}
+
+int tb_mru_get(tb_mru* c, uint64_t key, uint64_t* out) {
+  std::lock_guard<std::mutex> lk(c->mu);
+  auto it = c->index.find(key);
+  if (it == c->index.end()) return 0;
+  if (out != nullptr) *out = it->second->second;
+  c->order.splice(c->order.begin(), c->order, it->second);
+  return 1;
+}
+
+size_t tb_mru_size(const tb_mru* c) {
+  std::lock_guard<std::mutex> lk(c->mu);
+  return c->order.size();
 }
 
 }  // extern "C"

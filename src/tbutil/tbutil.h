@@ -190,6 +190,40 @@ int tb_flatmap_erase(tb_flatmap* m, uint64_t key);
 size_t tb_flatmap_size(const tb_flatmap* m);
 size_t tb_flatmap_capacity(const tb_flatmap* m);
 
+// Case-ignored string map (reference CaseIgnoredFlatMap,
+// containers/case_ignored_flat_map.h — HTTP header tables): open
+// addressing keyed by case-folded hash with case-insensitive equality;
+// stored keys keep their original spelling.
+typedef struct tb_cimap tb_cimap;
+tb_cimap* tb_cimap_create(size_t initial_capacity);
+void tb_cimap_destroy(tb_cimap* m);
+// 0 = inserted new, 1 = replaced existing value, -1 = OOM.
+int tb_cimap_set(tb_cimap* m, const char* key, size_t klen, const char* val,
+                 size_t vlen);
+// value length (>=0, copied into out up to cap) or -1 when absent.  A
+// value longer than cap is truncated to cap; the true length returns.
+long tb_cimap_get(const tb_cimap* m, const char* key, size_t klen, char* out,
+                  size_t cap);
+// 1 = erased, 0 = absent.
+int tb_cimap_erase(tb_cimap* m, const char* key, size_t klen);
+size_t tb_cimap_size(const tb_cimap* m);
+// iterate: copies the i-th live entry's key into out (original spelling);
+// returns key length or -1 past the end.  Order is unspecified but stable
+// between mutations.
+long tb_cimap_key_at(const tb_cimap* m, size_t i, char* out, size_t cap);
+
+// MRU cache (reference MRUCache, containers/mru_cache.h): u64→u64 with a
+// capacity bound; get/put move the entry to the front, inserts past
+// capacity evict the least-recently-used entry.
+typedef struct tb_mru tb_mru;
+tb_mru* tb_mru_create(size_t capacity);
+void tb_mru_destroy(tb_mru* c);
+// 0 = inserted, 1 = replaced; evicts LRU when over capacity.
+int tb_mru_put(tb_mru* c, uint64_t key, uint64_t value);
+// 1 = hit (*out filled, entry freshened), 0 = miss.
+int tb_mru_get(tb_mru* c, uint64_t key, uint64_t* out);
+size_t tb_mru_size(const tb_mru* c);
+
 #ifdef __cplusplus
 }
 #endif

@@ -244,3 +244,38 @@ class TestOversizedMessage:
         # and the window still functions afterwards: feedback caught up
         assert _wait(lambda: s.unconsumed_bytes == 0)
         s.close()
+
+
+class TestStreamOverDeviceLink:
+    """Streaming RPC with transport='tpu': the handshake piggybacks on an
+    RPC over the device link and stream frames ride the link's byte
+    stream — the 'bidirectional tensor stream over ICI' row of SURVEY
+    §2.5 running on the real device plane."""
+
+    def test_stream_rides_the_device_link(self, echo_server):
+        from incubator_brpc_tpu.rpc import ChannelOptions
+        from incubator_brpc_tpu.transport.device_link import DeviceSocket
+
+        server, accepted = echo_server
+        rec = Recorder()
+        accepted["handler"] = rec
+        ch = Channel()
+        assert ch.init(
+            f"127.0.0.1:{server.port}",
+            options=ChannelOptions(transport="tpu", timeout_ms=60000),
+        )
+        s = stream_create(StreamOptions(handler=Recorder()))
+        cntl = ch.call_method("test", "open_stream", b"", request_stream=s)
+        assert cntl.ok(), cntl.error_text
+        assert s.wait_connected(timeout=10)
+        # the RPC (and therefore the stream frames) rode a DeviceSocket
+        assert isinstance(ch._device_sock, DeviceSocket)
+        blob = bytes(range(256)) * 64
+        for i in range(20):
+            assert s.write(b"%03d:" % i + blob, timeout=30) == 0
+        assert _wait(lambda: len(rec.messages) == 20, timeout=30)
+        assert rec.messages[0][:4] == b"000:"
+        assert rec.messages[19][:4] == b"019:"
+        assert all(m[4:] == blob for m in rec.messages)
+        s.close()
+        assert rec.closed.wait(10)
