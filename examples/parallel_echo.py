@@ -1,14 +1,26 @@
 #!/usr/bin/env python
 """parallel_echo — scatter/gather over a ParallelChannel (reference
 example/parallel_echo_c++): one call fans out to N sub-channels, responses
-merge in channel order. Run: python examples/parallel_echo.py
+merge in channel order. With enough mesh devices, the second half shows
+the ICI collective lowering (BASELINE config #3): the same call over
+device links to distinct devices fuses into ONE shard_map all-gather
+dispatch — byte-identical to the host fan-out.
+
+Run: python examples/parallel_echo.py
 """
 
 import sys
 
 sys.path.insert(0, ".")
 
-from incubator_brpc_tpu.rpc import Channel, ParallelChannel, Server  # noqa: E402
+from incubator_brpc_tpu.rpc import (  # noqa: E402
+    Channel,
+    ChannelOptions,
+    ParallelChannel,
+    Server,
+    ServerOptions,
+    device_method,
+)
 
 
 def main() -> None:
@@ -31,6 +43,42 @@ def main() -> None:
     assert cntl.ok(), cntl.error_text
     print(f"merged response: {cntl.response_payload!r}")
     for s in servers:
+        s.stop()
+
+    # -- the collective lowering (SURVEY §2.5; needs a 4+ device mesh) ----
+    import jax
+
+    if len(jax.devices()) < 4:
+        print("(single device: the fused-collective half needs a 4+ mesh)")
+        return
+
+    def add_one(data, n):  # the device kernel every partition serves
+        import jax.numpy as jnp
+
+        return data + jnp.uint8(1), n
+
+    dservers = []
+    for i in range(3):
+        s = Server(ServerOptions(device_index=i + 1, usercode_inline=True))
+        s.add_service("dsvc", {"inc": device_method(add_one, width=256)})
+        assert s.start(0)
+        dservers.append(s)
+    fused = ParallelChannel()
+    for s in dservers:
+        ch = Channel()
+        assert ch.init(
+            f"127.0.0.1:{s.port}",
+            options=ChannelOptions(transport="tpu", timeout_ms=60000),
+        )
+        fused.add_channel(ch)
+    cntl = fused.call_method("dsvc", "inc", b"\x01\x02\x03")
+    assert cntl.ok(), cntl.error_text
+    print(
+        f"fused={getattr(cntl, 'collective_fused', False)} "
+        f"merged={cntl.response_payload!r}  "
+        "(one shard_map all-gather dispatch, not 3 RPCs)"
+    )
+    for s in dservers:
         s.stop()
 
 

@@ -54,6 +54,51 @@ def main() -> None:
     for s in shards + [extra]:
         s.stop()
 
+    # -- the same partitioned call over DEVICE LINKS (needs a 4+ mesh):
+    # each shard binds its own mesh device; the client holds a star of
+    # links through the DeviceLinkMap (the SocketMap analog; SURVEY §2.5's
+    # sharded parameter-server shape) ---------------------------------------
+    import jax
+
+    if len(jax.devices()) < 4:
+        print("(single device: the device-fabric half needs a 4+ mesh)")
+        return
+    from incubator_brpc_tpu.rpc import ChannelOptions, ServerOptions
+
+    dshards = []
+    for i in range(3):
+        s = Server(ServerOptions(device_index=i + 1, usercode_inline=True))
+        s.add_service(
+            "EchoService", {"Echo": (lambda c, req, _i=i: b"[dev%d]%s" % (_i, req))}
+        )
+        assert s.start(0)
+        dshards.append(s)
+    durl = "list://" + ",".join(
+        f"127.0.0.1:{s.port} {i}/3" for i, s in enumerate(dshards)
+    )
+    dpc2 = PartitionChannel()
+    assert dpc2.init(
+        durl,
+        partition_count=3,
+        options=ChannelOptions(transport="tpu", timeout_ms=60000),
+    )
+    from incubator_brpc_tpu.rpc import Controller
+
+    # sub-calls inherit the PARENT controller's budget: give the first
+    # call room for 3 link handshakes + the first jitted step's compile
+    cntl = dpc2.call_method(
+        "EchoService", "Echo", b"over-ici", cntl=Controller(timeout_ms=60000)
+    )
+    assert cntl.ok(), cntl.error_text
+    peers = sorted(
+        str(sub[0]._device_sock.link.devices[1]) for sub in dpc2._subs
+    )
+    print(f"device-fabric response: {cntl.response_payload!r}")
+    print(f"star fabric peers: {peers}")
+    dpc2.stop()
+    for s in dshards:
+        s.stop()
+
 
 if __name__ == "__main__":
     main()

@@ -28,12 +28,18 @@ import logging
 import threading
 from typing import Callable, List, Optional, Tuple
 
+from incubator_brpc_tpu.bvar import Adder
 from incubator_brpc_tpu.rpc.channel import Channel, ChannelOptions
 from incubator_brpc_tpu.rpc.controller import RETRIABLE, Controller
 from incubator_brpc_tpu.utils.endpoint import EndPoint
 from incubator_brpc_tpu.utils.status import ErrorCode, berror
 
 logger = logging.getLogger(__name__)
+
+# /vars observability for the collective lowering: how many combo calls
+# fused into one shard_map dispatch vs ran the host fan-out
+fused_dispatches = Adder(name="parallel_channel_fused")
+host_fanouts = Adder(name="parallel_channel_host_fanout")
 
 
 # -- ParallelChannel ---------------------------------------------------------
@@ -144,11 +150,13 @@ class ParallelChannel:
         if self.fuse_device_calls and ndone >= 2:
             fused = self._maybe_fused_device_call(service, method, request, plan, cntl)
             if fused is not None:
+                fused_dispatches << 1
                 cntl.response_payload = fused
                 cntl.collective_fused = True
                 if done is not None:
                     done(cntl)
                 return cntl
+        host_fanouts << 1
 
         # 1 <= fail_limit <= ndone (parallel_channel.cpp:625-637)
         fail_limit = self.fail_limit

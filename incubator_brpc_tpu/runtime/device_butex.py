@@ -31,17 +31,39 @@ class _WatcherPool:
     def __init__(self, nthreads: int = 2):
         self._jobs: List = []
         self._cond = threading.Condition()
+        self._active = 0  # jobs currently executing
         self._threads = [
             threading.Thread(target=self._run, name=f"tbrpc-cq-{i}", daemon=True)
             for i in range(nthreads)
         ]
         for t in self._threads:
             t.start()
+        # Interpreter-exit quiesce: a watcher still inside the PJRT wait
+        # when CPython finalizes races XLA's own static teardown — the
+        # blocked thread observes destructed runtime state and the process
+        # aborts ("terminate called ... FATAL: exception not rethrown").
+        # Draining pending/active jobs first (bounded) removes the race;
+        # device work completes on its own, we only need to outwait it.
+        import atexit
+
+        atexit.register(self.quiesce)
 
     def submit(self, job: Callable[[], None]) -> None:
         with self._cond:
             self._jobs.append(job)
             self._cond.notify()
+
+    def quiesce(self, timeout: float = 10.0) -> bool:
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        with self._cond:
+            while self._jobs or self._active:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(timeout=min(remaining, 0.1))
+        return True
 
     def _run(self) -> None:
         while True:
@@ -49,12 +71,17 @@ class _WatcherPool:
                 while not self._jobs:
                     self._cond.wait()
                 job = self._jobs.pop(0)
+                self._active += 1
             try:
                 job()
             except Exception:  # noqa: BLE001
                 import logging
 
                 logging.getLogger(__name__).exception("completion watcher raised")
+            finally:
+                with self._cond:
+                    self._active -= 1
+                    self._cond.notify_all()
 
 
 _watchers: Optional[_WatcherPool] = None

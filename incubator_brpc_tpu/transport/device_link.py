@@ -71,6 +71,43 @@ HANDSHAKE_METHOD = "handshake"
 link_steps = Adder(name="device_link_steps")
 link_bytes = Adder(name="device_link_bytes")
 
+# Every live link, for the interpreter-exit quiesce: a teardown-triggered
+# close frame dispatches one final exchange step on a worker fiber; if the
+# process exits while that fiber is inside the XLA dispatch (or the CQ
+# watcher inside the PJRT wait), CPython finalizes under it and the C++
+# teardown aborts ("terminate called ... FATAL: exception not rethrown").
+# The atexit hook outwaits in-flight drives/steps (bounded), then drains
+# the completion watchers.
+import weakref
+
+_all_links: "weakref.WeakSet" = weakref.WeakSet()
+_links_lock = threading.Lock()
+
+
+def _quiesce_links(timeout: float = 10.0) -> None:
+    import time as _time
+
+    deadline = _time.monotonic() + timeout
+    with _links_lock:
+        links = list(_all_links)
+    for link in links:
+        while _time.monotonic() < deadline:
+            with link._lock:
+                idle = not link._driving and link._inflight == 0
+            if idle:
+                break
+            _time.sleep(0.01)
+    # the drives above may have submitted completion watches: drain them
+    from incubator_brpc_tpu.runtime import device_butex as _db
+
+    if _db._watchers is not None:
+        _db._watchers.quiesce(timeout=max(0.1, deadline - _time.monotonic()))
+
+
+import atexit
+
+atexit.register(_quiesce_links)
+
 
 class DeviceLink:
     """One established two-party link: the QP pair + CQ + window."""
@@ -113,6 +150,8 @@ class DeviceLink:
         self.socks: List[Optional["DeviceSocket"]] = [None, None]
         self._pool = global_worker_pool()
         self._build_step()
+        with _links_lock:
+            _all_links.add(self)
 
     # -- the ICI primitive ---------------------------------------------------
 

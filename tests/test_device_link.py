@@ -297,7 +297,11 @@ class TestNPartyFabric:
                 partition_count=4,
                 options=ChannelOptions(transport="tpu", timeout_ms=60000),
             )
-            cntl = pc.call_method("part", "get", b"X")
+            from incubator_brpc_tpu.rpc import Controller
+
+            cntl = pc.call_method(
+                "part", "get", b"X", cntl=Controller(timeout_ms=60000)
+            )
             assert cntl.ok(), cntl.error_text
             # default merger concatenates in channel (partition) order
             assert cntl.response_payload == b"p0:Xp1:Xp2:Xp3:X"
@@ -415,8 +419,14 @@ class TestCollectiveLowering:
             mapper = PerIndexMapper()
             fused_pc = self._make_pc(servers, fuse=True, mapper=mapper)
             host_pc = self._make_pc(servers, fuse=False, mapper=mapper)
-            f = fused_pc.call_method("dsvc", "xform", b"ignored")
-            h = host_pc.call_method("dsvc", "xform", b"ignored")
+            from incubator_brpc_tpu.rpc import Controller
+
+            f = fused_pc.call_method(
+                "dsvc", "xform", b"ignored", cntl=Controller(timeout_ms=60000)
+            )
+            h = host_pc.call_method(
+                "dsvc", "xform", b"ignored", cntl=Controller(timeout_ms=60000)
+            )
             assert f.ok(), f.error_text
             assert h.ok(), h.error_text
             assert getattr(f, "collective_fused", False) is True
@@ -443,7 +453,11 @@ class TestCollectiveLowering:
             servers.append(s)
         try:
             pc = self._make_pc(servers, fuse=True)
-            cntl = pc.call_method("plain", "echo", b"hp")
+            from incubator_brpc_tpu.rpc import Controller
+
+            cntl = pc.call_method(
+                "plain", "echo", b"hp", cntl=Controller(timeout_ms=60000)
+            )
             assert cntl.ok(), cntl.error_text
             assert getattr(cntl, "collective_fused", False) is False
             assert cntl.response_payload == b"hphp"  # host fan-out concat
