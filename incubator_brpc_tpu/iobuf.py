@@ -128,7 +128,10 @@ class _NativeIOBuf:
             return b""
         out = ctypes.create_string_buffer(n)
         got = LIB.tb_iobuf_copy_to(self._h, out, n, pos)
-        return out.raw[:got]
+        # string_at copies exactly `got` bytes; .raw[:got] would first
+        # materialize the whole n-byte scratch (a second full copy on the
+        # messenger's deep-peek path)
+        return ctypes.string_at(out, got)
 
     def views(self) -> List[memoryview]:
         """Read-only zero-copy views of the refs. Valid only until the

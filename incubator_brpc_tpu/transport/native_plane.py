@@ -516,7 +516,13 @@ class NativeClientChannel:
                 ctypes.byref(err_code),
                 int(timeout_ms) if timeout_ms and timeout_ms > 0 else 0,
             )
-            resp_meta = meta_out.raw[: meta_len.value] if meta_len.value else b""
+            # string_at copies meta_len bytes; .raw[:n] would materialize
+            # the whole 64 KiB scratch per call
+            resp_meta = (
+                ctypes.string_at(meta_out, meta_len.value)
+                if meta_len.value
+                else b""
+            )
             return rc, err_code.value, resp_meta, body
         finally:
             destroy = False

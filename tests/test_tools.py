@@ -124,6 +124,25 @@ class TestPress:
         assert stats["ok"] > 10
         assert stats["latency_us_p99"] >= stats["latency_us_p50"] > 0
 
+    def test_press_over_device_links(self, echo_server):
+        # --transport tpu: the rdma_performance client's use_rdma flag —
+        # the same load loop over the device plane
+        server, _ = echo_server
+        from tools.rpc_press import run_press
+
+        stats = run_press(
+            f"127.0.0.1:{server.port}",
+            "dump",
+            "echo",
+            b"press-tpu",
+            threads=2,
+            duration=0.5,
+            timeout_ms=60000,
+            transport="tpu",
+        )
+        assert stats["fail"] == 0
+        assert stats["ok"] > 5
+
 
 class TestView:
     def test_view_prints_samples(self, tmp_path, capsys):

@@ -24,12 +24,19 @@ def run_press(
     threads: int = 4,
     duration: float = 5.0,
     timeout_ms: float = 1000,
+    transport: str = "tcp",
+    native_plane: bool = False,
 ) -> dict:
     from incubator_brpc_tpu.bvar import LatencyRecorder
     from incubator_brpc_tpu.rpc import Channel, ChannelOptions
 
     ch = Channel()
-    if not ch.init(server, options=ChannelOptions(timeout_ms=timeout_ms)):
+    if not ch.init(
+        server,
+        options=ChannelOptions(
+            timeout_ms=timeout_ms, transport=transport, native_plane=native_plane
+        ),
+    ):
         raise SystemExit(f"cannot init channel to {server}")
 
     latency = LatencyRecorder(name=None)
@@ -78,6 +85,15 @@ def main(argv=None) -> int:
     p.add_argument("--threads", type=int, default=4)
     p.add_argument("--duration", type=float, default=5.0, help="seconds")
     p.add_argument("--timeout-ms", type=float, default=1000)
+    p.add_argument(
+        "--transport", choices=("tcp", "tpu"), default="tcp",
+        help="tpu = drive the load over device links (the rdma_performance "
+        "client's use_rdma flag)",
+    )
+    p.add_argument(
+        "--native-plane", action="store_true",
+        help="route eligible calls through the C++ client channel",
+    )
     args = p.parse_args(argv)
 
     service, _, method = args.method.rpartition(".")
@@ -97,6 +113,8 @@ def main(argv=None) -> int:
         threads=args.threads,
         duration=args.duration,
         timeout_ms=args.timeout_ms,
+        transport=args.transport,
+        native_plane=args.native_plane,
     )
     print(
         f"qps={stats['qps']:.0f} ok={stats['ok']} fail={stats['fail']} "
