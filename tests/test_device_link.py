@@ -467,6 +467,79 @@ class TestCollectiveLowering:
                 s.join(timeout=5)
 
 
+class TestFabricFailurePaths:
+    def test_fused_falls_back_when_one_link_is_dead(self):
+        import jax
+
+        if len(jax.devices()) < 4:
+            pytest.skip("needs a 4+ device mesh")
+        from incubator_brpc_tpu.rpc import (
+            Controller,
+            Server,
+            ServerOptions,
+            device_method,
+        )
+        from incubator_brpc_tpu.rpc.combo import ParallelChannel
+
+        def k(data, n):
+            return data, n
+
+        servers = []
+        for i in range(3):
+            s = Server(ServerOptions(device_index=i + 1, usercode_inline=True))
+            s.add_service("fsvc", {"m": device_method(k, width=64)})
+            assert s.start(0)
+            servers.append(s)
+        try:
+            pc = ParallelChannel(fail_limit=1)  # any sub failing fails the call
+            for s in servers:
+                ch = Channel()
+                assert ch.init(
+                    f"127.0.0.1:{s.port}",
+                    options=ChannelOptions(transport="tpu", timeout_ms=60000),
+                )
+                pc.add_channel(ch)
+            c = pc.call_method("fsvc", "m", b"ok", cntl=Controller(timeout_ms=60000))
+            assert c.ok() and getattr(c, "collective_fused", False)
+            # kill one member: the fused preconditions must fail CLEANLY
+            # and the host fan-out arbitrate (no hang, no partial fuse).
+            # Server stop closes its link half GRACEFULLY (F_CLOSE rides
+            # the link); wait for the client side to observe it
+            dead_ds = pc._subs[1][0]._device_sock
+            servers[1].stop()
+            servers[1].join(timeout=5)
+            assert _wait(lambda: dead_ds.state != 0, timeout=10)
+            c2 = pc.call_method("fsvc", "m", b"after", cntl=Controller(timeout_ms=5000))
+            assert getattr(c2, "collective_fused", False) is False
+            # fail_limit=1 with a dead member: the call reports failure
+            assert c2.failed()
+        finally:
+            for s in servers:
+                s.stop()
+
+    def test_link_map_isolates_credentials(self, echo_server):
+        from incubator_brpc_tpu.transport.device_link import device_link_map
+
+        class FakeAuth:
+            def generate_credential(self) -> str:
+                return "cred"  # credentials are str by contract
+
+            def verify_credential(self, cred, sock) -> bool:
+                return True
+
+        from incubator_brpc_tpu.utils.endpoint import EndPoint
+
+        target = EndPoint(ip="127.0.0.1", port=echo_server.port)
+        plain = device_link_map.get_or_create(target, timeout_ms=30000)
+        authed = device_link_map.get_or_create(
+            target, timeout_ms=30000, auth=FakeAuth()
+        )
+        # different credentials must NEVER share a link (socket_map.h:35
+        # keys by auth identity for the same reason)
+        assert plain is not authed
+        assert plain.link is not authed.link
+
+
 class TestZeroCopyDelivery:
     def test_received_blocks_reference_step_output_memory(self, echo_server):
         # The receive path must wrap the link step's output buffer as an

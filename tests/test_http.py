@@ -3,6 +3,8 @@ test/brpc_http_rpc_protocol_unittest.cpp for parse conformance,
 brpc_builtin_service_unittest.cpp for page coverage: a real server is
 started and each endpoint is fetched over a real TCP connection)."""
 
+import time
+
 import pytest
 
 from incubator_brpc_tpu.protocol import http as http_mod
@@ -792,6 +794,44 @@ class TestProgressiveReader:
             expect = f"{len(blob)}:{_h.sha1(blob).hexdigest()}".encode()
             assert resp.startswith(b"HTTP/1.1 200")
             assert expect in resp
+        finally:
+            srv.stop()
+
+    def test_client_disconnect_mid_upload_unblocks_handler(self):
+        import socket as pysock
+        import threading as _threading
+
+        outcome = []
+        seen = _threading.Event()
+
+        def upload(frame):
+            try:
+                while True:
+                    piece = frame.body.read(timeout=15)
+                    seen.set()
+                    if not piece:
+                        outcome.append("eof")
+                        break
+            except IOError as e:
+                outcome.append(f"ioerror:{e}")
+            return 200, "text/plain", b"x"
+
+        srv = Server()
+        srv.add_http_handler("/up", upload, progressive=True)
+        assert srv.start(0)
+        try:
+            conn = pysock.create_connection(("127.0.0.1", srv.port), timeout=10)
+            conn.sendall(
+                b"POST /up HTTP/1.1\r\nHost: t\r\n"
+                b"Transfer-Encoding: chunked\r\n\r\n"
+                b"5\r\nhello\r\n"  # one chunk, NO terminator
+            )
+            assert seen.wait(10)  # handler got the first piece
+            conn.close()  # abort mid-upload
+            deadline = time.monotonic() + 10
+            while not outcome and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert outcome and outcome[0].startswith("ioerror:"), outcome
         finally:
             srv.stop()
 
