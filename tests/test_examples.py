@@ -19,6 +19,7 @@ EXAMPLES = [
     "examples/rtmp_relay.py",
     "examples/naming_failover.py",
     "examples/cache_clients.py",
+    "examples/link_performance.py",
 ]
 
 
