@@ -375,10 +375,23 @@ def _dir(server, frame) -> Resp:
     """dir_service.cpp: browse the filesystem from the portal (an admin
     surface, like the reference — it serves arbitrary paths too). /dir
     lists the working directory; /dir/<path> lists a directory or returns
-    a file (capped at 1 MiB)."""
+    a file (capped at 1 MiB). Gated behind the reloadable
+    ``enable_dir_service`` flag (default OFF): unlike the 2015 intranet
+    deployments the reference assumed, a default-on remote file read is
+    not acceptable on a server that might face a network."""
     import html
     import os
     import stat as stat_mod
+
+    from incubator_brpc_tpu.utils.flags import get_flag
+
+    if not get_flag("enable_dir_service"):
+        return (
+            403,
+            "text/plain",
+            b"dir service is off - set flag enable_dir_service "
+            b"(reloadable) to true\n",
+        )
 
     from urllib.parse import unquote
 

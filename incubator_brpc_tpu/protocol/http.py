@@ -172,6 +172,26 @@ def _dechunk(data, off: int):
         off += size + 2
 
 
+def _parse_request_head(head: str):
+    """Shared request-line + header-block parser for the stateless cut
+    (``parse``) and the stateful pinned path (``parse_conn``) — ONE copy,
+    so validation (version check, header folding) cannot drift between
+    them. Returns (method, target, headers)."""
+    lines = head.split("\r\n")
+    try:
+        method, target, version = lines[0].split(" ", 2)
+    except ValueError:
+        raise ParseError(f"bad request line {lines[0]!r}") from None
+    if not version.startswith("HTTP/1."):
+        raise ParseError(f"unsupported version {version!r}")
+    headers: Dict[str, str] = {}
+    for line in lines[1:]:
+        if ":" in line:
+            k, v = line.split(":", 1)
+            headers[k.strip().lower()] = v.strip()
+    return method, target, headers
+
+
 def parse_header(header: bytes) -> Optional[int]:
     """Total frame size once the header block is visible (the sizing hook —
     lets the messenger cut without copying the whole pending buffer, and
@@ -412,17 +432,9 @@ def parse_conn(sock, buf) -> Tuple[Optional[object], int]:
     # a chunked request: build the frame shell, install the decode state
     from incubator_brpc_tpu.utils.flags import get_flag
 
-    head = window[:head_end].decode("latin-1")
-    lines = head.split("\r\n")
-    try:
-        method, target, _version = lines[0].split(" ", 2)
-    except ValueError:
-        raise ParseError(f"bad request line {lines[0]!r}") from None
-    headers: Dict[str, str] = {}
-    for line in lines[1:]:
-        if ":" in line:
-            k, v = line.split(":", 1)
-            headers[k.strip().lower()] = v.strip()
+    method, target, headers = _parse_request_head(
+        window[:head_end].decode("latin-1")
+    )
     parts = urlsplit(target)
     query = dict(parse_qsl(parts.query, keep_blank_values=True))
     frame = HttpFrame(method.upper(), parts.path or "/", query, headers, b"")
@@ -507,19 +519,9 @@ def parse(buf: bytes) -> Tuple[Optional[HttpFrame], int]:
         if len(buf) > _MAX_HEADER_BYTES:
             raise ParseError("http header block too large")
         return None, 0
-    head = buf[:head_end].decode("latin-1")
-    lines = head.split("\r\n")
-    try:
-        method, target, version = lines[0].split(" ", 2)
-    except ValueError:
-        raise ParseError(f"bad request line {lines[0]!r}") from None
-    if not version.startswith("HTTP/1."):
-        raise ParseError(f"unsupported version {version!r}")
-    headers: Dict[str, str] = {}
-    for line in lines[1:]:
-        if ":" in line:
-            k, v = line.split(":", 1)
-            headers[k.strip().lower()] = v.strip()
+    method, target, headers = _parse_request_head(
+        bytes(buf[:head_end]).decode("latin-1")
+    )
     te = headers.get("transfer-encoding")
     if te is not None:
         te = te.strip().lower()  # same predicate as parse_header: the two
@@ -599,13 +601,20 @@ def build_chunk(data: bytes) -> bytes:
 CHUNK_END = b"0\r\n\r\n"
 
 
-def _send_progressive(sock, status: int, ctype: str, body_iter, close: bool) -> None:
+def _send_progressive(
+    sock, status: int, ctype: str, body_iter, close: bool, gate=None
+) -> None:
     """ProgressiveAttachment analog (reference progressive_attachment.{h,cpp}
     + ProgressiveReader): headers go out now, chunks stream as the producer
     yields them — unbounded bodies without buffering. The producer runs on
     its own fiber so a slow source never pins the reader fiber; the
     ``_http_stream_done`` gate in sock.context keeps a later pipelined
-    response from interleaving with the stream (HTTP in-order contract)."""
+    response from interleaving with the stream (HTTP in-order contract).
+
+    ``gate``: a progressive-UPLOAD frame whose handler streams its response
+    passes its own ordering gate — the drain releases it only when the
+    stream completes. Installing a fresh context gate here would clobber a
+    pipelined successor's, letting its response interleave mid-stream."""
     from incubator_brpc_tpu.runtime.worker_pool import global_worker_pool
 
     from incubator_brpc_tpu.runtime.butex import Butex
@@ -613,8 +622,11 @@ def _send_progressive(sock, status: int, ctype: str, body_iter, close: bool) -> 
     # a Butex, not a threading.Event: waiters must count as BLOCKED so the
     # worker pool grows past them (N stalled streams + N pipelined requests
     # would otherwise deadlock every carrier thread)
-    done = Butex(0)
-    sock.context["_http_stream_done"] = done
+    if gate is not None:
+        done = gate
+    else:
+        done = Butex(0)
+        sock.context["_http_stream_done"] = done
 
     def finish_gate():
         done.store(1)
@@ -707,8 +719,12 @@ def process_request(sock, frame: HttpFrame) -> None:
                 if close:
                     _close_when_drained(sock)
                 return
-            # a handler returned an iterator: stream it chunked (progressive)
-            _send_progressive(sock, status, ctype, iter(body), close)
+            # a handler returned an iterator: stream it chunked
+            # (progressive). A progressive-upload frame hands its OWN
+            # ordering gate to the drain — released at stream end, so a
+            # pipelined successor cannot interleave mid-stream
+            _send_progressive(sock, status, ctype, iter(body), close, gate=own_gate)
+            own_gate = None  # the drain owns its release now
             return
         if not isinstance(body, (bytes, bytearray, memoryview)):
             status, ctype, body = 500, "text/plain", (

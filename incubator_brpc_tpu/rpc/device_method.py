@@ -64,6 +64,22 @@ class DeviceMethod:
                 ident += ":" + inspect.getsource(self.kernel)
             except (OSError, TypeError):
                 pass
+            # closure cells and defaults: two kernels minted by one factory
+            # with different captured parameters share source text but must
+            # NOT share a fingerprint (the fused path would silently run
+            # the wrong parametrization for some shards)
+            clo = getattr(self.kernel, "__closure__", None) or ()
+            for cell in clo:
+                try:
+                    ident += f"|cell:{cell.cell_contents!r}"
+                except Exception:  # noqa: BLE001 — unrepr-able: be cautious
+                    ident += "|cell:?"
+            defaults = getattr(self.kernel, "__defaults__", None) or ()
+            for d in defaults:
+                try:
+                    ident += f"|def:{d!r}"
+                except Exception:  # noqa: BLE001
+                    ident += "|def:?"
             self._fingerprint = hashlib.sha1(ident.encode()).hexdigest()[:16]
         return self._fingerprint
 

@@ -688,6 +688,7 @@ class DeviceLinkMap:
         # another thread holds would let two handshakes race on one key) —
         # bounded by the distinct peers this process ever contacts
         self._key_locks: Dict[tuple, threading.Lock] = {}
+        self._cred_refs: Dict[tuple, tuple] = {}  # keep id()-keyed objects alive
 
     def _key_lock(self, key: tuple) -> threading.Lock:
         with self._lock:
@@ -718,8 +719,15 @@ class DeviceLinkMap:
         ident = (
             f"auth-{id(auth):x}" if auth is not None else "",
             f"ssl-{id(ssl_context):x}" if ssl_context is not None else "",
+            ssl_server_hostname or "",
         )
         key = (ep.ip, ep.port, device_index, slot_words, window, ident)
+        if auth is not None or ssl_context is not None:
+            # the key embeds id()s: retain the credential objects for the
+            # entry's lifetime, or a GC'd auth object's recycled address
+            # would alias a DIFFERENT principal onto this link
+            with self._lock:
+                self._cred_refs[key] = (auth, ssl_context)
         # per-key lock: a thundering herd to one peer produces ONE
         # handshake, while links to OTHER peers establish concurrently
         with self._key_lock(key):
@@ -768,6 +776,8 @@ class DeviceLinkMap:
                 ]:
                     old.recycle()
                     del self._links[k]
+                    if k != key:
+                        self._cred_refs.pop(k, None)
                 self._links[key] = ds
             return ds
 

@@ -110,6 +110,13 @@ define_flag(
 )
 define_flag("enable_rpcz", False, "collect rpcz spans", lambda v: True)
 define_flag(
+    "enable_dir_service",
+    False,
+    "serve the /dir filesystem-browse builtin page (an unauthenticated "
+    "file read on the portal: keep off unless the port is trusted)",
+    lambda v: True,
+)
+define_flag(
     "http_gateway_async_timeout_s",
     30,
     "how long the http->rpc gateway waits for an async handler",

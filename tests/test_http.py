@@ -370,14 +370,23 @@ class TestPortalCompleteness:
             srv.stop()
 
     def test_dir_lists_and_serves_files(self, portal_server, tmp_path):
-        f = tmp_path / "hello.txt"
-        f.write_text("dir-page-payload")
-        status, ctype, body = fetch(portal_server, f"/dir/{tmp_path}")
-        assert status == 200 and b"hello.txt" in body
-        status, _, body = fetch(portal_server, f"/dir/{f}")
-        assert status == 200 and body == b"dir-page-payload"
-        status, _, _ = fetch(portal_server, "/dir/no/such/path")
-        assert status == 404
+        from incubator_brpc_tpu.utils.flags import get_flag, set_flag
+
+        # OFF by default: an unauthenticated file read must be opt-in
+        status, _, _ = fetch(portal_server, "/dir")
+        assert status == 403
+        assert set_flag("enable_dir_service", True)
+        try:
+            f = tmp_path / "hello.txt"
+            f.write_text("dir-page-payload")
+            status, ctype, body = fetch(portal_server, f"/dir/{tmp_path}")
+            assert status == 200 and b"hello.txt" in body
+            status, _, body = fetch(portal_server, f"/dir/{f}")
+            assert status == 200 and body == b"dir-page-payload"
+            status, _, _ = fetch(portal_server, "/dir/no/such/path")
+            assert status == 404
+        finally:
+            set_flag("enable_dir_service", False)
 
     def test_threads_dumps_live_stacks(self, portal_server):
         status, _, body = fetch(portal_server, "/threads")
