@@ -121,6 +121,7 @@ class ChannelOptions:
         device_index: int = 0,
         link_slot_words: int = 16384,
         link_window: int = 8,
+        link_ack_mode: str = "local",
         native_plane: bool = False,
         ssl_context=None,
         ssl_server_hostname=None,
@@ -149,6 +150,9 @@ class ChannelOptions:
         self.device_index = device_index
         self.link_slot_words = link_slot_words
         self.link_window = link_window
+        # 'local' | 'wire': how the link's credit window learns about
+        # drained steps (wire = the multi-controller piggybacked-ack flow)
+        self.link_ack_mode = link_ack_mode
         # Route eligible sync calls through the native client (src/tbnet):
         # pack/write/read/match in C++ with the GIL released, one shared
         # connection with an elected completion-pump reader. Calls that
@@ -604,6 +608,7 @@ class Channel:
             slot_words=self._options.link_slot_words,
             window=self._options.link_window,
             timeout_ms=cntl.timeout_ms or 60000,
+            ack_mode=self._options.link_ack_mode,
             auth=self._options.auth,
             ssl_context=self._options.ssl_context,
             ssl_server_hostname=self._options.ssl_server_hostname,

@@ -61,7 +61,9 @@ logger = logging.getLogger(__name__)
 
 LINK_MAGIC = 0x5450554C  # "TPUL"
 LINK_HEADER_WORDS = 8
-# header words: 0 magic, 1 used_bytes, 2 seq, 3 ack, 4 flags, 5-7 reserved
+# header words: 0 magic, 1 used_bytes, 2 seq, 3 ack_lo, 4 flags,
+# 5 ack_hi (the delivered count is 64-bit on the wire: a wrapped 32-bit
+# ack would wedge the wire-mode credit window), 6-7 reserved
 F_DATA = 1
 F_CLOSE = 2
 
@@ -70,6 +72,7 @@ HANDSHAKE_METHOD = "handshake"
 
 link_steps = Adder(name="device_link_steps")
 link_bytes = Adder(name="device_link_bytes")
+link_acks = Adder(name="device_link_ack_steps")  # wire-mode catch-up steps
 
 # Every live link, for the interpreter-exit quiesce: a teardown-triggered
 # close frame dispatches one final exchange step on a worker fiber; if the
@@ -118,6 +121,7 @@ class DeviceLink:
         slot_words: int = 16384,
         window: int = 8,
         host_loopback: Optional[bool] = None,
+        ack_mode: str = "local",
     ):
         """``host_loopback``: when both parties share ONE device the
         exchange is a pure swap — the peer's bytes are already on this
@@ -125,12 +129,27 @@ class DeviceLink:
         messenger, so a device round trip would be two tunnel crossings
         that move no information. Default (None) takes the fast path for
         the shared-device geometry; tests pass False to force the jitted
-        on-device swap."""
+        on-device swap.
+
+        ``ack_mode``: how the credit window learns about drained steps.
+        'local' (default) gates on this process's shared delivery counter
+        — correct and cheapest when both parties live in one controller.
+        'wire' gates on the CUMULATIVE-DELIVERED count carried in received
+        slot headers (word 3) — the information flow a multi-controller
+        deployment has, where each host only observes its own deliveries:
+        the RDMA endpoint's piggybacked imm-data acks, with ack-only steps
+        dispatched when acks lag half the window (the accumulated-ack +
+        SendImm scheme, rdma_endpoint.h:117-123,176-195)."""
         if slot_words < 64:
             raise ValueError("slot_words too small")
+        if ack_mode not in ("local", "wire"):
+            raise ValueError(f"unknown ack_mode {ack_mode!r}")
         self.devices = devices  # [dev_side0, dev_side1]
         self.slot_words = slot_words
         self.window = window
+        self.ack_mode = ack_mode
+        self._peer_ack = 0  # wire mode: max delivered-count seen in rows
+        self._acks_sent = 0  # wire mode: highest ack value put on the wire
         self._host_loopback = host_loopback
         self._slot_bytes = slot_words * 4
         self._lock = threading.Lock()
@@ -293,25 +312,62 @@ class DeviceLink:
             or self._close_pending[0] or self._close_pending[1]
         )
 
-    def _drive(self) -> None:
-        import time as _time
+    def _window_full_locked(self) -> bool:
+        """Credit check under the link lock. 'local': dispatched-but-
+        undrained steps (this process sees both deliveries). 'wire': how
+        far our seq runs ahead of the peer's CUMULATIVE-DELIVERED count as
+        carried in received slot word 3 — the only signal a
+        multi-controller host has (rdma_endpoint.h:176-195)."""
+        if self.ack_mode == "wire":
+            return self._seq - self._peer_ack >= self.window
+        return self._inflight >= self.window
 
+    def _drive(self) -> None:
         while True:
+            ack_only = False
             with self._lock:
                 if self._closed or not self._has_work():
                     self._driving = False
                     return
-                if self._inflight >= self.window:
-                    need = self._cq.load() + 1  # wait one completion
+                if self._window_full_locked():
+                    # wire mode: when the acks we have put on the wire lag
+                    # our deliveries by half the window, the peer may be
+                    # blocked on US — dispatch ONE over-window catch-up
+                    # step carrying the fresh cumulative ack (and any
+                    # queued data; a pure ack frame would starve data at
+                    # window=1). The accumulated-ack + SendImm scheme,
+                    # rdma_endpoint.h:117-123,176-195.
+                    if (
+                        self.ack_mode == "wire"
+                        and self._next_deliver - self._acks_sent
+                        >= max(1, self.window // 2)
+                    ):
+                        ack_only = True
+                        need = None
+                    else:
+                        # local mode waits for a completion; wire mode
+                        # waits for DELIVERY progress (deliveries advance
+                        # _peer_ack, and _wbutex bumps on each one)
+                        need = (
+                            self._wbutex.load()
+                            if self.ack_mode == "wire"
+                            else self._cq.load() + 1
+                        )
                 else:
                     need = None
+                if need is None:
                     rows = [self._fill_slot_locked(s) for s in (0, 1)]
                     seq = self._seq
                     self._seq += 1
                     self._inflight += 1
             if need is not None:
-                self._cq.wait_for(need, timeout=1.0)
+                if self.ack_mode == "wire":
+                    self._wbutex.wait(need, timeout=1.0)
+                else:
+                    self._cq.wait_for(need, timeout=1.0)
                 continue
+            if ack_only:
+                link_acks << 1
             if self._step is None:
                 # host-loopback fast path: the swap IS the exchange —
                 # deliver side i the peer's outbound row, no device hop.
@@ -383,6 +439,8 @@ class DeviceLink:
         row[1] = used
         row[2] = self._seq & 0xFFFFFFFF
         row[5:LINK_HEADER_WORDS] = 0  # reserved words must not leak heap
+        row[5] = (self._next_deliver >> 32) & 0xFFFFFFFF  # ack high word
+        self._acks_sent = self._next_deliver  # words 3+5 carry this
         # word 3 carries the cumulative delivered count on the wire (the
         # RDMA endpoint's piggybacked imm-data ack slot). In this
         # single-controller build both parties share one delivery counter,
@@ -458,6 +516,14 @@ class DeviceLink:
                 return
             used = int(row[1])
             flags = int(row[4])
+            if self.ack_mode == "wire":
+                # the peer's cumulative-delivered count rides words 3+5
+                # (the piggybacked imm-data ack, 64-bit so it cannot
+                # wrap); this is the ONLY credit signal in wire mode
+                with self._lock:
+                    ack = int(row[3]) | (int(row[5]) << 32)
+                    if ack > self._peer_ack:
+                        self._peer_ack = ack
             sock = self.socks[side]
             if used and sock is not None:
                 # ZERO-copy delivery: the read IOBuf's block wraps the step
@@ -648,14 +714,19 @@ class LinkHub:
                 if sock is not None:
                     sock.recycle()
 
-    def create(self, cookie: str, devices, slot_words: int, window: int) -> DeviceLink:
+    def create(
+        self, cookie: str, devices, slot_words: int, window: int,
+        ack_mode: str = "local",
+    ) -> DeviceLink:
         import time as _time
 
         with self._lock:
             self._prune_locked()
             if cookie in self._links:
                 raise ValueError("cookie already in use")
-            link = DeviceLink(devices, slot_words=slot_words, window=window)
+            link = DeviceLink(
+                devices, slot_words=slot_words, window=window, ack_mode=ack_mode
+            )
             self._links[cookie] = (link, _time.monotonic())
             return link
 
@@ -704,6 +775,7 @@ class DeviceLinkMap:
         slot_words: int = 16384,
         window: int = 8,
         timeout_ms: float = 60000,
+        ack_mode: str = "local",
         auth=None,
         ssl_context=None,
         ssl_server_hostname=None,
@@ -721,7 +793,7 @@ class DeviceLinkMap:
             f"ssl-{id(ssl_context):x}" if ssl_context is not None else "",
             ssl_server_hostname or "",
         )
-        key = (ep.ip, ep.port, device_index, slot_words, window, ident)
+        key = (ep.ip, ep.port, device_index, slot_words, window, ack_mode, ident)
         if auth is not None or ssl_context is not None:
             # the key embeds id()s: retain the credential objects for the
             # entry's lifetime, or a GC'd auth object's recycled address
@@ -766,6 +838,7 @@ class DeviceLinkMap:
                 slot_words=slot_words,
                 window=window,
                 timeout_ms=timeout_ms,
+                ack_mode=ack_mode,
             )
             with self._lock:
                 # opportunistic sweep: recycle dead entries so a long-lived
@@ -806,6 +879,7 @@ def make_handshake_handler(server):
             client_dev = int(req["device"])
             slot_words = int(req.get("slot_words", 16384))
             window = int(req.get("window", 8))
+            ack_mode = str(req.get("ack_mode", "local"))
         except (ValueError, KeyError) as e:
             cntl.set_failed(ErrorCode.EREQUEST, f"bad handshake: {e}")
             return b""
@@ -824,6 +898,7 @@ def make_handshake_handler(server):
                 [devices[client_dev], devices[server_dev]],
                 slot_words=slot_words,
                 window=window,
+                ack_mode=ack_mode,
             )
         except ValueError as e:
             cntl.set_failed(ErrorCode.EREQUEST, str(e))
@@ -870,6 +945,7 @@ def establish_device_link(
     slot_words: int = 16384,
     window: int = 8,
     timeout_ms: float = 60000,
+    ack_mode: str = "local",
 ) -> DeviceSocket:
     """Client half: propose over the host socket, then attach side 0.
     ``channel`` must be an initialized single-server Channel whose normal
@@ -883,6 +959,7 @@ def establish_device_link(
             "device": device_index,
             "slot_words": slot_words,
             "window": window,
+            "ack_mode": ack_mode,
         }
     ).encode()
     cntl = channel._call_host(

@@ -260,6 +260,81 @@ class TestHostLoopbackFastPath:
         assert gbps > 0.2, f"loopback link moved only {gbps:.3f} GB/s"
 
 
+class TestWireAckWindow:
+    """ack_mode='wire': the credit window gates on the cumulative-delivered
+    count carried in received slot headers (word 3) — the only signal a
+    multi-controller host has (the RDMA piggybacked imm-data acks +
+    accumulated-ack/SendImm catch-up, rdma_endpoint.h:117-123,176-195)."""
+
+    def _make_link(self, **kw):
+        import jax
+
+        from incubator_brpc_tpu.transport.device_link import (
+            DeviceLink,
+            DeviceSocket,
+        )
+
+        devs = jax.devices()
+        pair = devs[:2] if len(devs) >= 2 else [devs[0], devs[0]]
+        link = DeviceLink(pair, ack_mode="wire", **kw)
+        sinks = (_CountingSink(), _CountingSink())
+        DeviceSocket(link, side=0, messenger=sinks[0])
+        DeviceSocket(link, side=1, messenger=sinks[1])
+        return link, sinks
+
+    def test_stream_drains_under_wire_acks(self):
+        link, sinks = self._make_link(slot_words=256, window=4)
+        payload = bytes((i * 13 + 5) % 256 for i in range(100_000))
+        assert link.send(0, payload, timeout=60) == 0
+        assert _wait(lambda: sinks[1].nbytes == len(payload), timeout=60)
+        assert b"".join(sinks[1].chunks) == payload
+        # the window held: seq never ran more than window + 1 catch-up
+        # step ahead of the acks the wire carried
+        assert link._seq - link._peer_ack <= link.window + 1
+
+    def test_window_one_still_makes_progress(self):
+        # the degenerate window: every data step needs an ack catch-up
+        # step — throughput halves, progress must NOT stop
+        link, sinks = self._make_link(slot_words=128, window=1)
+        payload = b"w1" * 3000
+        assert link.send(0, payload, timeout=60) == 0
+        assert _wait(lambda: sinks[1].nbytes == len(payload), timeout=60)
+        assert b"".join(sinks[1].chunks) == payload
+
+    def test_bidirectional_wire_acks(self):
+        link, sinks = self._make_link(slot_words=256, window=2)
+        a = bytes(range(256)) * 40
+        b = bytes(reversed(range(256))) * 40
+        assert link.send(0, a, timeout=60) == 0
+        assert link.send(1, b, timeout=60) == 0
+        assert _wait(lambda: sinks[1].nbytes == len(a), timeout=60)
+        assert _wait(lambda: sinks[0].nbytes == len(b), timeout=60)
+        assert b"".join(sinks[1].chunks) == a
+        assert b"".join(sinks[0].chunks) == b
+
+    def test_rpc_over_wire_ack_link(self, echo_server):
+        from incubator_brpc_tpu.rpc import Controller
+
+        ch = Channel()
+        assert ch.init(
+            f"127.0.0.1:{echo_server.port}",
+            options=ChannelOptions(
+                transport="tpu",
+                timeout_ms=60000,
+                link_ack_mode="wire",
+                link_slot_words=256,
+                link_window=2,
+            ),
+        )
+        big = bytes(range(256)) * 64
+        cntl = ch.call_method(
+            "EchoService", "Echo", big, cntl=Controller(timeout_ms=60000)
+        )
+        assert cntl.ok(), cntl.error_text
+        assert cntl.response_payload == big
+        assert ch._device_sock.link.ack_mode == "wire"
+
+
 class TestNPartyFabric:
     """The SocketMap-analog link manager: N peers, one link per peer device,
     partitioned RPC over the device plane (VERDICT r3 item 3)."""
