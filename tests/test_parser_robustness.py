@@ -1,0 +1,144 @@
+"""Parser robustness — every registered protocol parser must answer
+arbitrary bytes with exactly one of: (None, 0) (incomplete), a cut frame,
+ParseError (not mine / resync), or FatalParseError (mine but
+unacceptable). Anything else escaping the cut loop would wedge or
+misreport connections (the InputMessenger contract,
+transport/messenger.py). The reference leans on the same discipline —
+every policy parser returns ParseResult codes, never throws
+(src/brpc/protocol.h:64-158).
+
+Deterministic pseudo-fuzz: seeded random bytes, truncations of valid
+frames, and single-byte corruptions of valid frames.
+"""
+
+import random
+
+import pytest
+
+from incubator_brpc_tpu.protocol.registry import protocol_registry
+from incubator_brpc_tpu.protocol.tbus_std import FatalParseError, ParseError
+
+ALLOWED = (ParseError, FatalParseError)
+
+
+def _valid_seeds():
+    """A few valid frames across protocols, as corruption bases."""
+    from incubator_brpc_tpu.protocol.tbus_std import Meta, pack_frame
+
+    seeds = [
+        pack_frame(Meta(service="s", method="m"), b"payload" * 10, 3),
+        (
+            b"POST /a/b HTTP/1.1\r\nHost: t\r\nContent-Length: 5\r\n\r\nhello"
+        ),
+        b"GET /x HTTP/1.1\r\n\r\n",
+    ]
+    try:
+        from incubator_brpc_tpu.protocol.baidu_std import pack_request
+        from incubator_brpc_tpu.protocol.tbus_std import Meta as _M
+
+        seeds.append(pack_request(_M(service="svc", method="mth"), b"body", 7))
+    except Exception:  # noqa: BLE001 — signature drift: seeds are optional
+        pass
+    return [bytes(s) for s in seeds]
+
+
+def _drive_parser(fn, data: bytes):
+    try:
+        out = fn(data)
+    except ALLOWED:
+        return
+    except Exception as e:  # noqa: BLE001
+        raise AssertionError(
+            f"{fn.__module__}.{getattr(fn, '__name__', fn)} leaked "
+            f"{type(e).__name__}: {e!r} on {data[:40]!r}..."
+        ) from e
+    if out is None:
+        return
+    if isinstance(out, tuple):
+        frame, consumed = out
+        assert frame is None or consumed >= 0
+    else:
+        assert isinstance(out, int) or out is None  # parse_header total
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_random_bytes_never_leak_exceptions(seed):
+    rng = random.Random(seed)
+    protos = protocol_registry.ordered()
+    for _ in range(40):
+        n = rng.choice((1, 4, 16, 64, 300, 5000))
+        data = bytes(rng.getrandbits(8) for _ in range(n))
+        for proto in protos:
+            if proto.parse is not None:
+                _drive_parser(proto.parse, data)
+            if proto.parse_header is not None:
+                _drive_parser(proto.parse_header, data[:64])
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_http_parse_conn_never_leaks_exceptions(seed):
+    """The stateful pinned path (chunked decode) under garbage: only the
+    contract exceptions may escape, and consumed must never exceed what
+    was buffered."""
+    from incubator_brpc_tpu.iobuf import IOBuf
+    from incubator_brpc_tpu.protocol import http as http_mod
+
+    class FakeSock:
+        def __init__(self):
+            self.context = {}
+            self.on_failed = []
+
+    rng = random.Random(2000 + seed)
+    bases = _valid_seeds() + [
+        b"POST /u HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n"
+        b"5\r\nhello\r\n0\r\n\r\n",
+    ]
+    for base in bases:
+        for _ in range(25):
+            data = bytearray(base)
+            i = rng.randrange(len(data))
+            if rng.random() < 0.5:
+                data = data[:i]
+            else:
+                data[i] ^= 1 << rng.randrange(8)
+            sock = FakeSock()
+            buf = IOBuf()
+            buf.append(bytes(data))
+            # feed in two windows like the messenger would
+            for _round in range(2):
+                try:
+                    frame, consumed = http_mod.parse_conn(sock, buf)
+                except ALLOWED:
+                    break
+                except Exception as e:  # noqa: BLE001
+                    raise AssertionError(
+                        f"parse_conn leaked {type(e).__name__}: {e!r} "
+                        f"on {bytes(data)[:40]!r}"
+                    ) from e
+                assert consumed >= 0
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_corrupted_valid_frames_never_leak_exceptions(seed):
+    rng = random.Random(1000 + seed)
+    protos = protocol_registry.ordered()
+    for base in _valid_seeds():
+        for _ in range(30):
+            data = bytearray(base)
+            mode = rng.randrange(3)
+            if mode == 0:  # truncate
+                data = data[: rng.randrange(len(data))]
+            elif mode == 1:  # flip one byte
+                i = rng.randrange(len(data))
+                data[i] ^= 1 << rng.randrange(8)
+            else:  # splice garbage into the middle
+                i = rng.randrange(len(data))
+                data[i:i] = bytes(
+                    rng.getrandbits(8) for _ in range(rng.randrange(1, 9))
+                )
+            blob = bytes(data)
+            for proto in protos:
+                if proto.parse is not None:
+                    _drive_parser(proto.parse, blob)
+                if proto.parse_header is not None:
+                    _drive_parser(proto.parse_header, blob[:64])
