@@ -441,11 +441,11 @@ class DeviceLink:
         row[5:LINK_HEADER_WORDS] = 0  # reserved words must not leak heap
         row[5] = (self._next_deliver >> 32) & 0xFFFFFFFF  # ack high word
         self._acks_sent = self._next_deliver  # words 3+5 carry this
-        # word 3 carries the cumulative delivered count on the wire (the
-        # RDMA endpoint's piggybacked imm-data ack slot). In this
-        # single-controller build both parties share one delivery counter,
-        # so the window is gated on it directly (_inflight vs window); a
-        # multi-controller deployment reads this word instead.
+        # words 3(+5) carry the cumulative delivered count on the wire
+        # (the RDMA endpoint's piggybacked imm-data ack slot). ack_mode=
+        # 'local' gates the window on the shared in-process counter and
+        # only WRITES these; ack_mode='wire' — the multi-controller flow —
+        # gates on the values READ from received rows (_deliver).
         row[3] = self._next_deliver & 0xFFFFFFFF
         row[4] = flags
         if used:
