@@ -20,6 +20,7 @@ EXAMPLES = [
     "examples/naming_failover.py",
     "examples/cache_clients.py",
     "examples/link_performance.py",
+    "examples/http_upload.py",
 ]
 
 
