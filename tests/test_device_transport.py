@@ -95,3 +95,73 @@ class TestDeviceCalls:
         finally:
             server.stop()
             server.join(timeout=5)
+
+
+class TestBatchedDispatch:
+    """Micro-batched DeviceEndpoint: concurrent calls stack into one
+    vmapped dispatch; per-row method ids and correlation ids must route
+    independently inside the batch."""
+
+    def test_mixed_methods_in_one_batch(self):
+        import threading
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        from incubator_brpc_tpu.models.tensor_echo import TensorEchoService
+        from incubator_brpc_tpu.transport.device import DeviceEndpoint
+
+        svc = TensorEchoService()
+        svc.add_method(3, lambda p: p * jnp.uint32(2))
+        svc.add_method(5, lambda p: p + jnp.uint32(10))
+        ep = DeviceEndpoint(service=svc, window_size=32, max_batch=16)
+        ep.warm(64)
+        results = {}
+
+        def worker(i):
+            mid = (0, 3, 5)[i % 3]
+            words = np.full(16, i + 1, dtype=np.uint32)
+            pending = ep.call_words(
+                words, method_id=mid, correlation_id=i + 1, timeout=60
+            )
+            assert pending.wait(60)
+            results[i] = (mid, pending.error_code, pending.response_words)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(18)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(results) == 18
+        for i, (mid, code, words) in results.items():
+            assert code == 0, (i, code)
+            base = i + 1
+            want = {0: base, 3: base * 2, 5: base + 10}[mid]
+            assert (words == want).all(), (i, mid, words[:4])
+
+    def test_unknown_method_in_batch_errors_only_its_row(self):
+        import threading
+
+        from incubator_brpc_tpu.transport.device import DeviceEndpoint
+
+        ep = DeviceEndpoint(window_size=16, max_batch=8)
+        ep.warm(32)
+        results = {}
+
+        def worker(i):
+            mid = 999 if i == 3 else 0
+            code, out = ep.call_bytes(
+                b"row%02d" % i, method_id=mid, correlation_id=i + 1, timeout=60
+            )
+            results[i] = (code, out)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, (code, out) in results.items():
+            if i == 3:
+                assert code == 1002, (i, code)  # ENOMETHOD, only this row
+            else:
+                assert code == 0 and out == b"row%02d" % i, (i, code)
