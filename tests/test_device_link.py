@@ -615,6 +615,43 @@ class TestFabricFailurePaths:
         assert plain.link is not authed.link
 
 
+class TestStepFailureInjection:
+    def test_dispatch_failure_mid_traffic_fails_link_cleanly(self, echo_server):
+        # inject a step that blows up on the Nth dispatch: the link must
+        # fail (not wedge), in-flight callers must get errors, and the
+        # next call must re-handshake onto a FRESH link
+        from incubator_brpc_tpu.rpc import Controller
+
+        ch = _tpu_channel(echo_server)
+        assert ch.call_method(
+            "EchoService", "Echo", b"warm", cntl=Controller(timeout_ms=30000)
+        ).ok()
+        link = ch._device_sock.link
+        orig_step = link._step
+        calls = {"n": 0}
+
+        def failing_step(slots):
+            calls["n"] += 1
+            if calls["n"] >= 2:
+                raise RuntimeError("injected device fault")
+            return orig_step(slots)
+
+        link._step = failing_step
+        # this call's request or response step hits the injected fault
+        c = ch.call_method(
+            "EchoService", "Echo", b"boom", cntl=Controller(timeout_ms=10000)
+        )
+        # either the failure landed mid-call (error) or after (link dead)
+        assert c.failed() or link._closed
+        assert _wait(lambda: link._closed, timeout=10)
+        # recovery: the map re-handshakes a fresh link and traffic resumes
+        c2 = ch.call_method(
+            "EchoService", "Echo", b"again", cntl=Controller(timeout_ms=30000)
+        )
+        assert c2.ok(), c2.error_text
+        assert ch._device_sock.link is not link
+
+
 class TestZeroCopyDelivery:
     def test_received_blocks_reference_step_output_memory(self, echo_server):
         # The receive path must wrap the link step's output buffer as an
