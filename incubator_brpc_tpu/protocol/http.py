@@ -213,9 +213,13 @@ def parse_header(header: bytes) -> Optional[int]:
     te = _transfer_encoding(blob)
     if te is not None:
         if is_resp:
-            # progressive/chunked responses belong to the blocking helper
-            # or streams; the channel client speaks Content-Length
-            raise ParseError("chunked responses not supported on channels")
+            if te != "chunked":
+                raise FatalParseError(
+                    f"unsupported transfer-encoding {te!r}"
+                )
+            # chunked RESPONSE (a progressive server body consumed over a
+            # channel): stateful takeover, same as chunked requests
+            return None
         if te != "chunked":
             # 'gzip, chunked' etc.: dechunking alone would hand handlers
             # still-encoded bytes — refuse rather than corrupt. Fatal: the
@@ -429,9 +433,31 @@ def parse_conn(sock, buf) -> Tuple[Optional[object], int]:
     head_end = window.find(b"\r\n\r\n")
     if head_end < 0:
         return None, 0  # header block incomplete
-    # a chunked request: build the frame shell, install the decode state
     from incubator_brpc_tpu.utils.flags import get_flag
 
+    if looks_like_http_response(window):
+        # a chunked RESPONSE: the channel client consuming a progressive
+        # server body — accumulate statefully, deliver one response frame
+        # at the terminal chunk (the reference's full http client reads
+        # chunked responses through the same resumable parser)
+        head = window[:head_end].decode("latin-1")
+        lines = head.split("\r\n")
+        parts_line = lines[0].split(" ", 2)
+        if len(parts_line) < 2 or not parts_line[1].isdigit():
+            raise ParseError(f"bad status line {lines[0]!r}")
+        headers: Dict[str, str] = {}
+        for line in lines[1:]:
+            if ":" in line:
+                k, v = line.split(":", 1)
+                headers[k.strip().lower()] = v.strip()
+        frame = HttpResponseFrame(int(parts_line[1]), headers, b"")
+        buf.popn(head_end + 4)
+        st = _ChunkState(frame, None, max_total=int(get_flag("max_body_size")))
+        sock.context["_http_chunk"] = st
+        frame2, consumed2 = _conn_chunk_continue(sock, st, buf)
+        return frame2, head_end + 4 + consumed2
+
+    # a chunked request: build the frame shell, install the decode state
     method, target, headers = _parse_request_head(
         window[:head_end].decode("latin-1")
     )

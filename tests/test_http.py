@@ -342,6 +342,44 @@ class TestPortal:
         assert status == 200 and body == b"OK"
 
 
+class TestChunkedResponsesOnChannels:
+    def test_channel_receives_progressive_response(self):
+        """A Channel(protocol='http') consuming a handler that streams its
+        body chunked — the stateful response decode (the reference's full
+        http client reads chunked responses the same way)."""
+        from incubator_brpc_tpu.rpc import ChannelOptions, Controller
+
+        def streamy(cntl, req):
+            def gen():
+                for i in range(64):
+                    yield b"chunk-%03d|" % i
+
+            return gen()
+
+        srv = Server()
+        srv.add_service("s", {"stream": streamy, "plain": lambda c, r: r})
+        assert srv.start(0)
+        try:
+            ch = Channel()
+            assert ch.init(
+                f"127.0.0.1:{srv.port}", options=ChannelOptions(protocol="http")
+            )
+            cntl = ch.call_method(
+                "s", "stream", b"", cntl=Controller(timeout_ms=30000)
+            )
+            assert cntl.ok(), cntl.error_text
+            want = b"".join(b"chunk-%03d|" % i for i in range(64))
+            assert cntl.response_payload == want
+            # the connection stays usable for an ordinary response after
+            cntl2 = ch.call_method(
+                "s", "plain", b"pp", cntl=Controller(timeout_ms=30000)
+            )
+            assert cntl2.ok(), cntl2.error_text
+            assert cntl2.response_payload == b"pp"
+        finally:
+            srv.stop()
+
+
 class TestPortalCompleteness:
     """Round-4 pages: /protobufs /dir /threads /vlog (reference
     builtin/list_service, dir_service, threads_service, vlog_service)."""
