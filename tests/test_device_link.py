@@ -729,3 +729,57 @@ class TestZeroCopyDelivery:
         while link._out_nbytes[0] and _t.monotonic() < deadline:
             _t.sleep(0.01)
         assert link._out_nbytes[0] == 0
+
+
+class TestDynamicPartitionFused:
+    def test_dynamic_scheme_fuses_too(self):
+        """DynamicPartitionChannel picks a scheme, whose ParallelChannel
+        applies the same collective lowering when its partitions are
+        device-method servers."""
+        import jax
+
+        if len(jax.devices()) < 4:
+            pytest.skip("needs a 4+ device mesh")
+        from incubator_brpc_tpu.rpc import (
+            Controller,
+            Server,
+            ServerOptions,
+            device_method,
+        )
+        from incubator_brpc_tpu.rpc.combo import DynamicPartitionChannel
+
+        def bump(data, n):
+            import jax.numpy as jnp
+
+            return data + jnp.uint8(2), n
+
+        servers = []
+        for i in range(3):
+            s = Server(ServerOptions(device_index=i + 1, usercode_inline=True))
+            s.add_service("dd", {"k": device_method(bump, width=128)})
+            assert s.start(0)
+            servers.append(s)
+        try:
+            url = "list://" + ",".join(
+                f"127.0.0.1:{s.port} {i}/3" for i, s in enumerate(servers)
+            )
+            from incubator_brpc_tpu.rpc import ChannelOptions as CO
+
+            dpc = DynamicPartitionChannel()
+            assert dpc.init(
+                url, options=CO(transport="tpu", timeout_ms=60000)
+            )
+            deadline = time.monotonic() + 10
+            while not dpc._schemes and time.monotonic() < deadline:
+                time.sleep(0.05)
+            c = dpc.call_method(
+                "dd", "k", b"\x07", cntl=Controller(timeout_ms=60000)
+            )
+            assert c.ok(), c.error_text
+            assert c.response_payload == b"\x09" * 3
+            assert getattr(c, "collective_fused", False) is True
+            dpc.stop()
+        finally:
+            for s in servers:
+                s.stop()
+                s.join(timeout=5)
