@@ -122,6 +122,7 @@ class ChannelOptions:
         link_slot_words: int = 16384,
         link_window: int = 8,
         link_ack_mode: str = "local",
+        link_controller: str = "single",
         native_plane: bool = False,
         ssl_context=None,
         ssl_server_hostname=None,
@@ -153,6 +154,14 @@ class ChannelOptions:
         # 'local' | 'wire': how the link's credit window learns about
         # drained steps (wire = the multi-controller piggybacked-ack flow)
         self.link_ack_mode = link_ack_mode
+        # 'single' (both link halves in this process — the default, the
+        # in-process JAX model) | 'multi' (the peer is a DIFFERENT process
+        # holding its own device: lockstep SPMD dispatch coordinated over
+        # a control stream, transport/mc_link.py; requires
+        # jax.distributed.initialize on both hosts)
+        if link_controller not in ("single", "multi"):
+            raise ValueError(f"unknown link_controller {link_controller!r}")
+        self.link_controller = link_controller
         # Route eligible sync calls through the native client (src/tbnet):
         # pack/write/read/match in C++ with the GIL released, one shared
         # connection with an elected completion-pump reader. Calls that
@@ -609,6 +618,7 @@ class Channel:
             window=self._options.link_window,
             timeout_ms=cntl.timeout_ms or 60000,
             ack_mode=self._options.link_ack_mode,
+            controller=self._options.link_controller,
             auth=self._options.auth,
             ssl_context=self._options.ssl_context,
             ssl_server_hostname=self._options.ssl_server_hostname,

@@ -157,6 +157,12 @@ class DeviceLink:
         self._out_nbytes = [0, 0]
         self._close_pending = [False, False]
         self._closed = False
+        # admission gate mc_link flips when its close dance freezes the
+        # step budget: bytes queued after the freeze could never be
+        # dispatched, so they must be REFUSED, not silently dropped —
+        # checked in the same critical section that admits the queue
+        # extension (always False for the in-process link)
+        self._send_blocked = False
         self._seq = 0  # steps dispatched
         self._next_deliver = 0  # next seq to hand to the sockets
         self._inflight = 0  # dispatched, not yet drained
@@ -268,7 +274,7 @@ class DeviceLink:
         deadline = None
         while True:
             with self._lock:
-                if self._closed:
+                if self._closed or self._send_blocked:
                     return ErrorCode.EFAILEDSOCKET
                 if (
                     self._out_nbytes[side] <= budget
@@ -776,6 +782,7 @@ class DeviceLinkMap:
         window: int = 8,
         timeout_ms: float = 60000,
         ack_mode: str = "local",
+        controller: str = "single",
         auth=None,
         ssl_context=None,
         ssl_server_hostname=None,
@@ -793,7 +800,10 @@ class DeviceLinkMap:
             f"ssl-{id(ssl_context):x}" if ssl_context is not None else "",
             ssl_server_hostname or "",
         )
-        key = (ep.ip, ep.port, device_index, slot_words, window, ack_mode, ident)
+        key = (
+            ep.ip, ep.port, device_index, slot_words, window, ack_mode,
+            controller, ident,
+        )
         if auth is not None or ssl_context is not None:
             # the key embeds id()s: retain the credential objects for the
             # entry's lifetime, or a GC'd auth object's recycled address
@@ -832,14 +842,27 @@ class DeviceLinkMap:
                 raise ConnectionError(
                     f"device-link bootstrap channel init failed for {ep}"
                 )
-            ds = establish_device_link(
-                boot,
-                device_index=device_index,
-                slot_words=slot_words,
-                window=window,
-                timeout_ms=timeout_ms,
-                ack_mode=ack_mode,
-            )
+            if controller == "multi":
+                from incubator_brpc_tpu.transport.mc_link import (
+                    establish_mc_link,
+                )
+
+                ds = establish_mc_link(
+                    boot,
+                    device_index=device_index,
+                    slot_words=slot_words,
+                    window=window,
+                    timeout_ms=timeout_ms,
+                )
+            else:
+                ds = establish_device_link(
+                    boot,
+                    device_index=device_index,
+                    slot_words=slot_words,
+                    window=window,
+                    timeout_ms=timeout_ms,
+                    ack_mode=ack_mode,
+                )
             with self._lock:
                 # opportunistic sweep: recycle dead entries so a long-lived
                 # process contacting many ephemeral peers does not
@@ -875,12 +898,28 @@ def make_handshake_handler(server):
 
         try:
             req = json.loads(request.decode())
+        except ValueError as e:
+            cntl.set_failed(ErrorCode.EREQUEST, f"bad handshake: {e}")
+            return b""
+        if not isinstance(req, dict):
+            cntl.set_failed(ErrorCode.EREQUEST, "bad handshake: not an object")
+            return b""
+        if req.get("controller") == "multi":
+            # the multi-controller deployment: peer devices live in
+            # DIFFERENT processes; the link half built here is lockstep
+            # SPMD with the proposer's (transport/mc_link.py)
+            from incubator_brpc_tpu.transport.mc_link import (
+                accept_mc_handshake,
+            )
+
+            return accept_mc_handshake(server, cntl, req)
+        try:
             cookie = req["cookie"]
             client_dev = int(req["device"])
             slot_words = int(req.get("slot_words", 16384))
             window = int(req.get("window", 8))
             ack_mode = str(req.get("ack_mode", "local"))
-        except (ValueError, KeyError) as e:
+        except (ValueError, KeyError, TypeError) as e:
             cntl.set_failed(ErrorCode.EREQUEST, f"bad handshake: {e}")
             return b""
         devices = jax.devices()

@@ -1,0 +1,258 @@
+"""Two-process harness for the multi-controller device plane.
+
+Runnable as ``python -m incubator_brpc_tpu.transport.mc_worker <role> ...``.
+One process is the RPC server (and the jax.distributed coordinator), the
+other the client; each owns ONE local CPU device and the two form a
+2-device global mesh over which the link's exchange step runs lockstep
+SPMD (transport/mc_link.py). This is the deployment shape of the
+reference's RDMA transport — two real processes, handshake over TCP, data
+over the device fabric (/root/reference/src/brpc/rdma/rdma_endpoint.h:
+42-213, per-host init rdma_helper.cpp) — used by tests/test_mc_link.py
+and the driver's ``dryrun_multichip`` multi-process gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+
+def _force_local_device_count(n: int) -> None:
+    """MUST run before jax backends initialize: each worker owns exactly
+    ``n`` local virtual CPU devices (the parent harness may carry an
+    8-device XLA_FLAGS from tests/conftest.py — replace, don't append:
+    XLA keeps the first occurrence of a duplicated flag)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    pat = r"--xla_force_host_platform_device_count=\d+"
+    want = f"--xla_force_host_platform_device_count={n}"
+    if re.search(pat, flags):
+        flags = re.sub(pat, want, flags)
+    else:
+        flags = (flags + " " + want).strip()
+    os.environ["XLA_FLAGS"] = flags
+
+
+def _init_distributed(coord_port: int, process_id: int) -> None:
+    import jax
+
+    # this machine's sitecustomize registers the axon TPU plugin; beat it
+    # the same way tests/conftest.py does (config wins over env here)
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{coord_port}",
+        num_processes=2,
+        process_id=process_id,
+    )
+    assert len(jax.devices()) == 2, (
+        f"expected a 2-device global mesh, got {jax.devices()}"
+    )
+    assert len(jax.local_devices()) == 1
+
+
+def run_server(args) -> int:
+    _init_distributed(args.coord_port, process_id=0)
+    import threading
+
+    from incubator_brpc_tpu.rpc import Server, ServerOptions
+
+    # Exit is COORDINATED, not parent-driven: XLA's coordination service
+    # runs a cluster-wide shutdown barrier at interpreter exit (jax's
+    # atexit), so a worker that exits alone blocks in that barrier until
+    # the peer exits too. The client tells us it is done (a plain TCP
+    # RPC), we stop, and both processes reach the barrier together.
+    quit_ev = threading.Event()
+
+    def _quit(cntl, req: bytes) -> bytes:
+        quit_ev.set()
+        return b"bye"
+
+    server = Server(ServerOptions(device_index=0))
+    server.add_service(
+        "EchoService", {"Echo": lambda cntl, req: b"echo:" + req}
+    )
+    server.add_service("Admin", {"Quit": _quit})
+    assert server.start(args.rpc_port)
+    print(f"SERVER_READY port={server.port}", flush=True)
+    # parent closing our stdin is the fallback exit path (client crashed)
+    threading.Thread(
+        target=lambda: (sys.stdin.read(), quit_ev.set()), daemon=True
+    ).start()
+    quit_ev.wait()
+    server.stop()
+    server.join(timeout=10)
+    print("SERVER_DONE", flush=True)
+    return 0
+
+
+def run_client(args) -> int:
+    _init_distributed(args.coord_port, process_id=1)
+    from incubator_brpc_tpu.rpc import Channel, ChannelOptions, Controller
+
+    ch = Channel()
+    assert ch.init(
+        f"127.0.0.1:{args.rpc_port}",
+        options=ChannelOptions(
+            transport="tpu",
+            link_controller="multi",
+            timeout_ms=60000,
+            link_slot_words=args.slot_words,
+            link_window=args.window,
+        ),
+    )
+    # jax.distributed's init barrier ran, but the peer may not have bound
+    # its RPC port yet — retry the first call until the server is up
+    # (a refused bootstrap surfaces as a failed controller, not a raise)
+    deadline = time.monotonic() + 60.0
+    while True:
+        first = ch.call_method(
+            "EchoService", "Echo", b"hello",
+            cntl=Controller(timeout_ms=60000),
+        )
+        if first.ok():
+            break
+        if time.monotonic() > deadline:
+            print(f"CLIENT_FAIL connect: {first.error_text}", flush=True)
+            return 1
+        time.sleep(0.2)
+    assert first.response_payload == b"echo:hello"
+
+    for i in range(args.n_rpcs):
+        body = bytes((i + j) % 256 for j in range(args.payload))
+        req = f"m{i}:".encode() + body
+        cntl = ch.call_method(
+            "EchoService", "Echo", req, cntl=Controller(timeout_ms=60000)
+        )
+        assert cntl.ok(), f"echo {i} failed: {cntl.error_text}"
+        assert cntl.response_payload == b"echo:" + req, f"echo {i} corrupt"
+
+    link = ch._device_sock.link
+    stats = {
+        "n_rpcs": args.n_rpcs,
+        "payload": args.payload,
+        "steps": int(link._seq),
+        "peer_ack": int(link.peer_ack),
+        "devices": [str(d) for d in link.devices],
+        "window": link.window,
+        "slot_words": link.slot_words,
+    }
+    # the cross-host drain signal must actually flow: the peer's
+    # cumulative-delivered count rides slot words 3+5 back to us
+    assert stats["peer_ack"] > 0, "wire acks never advanced"
+    assert stats["steps"] >= args.n_rpcs, "fewer steps than RPCs?"
+    # clean shutdown: the close dance agrees on a final step count, both
+    # sides dispatch exactly that many, and the link quiesces
+    ch._device_sock.recycle()
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:
+        with link._lock:
+            done = link._closed and link._inflight == 0
+        if done:
+            break
+        time.sleep(0.05)
+    assert link._closed, "close dance did not finish"
+    stats["final_target"] = link._final_target
+    print("CLIENT_OK " + json.dumps(stats), flush=True)
+    # release the peer so both processes reach the coordination service's
+    # exit barrier together (see run_server)
+    host = Channel()
+    assert host.init(f"127.0.0.1:{args.rpc_port}")
+    host.call_method("Admin", "Quit", b"", cntl=Controller(timeout_ms=10000))
+    return 0
+
+
+def orchestrate_pair(extra=(), timeout: float = 240.0):
+    """Spawn the server+client pair as real OS processes and collect the
+    client's link stats. The single parent-side runner for both
+    tests/test_mc_link.py and the driver's dryrun gate. Returns
+    ``(stats, client_out, server_out)``; raises AssertionError with both
+    transcripts on any failure."""
+    import socket
+    import subprocess
+
+    ports = []
+    holders = []
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        holders.append(s)
+    for s in holders:
+        s.close()
+    coord, rpc = ports
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    def spawn(role, role_extra=()):
+        return subprocess.Popen(
+            [
+                sys.executable, "-m",
+                "incubator_brpc_tpu.transport.mc_worker", role,
+                "--coord-port", str(coord), "--rpc-port", str(rpc),
+                *role_extra,
+            ],
+            cwd=repo, env=env, stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+
+    server = spawn("server")
+    client = spawn("client", extra)
+    try:
+        # the pair self-orchestrates its exit: the client's Admin.Quit
+        # releases the server so both reach the coordination service's
+        # exit barrier together; communicate() closing the server's
+        # stdin is the fallback path when the client crashed early
+        client_out, _ = client.communicate(timeout=timeout)
+        server_out, _ = server.communicate(timeout=30.0)
+    except subprocess.TimeoutExpired:
+        client.kill()
+        server.kill()
+        client_out = (client.communicate()[0] or "") + " [KILLED]"
+        server_out = (server.communicate()[0] or "") + " [KILLED]"
+        raise AssertionError(
+            f"two-process pair timed out\n-- client --\n{client_out}\n"
+            f"-- server --\n{server_out}"
+        )
+    transcript = (
+        f"-- client --\n{client_out}\n-- server --\n{server_out}"
+    )
+    assert client.returncode == 0 and "CLIENT_OK" in client_out, (
+        f"client failed rc={client.returncode}\n{transcript}"
+    )
+    assert server.returncode == 0 and "SERVER_DONE" in server_out, (
+        f"server failed rc={server.returncode}\n{transcript}"
+    )
+    stats = json.loads(
+        client_out.split("CLIENT_OK", 1)[1].strip().splitlines()[0]
+    )
+    return stats, client_out, server_out
+
+
+def main(argv=None) -> int:
+    # SIGUSR1 dumps all thread stacks — the pair runs under an orchestration
+    # harness (pytest / dryrun), and a wedged worker must be diagnosable
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("role", choices=["server", "client"])
+    ap.add_argument("--coord-port", type=int, required=True)
+    ap.add_argument("--rpc-port", type=int, required=True)
+    ap.add_argument("--n-rpcs", type=int, default=8)
+    ap.add_argument("--payload", type=int, default=3000)
+    ap.add_argument("--slot-words", type=int, default=256)
+    ap.add_argument("--window", type=int, default=4)
+    args = ap.parse_args(argv)
+    _force_local_device_count(1)
+    return run_server(args) if args.role == "server" else run_client(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
