@@ -399,16 +399,48 @@ class SelectiveChannel:
     move to a different sub-channel (selective_channel.cpp). Like the
     reference — which wraps sub-channels in fake SocketIds and feeds them
     to an embedded LoadBalancer — the scheduler here IS a real LB from the
-    registry (rr/random/wrr/la) over per-sub pseudo-endpoints, with
-    latency/error feedback after every attempt, so ``lb_name="la"`` gives
-    locality-aware replica selection across clusters."""
+    registry (rr/random/wrr/la) over per-sub pseudo-endpoints reading
+    through DoublyBufferedData snapshots, with latency/error feedback
+    after every attempt, so ``lb_name="la"`` gives locality-aware replica
+    selection across clusters.
 
-    def __init__(self, max_retry: int = 3, lb_name: str = "rr"):
+    Health integrates the way the reference's fake Sockets do (a failed
+    sub-channel's SocketId is excluded by the LB until its health check
+    revives it, selective_channel.cpp + the Socket health-check loop):
+    ``health_check_fails`` consecutive transport-class failures take the
+    sub OUT of the LB's candidate set; after an exponentially backed-off
+    interval the sub is revived in place — the next real call is the
+    probe (Socket revives in place the same way), success resets it,
+    failure re-downs it with a doubled interval."""
+
+    # errors that indict the REPLICA (transport/overload), not the request
+    _HEALTH_ERRORS = frozenset(
+        {
+            ErrorCode.EFAILEDSOCKET,
+            ErrorCode.EHOSTDOWN,
+            ErrorCode.ERPCTIMEDOUT,
+            ErrorCode.EOVERCROWDED,
+            ErrorCode.ECLOSE,
+        }
+    )
+
+    def __init__(
+        self,
+        max_retry: int = 3,
+        lb_name: str = "rr",
+        health_check_fails: int = 2,
+        health_check_interval_s: float = 1.0,
+    ):
         from incubator_brpc_tpu.lb import create_load_balancer
 
         self.max_retry = max_retry
+        self.health_check_fails = health_check_fails
+        self.health_check_interval_s = health_check_interval_s
         self._subs: List[Channel] = []
         self._eps: List[EndPoint] = []  # pseudo endpoint per sub-channel
+        self._fail_streak: List[int] = []
+        self._down_until: List[float] = []  # 0 = healthy
+        self._backoff: List[float] = []
         self._lb = create_load_balancer(lb_name)
         self._lock = threading.Lock()
 
@@ -418,6 +450,9 @@ class SelectiveChannel:
             self._subs.append(channel)
             ep = EndPoint(ip="subchannel", port=idx)
             self._eps.append(ep)
+            self._fail_streak.append(0)
+            self._down_until.append(0.0)
+            self._backoff.append(self.health_check_interval_s)
         self._lb.add_server(ep)
         return idx
 
@@ -426,17 +461,88 @@ class SelectiveChannel:
         return len(self._subs)
 
     def _pick(self, excluded: set) -> Optional[int]:
+        import time as _time
+
+        now = _time.monotonic()
         with self._lock:
             excluded_eps = {self._eps[i] for i in excluded if i < len(self._eps)}
+            # downed subs stay out of the candidate set until their
+            # revive time — then they rejoin and the next call probes them
+            for i, until in enumerate(self._down_until):
+                if until > now:
+                    excluded_eps.add(self._eps[i])
         ep = self._lb.select(excluded=excluded_eps)
+        if ep is None and excluded_eps:
+            # every replica is either excluded or down: rather than fail
+            # the call outright, probe the least-recently-downed sub not
+            # excluded by THIS call (the reference likewise degrades to
+            # trying an unhealthy node when nothing healthy remains)
+            with self._lock:
+                candidates = [
+                    (self._down_until[i], i)
+                    for i in range(len(self._subs))
+                    if i not in excluded
+                ]
+            if candidates:
+                return min(candidates)[1]
         return ep.port if ep is not None else None
 
-    def _feedback(self, index: int, latency_us: float, error_code: int) -> None:
+    def _feedback(
+        self,
+        index: int,
+        latency_us: float,
+        error_code: int,
+        budget_starved: bool = False,
+    ) -> None:
+        """``budget_starved``: the attempt ran on the dregs of the shared
+        per-call deadline (an earlier slow replica ate it); its timeout
+        indicts the BUDGET, not this replica — feed the LB but leave the
+        health streak alone."""
+        import time as _time
+
         with self._lock:
             if index >= len(self._eps):
                 return
             ep = self._eps[index]
+            if error_code in self._HEALTH_ERRORS:
+                if not (
+                    budget_starved and error_code == ErrorCode.ERPCTIMEDOUT
+                ):
+                    self._fail_streak[index] += 1
+                    if self._fail_streak[index] >= self.health_check_fails:
+                        # down: excluded from _pick until the backed-off
+                        # revive time, then probed in place
+                        self._down_until[index] = (
+                            _time.monotonic() + self._backoff[index]
+                        )
+                        self._backoff[index] = min(
+                            self._backoff[index] * 2, 30.0
+                        )
+            else:
+                # a completed response — success OR application error —
+                # proves the replica reachable: 'consecutive' means what
+                # it says, so the streak resets and a downed replica whose
+                # probe got through revives
+                self._fail_streak[index] = 0
+                self._down_until[index] = 0.0
+                self._backoff[index] = self.health_check_interval_s
         self._lb.feedback(ep, latency_us, error_code)
+
+    def health(self) -> List[dict]:
+        """Introspection: per-sub health (mirrors /connections for subs)."""
+        import time as _time
+
+        now = _time.monotonic()
+        with self._lock:
+            return [
+                {
+                    "index": i,
+                    "down": self._down_until[i] > now,
+                    "fail_streak": self._fail_streak[i],
+                    "revive_in_s": max(0.0, self._down_until[i] - now),
+                }
+                for i in range(len(self._subs))
+            ]
 
     def call_method(
         self,
@@ -483,7 +589,7 @@ class SelectiveChannel:
         if cntl.timeout_ms is not None and cntl.timeout_ms > 0:
             deadline = _time.monotonic() + cntl.timeout_ms / 1000.0
         last: Optional[Controller] = None
-        for _ in range(attempts):
+        for attempt_no in range(attempts):
             remaining_ms = cntl.timeout_ms
             if deadline is not None:
                 remaining_ms = (deadline - _time.monotonic()) * 1000.0
@@ -509,7 +615,18 @@ class SelectiveChannel:
             sc.log_id = cntl.log_id
             sub.call_method(service, method, request, cntl=sc)
             last = sc
-            self._feedback(i, sc.latency_us, sc.error_code)
+            # only a LATER attempt can be budget-starved: the first one
+            # had the whole deadline, so its timeout indicts the replica
+            starved = (
+                attempt_no > 0
+                and cntl.timeout_ms is not None
+                and cntl.timeout_ms > 0
+                and remaining_ms is not None
+                and remaining_ms < max(50.0, 0.2 * cntl.timeout_ms)
+            )
+            self._feedback(
+                i, sc.latency_us, sc.error_code, budget_starved=starved
+            )
             if sc.ok():
                 cntl.response_payload = sc.response_payload
                 cntl.response_attachment = sc.response_attachment

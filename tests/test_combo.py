@@ -221,6 +221,117 @@ class TestSelectiveChannel:
         assert cntl.failed()
 
 
+class _ScriptedSub:
+    """Stand-in sub-channel whose outcomes are driven by the test: lets the
+    health state machine be exercised deterministically (the reference
+    tests its SelectiveChannel health path with controllable fake
+    SocketIds the same way)."""
+
+    def __init__(self):
+        self.healthy = True
+        self.calls = 0
+
+    def call_method(self, service, method, request, cntl=None, done=None):
+        self.calls += 1
+        if self.healthy:
+            cntl.response_payload = b"ok:" + request
+        else:
+            cntl.set_failed(ErrorCode.EFAILEDSOCKET, "scripted transport down")
+        if done:
+            done(cntl)
+        return cntl
+
+
+class TestSelectiveChannelHealth:
+    """The embedded LB integrates health: a sub-channel with consecutive
+    transport failures leaves the candidate set until its backed-off
+    revive probe (the reference excludes a failed fake Socket until the
+    health check revives it, selective_channel.cpp + socket health loop)."""
+
+    def test_downed_sub_is_excluded_until_revive_probe(self):
+        a, b = _ScriptedSub(), _ScriptedSub()
+        b.healthy = False
+        sc = SelectiveChannel(
+            max_retry=2, lb_name="rr",
+            health_check_fails=2, health_check_interval_s=0.3,
+        )
+        sc.add_channel(a)
+        sc.add_channel(b)
+        # drive calls: b fails its first attempts, hits the streak
+        # threshold, and is downed; every call still succeeds via a
+        for _ in range(10):
+            cntl = sc.call_method("s", "m", b"x")
+            assert cntl.ok(), cntl.error_text
+        health = {h["index"]: h for h in sc.health()}
+        assert health[1]["down"], health
+        b_calls_when_downed = b.calls
+        # b is OUT of the candidate set: further traffic never touches it
+        for _ in range(10):
+            assert sc.call_method("s", "m", b"x").ok()
+        assert b.calls == b_calls_when_downed, "downed sub still picked"
+        # after the interval, the next call probes b in place; still dead
+        # -> downed again with doubled backoff, traffic stays on a
+        time.sleep(0.35)
+        for _ in range(6):
+            assert sc.call_method("s", "m", b"x").ok()
+        assert b.calls == b_calls_when_downed + 1, "revive probe count"
+        # now b recovers; at the next revive probe it serves again and is
+        # restored as a full candidate (streak reset, backoff reset)
+        b.healthy = True
+        time.sleep(0.65)  # doubled backoff
+        for _ in range(8):
+            assert sc.call_method("s", "m", b"x").ok()
+        health = {h["index"]: h for h in sc.health()}
+        assert not health[1]["down"], health
+        assert b.calls > b_calls_when_downed + 1, "recovered sub not reused"
+
+    def test_all_down_still_probes_rather_than_failing(self):
+        a = _ScriptedSub()
+        a.healthy = False
+        sc = SelectiveChannel(
+            max_retry=1, lb_name="rr",
+            health_check_fails=1, health_check_interval_s=5.0,
+        )
+        sc.add_channel(a)
+        # first call downs it; second call has NO healthy candidate — the
+        # degraded path probes the downed sub instead of failing without
+        # an attempt
+        assert sc.call_method("s", "m", b"x").failed()
+        calls_before = a.calls
+        cntl = sc.call_method("s", "m", b"x")
+        assert cntl.failed()
+        assert a.calls > calls_before, "no probe attempted when all down"
+
+    def test_real_server_outage_shifts_traffic_off_the_replica(self):
+        """Integration shape: one replica's server dies mid-traffic; the
+        health gate takes it out of rotation (not merely per-call retry),
+        and throughput continues on the survivor."""
+        alive = make_server(b"alive")
+        dying = make_server(b"dying")
+        sc = SelectiveChannel(
+            max_retry=2, lb_name="rr",
+            health_check_fails=2, health_check_interval_s=30.0,
+        )
+        for srv in (alive, dying):
+            sc.add_channel(sub_channel(srv))
+        try:
+            for _ in range(4):
+                assert sc.call_method("svc", "echo", b"w").ok()
+            dying.stop()
+            dying.join(timeout=5)
+            # the first couple of calls may pay the failed attempt; once
+            # the streak downs the replica, calls go straight to alive
+            for _ in range(8):
+                cntl = sc.call_method("svc", "echo", b"w")
+                assert cntl.ok(), cntl.error_text
+                assert cntl.response_payload == b"alive:w"
+            health = {h["index"]: h for h in sc.health()}
+            assert health[1]["down"], health
+        finally:
+            alive.stop()
+            alive.join(timeout=5)
+
+
 class TestNamingTagDiff:
     def test_tag_change_is_remove_then_add(self, tmp_path):
         """A tag-only change must reach observers as remove-then-add so
