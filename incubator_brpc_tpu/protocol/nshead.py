@@ -124,12 +124,18 @@ def _process_request(sock, frame: NsheadFrame) -> None:
     cntl.log_id = frame.head["log_id"]
     cntl._sock = sock
     cntl._mark_start()
+    from incubator_brpc_tpu.rpc import server as server_mod
+
+    _prev_server = getattr(server_mod._usercode_tls, "server", None)
+    server_mod._usercode_tls.server = server  # thread_local_data() works here
     try:
         body = handler(cntl, frame.head, frame.payload) or b""
     except Exception as e:
         logger.exception("nshead service raised")
         cntl.set_failed(ErrorCode.EINTERNAL, f"nshead handler raised: {e!r}")
         body = b""
+    finally:
+        server_mod._usercode_tls.server = _prev_server
     cntl._mark_end()
     sock.write(
         pack_frame(

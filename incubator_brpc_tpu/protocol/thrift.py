@@ -368,12 +368,18 @@ def _server_process_request(sock, frame: ThriftRequestFrame) -> None:
     cntl.remote_side = sock.remote
     cntl._sock = sock
     cntl._mark_start()
+    from incubator_brpc_tpu.rpc import server as server_mod
+
+    _prev_server = getattr(server_mod._usercode_tls, "server", None)
+    server_mod._usercode_tls.server = server  # thread_local_data() works here
     try:
         reply = handler(cntl, frame.method, frame.payload)
     except Exception as e:
         logger.exception("thrift service raised")
         cntl.set_failed(ErrorCode.EINTERNAL, f"handler raised: {e!r}")
         reply = None
+    finally:
+        server_mod._usercode_tls.server = _prev_server
     cntl._mark_end()
     if cntl.error_code:
         # INTERNAL_ERROR(6) unless the handler chose UNKNOWN_METHOD-style

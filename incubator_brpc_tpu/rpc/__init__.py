@@ -10,6 +10,7 @@ from incubator_brpc_tpu.rpc.channel import Channel, ChannelOptions
 from incubator_brpc_tpu.rpc.channel import start_cancel
 from incubator_brpc_tpu.rpc.controller import Controller
 from incubator_brpc_tpu.rpc.server import (
+    thread_local_data,
     MethodStatus,
     Server,
     ServerOptions,
@@ -55,6 +56,7 @@ __all__ = [
     "SubCall",
     "MethodStatus",
     "Server",
+    "thread_local_data",
     "ServerOptions",
     "Stream",
     "StreamHandler",

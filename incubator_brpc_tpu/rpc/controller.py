@@ -109,6 +109,17 @@ class Controller:
     def ok(self) -> bool:
         return self.error_code == 0
 
+    def session_local_data(self):
+        """Per-connection pooled user data, lazily borrowed from the
+        server's session pool on this connection's first access
+        (reference Controller::session_local_data() backed by
+        ServerOptions.session_local_data_factory, server.h:55-239).
+        None on the client side or without a factory."""
+        server = getattr(self, "_server", None)
+        if server is None:
+            return None
+        return server.session_local_data(getattr(self, "_sock", None))
+
     def start_cancel(self) -> None:
         """Cancel this in-flight RPC from any thread (reference
         Controller::StartCancel / brpc::StartCancel(CallId),

@@ -144,6 +144,21 @@ class _MethodMap:
         return dict(self._by_name)
 
 
+# worker-thread context while user code runs: powers the argless
+# ``thread_local_data()`` (the reference's brpc::thread_local_data() reads
+# an equivalent per-thread slot set by the server loop)
+_usercode_tls = threading.local()
+
+
+def thread_local_data():
+    """Pooled per-thread data of the server whose handler is running on
+    this thread (reference brpc::thread_local_data(), server.h:55-239).
+    None outside a handler or when the server has no
+    thread_local_data_factory."""
+    server = getattr(_usercode_tls, "server", None)
+    return server.thread_local_data() if server is not None else None
+
+
 class ServerOptions:
     """Subset of reference ServerOptions (server.h:55-239) that applies here."""
 
@@ -163,6 +178,10 @@ class ServerOptions:
         ssl_context=None,
         native_plane: bool = False,
         native_loops: int = 2,
+        session_local_data_factory=None,
+        reserved_session_local_data: int = 0,
+        thread_local_data_factory=None,
+        reserved_thread_local_data: int = 0,
     ):
         self.max_concurrency = max_concurrency
         self.method_max_concurrency = method_max_concurrency
@@ -198,6 +217,20 @@ class ServerOptions:
         # details/ssl_helper.cpp). Mutually exclusive with native_plane:
         # the C++ reactor has no TLS stack.
         self.ssl_context = ssl_context
+        # Pooled per-connection user data (reference
+        # ServerOptions.session_local_data_factory, server.h:55-239 +
+        # simple_data_pool): lazily borrowed on first
+        # cntl.session_local_data() per connection, returned to the pool
+        # when the connection dies, reused by the next one. The factory is
+        # an object with create()/destroy(obj) or a zero-arg callable.
+        self.session_local_data_factory = session_local_data_factory
+        self.reserved_session_local_data = reserved_session_local_data
+        # Pooled per-worker-thread user data (reference
+        # thread_local_data_factory + brpc::thread_local_data()): one
+        # object per thread that runs this server's handlers, created on
+        # first thread_local_data() there, destroyed at server stop.
+        self.thread_local_data_factory = thread_local_data_factory
+        self.reserved_thread_local_data = reserved_thread_local_data
         # Run request processing (cut + handler) inline on the reactor
         # thread instead of a pool fiber — removes two thread handoffs per
         # request, the analog of the reference running user code directly
@@ -229,6 +262,13 @@ class Server:
         self._device_socks: list = []  # transport='tpu' links we accepted
         self._device_methods: dict = {}  # full name -> DeviceMethod (fused)
         self._native_plane = None  # NativeServerPlane when options ask for it
+        # session/thread-local data pools (simple_data_pool.h; built lazily
+        # from the option factories at start)
+        self._session_pool = None
+        self._tls_pool = None
+        self._tls_slots = threading.local()  # .data: per-thread object
+        self._tls_borrowed: list = []  # every live thread object (stop cleanup)
+        self._session_lock = threading.Lock()  # session borrow/release state
 
     # -- registration --------------------------------------------------------
 
@@ -390,6 +430,20 @@ class Server:
         ``listen`` may be a port (0 = ephemeral), "ip:port", or EndPoint."""
         if self._started:
             return False
+        if self.options.session_local_data_factory is not None:
+            from incubator_brpc_tpu.rpc.data_pool import SimpleDataPool
+
+            self._session_pool = SimpleDataPool(
+                self.options.session_local_data_factory,
+                reserved=self.options.reserved_session_local_data,
+            )
+        if self.options.thread_local_data_factory is not None:
+            from incubator_brpc_tpu.rpc.data_pool import SimpleDataPool
+
+            self._tls_pool = SimpleDataPool(
+                self.options.thread_local_data_factory,
+                reserved=self.options.reserved_thread_local_data,
+            )
         if isinstance(listen, int):
             ep = EndPoint(ip="127.0.0.1", port=listen)
         elif isinstance(listen, str):
@@ -528,9 +582,104 @@ class Server:
     def join(self, timeout: Optional[float] = None) -> bool:
         """Wait until every in-flight request finished."""
         with self._quiescent:
-            return self._quiescent.wait_for(
+            ok = self._quiescent.wait_for(
                 lambda: self._nprocessing == 0, timeout=timeout
             )
+        # handlers have drained: user data created by the factories dies
+        # with the server (reference destroys the pools in ~Server). Only
+        # when the server is actually stopping AND the drain finished — a
+        # timed-out join leaves live handlers that still hold the objects
+        if ok and self._stopping:
+            if self._tls_pool is not None:
+                for obj in self._tls_borrowed:
+                    self._tls_pool.give_back(obj)
+                self._tls_borrowed.clear()
+                self._tls_pool.destroy_all()
+            if self._session_pool is not None:
+                self._session_pool.destroy_all()
+        return ok
+
+    # -- session/thread-local user data (server.h:55-239) -------------------
+
+    def session_local_data(self, sock):
+        """Per-connection pooled data: borrowed from the pool on this
+        connection's first access, pinned on the socket, given back when
+        the connection dies (Controller::session_local_data,
+        server.h session_local_data_factory).
+
+        Give-back is guarded by a per-socket handler refcount
+        (``_session_handler_enter/_exit``): a connection that dies while
+        its handler is still running must NOT pool the object out from
+        under it — release defers to the last handler's exit."""
+        if self._session_pool is None or sock is None:
+            return None
+        from incubator_brpc_tpu.transport.sock import CONNECTED
+
+        ctx = sock.context
+        with self._session_lock:
+            # first-access is serialized: two pipelined requests on one
+            # connection must share ONE object, not leak a second borrow;
+            # the object stays pinned in ctx (even after failure) so every
+            # access on this connection sees the SAME data
+            obj = ctx.get("_session_local_data")
+            if obj is not None:
+                return obj
+            obj = self._session_pool.borrow()
+            ctx["_session_local_data"] = obj
+            if sock.state == CONNECTED:
+                sock.on_failed.append(self._session_give_back)
+            else:
+                # failed before the hook could land (set_failed iterates a
+                # one-time snapshot): the last handler's exit releases it
+                ctx["_session_release_pending"] = True
+        return obj
+
+    def _session_give_back(self, sock) -> None:
+        """on_failed hook: pool the connection's session object — unless a
+        handler on this connection is still running, in which case the
+        release defers to the last handler's exit."""
+        with self._session_lock:
+            if sock.context.get("_session_nhandlers", 0) > 0:
+                sock.context["_session_release_pending"] = True
+                return
+            data = sock.context.pop("_session_local_data", None)
+        if data is not None:
+            self._session_pool.give_back(data)
+
+    def _session_handler_enter(self, sock) -> None:
+        if self._session_pool is None or sock is None:
+            return
+        with self._session_lock:
+            ctx = sock.context
+            ctx["_session_nhandlers"] = ctx.get("_session_nhandlers", 0) + 1
+
+    def _session_handler_exit(self, sock) -> None:
+        if self._session_pool is None or sock is None:
+            return
+        data = None
+        with self._session_lock:
+            ctx = sock.context
+            n = ctx.get("_session_nhandlers", 1) - 1
+            ctx["_session_nhandlers"] = n
+            if n <= 0 and ctx.pop("_session_release_pending", False):
+                data = ctx.pop("_session_local_data", None)
+        if data is not None:
+            self._session_pool.give_back(data)
+
+    def thread_local_data(self):
+        """Per-worker-thread pooled data for THIS server
+        (brpc::thread_local_data(); created on a thread's first call,
+        reused for every later request on that thread, destroyed with the
+        server)."""
+        if self._tls_pool is None:
+            return None
+        slots = getattr(self._tls_slots, "data", None)
+        if slots is None:
+            slots = self._tls_pool.borrow()
+            self._tls_slots.data = slots
+            with self._lock:
+                self._tls_borrowed.append(slots)
+        return slots
 
     @property
     def port(self) -> int:
@@ -622,6 +771,10 @@ class Server:
         cntl.send_response = lambda response=b"": self._finish(
             sock, cntl, response, status
         )
+        self._session_handler_enter(sock)
+        cntl._session_entered = True  # paired in _finish
+        _prev_server = getattr(_usercode_tls, "server", None)
+        _usercode_tls.server = self
         try:
             response = prop.handler(cntl, payload)
         except Exception as e:
@@ -629,6 +782,7 @@ class Server:
             cntl.set_failed(ErrorCode.EINTERNAL, f"handler raised: {e!r}")
             response = b""
         finally:
+            _usercode_tls.server = _prev_server
             # the parent-span window is handler execution on THIS thread;
             # an async completion elsewhere must not leave stale TLS here
             from incubator_brpc_tpu.builtin.rpcz import clear_parent_span
@@ -641,6 +795,9 @@ class Server:
     def _finish(
         self, sock, cntl: Controller, response: bytes, status: Optional[MethodStatus]
     ) -> None:
+        if getattr(cntl, "_session_entered", False):
+            cntl._session_entered = False
+            self._session_handler_exit(sock)
         if cntl.failed() and cntl._accepted_stream_id:
             # handler accepted a stream then failed: the response will carry
             # stream_id=0, so the client kills its half — kill ours too
@@ -764,6 +921,9 @@ class Server:
             done.set()
 
         cntl.send_response = send_response
+        self._session_handler_enter(sock)
+        _prev_server = getattr(_usercode_tls, "server", None)
+        _usercode_tls.server = self
         try:
             response = prop.handler(cntl, body)
         except Exception as e:
@@ -771,6 +931,7 @@ class Server:
             cntl.set_failed(ErrorCode.EINTERNAL, f"handler raised: {e!r}")
             response = b""
         finally:
+            _usercode_tls.server = _prev_server
             clear_parent_span(cntl._span)
         if cntl._async and not cntl.failed():
             from incubator_brpc_tpu.utils.flags import get_flag
@@ -779,6 +940,7 @@ class Server:
                 cntl.set_failed(ErrorCode.ERPCTIMEDOUT, "async handler timed out")
             response = holder["response"]
         cntl._mark_end()
+        self._session_handler_exit(sock)
         self._release(status, cntl)
         if cntl._span is not None:
             end_server_span(cntl, response_size=len(response or b""))
