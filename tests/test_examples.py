@@ -21,6 +21,9 @@ EXAMPLES = [
     "examples/cache_clients.py",
     "examples/link_performance.py",
     "examples/http_upload.py",
+    "examples/session_data_and_thread_local.py",
+    "examples/dynamic_partition_echo.py",
+    "examples/multi_threaded_echo.py",
 ]
 
 
