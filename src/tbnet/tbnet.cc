@@ -262,6 +262,13 @@ struct NetConn : PollObj {
   std::mutex wmu;
   bool want_out = false;
   bool sniffed = false;
+  // one-entry meta memo: a client pumping one method sends byte-identical
+  // meta every frame — remember the resolved native method for those exact
+  // bytes and skip the JSON scan + name join + flatmap probe (the
+  // preferred-protocol-memory idea applied to routing)
+  std::string memo_meta;
+  uint64_t memo_idx = 0;
+  long memo_attachment = -1;  // -1 = no memo
   std::atomic<bool> dead{false};
   std::atomic<int> refs{0};
 };
@@ -467,6 +474,10 @@ void append_error(tb_iobuf* out, uint32_t cid_lo, uint32_t cid_hi,
 // SendRpcResponse round (baidu_rpc_protocol.cpp:307-503,136) for these
 // methods is native.  `out` collects every response of one readable burst;
 // the caller queues it once (one writev per burst, not per request).
+// `body` stays owned by the caller (a per-burst reusable scratch —
+// creating/destroying an iobuf handle per request was measurable on the
+// pump's ns/req floor); echo ref-shares its blocks into `out` before the
+// caller clears it.
 void run_native(NetConn* c, NativeMethod* nm, const tb_tbus_hdr* hdr,
                 const MetaLite& ml, tb_iobuf* body, tb_iobuf* out) {
   nm->nreq.fetch_add(1, std::memory_order_relaxed);
@@ -477,8 +488,7 @@ void run_native(NetConn* c, NativeMethod* nm, const tb_tbus_hdr* hdr,
     nm->nerr.fetch_add(1, std::memory_order_relaxed);
     append_error(out, hdr->cid_lo, hdr->cid_hi, c->srv->errs.elimit,
                  "concurrency limit reached");
-    tb_iobuf_destroy(body);
-    return;
+    return;  // caller owns body
   }
   uint32_t flags = kFlagResponse | (hdr->flags & kFlagBodyCrc);
   char meta[64];
@@ -506,9 +516,8 @@ void run_native(NetConn* c, NativeMethod* nm, const tb_tbus_hdr* hdr,
       nm->nerr.fetch_add(1, std::memory_order_relaxed);
       append_error(out, hdr->cid_lo, hdr->cid_hi, c->srv->errs.erequest,
                    "request too large to stage");
-      tb_iobuf_destroy(body);
       if (nm->max_concurrency) nm->nprocessing.fetch_sub(1);
-      return;
+      return;  // caller owns body
     }
     if (blen) tb_iobuf_copy_to(body, req, blen, 0);
     char* resp = nullptr;
@@ -531,7 +540,8 @@ void run_native(NetConn* c, NativeMethod* nm, const tb_tbus_hdr* hdr,
     append_header(out, nullptr, 0, 0, tb_crc32c(0, nullptr, 0), hdr->cid_lo,
                   hdr->cid_hi, flags, 0);
   }
-  tb_iobuf_destroy(body);
+  // body is the caller's reusable scratch: NOT destroyed here (the echo
+  // kind ref-shared its blocks into `out`; clear just drops this handle)
   if (nm->max_concurrency) nm->nprocessing.fetch_sub(1);
 }
 
@@ -571,11 +581,13 @@ FrameStatus process_frames(NetConn* c) {
   // and flush with ONE conn_queue_iobuf (one writev) at every exit —
   // the per-request syscall was the dominant cost of the old shape.
   tb_iobuf* batch = tb_iobuf_create();
+  tb_iobuf* scratch = tb_iobuf_create();  // per-frame body, cleared and reused
   auto flush = [&](FrameStatus st) {
     // every exit flushes: even a killed connection sends the responses of
     // the frames that parsed cleanly before the bad one
     if (tb_iobuf_size(batch) > 0) conn_queue_iobuf(c, batch);
     tb_iobuf_destroy(batch);
+    tb_iobuf_destroy(scratch);
     return st;
   };
   for (;;) {
@@ -600,10 +612,8 @@ FrameStatus process_frames(NetConn* c) {
         mptr = &mheap[0];
       }
     }
-    tb_iobuf* body = tb_iobuf_create();
-    rc = tb_tbus_cut(c->rbuf, &hdr, mptr, body);
+    rc = tb_tbus_cut(c->rbuf, &hdr, mptr, scratch);
     if (rc != 0) {  // crc mismatch / malformed: the stream can't re-sync
-      tb_iobuf_destroy(body);
       flush(FrameStatus::kKilled);
       conn_destroy(c, true);
       return FrameStatus::kKilled;
@@ -611,20 +621,36 @@ FrameStatus process_frames(NetConn* c) {
     const char* cb_meta = mptr != nullptr ? mptr : mstack;  // never null
     // native fast path: plain request frame whose meta is fully understood
     if ((hdr.flags & (kFlagResponse | kFlagStream)) == 0) {
+      if (c->memo_attachment >= 0 && hdr.meta_len == c->memo_meta.size() &&
+          memcmp(cb_meta, c->memo_meta.data(), hdr.meta_len) == 0 &&
+          c->memo_attachment <= static_cast<long>(tb_iobuf_size(scratch))) {
+        MetaLite ml;
+        ml.attachment = c->memo_attachment;
+        run_native(c, s->native_methods[c->memo_idx], &hdr, ml, scratch,
+                   batch);
+        tb_iobuf_clear(scratch);
+        continue;
+      }
       MetaLite ml = scan_meta(cb_meta, hdr.meta_len);
       if (ml.ok && !ml.to_python &&
-          ml.attachment <= static_cast<long>(tb_iobuf_size(body))) {
+          ml.attachment <= static_cast<long>(tb_iobuf_size(scratch))) {
         char full[256];
-        int fn = snprintf(full, sizeof full, "%s.%s", ml.service.c_str(),
-                          ml.method.c_str());
-        if (fn > 0 && static_cast<size_t>(fn) < sizeof full) {
+        size_t sl = ml.service.size(), mn = ml.method.size();
+        if (sl + 1 + mn < sizeof full) {
+          memcpy(full, ml.service.data(), sl);
+          full[sl] = '.';
+          memcpy(full + sl + 1, ml.method.data(), mn);
+          size_t fn = sl + 1 + mn;
+          full[fn] = '\0';
           uint64_t idx = 0;
           if (s->methods != nullptr &&
-              tb_flatmap_get(s->methods,
-                             method_key(full, static_cast<size_t>(fn)),
-                             &idx) == 1 &&
+              tb_flatmap_get(s->methods, method_key(full, fn), &idx) == 1 &&
               s->native_methods[idx]->full_name == full) {
-            run_native(c, s->native_methods[idx], &hdr, ml, body, batch);
+            c->memo_meta.assign(cb_meta, hdr.meta_len);
+            c->memo_idx = idx;
+            c->memo_attachment = ml.attachment;
+            run_native(c, s->native_methods[idx], &hdr, ml, scratch, batch);
+            tb_iobuf_clear(scratch);
             continue;
           }
         }
@@ -637,9 +663,14 @@ FrameStatus process_frames(NetConn* c) {
       if ((hdr.flags & kFlagResponse) == 0)
         append_error(batch, hdr.cid_lo, hdr.cid_hi, s->errs.enomethod,
                      "no such method");
-      tb_iobuf_destroy(body);
+      tb_iobuf_clear(scratch);
       continue;
     }
+    // the Python callee owns its body: hand it a fresh handle that
+    // ref-shares the scratch's blocks (no byte copy), then reuse scratch
+    tb_iobuf* body = tb_iobuf_create();
+    tb_iobuf_append_iobuf(body, scratch);
+    tb_iobuf_clear(scratch);
     s->frame_cb(s->frame_ctx, c->token, hdr.cid_lo, hdr.cid_hi, hdr.flags,
                 hdr.error_code, cb_meta, hdr.meta_len, body);
   }
@@ -958,6 +989,7 @@ struct tb_channel {
   std::deque<std::pair<uint64_t, Pending*>> doneq;  // any-mode completions
   std::atomic<uint64_t> next_cid{1};
   tb_iobuf* rbuf = nullptr;
+  tb_iobuf* pump_body = nullptr;  // reused per-response cut target (pump)
   std::atomic<int> err{0};  // sticky -errno
 };
 
@@ -1287,6 +1319,25 @@ long tb_channel_pump(tb_channel* ch, const void* meta, size_t meta_len,
   tb_iobuf* frame = tb_iobuf_create();
   int sent = 0, done = 0, outstanding = 0;
   long result = 0;
+  // every frame of the pump is identical except the correlation id: build
+  // the wire bytes ONCE (header + meta + payload, meta crc precomputed)
+  // and per request patch the 8 cid bytes + one append — no per-request
+  // crc, header build, or multi-append
+  std::vector<char> tmpl(32 + meta_len + payload_len);
+  {
+    uint32_t h[8];
+    h[0] = kMagic;
+    h[1] = static_cast<uint32_t>(meta_len + payload_len);
+    h[2] = meta_len ? kFlagHasMeta : 0;
+    h[3] = 0;
+    h[4] = 0;
+    h[5] = static_cast<uint32_t>(meta_len);
+    h[6] = tb_crc32c(0, meta, meta_len);
+    h[7] = 0;
+    memcpy(tmpl.data(), h, sizeof h);
+    if (meta_len) memcpy(tmpl.data() + 32, meta, meta_len);
+    if (payload_len) memcpy(tmpl.data() + 32 + meta_len, payload, payload_len);
+  }
   auto t0 = std::chrono::steady_clock::now();
   while (done < n && result == 0) {
     // fill the window: pack EVERY frame the window allows, then flush the
@@ -1294,9 +1345,10 @@ long tb_channel_pump(tb_channel* ch, const void* meta, size_t meta_len,
     // syscall per window refill, not per request)
     while (outstanding < inflight && sent < n) {
       uint64_t cid = ch->next_cid.fetch_add(1, std::memory_order_relaxed);
-      pack_flat(frame, meta, meta_len, payload, payload_len, nullptr, 0,
-                static_cast<uint32_t>(cid), static_cast<uint32_t>(cid >> 32),
-                0, 0);
+      uint32_t cid32[2] = {static_cast<uint32_t>(cid),
+                           static_cast<uint32_t>(cid >> 32)};
+      memcpy(tmpl.data() + 12, cid32, sizeof cid32);
+      tb_iobuf_append(frame, tmpl.data(), tmpl.size());
       ++sent;
       ++outstanding;
     }
@@ -1359,11 +1411,13 @@ long tb_channel_pump(tb_channel* ch, const void* meta, size_t meta_len,
           result = -EPROTO;
           break;
         }
-        tb_iobuf* body = tb_iobuf_create();
+        // one reusable body handle for the whole pump (clear per frame):
+        // a create/destroy pair per response is pure overhead here
+        if (ch->pump_body == nullptr) ch->pump_body = tb_iobuf_create();
         if (tb_tbus_cut(ch->rbuf, &hdr, hdr.meta_len ? mscratch : nullptr,
-                        body) != 0)
+                        ch->pump_body) != 0)
           result = -EPROTO;
-        tb_iobuf_destroy(body);
+        tb_iobuf_clear(ch->pump_body);
         if (result == 0) {
           if (hdr.error_code != 0) result = -EREMOTEIO;
           ++done;
@@ -1395,5 +1449,6 @@ void tb_channel_destroy(tb_channel* ch) {
   ch->doneq.clear();
   pl.unlock();
   tb_iobuf_destroy(ch->rbuf);
+  if (ch->pump_body != nullptr) tb_iobuf_destroy(ch->pump_body);
   delete ch;
 }
