@@ -108,6 +108,10 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
             ctypes.c_long,
             [b, ctypes.c_int, ctypes.c_size_t],
         ),
+        "tb_iobuf_append_from_fd_bulk": (
+            ctypes.c_long,
+            [b, ctypes.c_int, ctypes.c_size_t, ctypes.c_size_t],
+        ),
         "tb_region_register": (
             ctypes.c_int,
             [ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t],

@@ -272,6 +272,11 @@ class ParsedFrame:
     correlation_id: int
     flags: int
     error_code: int
+    # stream data frames keep their body as a zero-copy IOBuf cut of the
+    # read chain (the reference hands stream handlers butil::IOBufs,
+    # stream.h on_received_messages): None on every other frame kind, and
+    # on the pure-python parse path (which already materialized bytes)
+    payload_iobuf: object = None
 
     @property
     def is_response(self) -> bool:
@@ -401,6 +406,22 @@ def parse_frame_iobuf(buf, max_total: Optional[int] = None) -> Tuple[Optional[Pa
             f"attachment_size {att} exceeds body remainder {body_rest}"
         )
     payload_len = body_rest - att
+    if hdr.flags & FLAG_STREAM and att == 0:
+        # stream data: skip the payload materialization — the body IOBuf
+        # rides the frame to the stream layer, which hands it to raw
+        # handlers zero-copy (or materializes at consumption for the
+        # default bytes contract). Saves one full-payload copy per
+        # message on the stream hot path.
+        frame = ParsedFrame(
+            meta=meta,
+            payload=b"",
+            attachment=b"",
+            correlation_id=hdr.cid_lo | (hdr.cid_hi << 32),
+            flags=hdr.flags,
+            error_code=hdr.error_code,
+            payload_iobuf=body,
+        )
+        return frame, total
     payload = body.to_bytes(payload_len)
     attachment = body.to_bytes(att, pos=payload_len) if att else b""
     frame = ParsedFrame(

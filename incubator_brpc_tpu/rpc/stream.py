@@ -66,10 +66,16 @@ class StreamOptions:
         handler: Optional["StreamHandler"] = None,
         max_buf_size: int = 2 * 1024 * 1024,
         messages_in_batch: int = 128,
+        raw_messages: bool = False,
     ):
         self.handler = handler
         self.max_buf_size = max_buf_size  # 0 = unlimited (no flow control)
         self.messages_in_batch = messages_in_batch
+        # True: on_received_messages gets zero-copy IOBuf objects (the
+        # reference's contract — stream.h hands butil::IOBuf*s); False
+        # (default) keeps this API's bytes convenience, materialized at
+        # consumption on the ordered consumer fiber
+        self.raw_messages = raw_messages
 
 
 class StreamHandler:
@@ -218,7 +224,10 @@ class Stream:
         if ft == FT_FEEDBACK:
             self._set_remote_consumed(int(frame.meta.extra.get("consumed", 0)))
             return
-        self._rq.execute((ft, frame.payload))
+        # the native parse path leaves stream payloads as zero-copy IOBuf
+        # cuts; the consumer materializes only when the handler wants bytes
+        data = frame.payload_iobuf
+        self._rq.execute((ft, frame.payload if data is None else data))
 
     def _consume(self, it: TaskIterator) -> None:
         """Ordered consumer fiber (stream.cpp:86): batch data messages to the
@@ -226,8 +235,20 @@ class Stream:
         handler = self.options.handler
         batch: List[bytes] = []
         closed = False
+        raw = self.options.raw_messages
         for ft, payload in it:
             if ft == FT_DATA:
+                if not raw and not isinstance(payload, (bytes, bytearray)):
+                    payload = payload.to_bytes()  # IOBuf -> bytes contract
+                elif raw and isinstance(payload, (bytes, bytearray)):
+                    # parse paths that materialized bytes (pure-python
+                    # fallback, native-plane dispatch) still honor the raw
+                    # IOBuf contract: wrap, don't surprise the handler
+                    from incubator_brpc_tpu.iobuf import IOBuf
+
+                    wrapped = IOBuf()
+                    wrapped.append(bytes(payload))
+                    payload = wrapped
                 batch.append(payload)
             elif ft in (FT_CLOSE, FT_RST):
                 closed = True

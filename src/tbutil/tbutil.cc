@@ -557,6 +557,56 @@ long tb_iobuf_append_from_fd(tb_iobuf* b, int fd, size_t max_bytes) {
   return nr;
 }
 
+// Bulk variant for long streaming drains: same readv shape, but blocks of
+// ``block_bytes`` (SRC_MALLOC — freed, not pooled) instead of the pooled
+// default. 64 x 8 KB pooled blocks cap a burst at 512 KB and cost a
+// refcount+freelist round trip per 8 KB; a saturated byte stream reads
+// multi-MB bursts into a handful of big blocks instead (the reference's
+// IOPortal grows its read budget the same way when a socket keeps
+// delivering full reads, input_messenger read loop).
+long tb_iobuf_append_from_fd_bulk(tb_iobuf* b, int fd, size_t max_bytes,
+                                  size_t block_bytes) {
+  const size_t def = g_default_block_size.load(std::memory_order_relaxed);
+  if (block_bytes <= def) return tb_iobuf_append_from_fd(b, fd, max_bytes);
+  constexpr int kMaxIov = 32;
+  Block* blocks[kMaxIov];
+  struct iovec iov[kMaxIov];
+  int niov = 0;
+  size_t total = 0;
+  while (niov < kMaxIov && total < max_bytes) {
+    size_t want = max_bytes - total;
+    size_t cap = want < block_bytes ? want : block_bytes;
+    Block* blk = alloc_block_raw(cap);
+    if (blk == nullptr) break;
+    blocks[niov] = blk;
+    iov[niov].iov_base = blk->data;
+    iov[niov].iov_len = cap;
+    total += cap;
+    ++niov;
+  }
+  if (niov == 0) return -ENOMEM;
+  ssize_t nr = ::readv(fd, iov, niov);
+  if (nr < 0) {
+    int err = errno;
+    for (int i = 0; i < niov; ++i) dec_ref(blocks[i]);
+    return -err;
+  }
+  size_t left = static_cast<size_t>(nr);
+  for (int i = 0; i < niov; ++i) {
+    if (left == 0) {
+      dec_ref(blocks[i]);
+      continue;
+    }
+    uint32_t used = static_cast<uint32_t>(
+        left < iov[i].iov_len ? left : iov[i].iov_len);
+    blocks[i]->size.store(used, std::memory_order_release);
+    b->refs.push_back(BlockRef{blocks[i], 0, used});
+    b->nbytes += used;
+    left -= used;
+  }
+  return nr;
+}
+
 // ---- regions ----
 
 int tb_region_register(void* base, size_t total, size_t block_bytes) {

@@ -86,6 +86,9 @@ long tb_iobuf_cut_into_fd(tb_iobuf* b, int fd, size_t max_bytes);
 // readv up to max_bytes into fresh pool blocks appended to b. Returns bytes
 // read (0 on EOF), or -errno.
 long tb_iobuf_append_from_fd(tb_iobuf* b, int fd, size_t max_bytes);
+// bulk streaming drains: big SRC_MALLOC blocks instead of the pooled default
+long tb_iobuf_append_from_fd_bulk(tb_iobuf* b, int fd, size_t max_bytes,
+                                  size_t block_bytes);
 
 // ---- region allocator (registered-slab blocks; rdma/block_pool analog) ----
 // Carve `total` into fixed `block_bytes` blocks over caller memory `base`

@@ -493,3 +493,64 @@ def test_drain_inline_timeout_falls_back_to_keepwrite(echo_server):
         assert c.wait(7003, timeout=30.0).payload == big
     finally:
         c.sock.recycle()
+
+
+class TestBulkReadEscalation:
+    """Saturated-stream drains escalate to big malloc'd blocks
+    (append_from_fd_bulk) after consecutive full bursts; the re-cut byte
+    stream must stay intact across the pooled->bulk->pooled transitions."""
+
+    def test_large_echo_roundtrip_through_bulk_path(self):
+        from incubator_brpc_tpu.rpc import Channel, Controller, Server
+
+        srv = Server()
+        srv.add_service("bulk", {"echo": lambda cntl, req: req})
+        assert srv.start(0)
+        try:
+            ch = Channel()
+            assert ch.init(f"127.0.0.1:{srv.port}")
+            # 16 MiB >> the 512 KiB pooled burst: the server's drain sees
+            # many consecutive full reads and escalates; the response
+            # drives the client's drain the same way
+            blob = bytes(range(256)) * (16 * 4096)
+            for _ in range(2):
+                cntl = ch.call_method(
+                    "bulk", "echo", blob, cntl=Controller(timeout_ms=60000)
+                )
+                assert cntl.ok(), cntl.error_text
+                assert cntl.response_payload == blob
+            # and small frames still flow after de-escalation
+            c = ch.call_method("bulk", "echo", b"tiny")
+            assert c.ok() and c.response_payload == b"tiny"
+        finally:
+            srv.stop()
+            srv.join(timeout=10)
+
+    def test_bulk_append_iobuf_api(self):
+        import os
+        import socket as pysock
+
+        from incubator_brpc_tpu.iobuf import IOBuf
+
+        import threading
+
+        a, b = pysock.socketpair()
+        try:
+            payload = os.urandom(1 << 20)
+            # writer thread: sendall past the socketpair buffer would
+            # deadlock against an unread peer
+            w = threading.Thread(target=a.sendall, args=(payload,))
+            w.start()
+            buf = IOBuf()
+            got = 0
+            while got < len(payload):
+                rc = buf.append_from_fd_bulk(
+                    b.fileno(), 4 << 20, 256 << 10
+                )
+                assert rc > 0, rc
+                got += rc
+            w.join(timeout=10)
+            assert buf.to_bytes() == payload
+        finally:
+            a.close()
+            b.close()
