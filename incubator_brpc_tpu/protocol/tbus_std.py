@@ -360,6 +360,14 @@ def try_parse_frame(buf: bytes) -> Tuple[Optional[ParsedFrame], int]:
     return frame, total
 
 
+def _stream_layer_live() -> bool:
+    """True once rpc.stream bound its FLAG_STREAM consumer to the tbus_std
+    protocol entry (it owns frames carrying payload_iobuf)."""
+    from incubator_brpc_tpu import protocol as _pkg
+
+    return getattr(_pkg.TBUS_STD, "process_stream", None) is not None
+
+
 def parse_frame_iobuf(buf, max_total: Optional[int] = None) -> Tuple[Optional[ParsedFrame], int]:
     """Native cut: header peek + CRC walk + zero-copy body cut all happen in
     src/tbutil over the socket's read chain — Python never copies the frame
@@ -406,12 +414,15 @@ def parse_frame_iobuf(buf, max_total: Optional[int] = None) -> Tuple[Optional[Pa
             f"attachment_size {att} exceeds body remainder {body_rest}"
         )
     payload_len = body_rest - att
-    if hdr.flags & FLAG_STREAM and att == 0:
+    if hdr.flags & FLAG_STREAM and att == 0 and _stream_layer_live():
         # stream data: skip the payload materialization — the body IOBuf
         # rides the frame to the stream layer, which hands it to raw
         # handlers zero-copy (or materializes at consumption for the
         # default bytes contract). Saves one full-payload copy per
-        # message on the stream hot path.
+        # message on the stream hot path. Gated on the stream layer being
+        # REGISTERED: any other consumer of a FLAG_STREAM frame (a raw
+        # user_message_handler, a deployment that never imported
+        # rpc.stream) reads frame.payload and must keep getting bytes.
         frame = ParsedFrame(
             meta=meta,
             payload=b"",
