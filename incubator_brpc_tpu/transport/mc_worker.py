@@ -1,14 +1,22 @@
-"""Two-process harness for the multi-controller device plane.
+"""Multi-process harness for the multi-controller device plane.
 
 Runnable as ``python -m incubator_brpc_tpu.transport.mc_worker <role> ...``.
-One process is the RPC server (and the jax.distributed coordinator), the
-other the client; each owns ONE local CPU device and the two form a
-2-device global mesh over which the link's exchange step runs lockstep
-SPMD (transport/mc_link.py). This is the deployment shape of the
-reference's RDMA transport — two real processes, handshake over TCP, data
-over the device fabric (/root/reference/src/brpc/rdma/rdma_endpoint.h:
-42-213, per-host init rdma_helper.cpp) — used by tests/test_mc_link.py
-and the driver's ``dryrun_multichip`` multi-process gate.
+Process 0 is an RPC server (and the jax.distributed coordinator); the
+last process is the client; each owns ONE local CPU device and the N of
+them form an N-device global mesh. Two shapes:
+
+- the two-process PAIR (1 server + 1 client): one link, lockstep SPMD
+  exchange (transport/mc_link.py) — the reference RDMA transport's
+  deployment (/root/reference/src/brpc/rdma/rdma_endpoint.h:42-213,
+  per-host init rdma_helper.cpp);
+- the three-process FABRIC (2 servers + 1 fabric-client): a
+  PartitionChannel fans one call out over TWO cross-process links — the
+  client device holds a star of links, each a 2-device sub-mesh of the
+  global group running its own lockstep schedule. The N-party star of
+  the single-controller DeviceLinkMap, spanning real processes.
+
+Used by tests/test_mc_link.py and the driver's ``dryrun_multichip``
+multi-process gate.
 """
 
 from __future__ import annotations
@@ -36,7 +44,7 @@ def _force_local_device_count(n: int) -> None:
     os.environ["XLA_FLAGS"] = flags
 
 
-def _init_distributed(coord_port: int, process_id: int) -> None:
+def _init_distributed(coord_port: int, process_id: int, nprocs: int = 2) -> None:
     import jax
 
     # this machine's sitecustomize registers the axon TPU plugin; beat it
@@ -44,17 +52,17 @@ def _init_distributed(coord_port: int, process_id: int) -> None:
     jax.config.update("jax_platforms", "cpu")
     jax.distributed.initialize(
         coordinator_address=f"127.0.0.1:{coord_port}",
-        num_processes=2,
+        num_processes=nprocs,
         process_id=process_id,
     )
-    assert len(jax.devices()) == 2, (
-        f"expected a 2-device global mesh, got {jax.devices()}"
+    assert len(jax.devices()) == nprocs, (
+        f"expected a {nprocs}-device global mesh, got {jax.devices()}"
     )
     assert len(jax.local_devices()) == 1
 
 
 def run_server(args) -> int:
-    _init_distributed(args.coord_port, process_id=0)
+    _init_distributed(args.coord_port, args.proc_id, args.nprocs)
     import threading
 
     from incubator_brpc_tpu.rpc import Server, ServerOptions
@@ -74,6 +82,10 @@ def run_server(args) -> int:
     server.add_service(
         "EchoService", {"Echo": lambda cntl, req: b"echo:" + req}
     )
+    pid = args.proc_id
+    server.add_service(
+        "part", {"get": lambda cntl, req: b"p%d:" % pid + req}
+    )
     server.add_service("Admin", {"Quit": _quit})
     assert server.start(args.rpc_port)
     print(f"SERVER_READY port={server.port}", flush=True)
@@ -89,7 +101,7 @@ def run_server(args) -> int:
 
 
 def run_client(args) -> int:
-    _init_distributed(args.coord_port, process_id=1)
+    _init_distributed(args.coord_port, args.proc_id, args.nprocs)
     from incubator_brpc_tpu.rpc import Channel, ChannelOptions, Controller
 
     ch = Channel()
@@ -164,74 +176,215 @@ def run_client(args) -> int:
     return 0
 
 
-def orchestrate_pair(extra=(), timeout: float = 240.0):
-    """Spawn the server+client pair as real OS processes and collect the
-    client's link stats. The single parent-side runner for both
-    tests/test_mc_link.py and the driver's dryrun gate. Returns
-    ``(stats, client_out, server_out)``; raises AssertionError with both
-    transcripts on any failure."""
+def run_fabric_client(args) -> int:
+    """Three-process fabric: this client holds TWO multi-controller links
+    (one per server process) and a PartitionChannel splits each call
+    across them — the N-party star over real processes."""
+    _init_distributed(args.coord_port, args.proc_id, args.nprocs)
+    from incubator_brpc_tpu.rpc import (
+        Channel,
+        ChannelOptions,
+        Controller,
+        PartitionChannel,
+    )
+
+    ports = [int(p) for p in args.rpc_ports.split(",")]
+    n = len(ports)
+    url = "list://" + ",".join(
+        f"127.0.0.1:{p} {i}/{n}" for i, p in enumerate(ports)
+    )
+    pc = PartitionChannel()
+    assert pc.init(
+        url,
+        partition_count=n,
+        options=ChannelOptions(
+            transport="tpu",
+            link_controller="multi",
+            timeout_ms=60000,
+            link_slot_words=args.slot_words,
+            link_window=args.window,
+        ),
+    )
+    expected = b"".join(f"p{i}:X".encode() for i in range(n))
+    deadline = time.monotonic() + 90.0
+    while True:
+        cntl = pc.call_method(
+            "part", "get", b"X", cntl=Controller(timeout_ms=60000)
+        )
+        if cntl.ok() and cntl.response_payload == expected:
+            break
+        if time.monotonic() > deadline:
+            print(f"CLIENT_FAIL fabric: {cntl.error_text}", flush=True)
+            return 1
+        time.sleep(0.3)
+    for i in range(args.n_rpcs):
+        body = b"%04d" % i
+        cntl = pc.call_method(
+            "part", "get", body, cntl=Controller(timeout_ms=60000)
+        )
+        assert cntl.ok(), f"fabric rpc {i}: {cntl.error_text}"
+        want = b"".join(b"p%d:" % j + body for j in range(n))
+        assert cntl.response_payload == want, f"fabric rpc {i} merged wrong"
+    links = [sub[0]._device_sock.link for sub in pc._subs]
+    stats = {
+        "n_rpcs": args.n_rpcs,
+        "links": [
+            {
+                "devices": [str(d) for d in lk.devices],
+                "steps": int(lk._seq),
+                "peer_ack": int(lk.peer_ack),
+            }
+            for lk in links
+        ],
+    }
+    # one client device, two distinct peer devices: the star
+    assert len({l["devices"][0] for l in stats["links"]}) == 1
+    assert len({l["devices"][1] for l in stats["links"]}) == len(ports)
+    assert all(l["peer_ack"] > 0 for l in stats["links"])
+    pc.stop()
+    for sub in pc._subs:
+        sub[0]._device_sock.recycle()
+
+    def _settled(lk):
+        with lk._lock:
+            return lk._closed and lk._inflight == 0
+
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:
+        if all(_settled(lk) for lk in links):
+            break
+        time.sleep(0.05)
+    assert all(_settled(lk) for lk in links), "a link's close dance hung"
+    print("CLIENT_OK " + json.dumps(stats), flush=True)
+    # release every server so all N processes reach the exit barrier
+    for p in ports:
+        host = Channel()
+        assert host.init(f"127.0.0.1:{p}")
+        host.call_method("Admin", "Quit", b"", cntl=Controller(timeout_ms=10000))
+    return 0
+
+
+def _free_ports(n: int):
     import socket
+
+    holders, ports = [], []
+    for _ in range(n):
+        sk = socket.socket()
+        sk.bind(("127.0.0.1", 0))
+        ports.append(sk.getsockname()[1])
+        holders.append(sk)
+    for sk in holders:
+        sk.close()
+    return ports
+
+
+def _orchestrate(specs, label: str, timeout: float):
+    """Shared parent-side runner: spawn every (name, role, proc_id, args)
+    worker, collect outputs (client LAST in ``specs`` is the one whose
+    CLIENT_OK carries the stats), assert success, return (stats,
+    transcript). The exit is worker-coordinated (Admin.Quit + the
+    coordination service's barrier); communicate() closing stdin is the
+    fallback when the client crashed early."""
     import subprocess
 
-    ports = []
-    holders = []
-    for _ in range(2):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        ports.append(s.getsockname()[1])
-        holders.append(s)
-    for s in holders:
-        s.close()
-    coord, rpc = ports
     repo = os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )
     env = dict(os.environ)
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-
-    def spawn(role, role_extra=()):
-        return subprocess.Popen(
-            [
-                sys.executable, "-m",
-                "incubator_brpc_tpu.transport.mc_worker", role,
-                "--coord-port", str(coord), "--rpc-port", str(rpc),
-                *role_extra,
-            ],
-            cwd=repo, env=env, stdin=subprocess.PIPE,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    procs = []
+    for name, role, argv in specs:
+        procs.append(
+            (
+                name,
+                subprocess.Popen(
+                    [
+                        sys.executable, "-m",
+                        "incubator_brpc_tpu.transport.mc_worker", role,
+                        *argv,
+                    ],
+                    cwd=repo, env=env, stdin=subprocess.PIPE,
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    text=True,
+                ),
+            )
         )
-
-    server = spawn("server")
-    client = spawn("client", extra)
+    client_name, client = procs[-1]
+    outs = {}
     try:
-        # the pair self-orchestrates its exit: the client's Admin.Quit
-        # releases the server so both reach the coordination service's
-        # exit barrier together; communicate() closing the server's
-        # stdin is the fallback path when the client crashed early
-        client_out, _ = client.communicate(timeout=timeout)
-        server_out, _ = server.communicate(timeout=30.0)
+        outs[client_name], _ = client.communicate(timeout=timeout)
+        for name, proc in procs[:-1]:
+            outs[name], _ = proc.communicate(timeout=30.0)
     except subprocess.TimeoutExpired:
-        client.kill()
-        server.kill()
-        client_out = (client.communicate()[0] or "") + " [KILLED]"
-        server_out = (server.communicate()[0] or "") + " [KILLED]"
+        for name, proc in procs:
+            proc.kill()
+        for name, proc in procs:
+            if name not in outs:
+                outs[name] = (proc.communicate()[0] or "") + " [KILLED]"
         raise AssertionError(
-            f"two-process pair timed out\n-- client --\n{client_out}\n"
-            f"-- server --\n{server_out}"
+            f"{label} timed out\n"
+            + "".join(f"-- {n} --\n{o}\n" for n, o in outs.items())
         )
-    transcript = (
-        f"-- client --\n{client_out}\n-- server --\n{server_out}"
+    transcript = "".join(f"-- {n} --\n{o}\n" for n, o in outs.items())
+    assert client.returncode == 0 and "CLIENT_OK" in outs[client_name], (
+        f"{label} client failed rc={client.returncode}\n{transcript}"
     )
-    assert client.returncode == 0 and "CLIENT_OK" in client_out, (
-        f"client failed rc={client.returncode}\n{transcript}"
-    )
-    assert server.returncode == 0 and "SERVER_DONE" in server_out, (
-        f"server failed rc={server.returncode}\n{transcript}"
-    )
+    for name, proc in procs[:-1]:
+        assert proc.returncode == 0 and "SERVER_DONE" in outs[name], (
+            f"{label} {name} failed rc={proc.returncode}\n{transcript}"
+        )
     stats = json.loads(
-        client_out.split("CLIENT_OK", 1)[1].strip().splitlines()[0]
+        outs[client_name].split("CLIENT_OK", 1)[1].strip().splitlines()[0]
     )
-    return stats, client_out, server_out
+    return stats, transcript
+
+
+def orchestrate_pair(extra=(), timeout: float = 240.0):
+    """Spawn the server+client pair as real OS processes and collect the
+    client's link stats (used by tests/test_mc_link.py and the driver's
+    dryrun gate). Returns ``(stats, client_out, server_out)``."""
+    coord, rpc = _free_ports(2)
+    base = ("--coord-port", str(coord), "--rpc-port", str(rpc))
+    stats, transcript = _orchestrate(
+        [
+            ("server", "server", base),
+            ("client", "client", (*base, *extra)),
+        ],
+        label="two-process pair",
+        timeout=timeout,
+    )
+    return stats, transcript, transcript
+
+
+def orchestrate_fabric(n_servers: int = 2, extra=(), timeout: float = 300.0):
+    """Spawn ``n_servers`` server processes + one fabric client (all in one
+    jax.distributed group) and return the client's per-link stats."""
+    ports = _free_ports(n_servers + 1)
+    coord, rpc_ports = ports[0], ports[1:]
+    nprocs = n_servers + 1
+    specs = [
+        (
+            f"server{i}",
+            "server",
+            (
+                "--coord-port", str(coord), "--nprocs", str(nprocs),
+                "--proc-id", str(i), "--rpc-port", str(rpc_ports[i]),
+            ),
+        )
+        for i in range(n_servers)
+    ]
+    specs.append(
+        (
+            "fabric-client",
+            "fabric-client",
+            (
+                "--coord-port", str(coord), "--nprocs", str(nprocs),
+                "--proc-id", str(n_servers),
+                "--rpc-ports", ",".join(map(str, rpc_ports)), *extra,
+            ),
+        )
+    )
+    return _orchestrate(specs, label="fabric", timeout=timeout)
 
 
 def main(argv=None) -> int:
@@ -242,16 +395,26 @@ def main(argv=None) -> int:
 
     faulthandler.register(signal.SIGUSR1)
     ap = argparse.ArgumentParser()
-    ap.add_argument("role", choices=["server", "client"])
+    ap.add_argument("role", choices=["server", "client", "fabric-client"])
     ap.add_argument("--coord-port", type=int, required=True)
-    ap.add_argument("--rpc-port", type=int, required=True)
+    ap.add_argument("--rpc-port", type=int, default=0)
+    ap.add_argument("--rpc-ports", type=str, default="")  # fabric client
+    ap.add_argument("--nprocs", type=int, default=2)
+    ap.add_argument("--proc-id", type=int, default=-1)  # -1: by role
     ap.add_argument("--n-rpcs", type=int, default=8)
     ap.add_argument("--payload", type=int, default=3000)
     ap.add_argument("--slot-words", type=int, default=256)
     ap.add_argument("--window", type=int, default=4)
     args = ap.parse_args(argv)
+    if args.proc_id < 0:
+        # pair convention: server is the coordinator, client is last
+        args.proc_id = 0 if args.role == "server" else args.nprocs - 1
     _force_local_device_count(1)
-    return run_server(args) if args.role == "server" else run_client(args)
+    if args.role == "server":
+        return run_server(args)
+    if args.role == "fabric-client":
+        return run_fabric_client(args)
+    return run_client(args)
 
 
 if __name__ == "__main__":

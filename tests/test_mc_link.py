@@ -41,3 +41,18 @@ def test_two_process_windowed_burst():
     # 20000-byte echoes through 512-byte slots: many steps per RPC
     assert stats["steps"] > 40 * 4
     assert stats["peer_ack"] > 0
+
+
+def test_three_process_fabric():
+    """Client + TWO server processes in one jax.distributed group: a
+    PartitionChannel fans each call over two cross-process device links —
+    the client device holds a star of lockstep sub-meshes (the N-party
+    fabric spanning real hosts)."""
+    from incubator_brpc_tpu.transport.mc_worker import orchestrate_fabric
+
+    stats, _ = orchestrate_fabric(n_servers=2, extra=("--n-rpcs", "4"))
+    assert len(stats["links"]) == 2
+    # one client device shared, two distinct server devices
+    assert len({l["devices"][0] for l in stats["links"]}) == 1
+    assert len({l["devices"][1] for l in stats["links"]}) == 2
+    assert all(l["peer_ack"] > 0 for l in stats["links"])
