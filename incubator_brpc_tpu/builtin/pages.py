@@ -250,6 +250,21 @@ def _sockets(server, frame) -> Resp:
             extra.append("inline")
         if getattr(s, "is_client", False):
             extra.append("client")
+        link = getattr(s, "link", None)
+        if link is not None:
+            # device-link state: steps dispatched / window / in-flight,
+            # plus the lockstep schedule for multi-controller links
+            with link._lock:
+                extra.append(
+                    f"link[steps={link._seq} inflight={link._inflight} "
+                    f"window={link.window} ack={link.ack_mode}"
+                    + (
+                        f" target={link._target} peer_ack={link._peer_ack}"
+                        if hasattr(link, "own_side")
+                        else ""
+                    )
+                    + "]"
+                )
         lines.append(
             f"  {s.id:#018x} {kind} remote={s.remote} "
             f"state={st_name.get(s.state, s.state)} rbuf={rbuf} "

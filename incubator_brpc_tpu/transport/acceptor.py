@@ -74,13 +74,21 @@ class Acceptor:
                     except OSError:
                         pass
             lsock = _pysocket.socket(_pysocket.AF_UNIX, _pysocket.SOCK_STREAM)
-            lsock.bind(path)
+            try:
+                lsock.bind(path)
+            except OSError:
+                lsock.close()  # a failed bind must not leak the listen fd
+                raise
             self._unix_path = path
             resolved = endpoint
         else:
             lsock = _pysocket.socket(_pysocket.AF_INET, _pysocket.SOCK_STREAM)
             lsock.setsockopt(_pysocket.SOL_SOCKET, _pysocket.SO_REUSEADDR, 1)
-            lsock.bind((endpoint.ip, endpoint.port))
+            try:
+                lsock.bind((endpoint.ip, endpoint.port))
+            except OSError:
+                lsock.close()  # a failed bind must not leak the listen fd
+                raise
             resolved = None  # filled after listen (ephemeral port)
         lsock.listen(backlog)
         lsock.setblocking(False)
