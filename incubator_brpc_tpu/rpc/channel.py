@@ -66,6 +66,58 @@ def _recycle_when_drained(sock) -> None:
     when_drained(sock, lambda s: s.recycle())
 
 
+def _track_inflight(sock, cid: int) -> None:
+    """Record a written-but-unanswered correlation id on its connection so
+    connection death fails the call NOW, not at its deadline (the
+    reference fails every id parked on a Socket at SetFailed — the
+    per-socket id wait list). Stale entries (timed-out calls whose
+    response never came) are dropped when the id no longer locks.
+
+    Error delivery is CLAIM-based: whoever atomically removes the cid
+    from the set (response path, EndRPC, a write's on_error, or the
+    socket-failure sweep) owns it — a request sitting in the write queue
+    at set_failed would otherwise be errored twice (the queue's on_error
+    AND the sweep), costing a phantom retry or a duplicate on the wire."""
+    ctx = sock.context
+    cids = ctx.get("_inflight_cids")
+    if cids is None:
+        cids = ctx.setdefault("_inflight_cids", set())
+
+        def _fail_inflight(sk):
+            from incubator_brpc_tpu.runtime.worker_pool import (
+                global_worker_pool as _pool,
+            )
+
+            pending = sk.context.get("_inflight_cids")
+            while pending:
+                try:
+                    c = pending.pop()  # atomic claim under the GIL
+                except KeyError:
+                    break
+                _pool().spawn(
+                    call_id_space.error,
+                    c,
+                    ErrorCode.EFAILEDSOCKET,
+                    f"connection to {sk.remote} failed with the call in flight",
+                )
+
+        sock.on_failed.append(_fail_inflight)
+    cids.add(cid)
+
+
+def _claim_inflight(sock, cid: int) -> bool:
+    """True iff this caller atomically removed the cid (and may deliver
+    its error); False = another path already owns it."""
+    cids = sock.context.get("_inflight_cids")
+    if cids is None:
+        return True  # never tracked (pre-track failure): caller owns it
+    try:
+        cids.remove(cid)
+        return True
+    except KeyError:
+        return False
+
+
 def process_response(sock, frame: ParsedFrame) -> None:
     """tbus_std Protocol.process_response hook: route a response frame to
     its in-flight RPC via the correlation id (baidu_rpc_protocol.cpp:543).
@@ -77,6 +129,9 @@ def process_response(sock, frame: ParsedFrame) -> None:
     from incubator_brpc_tpu.transport.event_dispatcher import on_reactor_thread
 
     cid = frame.correlation_id
+    cids = sock.context.get("_inflight_cids")
+    if cids is not None:
+        cids.discard(cid)
     on_reactor = on_reactor_thread()
     rc, cntl = call_id_space.lock(cid, nowait=on_reactor)
     if rc == EBUSY:
@@ -806,10 +861,13 @@ class Channel:
         remaining = None
         if cntl._deadline:
             remaining = max(0.001, cntl._deadline - _time.monotonic())
+        _track_inflight(sock, cid)
         rc = sock.write(
             data,
-            on_error=lambda code, text: pool.spawn(
-                call_id_space.error, cid, code, text
+            on_error=lambda code, text: (
+                pool.spawn(call_id_space.error, cid, code, text)
+                if _claim_inflight(sock, cid)
+                else None
             ),
             timeout=remaining,
         )
@@ -830,9 +888,27 @@ class Channel:
         pending = sock.context.get("http_pending")
         if pending is None:
             pending = sock.context.setdefault("http_pending", collections.deque())
-            sock.on_failed.append(
-                lambda s: s.context.get("http_pending", collections.deque()).clear()
-            )
+
+            def _fail_fifo(s):
+                # fail every call still waiting for an ordered response —
+                # same fail-fast-at-SetFailed invariant as _track_inflight
+                # (clearing alone left them hanging until their deadline)
+                lk = s.context.get("_fifo_lock")
+                q = s.context.get("http_pending")
+                drained = []
+                if lk is not None and q is not None:
+                    with lk:
+                        drained = list(q)
+                        q.clear()
+                for c in drained:
+                    global_worker_pool().spawn(
+                        call_id_space.error,
+                        c,
+                        ErrorCode.EFAILEDSOCKET,
+                        f"connection to {s.remote} failed with the call in flight",
+                    )
+
+            sock.on_failed.append(_fail_fifo)
         pool = global_worker_pool()
         with lock:
             # append BEFORE the write: the inline drain can flush the
@@ -991,6 +1067,10 @@ class Channel:
         for tid in cntl._timer_ids:
             timer.unschedule(tid)
         cntl._timer_ids.clear()
+        for sock in cntl._sent_sockets:
+            cids = sock.context.get("_inflight_cids")
+            if cids is not None:
+                cids.discard(cntl.call_id)
         if cntl._span is not None:
             from incubator_brpc_tpu.builtin.rpcz import end_client_span
 

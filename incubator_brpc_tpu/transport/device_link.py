@@ -619,14 +619,12 @@ class DeviceSocket:
         if self.state != CONNECTED:
             return ErrorCode.EFAILEDSOCKET
         # bytes and IOBufs both queue zero-copy (the link keeps the IOBuf
-        # alive and gathers straight from its block views into the slot)
-        rc = self.link.send(self.side, data, timeout=timeout)
-        if rc != 0 and on_error is not None:
-            try:
-                on_error(rc, "device link send failed")
-            except Exception:
-                logger.exception("device write on_error raised")
-        return rc
+        # alive and gathers straight from its block views into the slot).
+        # A synchronous failure is reported ONCE, via the return code —
+        # the TCP Socket.write contract; also firing on_error would
+        # arbitrate the same failure twice (a queued id error delivered
+        # at unlock), burning a retry attempt.
+        return self.link.send(self.side, data, timeout=timeout)
 
     # -- read path (driven by link completions) ------------------------------
 

@@ -79,9 +79,19 @@ def run_server(args) -> int:
         return b"bye"
 
     server = Server(ServerOptions(device_index=0))
-    server.add_service(
-        "EchoService", {"Echo": lambda cntl, req: b"echo:" + req}
-    )
+    served = [0]
+
+    def _echo(cntl, req: bytes) -> bytes:
+        served[0] += 1
+        if args.die_after_rpcs and served[0] > args.die_after_rpcs:
+            # fault injection: the host vanishes mid-request — no
+            # response, no close dance, no clean exit (os._exit skips
+            # atexit, so not even the coordination service says goodbye)
+            print("SERVER_DYING", flush=True)
+            os._exit(42)
+        return b"echo:" + req
+
+    server.add_service("EchoService", {"Echo": _echo})
     pid = args.proc_id
     server.add_service(
         "part", {"get": lambda cntl, req: b"p%d:" % pid + req}
@@ -132,6 +142,8 @@ def run_client(args) -> int:
         time.sleep(0.2)
     assert first.response_payload == b"echo:hello"
 
+    if args.expect_peer_death:
+        return _run_client_peer_death(args, ch)
     for i in range(args.n_rpcs):
         body = bytes((i + j) % 256 for j in range(args.payload))
         req = f"m{i}:".encode() + body
@@ -278,7 +290,7 @@ def _free_ports(n: int):
     return ports
 
 
-def _orchestrate(specs, label: str, timeout: float):
+def _orchestrate(specs, label: str, timeout: float, servers_may_die=False):
     """Shared parent-side runner: spawn every (name, role, proc_id, args)
     worker, collect outputs (client LAST in ``specs`` is the one whose
     CLIENT_OK carries the stats), assert success, return (stats,
@@ -329,14 +341,66 @@ def _orchestrate(specs, label: str, timeout: float):
     assert client.returncode == 0 and "CLIENT_OK" in outs[client_name], (
         f"{label} client failed rc={client.returncode}\n{transcript}"
     )
-    for name, proc in procs[:-1]:
-        assert proc.returncode == 0 and "SERVER_DONE" in outs[name], (
-            f"{label} {name} failed rc={proc.returncode}\n{transcript}"
-        )
+    if not servers_may_die:
+        for name, proc in procs[:-1]:
+            assert proc.returncode == 0 and "SERVER_DONE" in outs[name], (
+                f"{label} {name} failed rc={proc.returncode}\n{transcript}"
+            )
     stats = json.loads(
         outs[client_name].split("CLIENT_OK", 1)[1].strip().splitlines()[0]
     )
     return stats, transcript
+
+
+def _run_client_peer_death(args, ch) -> int:
+    """Fault-injection client half: the peer dies mid-traffic. The link
+    must FAIL (fast, via the host socket under the control stream — not a
+    2-minute wedge), failing the in-flight RPC, and the dead link must
+    not poison the process."""
+    from incubator_brpc_tpu.rpc import Controller
+
+    ok_count = 0
+    failed_at = None
+    for i in range(args.n_rpcs):
+        cntl = ch.call_method(
+            "EchoService", "Echo", b"f%03d" % i,
+            cntl=Controller(timeout_ms=30000, max_retry=0),
+        )
+        if cntl.ok():
+            ok_count += 1
+        else:
+            failed_at = (i, cntl.error_code, cntl.error_text)
+            break
+    assert failed_at is not None, "peer died but no RPC ever failed"
+    link = ch._device_sock.link
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        with link._lock:
+            if link._closed:
+                break
+        time.sleep(0.05)
+    with link._lock:
+        closed = link._closed
+    assert closed, "link did not fail after peer death"
+    from incubator_brpc_tpu.transport.sock import CONNECTED
+
+    assert ch._device_sock.state != CONNECTED
+    print(
+        "CLIENT_OK "
+        + json.dumps(
+            {
+                "ok_before_death": ok_count,
+                "failed_at": failed_at[0],
+                "error_code": failed_at[1],
+            }
+        ),
+        flush=True,
+    )
+    # the peer is dead: the coordination service's exit barrier can never
+    # complete, so skip atexit — the CLEAN exit path is covered by the
+    # non-fault tests
+    sys.stdout.flush()
+    os._exit(0)
 
 
 def orchestrate_pair(extra=(), timeout: float = 240.0):
@@ -354,6 +418,38 @@ def orchestrate_pair(extra=(), timeout: float = 240.0):
         timeout=timeout,
     )
     return stats, transcript, transcript
+
+
+def orchestrate_peer_death(die_after: int = 3, timeout: float = 240.0):
+    """Fault-injection pair: the SERVER process dies mid-traffic (os._exit
+    inside a handler). The client must observe a fast, clean link failure.
+    The client doubles as the jax.distributed coordinator here so the
+    coordination service survives the death it is reporting on."""
+    coord, rpc = _free_ports(2)
+    specs = [
+        (
+            "server",
+            "server",
+            (
+                "--coord-port", str(coord), "--rpc-port", str(rpc),
+                "--proc-id", "1",
+                "--die-after-rpcs", str(die_after),
+            ),
+        ),
+        (
+            "client",
+            "client",
+            (
+                "--coord-port", str(coord), "--rpc-port", str(rpc),
+                "--proc-id", "0",
+                "--n-rpcs", str(die_after + 20),
+                "--expect-peer-death",
+            ),
+        ),
+    ]
+    return _orchestrate(
+        specs, label="peer-death pair", timeout=timeout, servers_may_die=True
+    )
 
 
 def orchestrate_fabric(n_servers: int = 2, extra=(), timeout: float = 300.0):
@@ -405,6 +501,8 @@ def main(argv=None) -> int:
     ap.add_argument("--payload", type=int, default=3000)
     ap.add_argument("--slot-words", type=int, default=256)
     ap.add_argument("--window", type=int, default=4)
+    ap.add_argument("--die-after-rpcs", type=int, default=0)  # server fault
+    ap.add_argument("--expect-peer-death", action="store_true")  # client
     args = ap.parse_args(argv)
     if args.proc_id < 0:
         # pair convention: server is the coordinator, client is last

@@ -56,3 +56,22 @@ def test_three_process_fabric():
     assert len({l["devices"][0] for l in stats["links"]}) == 1
     assert len({l["devices"][1] for l in stats["links"]}) == 2
     assert all(l["peer_ack"] > 0 for l in stats["links"])
+
+
+def test_peer_death_fails_link_fast():
+    """A server process that vanishes mid-traffic (os._exit in a handler,
+    no goodbye on any plane) must fail the client's link promptly — via
+    the host socket under the control stream, not a wedge timeout — and
+    the in-flight RPC errors instead of hanging."""
+    from incubator_brpc_tpu.transport.mc_worker import orchestrate_peer_death
+
+    stats, transcript = orchestrate_peer_death(die_after=3)
+    # the client's connection warm-up consumes one server-side echo
+    assert stats["ok_before_death"] >= 2, transcript
+    assert stats["failed_at"] >= 2
+    # fast failure: EFAILEDSOCKET via the dying TCP socket under the
+    # control stream — NOT the 30 s RPC deadline, NOT the wedge timer
+    from incubator_brpc_tpu.utils.status import ErrorCode
+
+    assert stats["error_code"] == ErrorCode.EFAILEDSOCKET, stats
+    assert "SERVER_DYING" in transcript

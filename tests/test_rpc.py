@@ -770,3 +770,32 @@ class TestSessionAndThreadLocalData:
         finally:
             srv.stop()
             srv.join(timeout=10)
+
+
+class TestInflightFailFast:
+    def test_inflight_call_fails_when_connection_dies(self):
+        """An RPC already on the wire fails the moment its connection dies
+        (the reference fails every id parked on a Socket at SetFailed) —
+        not at the call deadline."""
+        from incubator_brpc_tpu.rpc import Server
+
+        srv = make_echo_server(delay_s=8.0)  # handler outlives the server
+        ch = connect(srv.port, timeout_ms=30000, max_retry=0)
+        done = threading.Event()
+        out = {}
+
+        def on_done(cntl):
+            out["code"] = cntl.error_code
+            out["elapsed"] = time.monotonic() - t0
+            done.set()
+
+        t0 = time.monotonic()
+        ch.call("Echo", "echo", b"doomed", done=on_done)
+        time.sleep(0.4)  # request is in flight, handler sleeping
+        srv.stop()  # kills every connection under the client
+        assert done.wait(10), "call did not fail after connection death"
+        assert out["code"] == ErrorCode.EFAILEDSOCKET, out
+        assert out["elapsed"] < 6.0, (
+            f"failed at {out['elapsed']:.1f}s — deadline, not socket death"
+        )
+        srv.join(timeout=15)
