@@ -215,6 +215,14 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
         "tb_server_set_handoff_cb": (None, [b, HANDOFF_FN, ctypes.c_void_p]),
         "tb_server_set_closed_cb": (None, [b, CLOSED_FN, ctypes.c_void_p]),
         "tb_server_set_max_body": (None, [b, ctypes.c_size_t]),
+        "tb_server_get_native_max_concurrency": (
+            ctypes.c_long,
+            [b, ctypes.c_char_p],
+        ),
+        "tb_server_set_native_max_concurrency": (
+            ctypes.c_int,
+            [b, ctypes.c_char_p, ctypes.c_uint32],
+        ),
         "tb_server_register_native": (
             ctypes.c_int,
             [b, ctypes.c_char_p, ctypes.c_int, ctypes.c_uint32],

@@ -846,6 +846,39 @@ class Server:
             if self._nprocessing == 0:
                 self._quiescent.notify_all()
 
+    def reset_max_concurrency(self, max_concurrency: int) -> int:
+        """Change the server-level concurrency limit while RUNNING
+        (reference Server::ResetMaxConcurrency, server.h:483-488).
+        Returns the previous limit; 0 = unlimited. Takes effect on the
+        next admission check — in-flight requests are never evicted.
+
+        Native-plane caveat: a server that STARTED with max_concurrency=0
+        registered its native-kind methods for pure-C++ dispatch, which
+        has no server-level gate — raising a server-level limit later
+        bounds the Python-routed methods only (per-method limits reach
+        the native plane, see set_method_max_concurrency)."""
+        prev = self.options.max_concurrency
+        self.options.max_concurrency = max(0, int(max_concurrency))
+        return prev
+
+    def set_method_max_concurrency(self, full_name: str, n: int) -> bool:
+        """Per-method runtime limit (reference MaxConcurrencyOf setter,
+        server.h:490): True if the method exists. Propagates to the
+        native plane, where the limit is read per request."""
+        prop = self._methods.get(full_name)
+        if prop is None:
+            return False
+        prop.status.max_concurrency = max(0, int(n))
+        if self._native_plane is not None:
+            self._native_plane.set_native_max_concurrency(
+                full_name, prop.status.max_concurrency
+            )
+        return True
+
+    def method_max_concurrency(self, full_name: str) -> Optional[int]:
+        prop = self._methods.get(full_name)
+        return prop.status.max_concurrency if prop is not None else None
+
     def has_method(self, full_name: str) -> bool:
         """Cheap membership check (the gateway route test — methods() copies
         the whole map)."""

@@ -253,6 +253,24 @@ class NativeServerPlane:
                         "route", full
                     )
 
+    def set_native_max_concurrency(self, full_name: str, n: int) -> bool:
+        """Runtime retune of a natively-registered method's admission
+        limit (no-op False if the method is not native)."""
+        return (
+            LIB.tb_server_set_native_max_concurrency(
+                self._srv, full_name.encode(), n
+            )
+            == 0
+        )
+
+    def native_max_concurrency(self, full_name: str) -> int:
+        """Current native-plane limit; -1 = not natively registered."""
+        return int(
+            LIB.tb_server_get_native_max_concurrency(
+                self._srv, full_name.encode()
+            )
+        )
+
     def listen(self, ip: str, port: int) -> int:
         rc = LIB.tb_server_listen(self._srv, ip.encode(), port)
         if rc < 0:

@@ -818,6 +818,30 @@ int register_native_common(tb_server* s, const char* full_name, int kind,
 
 }  // namespace
 
+int tb_server_set_native_max_concurrency(tb_server* s, const char* full_name,
+                                         uint32_t max_concurrency) {
+  // runtime retune of a natively-dispatched method's admission limit
+  // (the Python plane's MaxConcurrencyOf setter must reach methods that
+  // never touch the interpreter); nm->max_concurrency is read per
+  // request, so the store takes effect on the next admission check
+  for (NativeMethod* nm : s->native_methods) {
+    if (nm->full_name == full_name) {
+      nm->max_concurrency = max_concurrency;
+      return 0;
+    }
+  }
+  return -1;
+}
+
+long tb_server_get_native_max_concurrency(tb_server* s,
+                                          const char* full_name) {
+  for (NativeMethod* nm : s->native_methods) {
+    if (nm->full_name == full_name)
+      return static_cast<long>(nm->max_concurrency);
+  }
+  return -1;  // not natively registered
+}
+
 int tb_server_register_native(tb_server* s, const char* full_name, int kind,
                               uint32_t max_concurrency) {
   if (kind != kKindEcho && kind != kKindNop) return -1;

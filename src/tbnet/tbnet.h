@@ -58,6 +58,11 @@ void tb_server_set_closed_cb(tb_server* s, tb_closed_fn cb, void* ctx);
 void tb_server_set_max_body(tb_server* s, size_t bytes);
 // kind: 1 = echo (respond with the request body), 2 = nop (empty response).
 // max_concurrency 0 = unlimited; exceeding it answers ELIMIT natively.
+// runtime retune of a native method's admission limit (0 = unlimited)
+int tb_server_set_native_max_concurrency(tb_server* s, const char* full_name,
+                                         uint32_t max_concurrency);
+long tb_server_get_native_max_concurrency(tb_server* s,
+                                          const char* full_name);
 int tb_server_register_native(tb_server* s, const char* full_name, int kind,
                               uint32_t max_concurrency);
 // User native method: bytes-in/bytes-out C callback, run entirely on the

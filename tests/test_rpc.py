@@ -799,3 +799,101 @@ class TestInflightFailFast:
             f"failed at {out['elapsed']:.1f}s — deadline, not socket death"
         )
         srv.join(timeout=15)
+
+
+class TestRuntimeConcurrencyReset:
+    """Reference Server::ResetMaxConcurrency + MaxConcurrencyOf setter
+    (server.h:483-490): limits are retunable while serving."""
+
+    def test_server_level_reset_takes_effect_live(self):
+        srv = make_echo_server(max_concurrency=0, delay_s=0.4)
+        try:
+            ch = connect(srv.port, timeout_ms=10000)
+            assert ch.call("Echo", "echo", b"warm").ok()
+            assert srv.reset_max_concurrency(1) == 0
+            codes = []
+            lock = threading.Lock()
+
+            def call():
+                c = ch.call("Echo", "echo", b"x")
+                with lock:
+                    codes.append(c.error_code)
+
+            threads = [threading.Thread(target=call) for _ in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert ErrorCode.ELIMIT in codes  # new limit enforced live
+            assert 0 in codes
+            # and back to unlimited
+            srv.reset_max_concurrency(0)
+            threads = [threading.Thread(target=call) for _ in range(3)]
+            codes.clear()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert codes.count(0) == 3, codes
+        finally:
+            srv.stop()
+            srv.join(timeout=10)
+
+    def test_method_level_setter(self):
+        srv = make_echo_server()
+        try:
+            assert srv.method_max_concurrency("Echo.echo") == 0
+            assert srv.set_method_max_concurrency("Echo.echo", 7)
+            assert srv.method_max_concurrency("Echo.echo") == 7
+            assert not srv.set_method_max_concurrency("Echo.nope", 3)
+            assert srv.method_max_concurrency("Echo.nope") is None
+        finally:
+            srv.stop()
+            srv.join(timeout=10)
+
+
+def test_native_plane_method_limit_retunes_live():
+    """set_method_max_concurrency reaches natively-dispatched methods
+    (their limit is read per request in C++)."""
+    from incubator_brpc_tpu.rpc import (
+        Channel,
+        ChannelOptions,
+        Server,
+        ServerOptions,
+        native_echo,
+    )
+    from incubator_brpc_tpu.transport import native_plane as np_mod
+
+    if not np_mod.NET_AVAILABLE:
+        import pytest
+
+        pytest.skip("native plane unavailable")
+    srv = Server(ServerOptions(native_plane=True, usercode_inline=True))
+    srv.add_service("n", {"echo": native_echo})
+    assert srv.start(0)
+    try:
+        if srv._native_plane is None:
+            import pytest
+
+            pytest.skip("native plane not active")
+        ch = Channel()
+        assert ch.init(
+            f"127.0.0.1:{srv.port}", options=ChannelOptions(native_plane=True)
+        )
+        assert ch.call_method("n", "echo", b"x").ok()
+        # the limit lives in C and is read per request: the setter must
+        # reach it, and traffic keeps flowing under the retuned value
+        assert srv._native_plane.native_max_concurrency("n.echo") == 0
+        assert srv.set_method_max_concurrency("n.echo", 5)
+        assert srv._native_plane.native_max_concurrency("n.echo") == 5
+        assert srv.method_max_concurrency("n.echo") == 5
+        nch = np_mod.NativeClientChannel("127.0.0.1", srv.port)
+        try:
+            nch.pump("n", "echo", b"y", 2000, inflight=2)
+        finally:
+            nch.close()
+        assert srv.set_method_max_concurrency("n.echo", 0)
+        assert srv._native_plane.native_max_concurrency("n.echo") == 0
+    finally:
+        srv.stop()
+        srv.join(timeout=10)
