@@ -36,6 +36,10 @@ _MAX_HEADER_PEEK = MAX_HEADER_PEEK
 
 
 class InputMessenger:
+    # sockets probe this before passing defer_tail: protocol clients
+    # (memcache/resp) and test sinks duck-type `process(sock)` without it
+    supports_defer_tail = True
+
     def __init__(self, protocols: Optional[List[Protocol]] = None):
         self._protocols = protocols  # None -> live registry order
 
@@ -53,8 +57,18 @@ class InputMessenger:
             protos = [pref] + [p for p in protos if p is not pref]
         return protos
 
-    def process(self, sock) -> None:
-        """Cut and dispatch every complete message in sock._read_buf."""
+    def process(self, sock, defer_tail: bool = False):
+        """Cut and dispatch every complete message in sock._read_buf.
+
+        ``defer_tail=True`` (the reactor's ProcessEvent path): the last
+        plain message is NOT processed here — it is returned as
+        ``(proto, frame)`` for the caller to run AFTER releasing the
+        socket's read state. The reference gets this for free from M:N
+        bthreads (the tail runs in-place but a new event starts a new
+        ProcessEvent); without it, a handler that blocks — e.g. issuing a
+        nested RPC back over the SAME connection — holds the reader and
+        later requests on that connection are never cut: self-call
+        deadlock (examples/cascade_echo.py is the regression test)."""
         cut: List[Tuple[Protocol, object]] = []
         buf = sock._read_buf
         max_body = int(get_flag("max_body_size"))
@@ -81,13 +95,13 @@ class InputMessenger:
                 try:
                     frame, consumed = pref.parse_conn(sock, buf)
                 except FatalParseError as e:
-                    self._dispatch(sock, cut)
+                    self._dispatch(sock, cut)  # never defer on a dying conn
                     sock.set_failed(ErrorCode.EREQUEST, f"corrupt frame: {e}")
-                    return
+                    return None
                 except ParseError as e:
                     self._dispatch(sock, cut)
                     sock.set_failed(ErrorCode.EREQUEST, f"unparsable: {e}")
-                    return
+                    return None
                 if frame is not None:
                     cut.append((pref, frame))
                     continue
@@ -103,7 +117,7 @@ class InputMessenger:
                     # bytes already consumed: the stream cannot re-sync
                     self._dispatch(sock, cut)
                     sock.set_failed(ErrorCode.EREQUEST, f"corrupt frame: {e}")
-                    return
+                    return None
                 except ParseError:
                     retry_others = True
                     continue
@@ -148,7 +162,7 @@ class InputMessenger:
                     sock.set_failed(
                         ErrorCode.EREQUEST, f"{proto.name}: {e}"
                     )
-                    return
+                    return None
                 except ParseError:
                     continue
                 matched = proto
@@ -156,7 +170,7 @@ class InputMessenger:
             if matched is None:
                 self._dispatch(sock, cut)
                 sock.set_failed(ErrorCode.EREQUEST, "unparsable bytes on the wire")
-                return
+                return None
             if total == -1:
                 continue  # fallback path already cut one frame
             sock.preferred_protocol = matched
@@ -175,7 +189,7 @@ class InputMessenger:
                 sock.set_failed(
                     ErrorCode.EREQUEST, f"frame of {total} B exceeds max_body_size"
                 )
-                return
+                return None
             if len(buf) < total:
                 break
             raw = buf.to_bytes(total)
@@ -185,17 +199,17 @@ class InputMessenger:
             except ParseError as e:
                 self._dispatch(sock, cut)
                 sock.set_failed(ErrorCode.EREQUEST, f"corrupt frame: {e}")
-                return
+                return None
             if frame is None or consumed != total:
                 self._dispatch(sock, cut)
                 sock.set_failed(ErrorCode.EREQUEST, "parser/header length mismatch")
-                return
+                return None
             cut.append((matched, frame))
-        self._dispatch(sock, cut)
+        return self._dispatch(sock, cut, defer_tail=defer_tail)
 
-    def _dispatch(self, sock, cut) -> None:
+    def _dispatch(self, sock, cut, defer_tail: bool = False):
         if not cut:
-            return
+            return None
         # Two classes of frame must be handled inline, in wire order, on
         # this (single-per-socket) reader fiber:
         # - stream frames: their per-stream ExecutionQueue push must happen
@@ -229,12 +243,17 @@ class InputMessenger:
             else:
                 rest.append((proto, frame))
         if not rest:
-            return
+            return None
         pool = global_worker_pool()
         for proto, frame in rest[:-1]:
             pool.spawn(self._process_one, sock, proto, frame)
         proto, frame = rest[-1]
+        if defer_tail:
+            # caller runs it after releasing the socket's read state, so a
+            # handler that blocks cannot wedge this connection's reads
+            return (proto, frame)
         self._process_one(sock, proto, frame)  # last message inline
+        return None
 
     @staticmethod
     def _process_one(sock, proto: Protocol, frame) -> None:

@@ -363,10 +363,11 @@ class Socket:
         if rc == 0 and epoch is not None:
             self._drive_drain(epoch, req, None, False)
 
-    def _ssl_read_pump(self) -> bool:
+    def _ssl_read_pump(self):
         """SSL read path: ciphertext fd → in_bio → handshake pump and/or
-        plaintext into _read_buf → messenger. Returns False if the socket
-        died (already failed)."""
+        plaintext into _read_buf → messenger. Returns ``(alive, tail)``
+        like the plaintext drain (same deferred-tail discipline — a
+        blocking handler must not wedge a TLS connection's reads either)."""
         import ssl as _ssl
 
         eof = False
@@ -381,7 +382,7 @@ class Socket:
                 self.set_failed(
                     ErrorCode.EFAILEDSOCKET, f"ssl read failed: {e}"
                 )
-                return False
+                return False, None
             if not data:
                 eof = True
                 break
@@ -399,15 +400,15 @@ class Socket:
                     self.set_failed(
                         ErrorCode.EFAILEDSOCKET, f"TLS handshake failed: {e}"
                     )
-                    return False
+                    return False, None
                 self._flush_ssl_out()
                 if not self._ssl_done:
                     if eof:
                         self.set_failed(
                             ErrorCode.EEOF, "peer closed mid-handshake"
                         )
-                        return False
-                    return True
+                        return False, None
+                    return True, None
             while True:
                 try:
                     pt = self._sslobj.read(65536)
@@ -420,7 +421,7 @@ class Socket:
                     self.set_failed(
                         ErrorCode.EFAILEDSOCKET, f"TLS record error: {e}"
                     )
-                    return False
+                    return False, None
                 if not pt:
                     eof = True
                     break
@@ -429,12 +430,18 @@ class Socket:
             # sits in out_bio; on a read-mostly connection no app write
             # would ever flush it
             self._flush_ssl_out()
+        tail = None
         if self.messenger is not None and len(self._read_buf):
-            self.messenger.process(self)
+            if not eof and getattr(self.messenger, "supports_defer_tail", False):
+                tail = self.messenger.process(self, defer_tail=True)
+            else:
+                # EOF: process everything inline BEFORE failing the socket
+                # so the final request's response can still be written
+                self.messenger.process(self)
         if eof:
             self.set_failed(ErrorCode.EEOF, "remote closed connection")
-            return False
-        return True
+            return False, None
+        return True, tail
 
     # -- construction -------------------------------------------------------
 
@@ -751,10 +758,13 @@ class Socket:
         if mask:
             self._dispatcher.rearm(self.fd, mask)
 
-    def _drain_and_cut(self) -> bool:
+    def _drain_and_cut(self):
         """Drain the fd to EAGAIN into the read IOBuf and run the messenger
-        cut loop. Caller holds an io ref AND read ownership. Returns False
-        if the socket died (EOF / read error) — it is already failed."""
+        cut loop. Caller holds an io ref AND read ownership. Returns
+        ``(alive, tail)``: alive=False if the socket died (EOF / read
+        error — already failed); ``tail`` is the deferred last message
+        (messenger defer_tail) to process AFTER the caller releases the
+        socket's read state."""
         self.last_active = _monotonic()
         if self._sslobj is not None:
             return self._ssl_read_pump()
@@ -804,28 +814,45 @@ class Socket:
                 ErrorCode.EFAILEDSOCKET,
                 f"read failed: {_errno.errorcode.get(-rc, rc)}",
             )
-            return False
+            return False, None
+        tail = None
         if self.messenger is not None and len(self._read_buf):
-            self.messenger.process(self)
+            if not eof and getattr(self.messenger, "supports_defer_tail", False):
+                tail = self.messenger.process(self, defer_tail=True)
+            else:
+                # duck-typed messengers — and the EOF case, where the tail
+                # must run BEFORE set_failed shuts the fd down (a half-
+                # closed client still expects its final response; a
+                # response+EOF read must surface the response, not EEOF)
+                self.messenger.process(self)
         if eof:
             self.set_failed(ErrorCode.EEOF, "remote closed connection")
-            return False
-        return True
+            return False, None
+        return True, tail
 
     def _process_event(self) -> None:
-        """ProcessEvent fiber: drain fd → cut messages → dispatch."""
+        """ProcessEvent fiber: drain fd → cut messages → dispatch. The
+        deferred tail message runs AFTER the read state is released and
+        the dispatcher re-armed: a handler that blocks (a nested RPC back
+        over this very connection, a slow service) must not wedge this
+        connection's reads — the reference's M:N bthreads give it the
+        same property for free."""
         if not self._acquire_io():
             with self._state_lock:
                 self._reading = False
             return
+        tail = None
         try:
-            if not self._drain_and_cut():
+            alive, tail = self._drain_and_cut()
+            if not alive:
                 return
         finally:
             self._release_io()
             with self._state_lock:
                 self._reading = False
             self._arm()
+        if tail is not None:
+            self.messenger._process_one(self, tail[0], tail[1])
 
     # -- caller-driven reads (sync-call fast path) --------------------------
     #
@@ -904,7 +931,12 @@ class Socket:
                     pass
             if self.fd not in r:
                 return self.state == CONNECTED
-            return self._drain_and_cut()
+            # caller-driven path: the sync caller IS the processor, and
+            # client responses never block — no tail deferral here
+            alive, tail = self._drain_and_cut()
+            if tail is not None:
+                self.messenger._process_one(self, tail[0], tail[1])
+            return alive
         finally:
             self._release_io()
 

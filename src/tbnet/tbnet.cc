@@ -330,7 +330,9 @@ struct NetLoop {
 
 struct NativeMethod {
   int kind;
-  uint32_t max_concurrency;
+  // runtime-retunable (tb_server_set_native_max_concurrency stores from
+  // the application thread while loop threads load per request)
+  std::atomic<uint32_t> max_concurrency{0};
   std::atomic<uint32_t> nprocessing{0};
   std::atomic<uint64_t> nreq{0};
   std::atomic<uint64_t> nerr{0};
@@ -482,8 +484,11 @@ void run_native(NetConn* c, NativeMethod* nm, const tb_tbus_hdr* hdr,
                 const MetaLite& ml, tb_iobuf* body, tb_iobuf* out) {
   nm->nreq.fetch_add(1, std::memory_order_relaxed);
   c->srv->native_reqs.fetch_add(1, std::memory_order_relaxed);
-  if (nm->max_concurrency &&
-      nm->nprocessing.fetch_add(1) >= nm->max_concurrency) {
+  // snapshot ONCE: a runtime retune between the admission fetch_add and
+  // the completion fetch_sub must see a consistent gate, or the counter
+  // leaks (limit dropped to 0 mid-request) / underflows (raised from 0)
+  const uint32_t limit = nm->max_concurrency.load(std::memory_order_relaxed);
+  if (limit && nm->nprocessing.fetch_add(1) >= limit) {
     nm->nprocessing.fetch_sub(1);
     nm->nerr.fetch_add(1, std::memory_order_relaxed);
     append_error(out, hdr->cid_lo, hdr->cid_hi, c->srv->errs.elimit,
@@ -516,7 +521,7 @@ void run_native(NetConn* c, NativeMethod* nm, const tb_tbus_hdr* hdr,
       nm->nerr.fetch_add(1, std::memory_order_relaxed);
       append_error(out, hdr->cid_lo, hdr->cid_hi, c->srv->errs.erequest,
                    "request too large to stage");
-      if (nm->max_concurrency) nm->nprocessing.fetch_sub(1);
+      if (limit) nm->nprocessing.fetch_sub(1);
       return;  // caller owns body
     }
     if (blen) tb_iobuf_copy_to(body, req, blen, 0);
@@ -542,7 +547,7 @@ void run_native(NetConn* c, NativeMethod* nm, const tb_tbus_hdr* hdr,
   }
   // body is the caller's reusable scratch: NOT destroyed here (the echo
   // kind ref-shared its blocks into `out`; clear just drops this handle)
-  if (nm->max_concurrency) nm->nprocessing.fetch_sub(1);
+  if (limit) nm->nprocessing.fetch_sub(1);
 }
 
 enum class FrameStatus { kOk, kHandoff, kKilled };
@@ -809,7 +814,7 @@ int register_native_common(tb_server* s, const char* full_name, int kind,
   nm->kind = kind;
   nm->fn = fn;
   nm->ud = ud;
-  nm->max_concurrency = max_concurrency;
+  nm->max_concurrency.store(max_concurrency, std::memory_order_relaxed);
   nm->full_name = full_name;
   s->native_methods.push_back(nm);
   tb_flatmap_insert(s->methods, key, s->native_methods.size() - 1);
@@ -826,7 +831,7 @@ int tb_server_set_native_max_concurrency(tb_server* s, const char* full_name,
   // request, so the store takes effect on the next admission check
   for (NativeMethod* nm : s->native_methods) {
     if (nm->full_name == full_name) {
-      nm->max_concurrency = max_concurrency;
+      nm->max_concurrency.store(max_concurrency, std::memory_order_relaxed);
       return 0;
     }
   }
@@ -837,7 +842,8 @@ long tb_server_get_native_max_concurrency(tb_server* s,
                                           const char* full_name) {
   for (NativeMethod* nm : s->native_methods) {
     if (nm->full_name == full_name)
-      return static_cast<long>(nm->max_concurrency);
+      return static_cast<long>(
+          nm->max_concurrency.load(std::memory_order_relaxed));
   }
   return -1;  // not natively registered
 }
