@@ -693,7 +693,7 @@ class TestSessionAndThreadLocalData:
             c = ch2.call_method("d", "use", b"")
             assert c.ok()
             assert c.response_payload.startswith(b"s2:")  # fresh object
-            deadline = time.monotonic() + 10
+            deadline = time.monotonic() + 25  # 1-core CI: generous
             pool = srv._session_pool
             while pool.free_count == 0 and time.monotonic() < deadline:
                 time.sleep(0.02)
