@@ -24,6 +24,10 @@ EXAMPLES = [
     "examples/session_data_and_thread_local.py",
     "examples/dynamic_partition_echo.py",
     "examples/multi_threaded_echo.py",
+    "examples/cancel_echo.py",
+    "examples/cascade_echo.py",
+    "examples/selective_echo.py",
+    "examples/asynchronous_echo.py",
 ]
 
 
