@@ -1,0 +1,250 @@
+"""Pipelined cross-process collective sessions — RPC-scheduled, ICI-run.
+
+The combo-channel fusion (rpc/combo.py + parallel/collective.py) collapses
+a ParallelChannel call into ONE shard_map dispatch — but only inside one
+controller, where the client stages every party's operand itself. Across
+controllers a one-shot fused call cannot win: the client cannot place
+bytes on non-addressable devices, so the operands would ride the host
+plane anyway (docs/DEVICE_PLANE.md). What DOES win across processes is
+the PIPELINED shape: schedule once over the host plane, then run K
+lockstep collective steps whose operands never leave the devices — the
+steady-state of the reference's "RDMA for tensor traffic" story, and of
+every real multi-host training loop.
+
+A session is proposed as a plain RPC to every server
+(``_tpu_transport.collective``): {parties (global device ids), your
+party index, steps, width, seed}. Each party — client included — then
+runs the IDENTICAL jitted program: K chained ``shard_map`` steps over
+``Mesh(parties, ("party",))`` where each step exchanges shards with a
+collective (``pmean`` here: every party's operand converges to the
+global mean, which makes convergence a checkable invariant). Lockstep
+needs no per-step coordination: the step count was agreed up front, the
+chain is data-dependent, and XLA pipelines the K dispatches.
+
+Deployment contract: every party is one process of a ``jax.distributed``
+group (the mc_link deployment); the session only needs the group — no
+device link is required, though sessions and links share the group
+freely (mc_worker's fabric client runs both).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import List, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+COLLECTIVE_METHOD = "collective"
+
+
+def _devices_by_id(ids: List[int]):
+    import jax
+
+    by_id = {d.id: d for d in jax.devices()}
+    try:
+        return [by_id[i] for i in ids]
+    except KeyError as e:
+        raise ValueError(
+            f"device id {e} not in this process's global view "
+            f"(is jax.distributed initialized everywhere?)"
+        )
+
+
+def run_collective_session(
+    party_ids: List[int],
+    own_index: int,
+    steps: int,
+    width: int,
+    seed: int,
+) -> Tuple[np.ndarray, float]:
+    """Run this party's half of the session; returns (final own shard,
+    elapsed seconds). Every party calls this with identical arguments
+    except ``own_index`` — the programs must match or the collectives
+    cannot rendezvous."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover — older JAX
+        from jax.experimental.shard_map import shard_map
+
+    devices = _devices_by_id(party_ids)
+    n = len(devices)
+    mesh = Mesh(np.asarray(devices), ("party",))
+    sharding = NamedSharding(mesh, P("party"))
+
+    def body(x):
+        # pmean: one step pulls every party to the global mean — the
+        # invariant each party verifies independently. A real workload
+        # swaps in its own kernel (psum gradients, all-to-all experts…);
+        # the session machinery is kernel-agnostic.
+        return shard_map(
+            lambda s: jax.lax.pmean(s, "party"),
+            mesh=mesh,
+            in_specs=P("party"),
+            out_specs=P("party"),
+        )(x)
+
+    step_fn = jax.jit(body, out_shardings=sharding)
+
+    # party i's deterministic initial operand (seed makes the expected
+    # global mean computable on every side without communication)
+    init = _party_operand(seed, own_index, width)
+    shard = jax.device_put(init[None, :], devices[own_index])
+    x = jax.make_array_from_single_device_arrays(
+        (n, width), sharding, [shard]
+    )
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        x = step_fn(x)  # chained: operands stay resident, XLA pipelines
+    own = None
+    for s in x.addressable_shards:
+        # a process can address several mesh devices (single-controller
+        # runs): OUR shard is the one on devices[own_index], not whichever
+        # the iterator yields last
+        if s.device == devices[own_index]:
+            own = np.asarray(s.data).reshape(-1)
+    elapsed = time.perf_counter() - t0
+    assert own is not None
+    return own, elapsed
+
+
+def _party_operand(seed: int, index: int, width: int) -> np.ndarray:
+    rng = np.random.default_rng(seed + index)
+    return rng.standard_normal(width).astype(np.float32)
+
+
+def expected_mean(seed: int, nparties: int, width: int) -> np.ndarray:
+    return np.mean(
+        [_party_operand(seed, i, width) for i in range(nparties)], axis=0
+    )
+
+
+def make_collective_handler(server):
+    """Server half: accept a session proposal, run our party's program on
+    a worker fiber, answer with the final shard's checksum once the chain
+    drains (the response doubles as the completion barrier the client
+    collects)."""
+
+    def collective(cntl, request: bytes) -> bytes:
+        try:
+            req = json.loads(request.decode())
+            party_ids = [int(i) for i in req["parties"]]
+            own_index = int(req["index"])
+            steps = int(req["steps"])
+            width = int(req["width"])
+            seed = int(req["seed"])
+        except (ValueError, KeyError, TypeError) as e:
+            from incubator_brpc_tpu.utils.status import ErrorCode
+
+            cntl.set_failed(ErrorCode.EREQUEST, f"bad collective proposal: {e}")
+            return b""
+        if not (0 < steps <= 100_000 and 0 < width <= (1 << 20)):
+            from incubator_brpc_tpu.utils.status import ErrorCode
+
+            cntl.set_failed(
+                ErrorCode.EREQUEST, "collective proposal out of bounds"
+            )
+            return b""
+        # Liveness: a party that never joins stalls the rendezvous until
+        # the collective backend's own timeout errors the chain (gloo on
+        # the CPU fabric; the coordination service reports dead PROCESSES
+        # group-wide) — the raise lands here and answers EINTERNAL. A
+        # live-but-declining peer is caught on the client by the
+        # pre-session grace check in propose_collective.
+        own, elapsed = run_collective_session(
+            party_ids, own_index, steps, width, seed
+        )
+        return json.dumps(
+            {
+                "checksum": float(np.sum(own, dtype=np.float64)),
+                "elapsed_s": elapsed,
+                "steps": steps,
+            }
+        ).encode()
+
+    return collective
+
+
+def propose_collective(
+    channels,
+    party_ids: List[int],
+    client_index: int,
+    steps: int,
+    width: int,
+    seed: int,
+    timeout_ms: float = 120000,
+):
+    """Client half: propose the session to every server (async — they
+    must all start dispatching, the collective needs every party), run
+    our own party's program, then collect completions. Returns
+    {"own": shard, "elapsed_s": s, "server_checksums": [...]}.
+
+    ``channels[i]`` is an initialized host channel to the server playing
+    party ``server_indexes[i]``; party indexes are assigned positionally:
+    servers take every index except ``client_index``."""
+    import threading
+
+    from incubator_brpc_tpu.rpc.controller import Controller
+    from incubator_brpc_tpu.transport.device_link import HANDSHAKE_SERVICE
+
+    server_indexes = [i for i in range(len(party_ids)) if i != client_index]
+    if len(server_indexes) != len(channels):
+        raise ValueError("one channel per server party required")
+    pending = []
+    for ch, idx in zip(channels, server_indexes):
+        payload = json.dumps(
+            {
+                "parties": party_ids,
+                "index": idx,
+                "steps": steps,
+                "width": width,
+                "seed": seed,
+            }
+        ).encode()
+        cntl = Controller(timeout_ms=timeout_ms)
+        ev = threading.Event()
+        # async: every party must be dispatching before any can finish —
+        # a sync proposal to server A would deadlock (A's collective
+        # blocks on parties that were never told to start)
+        ch.call_method(
+            HANDSHAKE_SERVICE,
+            COLLECTIVE_METHOD,
+            payload,
+            cntl=cntl,
+            done=lambda c, _ev=ev: _ev.set(),
+        )
+        pending.append((cntl, ev))
+    # grace check: a REJECTED proposal (bad field, unknown device, bounds)
+    # completes immediately — catch it BEFORE entering our own session,
+    # whose collective would otherwise wait on a party that never joins
+    # (mid-session process death is the backend's liveness domain — the
+    # coordination service / gloo timeout errors the chain group-wide)
+    grace_deadline = time.monotonic() + 0.5
+    while time.monotonic() < grace_deadline:
+        for cntl, ev in pending:
+            if ev.is_set() and cntl.failed():
+                raise RuntimeError(
+                    f"collective proposal rejected: {cntl.error_text}"
+                )
+        time.sleep(0.02)
+    own, elapsed = run_collective_session(
+        party_ids, client_index, steps, width, seed
+    )
+    checksums = []
+    deadline = time.monotonic() + timeout_ms / 1000.0  # shared, not per-peer
+    for cntl, ev in pending:
+        if not ev.wait(max(0.0, deadline - time.monotonic())):
+            raise TimeoutError("collective peer never completed")
+        if cntl.failed():
+            raise RuntimeError(f"collective peer failed: {cntl.error_text}")
+        checksums.append(
+            json.loads(cntl.response_payload.decode())["checksum"]
+        )
+    return {"own": own, "elapsed_s": elapsed, "server_checksums": checksums}

@@ -459,6 +459,21 @@ class Server:
             make_handshake_handler,
         )
 
+        # cross-process collective sessions share the transport service
+        # (parallel/mc_collective.py; meaningful under jax.distributed)
+        from incubator_brpc_tpu.parallel.mc_collective import (
+            COLLECTIVE_METHOD,
+            make_collective_handler,
+        )
+
+        co = f"{HANDSHAKE_SERVICE}.{COLLECTIVE_METHOD}"
+        if co not in self._methods:
+            self._methods.insert(
+                co,
+                MethodProperty(
+                    make_collective_handler(self), MethodStatus(co, 0), co
+                ),
+            )
         hs = f"{HANDSHAKE_SERVICE}.{HANDSHAKE_METHOD}"
         if hs not in self._methods:
             self._methods.insert(

@@ -237,9 +237,47 @@ def run_fabric_client(args) -> int:
         assert cntl.ok(), f"fabric rpc {i}: {cntl.error_text}"
         want = b"".join(b"p%d:" % j + body for j in range(n))
         assert cntl.response_payload == want, f"fabric rpc {i} merged wrong"
+    # pipelined cross-process collective session (mc_collective): all
+    # three parties run K lockstep pmean steps, operands device-resident
+    # across the chain; every party must converge to the global mean
+    coll = None
+    if args.collective_steps > 0:
+        import jax
+
+        import numpy as _np
+
+        from incubator_brpc_tpu.parallel.mc_collective import (
+            expected_mean,
+            propose_collective,
+        )
+
+        party_ids = sorted(d.id for d in jax.devices())
+        client_dev = jax.local_devices()[0].id
+        client_index = party_ids.index(client_dev)
+        chans = []
+        for p in ports:
+            hc = Channel()
+            assert hc.init(f"127.0.0.1:{p}")
+            chans.append(hc)
+        out = propose_collective(
+            chans, party_ids, client_index,
+            steps=args.collective_steps, width=256, seed=7,
+        )
+        want = expected_mean(7, len(party_ids), 256)
+        assert _np.allclose(out["own"], want, atol=1e-5), "no convergence"
+        want_sum = float(_np.sum(want, dtype=_np.float64))
+        for cs in out["server_checksums"]:
+            assert abs(cs - want_sum) < 1e-3, (cs, want_sum)
+        coll = {
+            "steps": args.collective_steps,
+            "per_step_ms": out["elapsed_s"] / args.collective_steps * 1e3,
+            "parties": len(party_ids),
+        }
+
     links = [sub[0]._device_sock.link for sub in pc._subs]
     stats = {
         "n_rpcs": args.n_rpcs,
+        "collective": coll,
         "links": [
             {
                 "devices": [str(d) for d in lk.devices],
@@ -501,6 +539,7 @@ def main(argv=None) -> int:
     ap.add_argument("--payload", type=int, default=3000)
     ap.add_argument("--slot-words", type=int, default=256)
     ap.add_argument("--window", type=int, default=4)
+    ap.add_argument("--collective-steps", type=int, default=0)  # fabric
     ap.add_argument("--die-after-rpcs", type=int, default=0)  # server fault
     ap.add_argument("--expect-peer-death", action="store_true")  # client
     args = ap.parse_args(argv)

@@ -1017,16 +1017,33 @@ class Socket:
         peer reconnects on the NEXT call instead of waiting out the
         health-check interval. One dialer at a time; the periodic health
         probe keeps running and revives through the same _revive gate."""
-        with self._state_lock:
-            if self.state == CONNECTED:
-                return True
-            if self.state != FAILED or not self.is_client or self.remote is None:
+        import time as _time
+
+        deadline = _monotonic() + timeout
+        while True:
+            with self._state_lock:
+                if self.state == CONNECTED:
+                    return True
+                if (
+                    self.state != FAILED
+                    or not self.is_client
+                    or self.remote is None
+                ):
+                    return False
+                if not self._reconnecting:
+                    self._reconnecting = True
+                    break
+            # another caller is dialing: WAIT for its verdict instead of
+            # failing this call instantly — racers that returned False
+            # here burned their whole retry budget inside one dial window
+            # (the reference queues writes behind the in-flight connect)
+            if _monotonic() >= deadline:
                 return False
-            if self._reconnecting:
-                return False  # another caller is dialing right now
-            self._reconnecting = True
+            _time.sleep(0.002)
         try:
-            conn = _dial(self.remote, timeout=timeout)
+            conn = _dial(
+                self.remote, timeout=max(0.05, deadline - _monotonic())
+            )
             if self._ssl_context is not None:
                 self._ssl_rewrap(conn)
         except OSError:  # ssl.SSLError and ConnectionError both subclass it

@@ -897,3 +897,47 @@ def test_native_plane_method_limit_retunes_live():
     finally:
         srv.stop()
         srv.join(timeout=10)
+
+
+def test_concurrent_callers_on_a_stale_mapped_socket():
+    """Ephemeral-port reuse resurrects a FAILED socket from the global
+    client map; concurrent callers must converge on ONE inline reconnect
+    (racers wait for the dialer's verdict) instead of burning their whole
+    retry budget inside the dial window."""
+    srv_a = make_echo_server()
+    port = srv_a.port
+    warm = connect(port)
+    assert warm.call("Echo", "echo", b"warm").ok()
+    srv_a.stop()
+    srv_a.join(timeout=5)
+    # a NEW server on the SAME port: the map still holds the dead socket
+    srv_b = Server()
+
+    def slow_echo(cntl, req):
+        time.sleep(0.2)
+        return req
+
+    srv_b.add_service("Echo", {"echo": slow_echo})
+    if not srv_b.start(port):
+        import pytest
+
+        pytest.skip("port could not be rebound")
+    try:
+        ch = connect(port, timeout_ms=5000)
+        codes = []
+        lock = threading.Lock()
+
+        def call():
+            c = ch.call("Echo", "echo", b"x", cntl=Controller(timeout_ms=5000))
+            with lock:
+                codes.append(c.error_code)
+
+        threads = [threading.Thread(target=call) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert codes.count(0) == 3, codes
+    finally:
+        srv_b.stop()
+        srv_b.join(timeout=10)
