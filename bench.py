@@ -590,8 +590,31 @@ def bench_fabricnet(results: dict) -> None:
         results["fabricnet_mfu_pct"] = flops / dt / V5E_PEAK_BF16 * 100.0
 
 
+def bench_host_calibration(results: dict) -> None:
+    """A fixed unit of single-thread CPU work (native CRC32C over 64 MiB),
+    repeated across the run. Every other row shares this host's one core
+    with unknown co-tenants; the calibration row turns 'the numbers moved'
+    into 'the HOST moved': ms-per-unit medians across rounds are directly
+    comparable, and a high max/min spread flags a contended capture."""
+    from incubator_brpc_tpu import native
+
+    if not native.NATIVE_AVAILABLE:
+        return
+    blob = b"c" * (64 << 20)
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        native.crc32c(blob)
+        times.append((time.perf_counter() - t0) * 1e3)
+    _record("host_calibration_ms", times)
+    # median, NOT min: a contended window usually still has one quiet
+    # iteration, so min stays flat exactly when the row should alarm
+    results["host_calibration_ms"] = sorted(times)[len(times) // 2]
+
+
 def main() -> None:
     results: dict = {}
+    bench_host_calibration(results)
     bench_device_echo(results)
     bench_rpc_echo(results)
     bench_native_plane(results)
@@ -663,6 +686,11 @@ def main() -> None:
                     # raw repetition stats per row: median/min/max/n —
                     # noise and regressions are distinguishable now
                     "spread": SAMPLES,
+                    # fixed CPU work unit (native CRC32C / 64 MiB): the
+                    # host-load normalizer for every row above. Compare
+                    # medians across rounds; a wide min/max marks a
+                    # contended capture window.
+                    "host_calibration_ms": results.get("host_calibration_ms"),
                     # where the pump nanoseconds went (the 921->~400 ns
                     # work): template frames (per-request pack was crc +
                     # header build + 3 appends; now patch 8 cid bytes +
