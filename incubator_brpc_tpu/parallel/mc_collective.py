@@ -66,7 +66,6 @@ def run_collective_session(
     except ``own_index`` — the programs must match or the collectives
     cannot rendezvous."""
     import jax
-    import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     try:
