@@ -62,6 +62,16 @@ def _vars(server, frame) -> Resp:
     return 200, "text/plain", body.encode()
 
 
+def _brpc_metrics(server, frame) -> Resp:
+    """prometheus_metrics_service.cpp: every exposed bvar in Prometheus
+    text exposition format — counters, gauges, and latency summaries with
+    quantile samples. ``?prefix=`` filters like /vars."""
+    from incubator_brpc_tpu.builtin import prometheus
+
+    body = prometheus.render_metrics(frame.query.get("prefix", ""))
+    return 200, prometheus.CONTENT_TYPE, body.encode()
+
+
 def _status(server, frame) -> Resp:
     """status_service.cpp: per-server, per-method live stats."""
     from incubator_brpc_tpu.builtin.portal import running_servers
@@ -517,6 +527,7 @@ _PAGES: Dict[str, object] = {
     "/vars": _vars,
     "/vars.json": _vars_json,
     "/vars/series.json": _vars_series,
+    "/brpc_metrics": _brpc_metrics,
     "/status": _status,
     "/flags": _flags,
     "/rpcz": _rpcz,

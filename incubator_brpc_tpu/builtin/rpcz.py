@@ -34,6 +34,9 @@ logger = logging.getLogger(__name__)
 
 SPAN_TYPE_CLIENT = "client"
 SPAN_TYPE_SERVER = "server"
+# non-RPC device-plane work (collective sessions): same store, same
+# queries, parented into the proposing RPC's trace
+SPAN_TYPE_COLLECTIVE = "collective"
 
 _tls = threading.local()  # .parent_span: active server span on this thread
 
@@ -262,6 +265,43 @@ def clear_parent_span(span) -> None:
     this worker's TLS."""
     if span is not None and getattr(_tls, "parent_span", None) is span:
         _tls.parent_span = None
+
+
+def start_custom_span(
+    span_type: str,
+    service: str,
+    method: str,
+    trace_id: int = 0,
+    parent_span_id: int = 0,
+) -> Optional[Span]:
+    """Sampled span for non-RPC work (collective sessions, background
+    pumps). With no explicit ids it parents to this thread's active server
+    span (the tls_bls.rpcz_parent_span rule, span.h:72-75); a caller that
+    has the proposing RPC's ids passes them so the span lands in the
+    client's trace even across the async handoff."""
+    if not rpcz_enabled() or not _limiter.grab():
+        return None
+    parent: Optional[Span] = getattr(_tls, "parent_span", None)
+    if not trace_id and parent is not None:
+        trace_id = parent.trace_id
+        parent_span_id = parent.span_id
+    return Span(
+        trace_id=trace_id or _new_id(),
+        span_id=_new_id(),
+        parent_span_id=parent_span_id,
+        span_type=span_type,
+        service=service,
+        method=method,
+        start_real_us=int(time.time() * 1e6),
+    )
+
+
+def end_custom_span(span: Optional[Span], error_code: int = 0) -> None:
+    if span is None:
+        return
+    span.latency_us = time.time() * 1e6 - span.start_real_us
+    span.error_code = error_code
+    span_store.submit(span)
 
 
 def end_server_span(cntl, response_size: int = 0) -> None:

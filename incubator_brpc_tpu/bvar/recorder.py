@@ -34,6 +34,9 @@ class IntRecorder(Variable):
         n = self._num.get_value()
         return (self._sum.get_value() / n) if n else 0.0
 
+    def sum(self) -> int:
+        return self._sum.get_value()
+
     def get_value(self):
         return self.average()
 
@@ -72,6 +75,10 @@ class LatencyRecorder(Variable):
 
     def count(self) -> int:
         return self._count.get_value()
+
+    def latency_sum(self) -> float:
+        """Total of every recorded latency — a summary's ``_sum`` sample."""
+        return self._latency.sum()
 
     def qps(self) -> float:
         return self._qps_window.get_value()

@@ -36,9 +36,69 @@ from typing import List, Tuple
 
 import numpy as np
 
+from incubator_brpc_tpu.bvar import Adder, LatencyRecorder
+
 logger = logging.getLogger(__name__)
 
 COLLECTIVE_METHOD = "collective"
+
+# session-level observability (ISSUE: the collective plane was blind):
+# every run_collective_session — proposer and server parties alike —
+# counts here and, when rpcz samples it, leaves one span in the proposing
+# RPC's trace carrying step count / operand width / participant set
+collective_sessions = Adder(name="mc_collective_sessions")
+collective_steps = Adder(name="mc_collective_steps")
+collective_errors = Adder(name="mc_collective_errors")
+collective_session_us = LatencyRecorder(name="mc_collective_session_us")
+
+
+def _start_session_span(
+    party_ids: List[int],
+    own_index: int,
+    steps: int,
+    width: int,
+    trace_id: int = 0,
+    parent_span_id: int = 0,
+):
+    from incubator_brpc_tpu.builtin.rpcz import (
+        SPAN_TYPE_COLLECTIVE,
+        start_custom_span,
+    )
+
+    span = start_custom_span(
+        SPAN_TYPE_COLLECTIVE,
+        "_tpu_transport",
+        COLLECTIVE_METHOD,
+        trace_id=trace_id,
+        parent_span_id=parent_span_id,
+    )
+    if span is not None:
+        span.annotate(
+            f"steps={steps} width={width} index={own_index} "
+            f"parties={party_ids}"
+        )
+    return span
+
+
+def _end_session_span(span, error_code: int = 0) -> None:
+    from incubator_brpc_tpu.builtin.rpcz import end_custom_span
+
+    end_custom_span(span, error_code=error_code)
+
+
+def _run_observed_session(span, party_ids, own_index, steps, width, seed):
+    """run_collective_session under span/counter bookkeeping: a raise
+    counts one error and closes the span with EINTERNAL (shared by the
+    handler and proposer parties); the SUCCESS close stays with the
+    caller, which may have more to do before the span ends."""
+    try:
+        return run_collective_session(party_ids, own_index, steps, width, seed)
+    except Exception:
+        collective_errors << 1
+        from incubator_brpc_tpu.utils.status import ErrorCode
+
+        _end_session_span(span, error_code=ErrorCode.EINTERNAL)
+        raise
 
 
 def _devices_by_id(ids: List[int]):
@@ -111,6 +171,9 @@ def run_collective_session(
             own = np.asarray(s.data).reshape(-1)
     elapsed = time.perf_counter() - t0
     assert own is not None
+    collective_sessions << 1
+    collective_steps << steps
+    collective_session_us << elapsed * 1e6
     return own, elapsed
 
 
@@ -151,15 +214,23 @@ def make_collective_handler(server):
                 ErrorCode.EREQUEST, "collective proposal out of bounds"
             )
             return b""
+        # the session span lands in the PROPOSING client's trace: the
+        # trace/span ids arrived in the request meta (baidu_std-style
+        # Dapper propagation) and are already on the controller
+        span = _start_session_span(
+            party_ids, own_index, steps, width,
+            trace_id=cntl.trace_id, parent_span_id=cntl.span_id,
+        )
         # Liveness: a party that never joins stalls the rendezvous until
         # the collective backend's own timeout errors the chain (gloo on
         # the CPU fabric; the coordination service reports dead PROCESSES
         # group-wide) — the raise lands here and answers EINTERNAL. A
         # live-but-declining peer is caught on the client by the
         # pre-session grace check in propose_collective.
-        own, elapsed = run_collective_session(
-            party_ids, own_index, steps, width, seed
+        own, elapsed = _run_observed_session(
+            span, party_ids, own_index, steps, width, seed
         )
+        _end_session_span(span)
         return json.dumps(
             {
                 "checksum": float(np.sum(own, dtype=np.float64)),
@@ -233,9 +304,11 @@ def propose_collective(
                     f"collective proposal rejected: {cntl.error_text}"
                 )
         time.sleep(0.02)
-    own, elapsed = run_collective_session(
-        party_ids, client_index, steps, width, seed
+    span = _start_session_span(party_ids, client_index, steps, width)
+    own, elapsed = _run_observed_session(
+        span, party_ids, client_index, steps, width, seed
     )
+    _end_session_span(span)
     checksums = []
     deadline = time.monotonic() + timeout_ms / 1000.0  # shared, not per-peer
     for cntl, ev in pending:

@@ -264,19 +264,49 @@ def pack_frame_iobuf(
     return buf
 
 
-@dataclass
 class ParsedFrame:
-    meta: Meta
-    payload: bytes
-    attachment: bytes
-    correlation_id: int
-    flags: int
-    error_code: int
-    # stream data frames keep their body as a zero-copy IOBuf cut of the
-    # read chain (the reference hands stream handlers butil::IOBufs,
-    # stream.h on_received_messages): None on every other frame kind, and
-    # on the pure-python parse path (which already materialized bytes)
-    payload_iobuf: object = None
+    """One cut frame. Stream data frames keep their body as a zero-copy
+    IOBuf cut of the read chain (the reference hands stream handlers
+    butil::IOBufs, stream.h on_received_messages): ``payload_iobuf`` is
+    None on every other frame kind, and on the pure-python parse path
+    (which already materialized bytes).
+
+    ``payload`` is LAZY: when only ``payload_iobuf`` was populated (the
+    stream fast path), the bytes materialize from it on first access — a
+    non-stream consumer of a FLAG_STREAM frame (a raw
+    user_message_handler, byte accounting) sees the real payload instead
+    of silently reading b"" once rpc.stream is imported anywhere in the
+    process (ADVICE r5). The stream layer itself reads ``payload_iobuf``
+    directly and never pays the copy."""
+
+    def __init__(
+        self,
+        meta: Meta,
+        payload: bytes = b"",
+        attachment: bytes = b"",
+        correlation_id: int = 0,
+        flags: int = 0,
+        error_code: int = 0,
+        payload_iobuf: object = None,
+    ) -> None:
+        self.meta = meta
+        self._payload = payload
+        self.attachment = attachment
+        self.correlation_id = correlation_id
+        self.flags = flags
+        self.error_code = error_code
+        self.payload_iobuf = payload_iobuf
+
+    @property
+    def payload(self) -> bytes:
+        if not self._payload and self.payload_iobuf is not None:
+            # materialize once, cache; to_bytes is a non-destructive copy
+            self._payload = self.payload_iobuf.to_bytes()
+        return self._payload
+
+    @payload.setter
+    def payload(self, value: bytes) -> None:
+        self._payload = value
 
     @property
     def is_response(self) -> bool:
@@ -285,6 +315,19 @@ class ParsedFrame:
     @property
     def is_stream(self) -> bool:
         return bool(self.flags & FLAG_STREAM)
+
+    def __repr__(self) -> str:
+        return (
+            f"<ParsedFrame {self.meta.service}.{self.meta.method} "
+            f"cid={self.correlation_id:#x} flags={self.flags} "
+            f"err={self.error_code} payload={len(self._payload)}B"
+            + (
+                f" iobuf={len(self.payload_iobuf)}B"
+                if self.payload_iobuf is not None
+                else ""
+            )
+            + ">"
+        )
 
 
 class ParseError(Exception):
@@ -420,9 +463,10 @@ def parse_frame_iobuf(buf, max_total: Optional[int] = None) -> Tuple[Optional[Pa
         # handlers zero-copy (or materializes at consumption for the
         # default bytes contract). Saves one full-payload copy per
         # message on the stream hot path. Gated on the stream layer being
-        # REGISTERED: any other consumer of a FLAG_STREAM frame (a raw
-        # user_message_handler, a deployment that never imported
-        # rpc.stream) reads frame.payload and must keep getting bytes.
+        # REGISTERED (a deployment that never imported rpc.stream keeps
+        # the eager path), and ParsedFrame.payload materializes lazily
+        # from payload_iobuf anyway — a non-stream consumer of this frame
+        # still reads the real bytes, it just pays the copy it needs.
         frame = ParsedFrame(
             meta=meta,
             payload=b"",

@@ -49,6 +49,41 @@ from incubator_brpc_tpu.utils.status import ErrorCode, berror
 logger = logging.getLogger(__name__)
 
 
+_warned_distributed_probe = False
+
+
+def _jax_distributed_initialized() -> bool:
+    """True when this process joined a ``jax.distributed`` group — the
+    deployment where cross-process collective sessions are meaningful.
+    Probes the coordination-service client only; never initializes a
+    backend (Server.start must stay cheap for pure-host servers)."""
+    import sys
+
+    if "jax" not in sys.modules:
+        # jax.distributed cannot have been initialized without importing
+        # jax — and importing it here would cost seconds of startup (and
+        # can raise on a misconfigured accelerator runtime)
+        return False
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client is not None
+    except (ImportError, AttributeError):
+        # private-API layout drift in a jax upgrade: don't silently strip
+        # the collective service from real distributed deployments — warn
+        # so the operator knows to pin enable_collective_service=True
+        global _warned_distributed_probe
+        if not _warned_distributed_probe:
+            _warned_distributed_probe = True
+            logger.warning(
+                "jax.distributed probe failed (private API moved?); "
+                "collective service auto-enable is off — set "
+                "ServerOptions(enable_collective_service=True) to force it",
+                exc_info=True,
+            )
+        return False
+
+
 class MethodStatus:
     """Per-method concurrency gate + latency stats
     (details/method_status.h:28,90-97: _nprocessing fetch_add vs
@@ -182,6 +217,8 @@ class ServerOptions:
         reserved_session_local_data: int = 0,
         thread_local_data_factory=None,
         reserved_thread_local_data: int = 0,
+        enable_collective_service: Optional[bool] = None,
+        collective_max_concurrency: int = 1,
     ):
         self.max_concurrency = max_concurrency
         self.method_max_concurrency = method_max_concurrency
@@ -231,6 +268,17 @@ class ServerOptions:
         # first thread_local_data() there, destroyed at server stop.
         self.thread_local_data_factory = thread_local_data_factory
         self.reserved_thread_local_data = reserved_thread_local_data
+        # Serve ``_tpu_transport.collective`` session proposals
+        # (parallel/mc_collective.py). A session pins a device for its
+        # whole step chain, so exposing it to any connected client is a
+        # resource-exhaustion surface (ADVICE r5): None (default) enables
+        # it only when this process joined a jax.distributed group — the
+        # deployment that needs it; True/False force it on/off.
+        self.enable_collective_service = enable_collective_service
+        # per-method admission limit for the collective handler (0 =
+        # unlimited); sessions beyond it are refused with ELIMIT instead
+        # of stacking device work behind a wedged chain
+        self.collective_max_concurrency = collective_max_concurrency
         # Run request processing (cut + handler) inline on the reactor
         # thread instead of a pool fiber — removes two thread handoffs per
         # request, the analog of the reference running user code directly
@@ -460,20 +508,41 @@ class Server:
         )
 
         # cross-process collective sessions share the transport service
-        # (parallel/mc_collective.py; meaningful under jax.distributed)
-        from incubator_brpc_tpu.parallel.mc_collective import (
-            COLLECTIVE_METHOD,
-            make_collective_handler,
-        )
-
-        co = f"{HANDSHAKE_SERVICE}.{COLLECTIVE_METHOD}"
-        if co not in self._methods:
-            self._methods.insert(
-                co,
-                MethodProperty(
-                    make_collective_handler(self), MethodStatus(co, 0), co
-                ),
+        # (parallel/mc_collective.py) — OPT-IN: registered only when the
+        # options ask for it, or by default when this process is part of a
+        # jax.distributed group (the only deployment where a session can
+        # rendezvous), and always behind a per-method concurrency limit
+        enable_co = self.options.enable_collective_service
+        if enable_co is None:
+            enable_co = _jax_distributed_initialized()
+            if not enable_co:
+                # the probe runs ONCE, at start: a process that joins its
+                # jax.distributed group after starting the server must
+                # pass enable_collective_service=True explicitly
+                logger.debug(
+                    "collective service not registered (no jax.distributed "
+                    "group at Server.start; set ServerOptions("
+                    "enable_collective_service=True) to force it)"
+                )
+        if enable_co:
+            from incubator_brpc_tpu.parallel.mc_collective import (
+                COLLECTIVE_METHOD,
+                make_collective_handler,
             )
+
+            co = f"{HANDSHAKE_SERVICE}.{COLLECTIVE_METHOD}"
+            if co not in self._methods:
+                self._methods.insert(
+                    co,
+                    MethodProperty(
+                        make_collective_handler(self),
+                        MethodStatus(
+                            co,
+                            max(0, self.options.collective_max_concurrency),
+                        ),
+                        co,
+                    ),
+                )
         hs = f"{HANDSHAKE_SERVICE}.{HANDSHAKE_METHOD}"
         if hs not in self._methods:
             self._methods.insert(
@@ -780,12 +849,39 @@ class Server:
         if cntl._span is not None:
             cntl._span.annotate("processing")
 
-        # wire the async-response closure before running user code
+        # wire the async-response closure before running user code. The
+        # closure finishes AT MOST ONCE: the async-reap timer below and a
+        # late (or duplicate) send_response from the handler must not both
+        # release the admission slot / session refcount.
         cntl._async = False
         cntl.set_async = lambda: setattr(cntl, "_async", True)
-        cntl.send_response = lambda response=b"": self._finish(
-            sock, cntl, response, status
-        )
+        finish_lock = threading.Lock()
+        cntl._finish_done = False
+
+        def _claim_finish() -> bool:
+            """True exactly once: the caller that wins owns the finish.
+            The reap claims BEFORE touching cntl, so it can never mutate
+            a controller whose timely response is being serialized."""
+            with finish_lock:
+                if cntl._finish_done:
+                    return False
+                cntl._finish_done = True
+                return True
+
+        def _finish_once(response: bytes = b"") -> None:
+            if _claim_finish():
+                self._finish(sock, cntl, response, status)
+
+        cntl.send_response = _finish_once
+
+        def _reap_unanswered(timeout: float) -> None:
+            if not _claim_finish():
+                return  # answered in time: nothing to do
+            cntl.set_failed(
+                ErrorCode.ERPCTIMEDOUT,
+                f"async handler sent no response within {timeout:g}s",
+            )
+            self._finish(sock, cntl, b"", status)
         self._session_handler_enter(sock)
         cntl._session_entered = True  # paired in _finish
         _prev_server = getattr(_usercode_tls, "server", None)
@@ -804,12 +900,63 @@ class Server:
 
             clear_parent_span(cntl._span)
         if cntl._async and not cntl.failed():
-            return  # handler owns the response now
-        self._finish(sock, cntl, response or b"", status)
+            # handler owns the response now — but bound how long it can
+            # hold the admission slot and session refcount (a handler
+            # that never responds would otherwise leak both forever —
+            # the gateway path's async timeout, mirrored; ADVICE r5)
+            self._watch_async_response(cntl, _reap_unanswered)
+            return
+        _finish_once(response or b"")
+
+    def _watch_async_response(self, cntl: Controller, reap) -> None:
+        """Arm the async-response reap: after ``async_response_timeout_s``
+        an unanswered async binary RPC is failed with ERPCTIMEDOUT through
+        ``reap`` (which claims the once-only finish first), releasing its
+        admission slot, session-handler refcount, and rpcz span."""
+        from incubator_brpc_tpu.runtime.timer_thread import global_timer_thread
+        from incubator_brpc_tpu.runtime.worker_pool import global_worker_pool
+        from incubator_brpc_tpu.utils.flags import get_flag
+
+        timeout = float(get_flag("async_response_timeout_s"))
+        if timeout <= 0:
+            return  # operator disabled the reap
+        if cntl._finish_done:
+            # a fast async handler already responded on another thread —
+            # arming now would pin cntl (payload, sock) until the timer
+            # fires just to no-op; the residual arm-vs-finish race is
+            # closed by the claim check at fire time
+            return
+
+        # the reap does socket writes + hook callbacks: too heavy for the
+        # shared TimerThread, so the timer only spawns (as _reap_idle does)
+        # — and only for RPCs still unanswered, so a burst of well-behaved
+        # async handlers doesn't turn into a burst of no-op fibers later
+        def _maybe_spawn_reap() -> None:
+            if not cntl._finish_done:
+                global_worker_pool().spawn(lambda: reap(timeout))
+
+        cntl._reap_timer_id = global_timer_thread().schedule(
+            _maybe_spawn_reap, delay=timeout
+        )
 
     def _finish(
         self, sock, cntl: Controller, response: bytes, status: Optional[MethodStatus]
     ) -> None:
+        # a finished RPC must not stay pinned by its armed reap timer
+        # (the timer entry holds cntl -> payload/sock for the full
+        # async_response_timeout_s otherwise); best-effort — a timer
+        # armed after a racing early send_response just no-ops at fire
+        tid = getattr(cntl, "_reap_timer_id", None)
+        if tid is not None:
+            cntl._reap_timer_id = None
+            from incubator_brpc_tpu.runtime.timer_thread import (
+                global_timer_thread,
+            )
+
+            try:
+                global_timer_thread().unschedule(tid)
+            except Exception:
+                pass
         if getattr(cntl, "_session_entered", False):
             cntl._session_entered = False
             self._session_handler_exit(sock)

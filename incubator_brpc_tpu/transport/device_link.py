@@ -45,12 +45,13 @@ import itertools
 import json
 import logging
 import threading
+import time
 from collections import deque
 from typing import Dict, List, Optional
 
 import numpy as np
 
-from incubator_brpc_tpu.bvar import Adder
+from incubator_brpc_tpu.bvar import Adder, LatencyRecorder, PerSecond
 from incubator_brpc_tpu.runtime.butex import Butex, ETIMEDOUT
 from incubator_brpc_tpu.runtime.device_butex import DeviceCompletionButex
 from incubator_brpc_tpu.runtime.worker_pool import global_worker_pool
@@ -73,6 +74,11 @@ HANDSHAKE_METHOD = "handshake"
 link_steps = Adder(name="device_link_steps")
 link_bytes = Adder(name="device_link_bytes")
 link_acks = Adder(name="device_link_ack_steps")  # wire-mode catch-up steps
+link_errors = Adder(name="device_link_errors")  # fail() calls, all links
+# send() attempts refused with EOVERCROWDED after a full window-stall wait
+link_overcrowded = Adder(name="device_link_overcrowded")
+
+_link_ids = itertools.count(1)  # per-link bvar namespace: device_link_<n>_*
 
 # Every live link, for the interpreter-exit quiesce: a teardown-triggered
 # close frame dispatches one final exchange step on a worker fiber; if the
@@ -174,9 +180,52 @@ class DeviceLink:
         self._cq = DeviceCompletionButex()
         self.socks: List[Optional["DeviceSocket"]] = [None, None]
         self._pool = global_worker_pool()
+        # -- per-link instrumentation (scraped at /brpc_metrics): the
+        # observable face of bench.py's link_stream_gbps — rtt per exchange
+        # step (dispatch -> in-order delivery), flush = the staging gather
+        # into a slot, pump = feeding delivered bytes into the messenger,
+        # plus bytes-per-second windows each way. Retired (hidden from the
+        # registry) when the link dies so churning links don't accumulate.
+        self.link_id = next(_link_ids)
+        pfx = f"device_link_{self.link_id}"
+        self._m_out_bytes = Adder()
+        self._m_in_bytes = Adder()
+        self._m_rtt = LatencyRecorder(name=f"{pfx}_step_rtt_us")
+        self._m_flush = LatencyRecorder(name=f"{pfx}_flush_us")
+        self._m_pump = LatencyRecorder(name=f"{pfx}_pump_us")
+        self._m_out_rate = PerSecond(self._m_out_bytes, name=f"{pfx}_out_bytes_second")
+        self._m_in_rate = PerSecond(self._m_in_bytes, name=f"{pfx}_in_bytes_second")
+        self._metrics_retired = False
+        self._step_ts: Dict[int, float] = {}  # seq -> dispatch perf_counter
         self._build_step()
         with _links_lock:
             _all_links.add(self)
+
+    def _retire_metrics(self) -> None:
+        """Drop this link's names from the expose registry (terminal).
+        The aggregate device_link_* counters live on."""
+        if self._metrics_retired:
+            return
+        self._metrics_retired = True
+        for v in (
+            self._m_rtt, self._m_flush, self._m_pump,
+            self._m_out_rate, self._m_in_rate,
+        ):
+            try:
+                v.hide()
+            except Exception:
+                pass
+
+    def _maybe_retire_metrics(self) -> None:
+        """Clean-close path: the base link never reaches fail() on an
+        orderly ECLOSE dance, so once every handshaken side's socket has
+        left CONNECTED the link carries no more traffic — drop its names
+        then too (churning links must not accumulate registry entries)."""
+        from incubator_brpc_tpu.transport.sock import CONNECTED
+
+        socks = [s for s in self.socks if s is not None]
+        if socks and all(s.state != CONNECTED for s in socks):
+            self._retire_metrics()
 
     # -- the ICI primitive ---------------------------------------------------
 
@@ -291,6 +340,7 @@ class DeviceLink:
                 deadline = _time.monotonic() + (timeout if timeout else 10.0)
             remaining = deadline - _time.monotonic()
             if remaining <= 0:
+                link_overcrowded << 1
                 return ErrorCode.EOVERCROWDED
             self._wbutex.wait(seq, timeout=remaining)
         self._kick()
@@ -366,6 +416,7 @@ class DeviceLink:
                     seq = self._seq
                     self._seq += 1
                     self._inflight += 1
+                    self._step_ts[seq] = time.perf_counter()
             if need is not None:
                 if self.ack_mode == "wire":
                     self._wbutex.wait(need, timeout=1.0)
@@ -413,6 +464,7 @@ class DeviceLink:
         np.empty, not np.zeros: the receiver only reads ``used`` bytes,
         so a full-slot memset per step would touch every byte twice
         (VERDICT r3 weak #5); only the header words are written below."""
+        t0 = time.perf_counter()
         row = np.empty(self._width, dtype=np.uint32)
         rb = row.view(np.uint8)
         used = 0
@@ -456,6 +508,8 @@ class DeviceLink:
         row[4] = flags
         if used:
             link_bytes << used
+            self._m_out_bytes << used
+        self._m_flush << (time.perf_counter() - t0) * 1e6
         return row
 
     # -- receive side --------------------------------------------------------
@@ -483,12 +537,18 @@ class DeviceLink:
                     arrays = self._reorder.pop(self._next_deliver, None)
                     if arrays is None:
                         return
+                    dispatched_at = self._step_ts.pop(self._next_deliver, None)
                     self._next_deliver += 1
                 self._deliver_tid = threading.get_ident()
+                t0 = time.perf_counter()
                 try:
                     self._deliver(arrays)
                 finally:
                     self._deliver_tid = None
+                    now = time.perf_counter()
+                    self._m_pump << (now - t0) * 1e6
+                    if dispatched_at is not None:
+                        self._m_rtt << (now - dispatched_at) * 1e6
             with self._lock:
                 self._inflight -= 1
             self._wbutex.add(1)
@@ -531,6 +591,8 @@ class DeviceLink:
                     if ack > self._peer_ack:
                         self._peer_ack = ack
             sock = self.socks[side]
+            if used:
+                self._m_in_bytes << used
             if used and sock is not None:
                 # ZERO-copy delivery: the read IOBuf's block wraps the step
                 # output's own buffer (external block + release-cb — the
@@ -552,6 +614,9 @@ class DeviceLink:
             for side in (0, 1):
                 self._out[side].clear()
                 self._out_nbytes[side] = 0
+            self._step_ts.clear()
+        link_errors << 1
+        self._retire_metrics()
         self._wbutex.add(1)
         self._wbutex.wake_all()
         for sock in self.socks:
@@ -663,6 +728,7 @@ class DeviceSocket:
                 cb(self)
             except Exception:
                 logger.exception("device socket on_failed raised")
+        self.link._maybe_retire_metrics()
         return True
 
     def recycle(self) -> None:

@@ -70,6 +70,10 @@ from incubator_brpc_tpu.utils.status import ErrorCode
 logger = logging.getLogger(__name__)
 
 mc_ctrl_msgs = Adder(name="mc_link_control_msgs")
+# completion waits that made no progress (peer slow / not yet dispatching):
+# each tick is one bounded 1 s retry before the wedge timer would fire
+mc_stall_retries = Adder(name="mc_link_stall_retries")
+mc_wedge_failures = Adder(name="mc_link_wedge_failures")
 
 
 class MultiControllerLink(DeviceLink):
@@ -250,6 +254,9 @@ class MultiControllerLink(DeviceLink):
                         seq = self._seq
                         self._seq += 1
                         self._inflight += 1
+                        # feeds the step_rtt_us summary exactly like the
+                        # base _drive: popped at in-order delivery
+                        self._step_ts[seq] = _time.perf_counter()
             if finish:
                 self._finish_close()
                 return
@@ -261,10 +268,12 @@ class MultiControllerLink(DeviceLink):
                     # dispatching (died mid-burst). Gloo/XLA eventually
                     # error the half-joined collective; this timer bounds
                     # the wait even if the backend blocks silently.
+                    mc_stall_retries << 1
                     now = _time.monotonic()
                     if stall_since is None:
                         stall_since = now
                     elif now - stall_since > self.wedge_timeout:
+                        mc_wedge_failures << 1
                         self.fail(
                             "device plane wedged (peer not dispatching)"
                         )
@@ -300,6 +309,7 @@ class MultiControllerLink(DeviceLink):
                 return
             self._finished = True
             self._closed = True
+        self._retire_metrics()  # clean close never reaches fail()
         sock = self.socks[self.own_side]
         if sock is not None:
             sock.set_failed(ErrorCode.ECLOSE, "device link closed")

@@ -25,9 +25,16 @@ import ctypes
 import logging
 import socket as _pysocket
 import threading
+import time
 from typing import Dict, Optional
 
 from incubator_brpc_tpu import native
+from incubator_brpc_tpu.bvar import (
+    Adder,
+    IntRecorder,
+    LatencyRecorder,
+    PassiveStatus,
+)
 from incubator_brpc_tpu.native import CLOSED_FN, FRAME_FN, HANDOFF_FN, LIB
 from incubator_brpc_tpu.utils.endpoint import EndPoint
 from incubator_brpc_tpu.utils.status import ErrorCode
@@ -42,6 +49,15 @@ KIND_NOP = 2
 # flags mirrored from protocol/tbus_std.py (also in tbnet.cc)
 _FLAG_RESPONSE = 1
 _FLAG_STREAM = 2
+
+# client fast-path instrumentation: per-call round-trip latency (Python
+# boundary included — the L5 crossing rpc_echo_us measures), transport
+# errors, and the pipelined pump's ns/request (bench.py's native_pump_ns,
+# now scrapeable from /brpc_metrics on any process that ran a pump)
+native_client_calls = Adder(name="native_client_calls")
+native_client_errors = Adder(name="native_client_errors")
+native_client_call_us = LatencyRecorder(name="native_client_call_us")
+native_pump_ns = IntRecorder(name="native_pump_ns")
 
 
 def _native_kind(handler) -> Optional[int]:
@@ -183,6 +199,10 @@ class NativeServerPlane:
         if not NET_AVAILABLE:
             raise RuntimeError("native plane unavailable")
         self._server = server
+        # serializes the tb_server_stats native read against destroy: a
+        # /brpc_metrics scrape snapshots the expose registry before stop()
+        # hides the per-port gauges, so stats() can race tb_server_destroy
+        self._stats_lock = threading.Lock()
         self._srv = LIB.tb_server_create(nloops)
         from incubator_brpc_tpu.utils.flags import get_flag
 
@@ -276,6 +296,17 @@ class NativeServerPlane:
         if rc < 0:
             raise OSError(-rc, "tb_server_listen failed")
         self.port = rc
+        # surface the C++ plane's counters as bvars (scraped from
+        # /brpc_metrics and /vars like everything else); port-scoped names
+        # since one process may run several native planes. Hidden at stop.
+        self._m_stats = [
+            PassiveStatus(
+                (lambda _k=k: self.stats()[_k]),
+                name=f"native_plane_{self.port}_{k}",
+            )
+            for k in ("accepted", "native_reqs", "cb_frames", "handoffs",
+                      "live_conns")
+        ]
         return rc
 
     # -- callbacks from loop threads --------------------------------------
@@ -394,6 +425,11 @@ class NativeServerPlane:
         if self._stopped:
             return
         self._stopped = True
+        for v in getattr(self, "_m_stats", []):
+            try:
+                v.hide()  # free the port-scoped names for the next plane
+            except Exception:
+                pass
         # stop joins the loop threads, so no callback can be in flight when
         # destroy frees the epoll/event fds and the method table
         LIB.tb_server_stop(self._srv)
@@ -410,24 +446,31 @@ class NativeServerPlane:
             socks, self._socks = list(self._socks.values()), {}
         for s in socks:
             s._mark_closed()
-        srv, self._srv = self._srv, None
+        with self._stats_lock:
+            srv, self._srv = self._srv, None
         LIB.tb_server_destroy(srv)
 
     def stats(self) -> Dict[str, int]:
-        if self._srv is None:
-            return getattr(
-                self,
-                "_final_stats",
-                dict.fromkeys(
-                    ("accepted", "native_reqs", "cb_frames", "handoffs",
-                     "live_conns"),
-                    0,
-                ),
-            )
-        vals = [ctypes.c_uint64() for _ in range(5)]
-        LIB.tb_server_stats(self._srv, *[ctypes.byref(v) for v in vals])
-        keys = ("accepted", "native_reqs", "cb_frames", "handoffs", "live_conns")
-        return dict(zip(keys, (v.value for v in vals)))
+        with self._stats_lock:
+            if self._srv is not None:
+                vals = [ctypes.c_uint64() for _ in range(5)]
+                LIB.tb_server_stats(
+                    self._srv, *[ctypes.byref(v) for v in vals]
+                )
+                keys = (
+                    "accepted", "native_reqs", "cb_frames", "handoffs",
+                    "live_conns",
+                )
+                return dict(zip(keys, (v.value for v in vals)))
+        return getattr(
+            self,
+            "_final_stats",
+            dict.fromkeys(
+                ("accepted", "native_reqs", "cb_frames", "handoffs",
+                 "live_conns"),
+                0,
+            ),
+        )
 
     def connection_count(self) -> int:
         with self._socks_lock:
@@ -518,6 +561,7 @@ class NativeClientChannel:
                 meta_out = tls.meta_out = ctypes.create_string_buffer(64 * 1024)
                 meta_len = tls.meta_len = ctypes.c_uint32(0)
                 err_code = tls.err_code = ctypes.c_uint32(0)
+            t0 = time.perf_counter()
             rc = LIB.tb_channel_call(
                 self._ch,
                 meta,
@@ -534,6 +578,11 @@ class NativeClientChannel:
                 ctypes.byref(err_code),
                 int(timeout_ms) if timeout_ms and timeout_ms > 0 else 0,
             )
+            native_client_calls << 1
+            if rc < 0:
+                native_client_errors << 1
+            else:
+                native_client_call_us << (time.perf_counter() - t0) * 1e6
             # string_at copies meta_len bytes; .raw[:n] would materialize
             # the whole 64 KiB scratch per call
             resp_meta = (
@@ -577,7 +626,9 @@ class NativeClientChannel:
                 timeout_ms,
             )
             if rc < 0:
+                native_client_errors << 1
                 raise OSError(-rc, "native pump failed")
+            native_pump_ns << int(rc)
             return float(rc)
         finally:
             destroy = False

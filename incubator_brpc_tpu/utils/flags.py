@@ -122,6 +122,15 @@ define_flag(
     "how long the http->rpc gateway waits for an async handler",
     lambda v: v > 0,
 )
+define_flag(
+    "async_response_timeout_s",
+    30.0,
+    "fail a binary-path async handler (cntl.set_async) that has not sent "
+    "its response after this long, releasing its admission slot and "
+    "pooled session data (the gateway's async-timeout, applied to the "
+    "binary path); 0 disables the reap",
+    lambda v: v >= 0,
+)
 define_flag("rpcz_keep_span_seconds", 1800, "span retention", lambda v: v > 0)
 define_flag("rpcz_max_spans", 10000, "max spans retained in memory", lambda v: v > 0)
 define_flag(

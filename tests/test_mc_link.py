@@ -11,13 +11,35 @@ peers) with per-host device init (rdma_helper.cpp).
 
 from __future__ import annotations
 
+import pytest
+
 from incubator_brpc_tpu.transport.mc_worker import orchestrate_pair
 
+# jaxlib refuses multi-process computations on some backends (CPU builds
+# without cross-host collectives raise this in every worker): when the
+# probe run dies on it, every pairing in this module would burn its full
+# handshake deadline the same way — skip them fast instead
+_FABRIC_UNSUPPORTED = "Multiprocess computations aren't implemented"
 
-def test_two_process_echo():
+
+@pytest.fixture(scope="module")
+def fabric_pair():
+    """One two-process run, shared by the module: its stats back
+    test_two_process_echo, and its failure mode gates everything else —
+    a backend that cannot run multi-process computations at all fails
+    each orchestration only after minutes of deadline."""
+    try:
+        return orchestrate_pair()
+    except AssertionError as e:
+        if _FABRIC_UNSUPPORTED in str(e):
+            pytest.skip(f"jax backend: {_FABRIC_UNSUPPORTED}")
+        raise
+
+
+def test_two_process_echo(fabric_pair):
     """RPCs echo across processes over the device plane; the cross-host
     wire acks advance; the close dance quiesces both sides cleanly."""
-    stats, _, _ = orchestrate_pair()
+    stats, _, _ = fabric_pair
     assert stats["n_rpcs"] == 8
     assert stats["peer_ack"] > 0
     assert stats["steps"] >= stats["n_rpcs"]
@@ -26,7 +48,7 @@ def test_two_process_echo():
     assert len(set(stats["devices"])) == 2
 
 
-def test_two_process_windowed_burst():
+def test_two_process_windowed_burst(fabric_pair):
     """Payloads spanning many slots under a small window: the lockstep
     credit (own undrained completions) must pipeline without deadlock and
     without corrupting the re-cut byte stream."""
@@ -43,7 +65,7 @@ def test_two_process_windowed_burst():
     assert stats["peer_ack"] > 0
 
 
-def test_three_process_fabric():
+def test_three_process_fabric(fabric_pair):
     """Client + TWO server processes in one jax.distributed group: a
     PartitionChannel fans each call over two cross-process device links —
     the client device holds a star of lockstep sub-meshes (the N-party
@@ -58,7 +80,7 @@ def test_three_process_fabric():
     assert all(l["peer_ack"] > 0 for l in stats["links"])
 
 
-def test_peer_death_fails_link_fast():
+def test_peer_death_fails_link_fast(fabric_pair):
     """A server process that vanishes mid-traffic (os._exit in a handler,
     no goodbye on any plane) must fail the client's link promptly — via
     the host socket under the control stream, not a wedge timeout — and
@@ -77,7 +99,7 @@ def test_peer_death_fails_link_fast():
     assert "SERVER_DYING" in transcript
 
 
-def test_three_process_collective_session():
+def test_three_process_collective_session(fabric_pair):
     """The pipelined cross-process collective: scheduled once over the
     host plane, K lockstep pmean steps across three processes' devices
     with operands resident on-device through the chain. Every party must

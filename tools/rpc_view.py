@@ -13,13 +13,157 @@ Two modes:
   Print rpc_dump sample files:
     python tools/rpc_view.py ./rpc_dump/requests.1234.0000
     python tools/rpc_view.py --service users --method get --json dump.0000
+
+  Scrape /brpc_metrics and pretty-print the delta between two scrapes
+  (the poor man's rpc_press dashboard — counters as rates, gauges and
+  summary quantiles as current values):
+    python tools/rpc_view.py --metrics --target 127.0.0.1:8000
+    python tools/rpc_view.py --metrics --target 127.0.0.1:8000 \
+        --interval 5 --prefix method_
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
+import time
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*(?:\{[^}]*\})?) (-?\d+(?:\.\d+)?"
+    r"(?:[eE][+-]?\d+)?|[+-]Inf|NaN)$"
+)
+
+
+def parse_exposition(text: str):
+    """Prometheus text exposition -> ({series_key: float}, {name: type}).
+    A series key is the metric name plus its label set verbatim
+    (``m{quantile="0.99"}``); types come from the ``# TYPE`` comments."""
+    values = {}
+    types = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) == 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        values[m.group(1)] = float(
+            m.group(2).replace("Inf", "inf").replace("NaN", "nan")
+        )
+    return values, types
+
+
+def _series_base(key: str) -> str:
+    return key.partition("{")[0]
+
+
+def _is_counterish(key: str, types: dict) -> bool:
+    """counter samples and summary _sum/_count accumulate: show as rates."""
+    base = _series_base(key)
+    if types.get(base) == "counter":
+        return True
+    for suffix in ("_sum", "_count"):
+        if base.endswith(suffix) and types.get(base[: -len(suffix)]) == "summary":
+            return True
+    return False
+
+
+def metrics_delta_lines(before, after, types, seconds: float):
+    """Human-readable rows for every series whose value changed between
+    two scrapes (counter-ish series get a +delta and a per-second rate),
+    plus quantile lines of any summary that saw traffic."""
+    out = []
+    changed_summaries = set()
+    for key in sorted(after):
+        base = _series_base(key)
+        if types.get(base) == "summary":
+            continue  # quantile lines: shown with their summary below
+        old = before.get(key)
+        new = after[key]
+        if old is not None and old == new:
+            continue
+        if _is_counterish(key, types):
+            delta = new - (old or 0.0)
+            rate = delta / seconds if seconds > 0 else 0.0
+            out.append(
+                f"{key} {_num(old)} -> {_num(new)}  (+{_num(delta)}, "
+                f"{rate:.1f}/s)"
+            )
+            if base.endswith("_count"):
+                changed_summaries.add(base[: -len("_count")])
+        else:
+            out.append(f"{key} {_num(old)} -> {_num(new)}")
+    for key in sorted(after):
+        base = _series_base(key)
+        if "{" in key and base in changed_summaries:
+            out.append(f"{key} {_num(after[key])}")
+    return out
+
+
+def _num(v) -> str:
+    if v is None:
+        return "-"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))  # full precision: %g would round big counters
+    return f"{v:g}"
+
+
+def scrape_metrics(target: str, prefix: str = ""):
+    """One GET /brpc_metrics against host:port -> (values, types)."""
+    from incubator_brpc_tpu.protocol.http import http_call
+
+    host, _, port = target.rpartition(":")
+    path = "/brpc_metrics" + (f"?prefix={prefix}" if prefix else "")
+    status, _, body = http_call(host, int(port), path, timeout=15)
+    if status != 200:
+        raise OSError(f"GET {path} -> {status}")
+    return parse_exposition(body.decode())
+
+
+def metrics_mode(target: str, interval: float, prefix: str = "") -> int:
+    host, _, port = target.rpartition(":")
+    if not host or not port.isdigit():
+        print(f"bad --target {target!r} (want host:port)", file=sys.stderr)
+        return 2
+    try:
+        before, types = scrape_metrics(target, prefix)
+    except OSError as e:
+        print(f"rpc_view: target {target} unreachable: {e}", file=sys.stderr)
+        return 1
+    if interval <= 0:
+        # single scrape: dump current values
+        for key in sorted(before):
+            print(f"{key} {_num(before[key])}")
+        print(f"# {len(before)} series from {target}")
+        return 0
+    t0 = time.monotonic()
+    time.sleep(interval)
+    try:
+        after, types2 = scrape_metrics(target, prefix)
+    except OSError as e:
+        print(
+            f"rpc_view: target {target} unreachable on second scrape: {e}",
+            file=sys.stderr,
+        )
+        return 1
+    # rates use the MEASURED window: the second scrape itself can take
+    # long enough (loaded server, big percentile reservoirs) to skew
+    # nominal-interval rates exactly when an operator is reading them
+    elapsed = time.monotonic() - t0
+    types.update(types2)
+    lines = metrics_delta_lines(before, after, types, elapsed)
+    print(f"# /brpc_metrics delta over {elapsed:.1f}s — {target}")
+    for line in lines:
+        print(line)
+    print(f"# {len(after)} series, {len(lines)} rows changed")
+    return 0
 
 
 def make_proxy_server(target: str):
@@ -134,15 +278,36 @@ def main(argv=None) -> int:
     p.add_argument("--method", help="only samples of this method")
     p.add_argument("--json", action="store_true", help="one JSON line per sample")
     p.add_argument("--serve", type=int, help="proxy mode: listen on this port")
-    p.add_argument("--target", help="proxy mode: host:port of the server to view")
+    p.add_argument(
+        "--target", help="proxy/metrics mode: host:port of the server"
+    )
+    p.add_argument(
+        "--metrics",
+        action="store_true",
+        help="scrape /brpc_metrics from --target and print the delta "
+        "between two scrapes (--interval apart; 0 = one scrape)",
+    )
+    p.add_argument(
+        "--interval",
+        type=float,
+        default=1.0,
+        help="metrics mode: seconds between the two scrapes",
+    )
+    p.add_argument(
+        "--prefix", default="", help="metrics mode: only metrics with this prefix"
+    )
     args = p.parse_args(argv)
 
+    if args.metrics:
+        if not args.target:
+            p.error("--metrics requires --target host:port")
+        return metrics_mode(args.target, args.interval, args.prefix)
     if args.serve is not None:
         if not args.target:
             p.error("--serve requires --target host:port")
         return serve_proxy(args.serve, args.target)
     if not args.paths:
-        p.error("give dump files, or --serve PORT --target HOST:PORT")
+        p.error("give dump files, or --serve/--metrics with --target")
     return print_dumps(args)
 
 
