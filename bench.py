@@ -287,6 +287,47 @@ def bench_native_plane(results: dict) -> None:
         results["native_echo_32k_gbps"] = 2 * len(big) / min(ns32)
     finally:
         nch.close()
+
+    # baidu_std (PRPC) on the SAME native plane: the canonical wire
+    # protocol cut, dispatched and packed in C++ (no interpreter on the
+    # hot path). rpc_echo_prpc_us crosses the Python L5 API over PRPC;
+    # prpc_pump_ns is the interpreter-free pipelined comparable for the
+    # reference's 200-300 ns/req single-thread baidu_std echo
+    # (docs/cn/benchmark.md:57) — the row that used to pay the 6-7x
+    # Python tax through the Socket reactor.
+    chp = Channel()
+    assert chp.init(
+        f"127.0.0.1:{server.port}",
+        options=ChannelOptions(native_plane=True, protocol="baidu_std"),
+    )
+    for _ in range(100):
+        c = chp.call_method("bench", "echo", payload)
+        assert c.ok(), c.error_text
+    lat = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            if chp.call_method("bench", "echo", payload).failed():
+                raise AssertionError("prpc echo failed mid-run")
+        lat.append((time.perf_counter() - t0) / n * 1e6)
+    _record("rpc_echo_prpc_us", lat)
+    results["rpc_echo_prpc_us"] = min(lat)
+
+    nchp = np_mod.NativeClientChannel(
+        "127.0.0.1", server.port, protocol="baidu_std"
+    )
+    try:
+        nchp.pump("bench", "echo", payload, 2000, inflight=64)  # warm
+        pump = [
+            nchp.pump("bench", "echo", payload, 100000, inflight=128)
+            for _ in range(5)
+        ]
+        _record("prpc_pump_ns", pump)
+        best = min(pump)
+        results["prpc_pump_ns"] = best
+        results["prpc_pump_qps"] = 1e9 / best
+    finally:
+        nchp.close()
     server.stop()
 
     # pooled multi-connection large payloads (the reference's headline
@@ -640,6 +681,14 @@ def main() -> None:
                     "rpc_echo_qps": round(results.get("rpc_echo_qps", 0)) or None,
                     "native_pump_ns": round(results.get("native_pump_ns", 0)) or None,
                     "native_pump_qps": round(results.get("native_pump_qps", 0)) or None,
+                    # baidu_std on the native plane (PRPC in C++ end to end)
+                    "rpc_echo_prpc_us": (
+                        round(results["rpc_echo_prpc_us"], 1)
+                        if "rpc_echo_prpc_us" in results
+                        else None
+                    ),
+                    "prpc_pump_ns": round(results.get("prpc_pump_ns", 0)) or None,
+                    "prpc_pump_qps": round(results.get("prpc_pump_qps", 0)) or None,
                     "native_echo_32k_gbps": (
                         round(results["native_echo_32k_gbps"], 3)
                         if "native_echo_32k_gbps" in results
@@ -705,6 +754,7 @@ def main() -> None:
                     "baselines": {
                         "large_frame": "brpc same-machine >=32KB multi-conn ~2.3 GB/s (docs/cn/benchmark.md:106); on-device HBM echo vs network loopback — not apples-to-apples",
                         "rpc_echo": "brpc single-thread echo 200-300 ns/req, 3-5 M qps/thread on 24 HT cores with client and server on separate cores (docs/cn/benchmark.md:57); native_pump_ns is the comparable (pipelined, no interpreter) with client AND server sharing this host's single core; rpc_echo_us crosses the Python L5 API into the native plane",
+                        "rpc_echo_prpc": "the canonical baidu_std wire on the native plane: brpc's headline 200-300 ns/req, 3-5 M qps/thread single-thread echo IS this protocol (docs/cn/benchmark.md:57); prpc_pump_ns is the interpreter-free comparable (client+server share one core here), rpc_echo_prpc_us crosses the Python L5 per call",
                         "native_echo_32k": "brpc same-machine >=32KB single-conn ~0.8 GB/s, multi-conn ~2.3 GB/s (docs/cn/benchmark.md:106); ours is one connection, bidirectional bytes",
                         "pooled_32k": "the reference's pooled multi-connection ~2.3 GB/s row: ours is 4 concurrent connections x 32 KiB echoes, bidirectional bytes, on one shared core",
                         "stream": "brpc same-machine single-conn ~0.8 GB/s (docs/cn/benchmark.md:106)",

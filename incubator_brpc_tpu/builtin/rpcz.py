@@ -189,6 +189,13 @@ def _new_id() -> int:
     return random.getrandbits(63) | 1
 
 
+def in_trace_context() -> bool:
+    """True when a server span is active on this thread — a cascaded
+    client call made here belongs to an observable trace, so its Dapper
+    ids must reach the wire even if this hop doesn't sample."""
+    return getattr(_tls, "parent_span", None) is not None
+
+
 def rpcz_enabled() -> bool:
     return bool(get_flag("enable_rpcz"))
 

@@ -271,6 +271,9 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
             [ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
              ctypes.POINTER(ctypes.c_int)],
         ),
+        # wire protocol: 0 = tbus_std (default), 1 = baidu_std (PRPC);
+        # must be set before the first send
+        "tb_channel_set_protocol": (ctypes.c_int, [b, ctypes.c_int]),
         "tb_channel_call": (
             ctypes.c_long,
             [

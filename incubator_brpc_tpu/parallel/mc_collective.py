@@ -42,6 +42,13 @@ logger = logging.getLogger(__name__)
 
 COLLECTIVE_METHOD = "collective"
 
+# How long propose_collective watches freshly-dispatched RUN proposals for
+# an instant bounce (admission ELIMIT from an overlapping session, a server
+# mid-stop) before entering its own session. The accept pre-ack already
+# covers validation rejections, so this only needs to span a local RPC
+# round trip — 10x under the old fixed 0.5 s grace window.
+_REJECT_WATCH_S = 0.05
+
 # session-level observability (ISSUE: the collective plane was blind):
 # every run_collective_session — proposer and server parties alike —
 # counts here and, when rpcz samples it, leaves one span in the proposing
@@ -214,6 +221,21 @@ def make_collective_handler(server):
                 ErrorCode.EREQUEST, "collective proposal out of bounds"
             )
             return b""
+        if req.get("phase") == "accept":
+            # Accept pre-ack (ADVICE r5): the proposer waits for every
+            # party's explicit accept BEFORE entering its own session,
+            # instead of burning a fixed grace window. Validation beyond
+            # the bounds above: every named device must be addressable in
+            # this process's global view, or the session could never
+            # rendezvous. Nothing is run or reserved here.
+            try:
+                _devices_by_id(party_ids)
+            except ValueError as e:
+                from incubator_brpc_tpu.utils.status import ErrorCode
+
+                cntl.set_failed(ErrorCode.EREQUEST, str(e))
+                return b""
+            return json.dumps({"accept": True, "index": own_index}).encode()
         # the session span lands in the PROPOSING client's trace: the
         # trace/span ids arrived in the request meta (baidu_std-style
         # Dapper propagation) and are already on the controller
@@ -225,8 +247,8 @@ def make_collective_handler(server):
         # the collective backend's own timeout errors the chain (gloo on
         # the CPU fabric; the coordination service reports dead PROCESSES
         # group-wide) — the raise lands here and answers EINTERNAL. A
-        # live-but-declining peer is caught on the client by the
-        # pre-session grace check in propose_collective.
+        # live-but-declining peer is caught on the client by the accept
+        # pre-ack phase in propose_collective.
         own, elapsed = _run_observed_session(
             span, party_ids, own_index, steps, width, seed
         )
@@ -267,43 +289,79 @@ def propose_collective(
     server_indexes = [i for i in range(len(party_ids)) if i != client_index]
     if len(server_indexes) != len(channels):
         raise ValueError("one channel per server party required")
-    pending = []
+
+    def proposal(idx: int, phase: str = "") -> bytes:
+        d = {
+            "parties": party_ids,
+            "index": idx,
+            "steps": steps,
+            "width": width,
+            "seed": seed,
+        }
+        if phase:
+            d["phase"] = phase
+        return json.dumps(d).encode()
+
+    # Phase 1 — explicit accept pre-ack from EVERY server (replaces the
+    # old fixed 0.5 s grace window, ADVICE r5): each party validates the
+    # proposal (fields, bounds, device visibility) and answers
+    # immediately, without running anything. A rejection surfaces here,
+    # BEFORE we enter our own session whose collective would wait on a
+    # party that never joins — and a clean accept set lets us proceed the
+    # moment the last ack lands instead of always burning 500 ms.
+    accepts = []
     for ch, idx in zip(channels, server_indexes):
-        payload = json.dumps(
-            {
-                "parties": party_ids,
-                "index": idx,
-                "steps": steps,
-                "width": width,
-                "seed": seed,
-            }
-        ).encode()
         cntl = Controller(timeout_ms=timeout_ms)
         ev = threading.Event()
-        # async: every party must be dispatching before any can finish —
-        # a sync proposal to server A would deadlock (A's collective
-        # blocks on parties that were never told to start)
         ch.call_method(
             HANDSHAKE_SERVICE,
             COLLECTIVE_METHOD,
-            payload,
+            proposal(idx, phase="accept"),
+            cntl=cntl,
+            done=lambda c, _ev=ev: _ev.set(),
+        )
+        accepts.append((cntl, ev))
+    accept_deadline = time.monotonic() + timeout_ms / 1000.0
+    for cntl, ev in accepts:
+        if not ev.wait(max(0.0, accept_deadline - time.monotonic())):
+            raise TimeoutError("collective peer never acknowledged proposal")
+        if cntl.failed():
+            raise RuntimeError(
+                f"collective proposal rejected: {cntl.error_text}"
+            )
+
+    # Phase 2 — the run proposals (async: every party must be dispatching
+    # before any can finish; a sync proposal to server A would deadlock —
+    # A's collective blocks on parties that were never told to start).
+    # Mid-session process death stays the backend's liveness domain (the
+    # coordination service / gloo timeout errors the chain group-wide).
+    pending = []
+    for ch, idx in zip(channels, server_indexes):
+        cntl = Controller(timeout_ms=timeout_ms)
+        ev = threading.Event()
+        ch.call_method(
+            HANDSHAKE_SERVICE,
+            COLLECTIVE_METHOD,
+            proposal(idx),
             cntl=cntl,
             done=lambda c, _ev=ev: _ev.set(),
         )
         pending.append((cntl, ev))
-    # grace check: a REJECTED proposal (bad field, unknown device, bounds)
-    # completes immediately — catch it BEFORE entering our own session,
-    # whose collective would otherwise wait on a party that never joins
-    # (mid-session process death is the backend's liveness domain — the
-    # coordination service / gloo timeout errors the chain group-wide)
-    grace_deadline = time.monotonic() + 0.5
-    while time.monotonic() < grace_deadline:
+    # Short rejection watch before committing to our own session: the
+    # accept phase reserves nothing, so a run proposal can still bounce
+    # instantly (admission ELIMIT from an overlapping session, a server
+    # mid-stop). A completed failure here means a party that will never
+    # join — surface it now rather than waiting out the collective
+    # backend's timeout. Bounded at _REJECT_WATCH_S (one local RPC round
+    # trip), not the old always-burned 0.5 s.
+    watch_deadline = time.monotonic() + _REJECT_WATCH_S
+    while time.monotonic() < watch_deadline:
         for cntl, ev in pending:
             if ev.is_set() and cntl.failed():
                 raise RuntimeError(
                     f"collective proposal rejected: {cntl.error_text}"
                 )
-        time.sleep(0.02)
+        time.sleep(0.005)
     span = _start_session_span(party_ids, client_index, steps, width)
     own, elapsed = _run_observed_session(
         span, party_ids, client_index, steps, width, seed

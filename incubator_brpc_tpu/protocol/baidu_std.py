@@ -126,6 +126,29 @@ def _signed64(v: int) -> int:
     return v - (1 << 64) if v >= (1 << 63) else v
 
 
+def encode_request_submeta(
+    service: str,
+    method: str,
+    log_id: int = 0,
+    trace_id: int = 0,
+    span_id: int = 0,
+    parent_span_id: int = 0,
+) -> bytes:
+    """The RpcRequestMeta SUBMESSAGE bytes (RpcMeta field 1) — the single
+    source of the request field table, shared by RpcMeta.encode and the
+    native client plane (src/tbnet wraps these bytes into a full RpcMeta,
+    splicing in its own correlation_id/attachment_size, so native frames
+    stay byte-identical to this codec's pack_request)."""
+    return (
+        _f_bytes(1, service.encode())
+        + _f_bytes(2, method.encode())
+        + _f_varint(3, log_id)
+        + _f_varint(4, trace_id)
+        + _f_varint(5, span_id)
+        + _f_varint(6, parent_span_id)
+    )
+
+
 # -- RpcMeta --------------------------------------------------------------
 
 
@@ -156,13 +179,13 @@ class RpcMeta:
             )
             out += _tag(2, 2) + _varint(len(sub)) + sub
         else:
-            sub = (
-                _f_bytes(1, self.service_name.encode())
-                + _f_bytes(2, self.method_name.encode())
-                + _f_varint(3, self.log_id)
-                + _f_varint(4, self.trace_id)
-                + _f_varint(5, self.span_id)
-                + _f_varint(6, self.parent_span_id)
+            sub = encode_request_submeta(
+                self.service_name,
+                self.method_name,
+                self.log_id,
+                self.trace_id,
+                self.span_id,
+                self.parent_span_id,
             )
             out += _tag(1, 2) + _varint(len(sub)) + sub
         out += _f_varint(3, self.compress_type)
@@ -238,6 +261,26 @@ def parse_header(header: bytes) -> Optional[int]:
     return HEADER_BYTES + body_size
 
 
+def rpc_meta_to_meta(rm: RpcMeta) -> Meta:
+    """Bridge a decoded RpcMeta into the framework's Meta shape (shared by
+    the Python parse path below and the native plane's per-frame PRPC
+    callback route)."""
+    meta = Meta(
+        service=rm.service_name,
+        method=rm.method_name,
+        compress=_WIRE_TO_COMPRESS.get(rm.compress_type, ""),
+        attachment_size=rm.attachment_size,
+        log_id=rm.log_id,
+        trace_id=rm.trace_id,
+        span_id=rm.span_id,
+        parent_span_id=rm.parent_span_id,
+        error_text=rm.error_text,
+    )
+    if rm.authentication_data:
+        meta.extra["auth"] = rm.authentication_data.decode(errors="replace")
+    return meta
+
+
 def try_parse_frame(buf: bytes) -> Tuple[Optional[ParsedFrame], int]:
     """Cut one frame; returns (frame, consumed) | (None, 0). The parsed
     result is bridged into the framework's ParsedFrame/Meta shape so the
@@ -258,19 +301,7 @@ def try_parse_frame(buf: bytes) -> Tuple[Optional[ParsedFrame], int]:
         raise ParseError("attachment_size exceeds body")
     payload = bytes(rest[: len(rest) - att])
     attachment = bytes(rest[len(rest) - att :]) if att else b""
-    meta = Meta(
-        service=rm.service_name,
-        method=rm.method_name,
-        compress=_WIRE_TO_COMPRESS.get(rm.compress_type, ""),
-        attachment_size=att,
-        log_id=rm.log_id,
-        trace_id=rm.trace_id,
-        span_id=rm.span_id,
-        parent_span_id=rm.parent_span_id,
-        error_text=rm.error_text,
-    )
-    if rm.authentication_data:
-        meta.extra["auth"] = rm.authentication_data.decode(errors="replace")
+    meta = rpc_meta_to_meta(rm)
     frame = ParsedFrame(
         meta=meta,
         payload=payload,

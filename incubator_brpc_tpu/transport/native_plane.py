@@ -5,14 +5,15 @@ stand-ins); tbnet is the native epoll reactor + tbus_std messenger + method
 dispatcher, and this module is the seam between it and the Python L5:
 
 - ``NativeServerPlane`` replaces the Python Acceptor/EventDispatcher for a
-  Server: tbus_std frames cut, verified and (for natively-registered
-  methods) ANSWERED without the interpreter; other frames surface here as
-  one callback per frame and run through the exact same
+  Server: tbus_std AND baidu_std (PRPC) frames cut, verified and (for
+  natively-registered methods) ANSWERED without the interpreter in the
+  protocol they arrived in; other frames surface here as one callback per
+  frame (flag 0x100 marks PRPC metas) and run through the exact same
   ``Server.process_request`` path (admission, auth, rpcz, dump) over a
-  ``NativeConnSock`` facade; connections that open with a different
-  protocol (the HTTP portal, baidu_std, nshead...) are handed off wholesale
-  to a real Python ``Socket`` — one port, every protocol, like the
-  reference's protocol scan (input_messenger.cpp:60-129).
+  ``NativeConnSock`` facade; connections that open with any OTHER
+  protocol (the HTTP portal, nshead...) are handed off wholesale to a
+  real Python ``Socket`` — one port, every protocol, like the reference's
+  protocol scan (input_messenger.cpp:60-129).
 - ``NativeClientChannel`` is the client fast path: pack/write/read/match in
   C++ with the GIL released; concurrent callers share one connection and
   elect a completion-pump reader (the single-connection multi-caller shape
@@ -49,6 +50,12 @@ KIND_NOP = 2
 # flags mirrored from protocol/tbus_std.py (also in tbnet.cc)
 _FLAG_RESPONSE = 1
 _FLAG_STREAM = 2
+# internal callback-only flag from tbnet.cc: the frame arrived on a
+# baidu_std (PRPC) connection and its meta is RpcMeta proto bytes
+_FLAG_WIRE_PRPC = 0x100
+
+# tb_channel_set_protocol values (tbnet.h)
+_CH_PROTO = {"tbus_std": 0, "baidu_std": 1}
 
 # client fast-path instrumentation: per-call round-trip latency (Python
 # boundary included — the L5 crossing rpc_echo_us measures), transport
@@ -58,6 +65,9 @@ native_client_calls = Adder(name="native_client_calls")
 native_client_errors = Adder(name="native_client_errors")
 native_client_call_us = LatencyRecorder(name="native_client_call_us")
 native_pump_ns = IntRecorder(name="native_pump_ns")
+# the same pipelined pump over the baidu_std (PRPC) wire — bench.py's
+# prpc_pump_ns row scrapes this
+prpc_pump_ns = IntRecorder(name="prpc_pump_ns")
 
 
 def _native_kind(handler) -> Optional[int]:
@@ -218,6 +228,7 @@ class NativeServerPlane:
         LIB.tb_server_set_closed_cb(self._srv, self._closed_cb, None)
         self._socks: Dict[int, NativeConnSock] = {}
         self._socks_lock = threading.Lock()
+        self._stats_snap = None  # (monotonic, stats dict) for the gauges
         self._handoff_socks: set = set()  # live handed-off Python Sockets
         self._user_libs: list = []  # dlopened user-method libraries
         self._stopped = False
@@ -301,13 +312,26 @@ class NativeServerPlane:
         # since one process may run several native planes. Hidden at stop.
         self._m_stats = [
             PassiveStatus(
-                (lambda _k=k: self.stats()[_k]),
+                (lambda _k=k: self._stats_snapshot()[_k]),
                 name=f"native_plane_{self.port}_{k}",
             )
             for k in ("accepted", "native_reqs", "cb_frames", "handoffs",
                       "live_conns")
         ]
         return rc
+
+    def _stats_snapshot(self) -> Dict[str, int]:
+        """stats() memoized for ~50 ms: one /brpc_metrics scrape touches
+        all five per-port gauges — a single native read feeds them all,
+        and the five samples come from the same instant instead of five
+        slightly different ones (benign race on the cache slot: worst
+        case is one extra native read)."""
+        now = time.monotonic()
+        snap = self._stats_snap
+        if snap is None or now - snap[0] > 0.05:
+            snap = (now, self.stats())
+            self._stats_snap = snap
+        return snap[1]
 
     # -- callbacks from loop threads --------------------------------------
 
@@ -329,7 +353,19 @@ class NativeServerPlane:
             meta_bytes = (
                 ctypes.string_at(meta_ptr, meta_len) if meta_len else b""
             )
-            meta = Meta.from_bytes(meta_bytes)
+            is_prpc = bool(flags & _FLAG_WIRE_PRPC)
+            if is_prpc:
+                # baidu_std frame off the C++ cut loop: the meta is RpcMeta
+                # proto bytes; responses must leave in PRPC, which
+                # _send_response keys off frame.wire_protocol
+                from incubator_brpc_tpu.protocol.baidu_std import (
+                    RpcMeta,
+                    rpc_meta_to_meta,
+                )
+
+                meta = rpc_meta_to_meta(RpcMeta.decode(meta_bytes))
+            else:
+                meta = Meta.from_bytes(meta_bytes)
             blen = len(body)
             att = meta.attachment_size
             if att > blen:
@@ -344,19 +380,26 @@ class NativeServerPlane:
                 payload=payload,
                 attachment=attachment,
                 correlation_id=cid_lo | (cid_hi << 32),
-                flags=flags,
+                flags=flags & ~_FLAG_WIRE_PRPC,
                 error_code=error_code,
             )
+            if is_prpc:
+                frame.wire_protocol = "baidu_std"
             sock = self._sock_for(token)
             self._dispatch(sock, frame)
         except Exception:
             logger.exception("native frame dispatch failed")
 
     def _dispatch(self, sock: NativeConnSock, frame) -> None:
-        """Mirror of InputMessenger._process_one for pre-cut tbus frames."""
+        """Mirror of InputMessenger._process_one for pre-cut frames."""
         from incubator_brpc_tpu import protocol as proto_pkg
 
-        proto = proto_pkg.TBUS_STD
+        if getattr(frame, "wire_protocol", None) == "baidu_std":
+            from incubator_brpc_tpu.protocol.baidu_std import BAIDU_STD
+
+            proto = BAIDU_STD
+        else:
+            proto = proto_pkg.TBUS_STD
         if frame.is_stream and proto.process_stream is not None:
             proto.process_stream(sock, frame)  # in wire order, inline
             return
@@ -374,9 +417,9 @@ class NativeServerPlane:
             )
 
     def _on_handoff(self, _ctx, fd, buffered_ptr, buffered_len) -> None:
-        """Non-tbus_std connection: wrap the fd in a real Python Socket so
-        the full protocol scan (HTTP portal, baidu_std, nshead, redis...)
-        runs exactly as with the Python acceptor."""
+        """Connection speaking neither tbus_std nor baidu_std: wrap the fd
+        in a real Python Socket so the full protocol scan (HTTP portal,
+        nshead, redis...) runs exactly as with the Python acceptor."""
         try:
             data = (
                 ctypes.string_at(buffered_ptr, buffered_len)
@@ -485,19 +528,36 @@ class NativeServerPlane:
 
 
 class NativeClientChannel:
-    """Client fast path over one shared native connection."""
+    """Client fast path over one shared native connection.
+
+    ``protocol`` selects the wire format the C++ channel emits:
+    "tbus_std" (default) or "baidu_std" — the latter sends wire-exact PRPC
+    frames (header + proto2 RpcMeta) so the native client interop-tests
+    byte-for-byte against protocol/baidu_std.py and against reference
+    binaries."""
 
     _META_CACHE_MAX = 1024
 
-    def __init__(self, ip: str, port: int, connect_timeout_ms: int = 5000):
+    def __init__(
+        self,
+        ip: str,
+        port: int,
+        connect_timeout_ms: int = 5000,
+        protocol: str = "tbus_std",
+    ):
         if not NET_AVAILABLE:
             raise RuntimeError("native plane unavailable")
+        if protocol not in _CH_PROTO:
+            raise ValueError(f"unsupported native protocol {protocol!r}")
         err = ctypes.c_int(0)
         self._ch = LIB.tb_channel_connect(
             ip.encode(), port, connect_timeout_ms, ctypes.byref(err)
         )
         if not self._ch:
             raise OSError(err.value, f"connect {ip}:{port} failed")
+        self.protocol = protocol
+        if protocol != "tbus_std":
+            LIB.tb_channel_set_protocol(self._ch, _CH_PROTO[protocol])
         self._meta_cache: Dict[tuple, bytes] = {}
         self._lock = threading.Lock()
         self._closed = False
@@ -510,22 +570,72 @@ class NativeClientChannel:
     def healthy(self) -> bool:
         return not self._closed and LIB.tb_channel_error(self._ch) == 0
 
-    def _meta_bytes(self, service: str, method: str, att_len: int) -> bytes:
-        if att_len:
-            from incubator_brpc_tpu.protocol.tbus_std import Meta
-
-            return Meta(service=service, method=method).to_bytes(
-                attachment_size=att_len
+    def _meta_bytes(
+        self,
+        service: str,
+        method: str,
+        att_len: int,
+        log_id: int = 0,
+        trace_id: int = 0,
+        span_id: int = 0,
+    ) -> bytes:
+        traced = bool(log_id or trace_id or span_id)
+        if self.protocol == "baidu_std":
+            # the RpcRequestMeta submessage only — correlation_id and
+            # attachment_size live OUTSIDE it, spliced in by the C++
+            # channel, so the cache key never depends on the attachment.
+            # Traced calls (log_id / Dapper ids) build uncached: the ids
+            # change per call and MUST reach the wire — the server parents
+            # its span into the client's trace off them.
+            from incubator_brpc_tpu.protocol.baidu_std import (
+                encode_request_submeta,
             )
+
+            if traced:
+                return encode_request_submeta(
+                    service, method, log_id, trace_id, span_id
+                )
+            key = (service, method)
+            m = self._meta_cache.get(key)
+            if m is None:
+                m = encode_request_submeta(service, method)
+                if len(self._meta_cache) < self._META_CACHE_MAX:
+                    self._meta_cache[key] = m
+            return m
+        from incubator_brpc_tpu.protocol.tbus_std import Meta
+
+        if traced or att_len:
+            return Meta(
+                service=service,
+                method=method,
+                log_id=log_id,
+                trace_id=trace_id,
+                span_id=span_id,
+            ).to_bytes(attachment_size=att_len)
         key = (service, method)
         m = self._meta_cache.get(key)
         if m is None:
-            from incubator_brpc_tpu.protocol.tbus_std import Meta
-
             m = Meta(service=service, method=method).to_bytes()
             if len(self._meta_cache) < self._META_CACHE_MAX:
                 self._meta_cache[key] = m
         return m
+
+    def decode_resp_meta(self, resp_meta: bytes):
+        """Response meta bytes -> framework Meta: JSON on tbus_std, RpcMeta
+        proto bytes on baidu_std (the raw bytes tb_channel_call copied
+        out)."""
+        from incubator_brpc_tpu.protocol.tbus_std import Meta
+
+        if not resp_meta:
+            return Meta()
+        if self.protocol == "baidu_std":
+            from incubator_brpc_tpu.protocol.baidu_std import (
+                RpcMeta,
+                rpc_meta_to_meta,
+            )
+
+            return rpc_meta_to_meta(RpcMeta.decode(resp_meta))
+        return Meta.from_bytes(resp_meta)
 
     def call(
         self,
@@ -534,10 +644,15 @@ class NativeClientChannel:
         payload: bytes,
         attachment: bytes = b"",
         timeout_ms: int = 500,
+        log_id: int = 0,
+        trace_id: int = 0,
+        span_id: int = 0,
     ):
         """One native round trip. Returns (rc, err_code, resp_meta_bytes,
         body: IOBuf) — rc < 0 is a transport errno, err_code the server's
-        RPC error."""
+        RPC error. Nonzero log_id/trace_id/span_id travel in the request
+        meta exactly as the Python packers send them (Dapper
+        propagation)."""
         import errno as _errno
 
         from incubator_brpc_tpu.iobuf import IOBuf
@@ -549,7 +664,9 @@ class NativeClientChannel:
                 return -_errno.EPIPE, 0, b"", IOBuf()
             self._inflight += 1
         try:
-            meta = self._meta_bytes(service, method, len(attachment))
+            meta = self._meta_bytes(
+                service, method, len(attachment), log_id, trace_id, span_id
+            )
             flags = FLAG_BODY_CRC if get_flag("tbus_body_crc") else 0
             body = IOBuf()
             tls = self._tls
@@ -628,7 +745,10 @@ class NativeClientChannel:
             if rc < 0:
                 native_client_errors << 1
                 raise OSError(-rc, "native pump failed")
-            native_pump_ns << int(rc)
+            if self.protocol == "baidu_std":
+                prpc_pump_ns << int(rc)
+            else:
+                native_pump_ns << int(rc)
             return float(rc)
         finally:
             destroy = False

@@ -48,7 +48,20 @@ constexpr uint32_t kFlagResponse = 1;
 constexpr uint32_t kFlagStream = 2;
 constexpr uint32_t kFlagHasMeta = 4;
 constexpr uint32_t kFlagBodyCrc = 8;
+// internal-only callback flag: the frame arrived on a baidu_std (PRPC)
+// connection and its meta is raw RpcMeta proto bytes (never on the wire;
+// must stay out of the tbus_std wire-flag space above)
+constexpr uint32_t kFlagWirePrpc = 0x100;
 constexpr size_t kHeader = 32;
+
+// baidu_std: "PRPC" + body_size(u32 BE) + meta_size(u32 BE)
+// (protocol/baidu_std.py; reference baidu_rpc_protocol.cpp:53-58)
+constexpr uint32_t kMagicPrpc = 0x43505250;  // "PRPC" read as LE u32
+constexpr size_t kPrpcHeader = 12;
+
+// connection wire protocol, fixed at sniff time
+constexpr int kProtoTbus = 1;
+constexpr int kProtoPrpc = 2;
 
 constexpr int kKindEcho = 1;
 constexpr int kKindNop = 2;
@@ -194,6 +207,316 @@ MetaLite scan_meta(const char* s, size_t n) {
 }
 
 // ---------------------------------------------------------------------------
+// baidu_std (PRPC): hand-rolled proto2 wire codec for RpcMeta — varint +
+// length-delimited only, the exact field tables of protocol/baidu_std.py
+// (policy/baidu_rpc_meta.proto):
+//   RpcMeta:        1 request(msg)  2 response(msg)  3 compress_type
+//                   4 correlation_id  5 attachment_size
+//                   7 authentication_data  8 stream_settings(msg)
+//   RpcRequestMeta: 1 service_name  2 method_name  3 log_id  4 trace_id
+//                   5 span_id  6 parent_span_id
+//   RpcResponseMeta: 1 error_code  2 error_text
+// Same routing philosophy as the JSON scanner above: the native fast path
+// only vouches for service/method/cid/attachment_size; anything else
+// (compression, tracing ids, auth, streams) routes to Python, which
+// implements the full semantics.
+// ---------------------------------------------------------------------------
+
+size_t varint_len(uint64_t v) {
+  size_t n = 1;
+  while (v >= 0x80) {
+    v >>= 7;
+    ++n;
+  }
+  return n;
+}
+
+size_t put_varint(uint8_t* out, uint64_t v) {
+  size_t n = 0;
+  while (v >= 0x80) {
+    out[n++] = static_cast<uint8_t>(v) | 0x80;
+    v >>= 7;
+  }
+  out[n++] = static_cast<uint8_t>(v);
+  return n;
+}
+
+// fixed 10-byte (padded) varint: value-independent length so the pump's
+// frame template can patch the correlation id in place.  Decoders accept
+// non-minimal varints (protocol/baidu_std.py _read_varint reads through
+// shift 63), so the bytes stay wire-legal.
+void put_varint_fixed10(uint8_t* out, uint64_t v) {
+  for (int i = 0; i < 9; ++i)
+    out[i] = static_cast<uint8_t>((v >> (7 * i)) & 0x7F) | 0x80;
+  out[9] = static_cast<uint8_t>((v >> 63) & 0x7F);
+}
+
+// bounded varint read; false on truncation/overlong
+bool read_varint(const uint8_t* p, size_t n, size_t* off, uint64_t* out) {
+  uint64_t v = 0;
+  int shift = 0;
+  while (*off < n && shift <= 63) {
+    uint8_t b = p[*off];
+    ++*off;
+    v |= static_cast<uint64_t>(b & 0x7F) << shift;
+    if ((b & 0x80) == 0) {
+      *out = v;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+struct PrpcMeta {
+  bool ok = false;         // meta parsed cleanly
+  bool to_python = false;  // fields beyond the native fast path's scope
+  bool is_response = false;
+  const char* svc = nullptr;
+  size_t svc_len = 0;
+  const char* mth = nullptr;
+  size_t mth_len = 0;
+  // the RpcRequestMeta submessage slice — the per-connection routing memo
+  // key (byte-identical submessage => same method)
+  const char* req_sub = nullptr;
+  size_t req_sub_len = 0;
+  uint64_t cid = 0;
+  long attachment = 0;
+  uint32_t error_code = 0;
+};
+
+PrpcMeta scan_prpc_meta(const char* s, size_t n) {
+  PrpcMeta m;
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(s);
+  size_t off = 0;
+  while (off < n) {
+    uint64_t key = 0;
+    if (!read_varint(p, n, &off, &key)) return m;
+    uint64_t field = key >> 3;
+    int wt = static_cast<int>(key & 7);
+    if (wt == 0) {
+      uint64_t v = 0;
+      if (!read_varint(p, n, &off, &v)) return m;
+      if (field == 3) {  // compress_type: Python owns the codecs
+        if (v != 0) m.to_python = true;
+      } else if (field == 4) {
+        m.cid = v;
+      } else if (field == 5) {
+        if (v > (1ull << 31)) return m;
+        m.attachment = static_cast<long>(v);
+      } else {
+        m.to_python = true;
+      }
+    } else if (wt == 2) {
+      uint64_t len = 0;
+      // subtraction form: `off + len > n` would wrap on an attacker-
+      // supplied 64-bit length and defeat the bounds check entirely
+      if (!read_varint(p, n, &off, &len) || len > n - off) return m;
+      const char* sub = s + off;
+      size_t sub_len = static_cast<size_t>(len);
+      off += sub_len;
+      if (field == 1) {  // RpcRequestMeta
+        m.req_sub = sub;
+        m.req_sub_len = sub_len;
+        const uint8_t* q = reinterpret_cast<const uint8_t*>(sub);
+        size_t qoff = 0;
+        while (qoff < sub_len) {
+          uint64_t k2 = 0;
+          if (!read_varint(q, sub_len, &qoff, &k2)) return m;
+          uint64_t f2 = k2 >> 3;
+          int w2 = static_cast<int>(k2 & 7);
+          if (w2 == 2) {
+            uint64_t l2 = 0;
+            if (!read_varint(q, sub_len, &qoff, &l2) || l2 > sub_len - qoff)
+              return m;
+            if (f2 == 1) {
+              m.svc = sub + qoff;
+              m.svc_len = static_cast<size_t>(l2);
+            } else if (f2 == 2) {
+              m.mth = sub + qoff;
+              m.mth_len = static_cast<size_t>(l2);
+            } else {
+              m.to_python = true;
+            }
+            qoff += static_cast<size_t>(l2);
+          } else if (w2 == 0) {
+            uint64_t v2 = 0;
+            if (!read_varint(q, sub_len, &qoff, &v2)) return m;
+            // log_id/trace_id/span ids: rpcz semantics live in Python
+            if (v2 != 0) m.to_python = true;
+          } else if (w2 == 1 || w2 == 5) {
+            size_t skip = w2 == 1 ? 8 : 4;
+            if (qoff + skip > sub_len) return m;
+            qoff += skip;
+            m.to_python = true;
+          } else {
+            return m;
+          }
+        }
+      } else if (field == 2) {  // RpcResponseMeta
+        m.is_response = true;
+        const uint8_t* q = reinterpret_cast<const uint8_t*>(sub);
+        size_t qoff = 0;
+        while (qoff < sub_len) {
+          uint64_t k2 = 0;
+          if (!read_varint(q, sub_len, &qoff, &k2)) return m;
+          uint64_t f2 = k2 >> 3;
+          int w2 = static_cast<int>(k2 & 7);
+          if (w2 == 0) {
+            uint64_t v2 = 0;
+            if (!read_varint(q, sub_len, &qoff, &v2)) return m;
+            if (f2 == 1) m.error_code = static_cast<uint32_t>(v2);
+          } else if (w2 == 2) {
+            uint64_t l2 = 0;
+            if (!read_varint(q, sub_len, &qoff, &l2) || l2 > sub_len - qoff)
+              return m;
+            qoff += static_cast<size_t>(l2);  // error_text: Python decodes
+          } else if (w2 == 1 || w2 == 5) {
+            size_t skip = w2 == 1 ? 8 : 4;
+            if (qoff + skip > sub_len) return m;
+            qoff += skip;
+          } else {
+            return m;
+          }
+        }
+      } else {  // auth data (7), stream settings (8), unknown
+        m.to_python = true;
+      }
+    } else if (wt == 1 || wt == 5) {
+      // fixed64/fixed32: RpcMeta never uses them today, but they are
+      // legal proto2 — skip and route to Python (which walks them the
+      // same way) instead of killing the connection
+      size_t skip = wt == 1 ? 8 : 4;
+      if (off + skip > n) return m;
+      off += skip;
+      m.to_python = true;
+    } else {
+      return m;
+    }
+  }
+  m.ok = true;
+  return m;
+}
+
+// big-endian u32 (the PRPC header's byte order)
+void put_be32(uint8_t* out, uint32_t v) {
+  out[0] = static_cast<uint8_t>(v >> 24);
+  out[1] = static_cast<uint8_t>(v >> 16);
+  out[2] = static_cast<uint8_t>(v >> 8);
+  out[3] = static_cast<uint8_t>(v);
+}
+
+uint32_t get_be32(const uint8_t* p) {
+  return (static_cast<uint32_t>(p[0]) << 24) |
+         (static_cast<uint32_t>(p[1]) << 16) |
+         (static_cast<uint32_t>(p[2]) << 8) | static_cast<uint32_t>(p[3]);
+}
+
+// Peek the 12-byte PRPC header off `in` without consuming — the tb_tbus_peek
+// analog shared by the server cut loop and both client read paths.
+// 0 = sizes filled and sane (magic, meta <= body <= max_body);
+// 1 = fewer than 12 bytes buffered; -1 = not a PRPC frame / oversized.
+int prpc_peek(const tb_iobuf* in, uint32_t* body_len, uint32_t* meta_len,
+              size_t max_body) {
+  if (tb_iobuf_size(in) < kPrpcHeader) return 1;
+  uint8_t hdr[kPrpcHeader];
+  tb_iobuf_copy_to(in, hdr, kPrpcHeader, 0);
+  uint32_t b = get_be32(hdr + 4), m = get_be32(hdr + 8);
+  if (memcmp(hdr, "PRPC", 4) != 0 || m > b || b > max_body) return -1;
+  *body_len = b;
+  *meta_len = m;
+  return 0;
+}
+
+// client-side frame size cap (the tbus client paths use the same bound)
+constexpr size_t kClientMaxBody = 512u << 20;
+
+// Append "PRPC" header + response RpcMeta, byte-identical to
+// protocol/baidu_std.py pack_response: the response submessage is ALWAYS
+// emitted (even empty), zero scalar fields are skipped.  The caller
+// appends payload (+attachment) after.
+void append_prpc_resp_header(tb_iobuf* out, uint64_t cid, uint32_t error_code,
+                             const char* error_text, size_t text_len,
+                             size_t payload_len, size_t att_len) {
+  uint8_t meta[512];
+  // RpcResponseMeta submessage
+  uint8_t sub[400];
+  size_t sn = 0;
+  if (error_code != 0) {
+    sub[sn++] = 0x08;  // field 1, varint
+    sn += put_varint(sub + sn, error_code);
+  }
+  if (text_len > sizeof sub - sn - 12) text_len = sizeof sub - sn - 12;
+  if (text_len > 0) {
+    sub[sn++] = 0x12;  // field 2, len-delimited
+    sn += put_varint(sub + sn, text_len);
+    memcpy(sub + sn, error_text, text_len);
+    sn += text_len;
+  }
+  size_t mn = 0;
+  meta[mn++] = 0x12;  // RpcMeta.response (field 2)
+  mn += put_varint(meta + mn, sn);
+  memcpy(meta + mn, sub, sn);
+  mn += sn;
+  if (cid != 0) {
+    meta[mn++] = 0x20;  // correlation_id (field 4)
+    mn += put_varint(meta + mn, cid);
+  }
+  if (att_len != 0) {
+    meta[mn++] = 0x28;  // attachment_size (field 5)
+    mn += put_varint(meta + mn, att_len);
+  }
+  uint8_t hdr[kPrpcHeader];
+  hdr[0] = 'P';
+  hdr[1] = 'R';
+  hdr[2] = 'P';
+  hdr[3] = 'C';
+  put_be32(hdr + 4, static_cast<uint32_t>(mn + payload_len + att_len));
+  put_be32(hdr + 8, static_cast<uint32_t>(mn));
+  // header + meta contiguously (one small append)
+  uint8_t scratch[sizeof hdr + sizeof meta];
+  memcpy(scratch, hdr, sizeof hdr);
+  memcpy(scratch + sizeof hdr, meta, mn);
+  tb_iobuf_append(out, scratch, sizeof hdr + mn);
+}
+
+// Full client-side PRPC request: `sub` is the pre-encoded RpcRequestMeta
+// submessage; the wrapper adds correlation_id + attachment_size in the
+// field order protocol/baidu_std.py emits (1, 4, 5 — compress/auth are
+// Python-route-only), then payload + attachment.
+void pack_prpc_request(tb_iobuf* out, const void* sub, size_t sub_len,
+                       const void* payload, size_t payload_len,
+                       const void* att, size_t att_len, uint64_t cid) {
+  std::vector<uint8_t> meta;
+  meta.reserve(sub_len + 24);
+  uint8_t tmp[10];
+  meta.push_back(0x0A);  // RpcMeta.request (field 1)
+  meta.insert(meta.end(), tmp, tmp + put_varint(tmp, sub_len));
+  const uint8_t* sp = static_cast<const uint8_t*>(sub);
+  meta.insert(meta.end(), sp, sp + sub_len);
+  if (cid != 0) {
+    meta.push_back(0x20);
+    meta.insert(meta.end(), tmp, tmp + put_varint(tmp, cid));
+  }
+  if (att_len != 0) {
+    meta.push_back(0x28);
+    meta.insert(meta.end(), tmp, tmp + put_varint(tmp, att_len));
+  }
+  uint8_t hdr[kPrpcHeader];
+  hdr[0] = 'P';
+  hdr[1] = 'R';
+  hdr[2] = 'P';
+  hdr[3] = 'C';
+  put_be32(hdr + 4,
+           static_cast<uint32_t>(meta.size() + payload_len + att_len));
+  put_be32(hdr + 8, static_cast<uint32_t>(meta.size()));
+  tb_iobuf_append(out, hdr, sizeof hdr);
+  tb_iobuf_append(out, meta.data(), meta.size());
+  if (payload_len) tb_iobuf_append(out, payload, payload_len);
+  if (att_len) tb_iobuf_append(out, att, att_len);
+}
+
+// ---------------------------------------------------------------------------
 // frame pack helpers
 // ---------------------------------------------------------------------------
 
@@ -262,10 +585,13 @@ struct NetConn : PollObj {
   std::mutex wmu;
   bool want_out = false;
   bool sniffed = false;
+  int proto = 0;  // kProtoTbus / kProtoPrpc once sniffed
   // one-entry meta memo: a client pumping one method sends byte-identical
   // meta every frame — remember the resolved native method for those exact
   // bytes and skip the JSON scan + name join + flatmap probe (the
-  // preferred-protocol-memory idea applied to routing)
+  // preferred-protocol-memory idea applied to routing).  On PRPC conns the
+  // memo key is the RpcRequestMeta SUBMESSAGE (the correlation id lives
+  // outside it, so the submessage stays byte-identical across a pump).
   std::string memo_meta;
   uint64_t memo_idx = 0;
   long memo_attachment = -1;  // -1 = no memo
@@ -461,14 +787,30 @@ void conn_destroy(NetConn* c, bool close_fd) {
 
 // ---- server-side frame dispatch ----
 
+// per-request routing context shared by the tbus and PRPC dispatch loops
+struct ReqCtx {
+  int wire;            // kProtoTbus / kProtoPrpc
+  uint32_t cid_lo;
+  uint32_t cid_hi;
+  uint32_t resp_flags; // tbus: response flags to echo (body-crc bit)
+  long attachment;     // request attachment size (PRPC echo re-stamps it)
+};
+
 // append an error response frame into `out` (flushed with the batch)
-void append_error(tb_iobuf* out, uint32_t cid_lo, uint32_t cid_hi,
-                  uint32_t code, const char* text) {
+void append_error(tb_iobuf* out, const ReqCtx& rc, uint32_t code,
+                  const char* text) {
+  if (rc.wire == kProtoPrpc) {
+    append_prpc_resp_header(
+        out, static_cast<uint64_t>(rc.cid_lo) |
+                 (static_cast<uint64_t>(rc.cid_hi) << 32),
+        code, text, strlen(text), 0, 0);
+    return;
+  }
   char meta[256];
   int n = snprintf(meta, sizeof meta, "{\"error_text\":\"%s\"}", text);
   if (n < 0) n = 0;
-  pack_flat(out, meta, static_cast<size_t>(n), nullptr, 0, nullptr, 0, cid_lo,
-            cid_hi, kFlagResponse, code);
+  pack_flat(out, meta, static_cast<size_t>(n), nullptr, 0, nullptr, 0,
+            rc.cid_lo, rc.cid_hi, kFlagResponse, code);
 }
 
 // Native method kinds: the response is built and appended into the burst's
@@ -480,8 +822,8 @@ void append_error(tb_iobuf* out, uint32_t cid_lo, uint32_t cid_hi,
 // creating/destroying an iobuf handle per request was measurable on the
 // pump's ns/req floor); echo ref-shares its blocks into `out` before the
 // caller clears it.
-void run_native(NetConn* c, NativeMethod* nm, const tb_tbus_hdr* hdr,
-                const MetaLite& ml, tb_iobuf* body, tb_iobuf* out) {
+void run_native(NetConn* c, NativeMethod* nm, const ReqCtx& rc,
+                tb_iobuf* body, tb_iobuf* out) {
   nm->nreq.fetch_add(1, std::memory_order_relaxed);
   c->srv->native_reqs.fetch_add(1, std::memory_order_relaxed);
   // snapshot ONCE: a runtime retune between the admission fetch_add and
@@ -491,25 +833,32 @@ void run_native(NetConn* c, NativeMethod* nm, const tb_tbus_hdr* hdr,
   if (limit && nm->nprocessing.fetch_add(1) >= limit) {
     nm->nprocessing.fetch_sub(1);
     nm->nerr.fetch_add(1, std::memory_order_relaxed);
-    append_error(out, hdr->cid_lo, hdr->cid_hi, c->srv->errs.elimit,
-                 "concurrency limit reached");
+    append_error(out, rc, c->srv->errs.elimit, "concurrency limit reached");
     return;  // caller owns body
   }
-  uint32_t flags = kFlagResponse | (hdr->flags & kFlagBodyCrc);
+  const uint64_t cid64 = static_cast<uint64_t>(rc.cid_lo) |
+                         (static_cast<uint64_t>(rc.cid_hi) << 32);
+  uint32_t flags = kFlagResponse | rc.resp_flags;
   char meta[64];
   size_t meta_len = 0;
   if (nm->kind == kKindEcho) {
-    if (ml.attachment > 0) {
-      int n = snprintf(meta, sizeof meta, "{\"attachment_size\":%ld}",
-                       ml.attachment);
-      meta_len = n > 0 ? static_cast<size_t>(n) : 0;
-    }
-    if (meta_len) flags |= kFlagHasMeta;
-    uint32_t crc = tb_crc32c(0, meta, meta_len);
     size_t blen = tb_iobuf_size(body);
-    if (flags & kFlagBodyCrc) crc = tb_iobuf_crc32c(body, crc, 0, blen);
-    append_header(out, meta, meta_len, blen, crc, hdr->cid_lo, hdr->cid_hi,
-                  flags, 0);
+    if (rc.wire == kProtoPrpc) {
+      append_prpc_resp_header(out, cid64, 0, nullptr, 0,
+                              blen - static_cast<size_t>(rc.attachment),
+                              static_cast<size_t>(rc.attachment));
+    } else {
+      if (rc.attachment > 0) {
+        int n = snprintf(meta, sizeof meta, "{\"attachment_size\":%ld}",
+                         rc.attachment);
+        meta_len = n > 0 ? static_cast<size_t>(n) : 0;
+      }
+      if (meta_len) flags |= kFlagHasMeta;
+      uint32_t crc = tb_crc32c(0, meta, meta_len);
+      if (flags & kFlagBodyCrc) crc = tb_iobuf_crc32c(body, crc, 0, blen);
+      append_header(out, meta, meta_len, blen, crc, rc.cid_lo, rc.cid_hi,
+                    flags, 0);
+    }
     tb_iobuf_append_iobuf(out, body);  // zero-copy: request refs shared
   } else if (nm->kind == kKindCallback) {
     // contiguous request for the C ABI (stack buffer for small bodies)
@@ -519,7 +868,7 @@ void run_native(NetConn* c, NativeMethod* nm, const tb_tbus_hdr* hdr,
                                         : static_cast<char*>(malloc(blen));
     if (req == nullptr) {  // OOM on a huge body: an error response, not a crash
       nm->nerr.fetch_add(1, std::memory_order_relaxed);
-      append_error(out, hdr->cid_lo, hdr->cid_hi, c->srv->errs.erequest,
+      append_error(out, rc, c->srv->errs.erequest,
                    "request too large to stage");
       if (limit) nm->nprocessing.fetch_sub(1);
       return;  // caller owns body
@@ -527,23 +876,30 @@ void run_native(NetConn* c, NativeMethod* nm, const tb_tbus_hdr* hdr,
     if (blen) tb_iobuf_copy_to(body, req, blen, 0);
     char* resp = nullptr;
     size_t resp_len = 0;
-    int rc = nm->fn(nm->ud, req, blen, &resp, &resp_len);
+    int rc2 = nm->fn(nm->ud, req, blen, &resp, &resp_len);
     if (req != stackbuf) free(req);
-    if (rc != 0) {
+    if (rc2 != 0) {
       nm->nerr.fetch_add(1, std::memory_order_relaxed);
-      append_error(out, hdr->cid_lo, hdr->cid_hi, static_cast<uint32_t>(rc),
+      append_error(out, rc, static_cast<uint32_t>(rc2),
                    "native method failed");
+    } else if (rc.wire == kProtoPrpc) {
+      append_prpc_resp_header(out, cid64, 0, nullptr, 0, resp_len, 0);
+      if (resp_len) tb_iobuf_append(out, resp, resp_len);
     } else {
       uint32_t crc = tb_crc32c(0, nullptr, 0);
       if (flags & kFlagBodyCrc) crc = tb_crc32c(crc, resp, resp_len);
-      append_header(out, nullptr, 0, resp_len, crc, hdr->cid_lo, hdr->cid_hi,
+      append_header(out, nullptr, 0, resp_len, crc, rc.cid_lo, rc.cid_hi,
                     flags, 0);
       if (resp_len) tb_iobuf_append(out, resp, resp_len);
     }
     free(resp);
   } else {  // nop
-    append_header(out, nullptr, 0, 0, tb_crc32c(0, nullptr, 0), hdr->cid_lo,
-                  hdr->cid_hi, flags, 0);
+    if (rc.wire == kProtoPrpc) {
+      append_prpc_resp_header(out, cid64, 0, nullptr, 0, 0, 0);
+    } else {
+      append_header(out, nullptr, 0, 0, tb_crc32c(0, nullptr, 0), rc.cid_lo,
+                    rc.cid_hi, flags, 0);
+    }
   }
   // body is the caller's reusable scratch: NOT destroyed here (the echo
   // kind ref-shared its blocks into `out`; clear just drops this handle)
@@ -570,18 +926,32 @@ void do_handoff(NetConn* c) {
   free(buffered);
 }
 
+FrameStatus process_frames_tbus(NetConn* c);
+FrameStatus process_frames_prpc(NetConn* c);
+
 FrameStatus process_frames(NetConn* c) {
-  tb_server* s = c->srv;
   if (!c->sniffed) {
     if (tb_iobuf_size(c->rbuf) < 4) return FrameStatus::kOk;
     uint32_t magic = 0;
     tb_iobuf_copy_to(c->rbuf, &magic, 4, 0);
-    if (magic != kMagic) {
+    if (magic == kMagic) {
+      c->proto = kProtoTbus;
+    } else if (magic == kMagicPrpc) {
+      // baidu_std spoken natively: no interpreter, no fd handoff (the
+      // handoff fallback still owns every OTHER protocol)
+      c->proto = kProtoPrpc;
+    } else {
       do_handoff(c);
       return FrameStatus::kHandoff;
     }
     c->sniffed = true;
   }
+  return c->proto == kProtoPrpc ? process_frames_prpc(c)
+                                : process_frames_tbus(c);
+}
+
+FrameStatus process_frames_tbus(NetConn* c) {
+  tb_server* s = c->srv;
   // One response batch per readable burst: native responses append here
   // and flush with ONE conn_queue_iobuf (one writev) at every exit —
   // the per-request syscall was the dominant cost of the old shape.
@@ -629,10 +999,9 @@ FrameStatus process_frames(NetConn* c) {
       if (c->memo_attachment >= 0 && hdr.meta_len == c->memo_meta.size() &&
           memcmp(cb_meta, c->memo_meta.data(), hdr.meta_len) == 0 &&
           c->memo_attachment <= static_cast<long>(tb_iobuf_size(scratch))) {
-        MetaLite ml;
-        ml.attachment = c->memo_attachment;
-        run_native(c, s->native_methods[c->memo_idx], &hdr, ml, scratch,
-                   batch);
+        ReqCtx rc2{kProtoTbus, hdr.cid_lo, hdr.cid_hi,
+                   hdr.flags & kFlagBodyCrc, c->memo_attachment};
+        run_native(c, s->native_methods[c->memo_idx], rc2, scratch, batch);
         tb_iobuf_clear(scratch);
         continue;
       }
@@ -654,7 +1023,9 @@ FrameStatus process_frames(NetConn* c) {
             c->memo_meta.assign(cb_meta, hdr.meta_len);
             c->memo_idx = idx;
             c->memo_attachment = ml.attachment;
-            run_native(c, s->native_methods[idx], &hdr, ml, scratch, batch);
+            ReqCtx rc2{kProtoTbus, hdr.cid_lo, hdr.cid_hi,
+                       hdr.flags & kFlagBodyCrc, ml.attachment};
+            run_native(c, s->native_methods[idx], rc2, scratch, batch);
             tb_iobuf_clear(scratch);
             continue;
           }
@@ -665,9 +1036,10 @@ FrameStatus process_frames(NetConn* c) {
     // admission/stats/errors stay consistent with the Python server path)
     s->cb_frames.fetch_add(1, std::memory_order_relaxed);
     if (s->frame_cb == nullptr) {
-      if ((hdr.flags & kFlagResponse) == 0)
-        append_error(batch, hdr.cid_lo, hdr.cid_hi, s->errs.enomethod,
-                     "no such method");
+      if ((hdr.flags & kFlagResponse) == 0) {
+        ReqCtx rc2{kProtoTbus, hdr.cid_lo, hdr.cid_hi, 0, 0};
+        append_error(batch, rc2, s->errs.enomethod, "no such method");
+      }
       tb_iobuf_clear(scratch);
       continue;
     }
@@ -678,6 +1050,106 @@ FrameStatus process_frames(NetConn* c) {
     tb_iobuf_clear(scratch);
     s->frame_cb(s->frame_ctx, c->token, hdr.cid_lo, hdr.cid_hi, hdr.flags,
                 hdr.error_code, cb_meta, hdr.meta_len, body);
+  }
+}
+
+// baidu_std cut + dispatch loop: the PRPC counterpart of the tbus loop
+// above (reference ParseRpcMessage + ProcessRpcRequest,
+// baidu_rpc_protocol.cpp:92-503), same batching/scratch discipline — one
+// writev per readable burst, native methods answered without the
+// interpreter, everything else one frame callback into Python.
+FrameStatus process_frames_prpc(NetConn* c) {
+  tb_server* s = c->srv;
+  tb_iobuf* batch = tb_iobuf_create();
+  tb_iobuf* scratch = tb_iobuf_create();
+  auto flush = [&](FrameStatus st) {
+    if (tb_iobuf_size(batch) > 0) conn_queue_iobuf(c, batch);
+    tb_iobuf_destroy(batch);
+    tb_iobuf_destroy(scratch);
+    return st;
+  };
+  for (;;) {
+    uint32_t body_len = 0, meta_len = 0;
+    int prc = prpc_peek(c->rbuf, &body_len, &meta_len, s->max_body);
+    if (prc == 1) return flush(FrameStatus::kOk);
+    if (prc != 0) {
+      flush(FrameStatus::kKilled);  // earlier valid responses go out
+      conn_destroy(c, true);
+      return FrameStatus::kKilled;
+    }
+    if (tb_iobuf_size(c->rbuf) < kPrpcHeader + body_len)
+      return flush(FrameStatus::kOk);
+    char mstack[4096];
+    std::string mheap;
+    char* mptr = mstack;
+    if (meta_len > sizeof mstack) {
+      mheap.resize(meta_len);
+      mptr = &mheap[0];
+    }
+    if (meta_len) tb_iobuf_copy_to(c->rbuf, mptr, meta_len, kPrpcHeader);
+    tb_iobuf_popn(c->rbuf, kPrpcHeader + meta_len);
+    tb_iobuf_cutn(c->rbuf, scratch, body_len - meta_len);
+    PrpcMeta pm = scan_prpc_meta(mptr, meta_len);
+    if (!pm.ok) {
+      // meta that doesn't parse as proto2 at all: the stream is hopeless
+      // (the Python plane's FatalParseError path)
+      flush(FrameStatus::kKilled);
+      conn_destroy(c, true);
+      return FrameStatus::kKilled;
+    }
+    const long blen = static_cast<long>(tb_iobuf_size(scratch));
+    if (!pm.is_response && !pm.to_python && pm.attachment <= blen) {
+      ReqCtx rc{kProtoPrpc, static_cast<uint32_t>(pm.cid),
+                static_cast<uint32_t>(pm.cid >> 32), 0, pm.attachment};
+      // memo keyed on the request submessage (cid lives outside it)
+      if (c->memo_attachment >= 0 &&
+          pm.req_sub_len == c->memo_meta.size() && pm.req_sub_len > 0 &&
+          memcmp(pm.req_sub, c->memo_meta.data(), pm.req_sub_len) == 0) {
+        run_native(c, s->native_methods[c->memo_idx], rc, scratch, batch);
+        tb_iobuf_clear(scratch);
+        continue;
+      }
+      char full[256];
+      size_t sl = pm.svc_len, mn = pm.mth_len;
+      if (pm.svc != nullptr && pm.mth != nullptr && sl + 1 + mn < sizeof full) {
+        memcpy(full, pm.svc, sl);
+        full[sl] = '.';
+        memcpy(full + sl + 1, pm.mth, mn);
+        size_t fn = sl + 1 + mn;
+        full[fn] = '\0';
+        uint64_t idx = 0;
+        if (s->methods != nullptr &&
+            tb_flatmap_get(s->methods, method_key(full, fn), &idx) == 1 &&
+            s->native_methods[idx]->full_name == full) {
+          c->memo_meta.assign(pm.req_sub, pm.req_sub_len);
+          c->memo_idx = idx;
+          c->memo_attachment = 0;  // >=0 marks the memo live (PRPC mode)
+          run_native(c, s->native_methods[idx], rc, scratch, batch);
+          tb_iobuf_clear(scratch);
+          continue;
+        }
+      }
+    }
+    // python route: responses, compressed, traced, auth'd, streamed or
+    // unknown-method frames — flag 0x100 tells the callee the meta is
+    // RpcMeta proto bytes and the connection answers in PRPC
+    s->cb_frames.fetch_add(1, std::memory_order_relaxed);
+    uint32_t cb_flags = kFlagWirePrpc | (pm.is_response ? kFlagResponse : 0);
+    if (s->frame_cb == nullptr) {
+      if (!pm.is_response) {
+        ReqCtx rc{kProtoPrpc, static_cast<uint32_t>(pm.cid),
+                  static_cast<uint32_t>(pm.cid >> 32), 0, 0};
+        append_error(batch, rc, s->errs.enomethod, "no such method");
+      }
+      tb_iobuf_clear(scratch);
+      continue;
+    }
+    tb_iobuf* body = tb_iobuf_create();
+    tb_iobuf_append_iobuf(body, scratch);
+    tb_iobuf_clear(scratch);
+    s->frame_cb(s->frame_ctx, c->token, static_cast<uint32_t>(pm.cid),
+                static_cast<uint32_t>(pm.cid >> 32), cb_flags, pm.error_code,
+                mptr, meta_len, body);
   }
 }
 
@@ -1011,6 +1483,7 @@ struct Pending {
 
 struct tb_channel {
   int fd = -1;
+  int proto = 0;  // 0 = tbus_std, 1 = baidu_std (PRPC)
   std::mutex wmu;  // writers (pack + writev serialize)
   std::mutex rmu;  // reader election
   std::mutex pmu;  // pending table + done queue + cv
@@ -1038,6 +1511,46 @@ void channel_fail(tb_channel* ch, int err) {
   ch->pcv.notify_all();
 }
 
+// Cut one complete PRPC response off ch->rbuf.  Returns 1 when a frame
+// was consumed (fills cid/meta/err_code and cuts payload+attachment into
+// the pending's dst under pmu — same locking contract as the tbus path),
+// 0 when incomplete, -EPROTO on garbage.  Caller holds rmu.
+int prpc_complete_one(tb_channel* ch) {
+  uint32_t body_len = 0, meta_len = 0;
+  int prc = prpc_peek(ch->rbuf, &body_len, &meta_len, kClientMaxBody);
+  if (prc == 1) return 0;
+  if (prc != 0) return -EPROTO;
+  if (tb_iobuf_size(ch->rbuf) < kPrpcHeader + body_len) return 0;
+  std::string meta(meta_len, '\0');
+  if (meta_len) tb_iobuf_copy_to(ch->rbuf, &meta[0], meta_len, kPrpcHeader);
+  PrpcMeta pm = scan_prpc_meta(meta.data(), meta_len);
+  if (!pm.ok) return -EPROTO;
+  size_t rest = body_len - meta_len;
+  {
+    // completion runs under pmu so a timed-out caller can't free its
+    // Pending (or its body iobuf) while the cut writes into it
+    std::unique_lock<std::mutex> pl(ch->pmu);
+    auto it = ch->pending.find(pm.cid);
+    Pending* p = it == ch->pending.end() ? nullptr : it->second;
+    tb_iobuf* dst = (p != nullptr && p->targeted) ? p->body : tb_iobuf_create();
+    tb_iobuf_popn(ch->rbuf, kPrpcHeader + meta_len);
+    if (rest) tb_iobuf_cutn(ch->rbuf, dst, rest);
+    if (p == nullptr) {
+      tb_iobuf_destroy(dst);  // timed-out caller already left: drop
+    } else {
+      p->meta = std::move(meta);
+      p->err_code = pm.error_code;
+      if (!p->targeted) {
+        p->body = dst;
+        ch->doneq.emplace_back(pm.cid, p);
+      }
+      p->done = true;
+      ch->pcv.notify_all();
+    }
+  }
+  return 1;
+}
+
 // read whatever arrives within `slice_ms`, completing pendings.  Caller
 // holds rmu.  Returns false when the channel failed.
 bool pump_once(tb_channel* ch, int slice_ms) {
@@ -1060,6 +1573,17 @@ bool pump_once(tb_channel* ch, int slice_ms) {
     if (n == -EINTR) continue;
     channel_fail(ch, n == 0 ? -EPIPE : static_cast<int>(n));
     return false;
+  }
+  if (ch->proto == 1) {
+    for (;;) {
+      int rc2 = prpc_complete_one(ch);
+      if (rc2 == 0) break;
+      if (rc2 < 0) {
+        channel_fail(ch, rc2);
+        return false;
+      }
+    }
+    return true;
   }
   for (;;) {
     tb_tbus_hdr hdr;
@@ -1134,9 +1658,13 @@ int channel_send_cid(tb_channel* ch, uint64_t cid, const void* meta,
                      const void* att, size_t att_len, uint32_t flags_extra,
                      uint64_t deadline) {
   tb_iobuf* frame = tb_iobuf_create();
-  pack_flat(frame, meta, meta_len, payload, payload_len, att, att_len,
-            static_cast<uint32_t>(cid), static_cast<uint32_t>(cid >> 32),
-            flags_extra, 0);
+  if (ch->proto == 1)  // meta = RpcRequestMeta submessage; flags n/a
+    pack_prpc_request(frame, meta, meta_len, payload, payload_len, att,
+                      att_len, cid);
+  else
+    pack_flat(frame, meta, meta_len, payload, payload_len, att, att_len,
+              static_cast<uint32_t>(cid), static_cast<uint32_t>(cid >> 32),
+              flags_extra, 0);
   int rc = write_frame(ch, frame, deadline);
   tb_iobuf_destroy(frame);
   if (rc != 0 && rc != -ETIMEDOUT) channel_fail(ch, rc);
@@ -1209,6 +1737,12 @@ tb_channel* tb_channel_connect(const char* ip, int port, int timeout_ms,
   ch->fd = fd;
   ch->rbuf = tb_iobuf_create();
   return ch;
+}
+
+int tb_channel_set_protocol(tb_channel* ch, int proto) {
+  if (proto != 0 && proto != 1) return -1;
+  ch->proto = proto;
+  return 0;
 }
 
 long tb_channel_call(tb_channel* ch, const void* meta, size_t meta_len,
@@ -1351,10 +1885,30 @@ long tb_channel_pump(tb_channel* ch, const void* meta, size_t meta_len,
   long result = 0;
   // every frame of the pump is identical except the correlation id: build
   // the wire bytes ONCE (header + meta + payload, meta crc precomputed)
-  // and per request patch the 8 cid bytes + one append — no per-request
-  // crc, header build, or multi-append
-  std::vector<char> tmpl(32 + meta_len + payload_len);
-  {
+  // and per request patch the cid bytes + one append — no per-request
+  // crc, header build, or multi-append.  PRPC carries the cid as a meta
+  // varint, so the template encodes it as a padded 10-byte varint (fixed
+  // width => patchable in place; decoders accept non-minimal varints).
+  std::vector<char> tmpl;
+  size_t cid_off = 12;  // tbus: header words 3-4
+  if (ch->proto == 1) {
+    size_t meta_total = 1 + varint_len(meta_len) + meta_len + 1 + 10;
+    tmpl.resize(kPrpcHeader + meta_total + payload_len);
+    uint8_t* t = reinterpret_cast<uint8_t*>(tmpl.data());
+    memcpy(t, "PRPC", 4);
+    put_be32(t + 4, static_cast<uint32_t>(meta_total + payload_len));
+    put_be32(t + 8, static_cast<uint32_t>(meta_total));
+    size_t o = kPrpcHeader;
+    t[o++] = 0x0A;  // RpcMeta.request wrapping the caller's submessage
+    o += put_varint(t + o, meta_len);
+    if (meta_len) memcpy(t + o, meta, meta_len);
+    o += meta_len;
+    t[o++] = 0x20;  // correlation_id
+    cid_off = o;
+    o += 10;  // patched per request
+    if (payload_len) memcpy(t + o, payload, payload_len);
+  } else {
+    tmpl.resize(32 + meta_len + payload_len);
     uint32_t h[8];
     h[0] = kMagic;
     h[1] = static_cast<uint32_t>(meta_len + payload_len);
@@ -1375,9 +1929,14 @@ long tb_channel_pump(tb_channel* ch, const void* meta, size_t meta_len,
     // syscall per window refill, not per request)
     while (outstanding < inflight && sent < n) {
       uint64_t cid = ch->next_cid.fetch_add(1, std::memory_order_relaxed);
-      uint32_t cid32[2] = {static_cast<uint32_t>(cid),
-                           static_cast<uint32_t>(cid >> 32)};
-      memcpy(tmpl.data() + 12, cid32, sizeof cid32);
+      if (ch->proto == 1) {
+        put_varint_fixed10(
+            reinterpret_cast<uint8_t*>(tmpl.data()) + cid_off, cid);
+      } else {
+        uint32_t cid32[2] = {static_cast<uint32_t>(cid),
+                             static_cast<uint32_t>(cid >> 32)};
+        memcpy(tmpl.data() + cid_off, cid32, sizeof cid32);
+      }
       tb_iobuf_append(frame, tmpl.data(), tmpl.size());
       ++sent;
       ++outstanding;
@@ -1428,6 +1987,30 @@ long tb_channel_pump(tb_channel* ch, const void* meta, size_t meta_len,
         break;
       }
       while (result == 0) {
+        if (ch->proto == 1) {
+          uint32_t body_len = 0, pmeta_len = 0;
+          int prc3 = prpc_peek(ch->rbuf, &body_len, &pmeta_len,
+                               kClientMaxBody);
+          if (prc3 == 1) break;
+          char mscratch[4096];
+          if (prc3 != 0 || pmeta_len > sizeof mscratch) {
+            result = -EPROTO;
+            break;
+          }
+          if (tb_iobuf_size(ch->rbuf) < kPrpcHeader + body_len) break;
+          if (pmeta_len)
+            tb_iobuf_copy_to(ch->rbuf, mscratch, pmeta_len, kPrpcHeader);
+          tb_iobuf_popn(ch->rbuf, kPrpcHeader + body_len);
+          PrpcMeta pm = scan_prpc_meta(mscratch, pmeta_len);
+          if (!pm.ok) {
+            result = -EPROTO;
+          } else {
+            if (pm.error_code != 0) result = -EREMOTEIO;
+            ++done;
+            --outstanding;
+          }
+          continue;
+        }
         tb_tbus_hdr hdr;
         int prc2 = tb_tbus_peek(ch->rbuf, &hdr);
         if (prc2 == 1) break;

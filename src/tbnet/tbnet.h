@@ -1,5 +1,6 @@
-// tbnet — native L2/L3 network plane: epoll reactor, tbus_std frame cut,
-// method dispatch, and a client channel, all in C++.
+// tbnet — native L2/L3 network plane: epoll reactor, tbus_std AND
+// baidu_std (PRPC) frame cut, method dispatch, and a client channel, all
+// in C++.
 //
 // Re-designed counterpart of the reference's I/O core
 // (/root/reference/src/brpc/event_dispatcher.cpp epoll loops,
@@ -10,10 +11,22 @@
 // lookup, response pack, writev — never touches the Python interpreter for
 // natively-registered methods; everything else routes to ONE Python
 // callback per frame (the "process_request" boundary), and connections
-// that speak a different protocol (HTTP portal, baidu_std, nshead...) are
-// handed off to the Python plane wholesale after the first bytes are
-// sniffed (the reference's server tries every registered protocol on a new
-// connection the same way, input_messenger.cpp:60-129).
+// that speak a different protocol (HTTP portal, nshead...) are handed off
+// to the Python plane wholesale after the first bytes are sniffed (the
+// reference's server tries every registered protocol on a new connection
+// the same way, input_messenger.cpp:60-129).
+//
+// Wire protocols spoken natively per connection (sniffed on the first 4
+// bytes, fixed for the connection's lifetime):
+//   * tbus_std — "TPRC" 32-byte header (protocol/tbus_std.py)
+//   * baidu_std — "PRPC" 12-byte header + proto2 RpcMeta, the reference's
+//     canonical protocol (baidu_rpc_protocol.cpp:53-58); the RpcMeta
+//     varint/length-delimited codec is hand-rolled here, byte-compatible
+//     with protocol/baidu_std.py.  Frames whose meta carries semantics the
+//     fast path doesn't implement (compression, tracing ids, auth data,
+//     stream settings, responses) route per-frame to Python with flag bit
+//     8 (0x100) set in the callback's `flags` so the Python side decodes
+//     the meta as RpcMeta instead of JSON.
 #ifndef TBNET_H
 #define TBNET_H
 
@@ -34,6 +47,9 @@ typedef struct tb_channel tb_channel;
 // compression, or JSON escapes).  Ownership of `body` (payload+attachment,
 // meta already stripped) transfers to the callee — it must eventually
 // tb_iobuf_destroy it.  Runs on a loop thread; must not block for long.
+// `flags` bit 8 (0x100) marks a frame that arrived on a baidu_std (PRPC)
+// connection: `meta` is then raw RpcMeta proto bytes, not JSON, and the
+// callee answers with PRPC bytes via tb_conn_write.
 typedef void (*tb_frame_fn)(void* ctx, uint64_t conn_token, uint32_t cid_lo,
                             uint32_t cid_hi, uint32_t flags,
                             uint32_t error_code, const char* meta,
@@ -92,7 +108,9 @@ void tb_server_stats(const tb_server* s, uint64_t* accepted,
                      uint64_t* handoffs, uint64_t* live_conns);
 
 // ---- per-connection surface (used by the Python frame route) ----
-// Queue a response frame on the connection. 0 ok, -1 stale token.
+// Queue a tbus_std response frame on the connection (tbus_std conns only;
+// the Python route answers baidu_std conns with pre-packed PRPC bytes
+// through tb_conn_write). 0 ok, -1 stale token.
 int tb_conn_respond(uint64_t token, const void* meta, size_t meta_len,
                     const void* payload, size_t payload_len,
                     const void* att, size_t att_len, uint32_t cid_lo,
@@ -109,6 +127,16 @@ int tb_conn_close(uint64_t token);
 // Blocking connect with timeout; NULL on failure (*err_out = errno).
 tb_channel* tb_channel_connect(const char* ip, int port, int timeout_ms,
                                int* err_out);
+// Select the channel's wire protocol BEFORE the first send: 0 = tbus_std
+// (default), 1 = baidu_std (PRPC).  In baidu_std mode the `meta` argument
+// of call/send/pump is the pre-encoded RpcRequestMeta SUBMESSAGE
+// (service_name/method_name/...); the channel wraps it into a full
+// RpcMeta, splicing in its own correlation_id and attachment_size, so the
+// emitted frames are byte-identical to protocol/baidu_std.py's
+// pack_request.  meta_out of call/recv receives the raw response RpcMeta
+// proto bytes (decode on the Python side); err_code_out carries the
+// RpcResponseMeta error_code.  Returns 0, or -1 for an unknown protocol.
+int tb_channel_set_protocol(tb_channel* ch, int proto);
 // Synchronous call over the shared connection.  Thread-safe: concurrent
 // callers elect one reader which pumps completions for everyone (the
 // single-connection multi-caller shape of the reference's client,

@@ -1,0 +1,441 @@
+"""Native baidu_std (PRPC) on the C++ plane (src/tbnet).
+
+The canonical wire protocol cut, dispatched and packed without the
+interpreter — and proven byte-exact against the Python codec
+(protocol/baidu_std.py) in BOTH directions, the interop oracle SURVEY §7
+step 4 calls for. Covers: C++ server responses vs pack_response (success,
+attachment, error), C++ client frames vs pack_request, compress_type
+passthrough over the Python route, padded-varint acceptance, native↔Python
+cross-client echo over one port, and the pipelined PRPC pump.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+import pytest
+
+from incubator_brpc_tpu.protocol import baidu_std
+from incubator_brpc_tpu.protocol.tbus_std import Meta
+from incubator_brpc_tpu.rpc import (
+    Channel,
+    ChannelOptions,
+    Server,
+    ServerOptions,
+    native_echo,
+    native_nop,
+)
+from incubator_brpc_tpu.transport import native_plane
+from incubator_brpc_tpu.utils.status import ErrorCode
+
+pytestmark = pytest.mark.skipif(
+    not native_plane.NET_AVAILABLE, reason="native runtime unavailable"
+)
+
+
+@pytest.fixture
+def native_server():
+    created = []
+
+    def make(services=None, options=None):
+        opts = options or ServerOptions(native_plane=True, usercode_inline=True)
+        opts.native_plane = True
+        srv = Server(opts)
+        for name, handlers in (services or {}).items():
+            srv.add_service(name, handlers)
+        created.append(srv)
+        assert srv.start(0)
+        assert srv._native_plane is not None, "native plane did not engage"
+        return srv
+
+    yield make
+    for srv in created:
+        srv.stop()
+
+
+def _read_prpc_frame(sock: socket.socket, buf: bytes = b"") -> bytes:
+    """Read exactly one PRPC frame off a raw socket."""
+    while True:
+        if len(buf) >= 12:
+            total = 12 + struct.unpack(">I", buf[4:8])[0]
+            if len(buf) >= total:
+                return buf[:total]
+        data = sock.recv(65536)
+        assert data, "connection closed mid-frame"
+        buf += data
+
+
+class TestServerWireExactness:
+    """C++-packed PRPC responses must be byte-identical to what
+    protocol/baidu_std.py's pack_response emits for the same fields."""
+
+    def _roundtrip(self, port: int, wire: bytes) -> bytes:
+        s = socket.create_connection(("127.0.0.1", port))
+        try:
+            s.sendall(wire)
+            return _read_prpc_frame(s)
+        finally:
+            s.close()
+
+    def test_native_echo_response_byte_exact(self, native_server):
+        srv = native_server({"svc": {"echo": native_echo}})
+        req = baidu_std.pack_request(
+            Meta(service="svc", method="echo"), b"payload", correlation_id=77
+        )
+        resp = self._roundtrip(srv.port, req)
+        assert resp == baidu_std.pack_response(
+            None, b"payload", correlation_id=77
+        )
+        # answered in C++, not via the frame callback
+        assert srv._native_plane.stats()["native_reqs"] >= 1
+        assert srv._native_plane.stats()["cb_frames"] == 0
+
+    def test_native_echo_with_attachment_byte_exact(self, native_server):
+        srv = native_server({"svc": {"echo": native_echo}})
+        att = b"AT" * 500
+        req = baidu_std.pack_request(
+            Meta(service="svc", method="echo"), b"pp", correlation_id=3,
+            attachment=att,
+        )
+        resp = self._roundtrip(srv.port, req)
+        assert resp == baidu_std.pack_response(
+            None, b"pp", correlation_id=3, attachment=att
+        )
+
+    def test_native_nop_response_byte_exact(self, native_server):
+        srv = native_server({"svc": {"nop": native_nop}})
+        req = baidu_std.pack_request(
+            Meta(service="svc", method="nop"), b"ignored", correlation_id=9
+        )
+        resp = self._roundtrip(srv.port, req)
+        assert resp == baidu_std.pack_response(None, b"", correlation_id=9)
+
+    def test_error_response_decode_reencode_stable(self, native_server):
+        # unknown method: the response must decode with the Python codec
+        # and re-encode to the identical bytes (pack path exactness for
+        # error responses, whatever plane answered)
+        srv = native_server({"svc": {"echo": native_echo}})
+        req = baidu_std.pack_request(
+            Meta(service="svc", method="nope"), b"", correlation_id=11
+        )
+        resp = self._roundtrip(srv.port, req)
+        frame, consumed = baidu_std.try_parse_frame(resp)
+        assert consumed == len(resp)
+        assert frame.error_code == ErrorCode.ENOMETHOD
+        assert frame.correlation_id == 11
+        assert frame.meta.error_text
+        again = baidu_std.pack_response(
+            frame.meta,
+            frame.payload,
+            frame.correlation_id,
+            error_code=frame.error_code,
+        )
+        assert again == resp
+
+    def test_padded_varint_correlation_id_accepted(self, native_server):
+        # non-minimal varints are wire-legal proto2; the C++ parser (and
+        # the pump's fixed-width cid template relies on this) must accept
+        # them and echo the decoded cid back minimally encoded
+        srv = native_server({"svc": {"echo": native_echo}})
+        sub = baidu_std.encode_request_submeta("svc", "echo")
+        cid = 5
+        cid10 = bytes(
+            ((cid >> (7 * i)) & 0x7F) | 0x80 for i in range(9)
+        ) + bytes([(cid >> 63) & 0x7F])
+        meta = b"\x0a" + bytes([len(sub)]) + sub + b"\x20" + cid10
+        wire = (
+            b"PRPC"
+            + struct.pack(">II", len(meta) + 3, len(meta))
+            + meta
+            + b"abc"
+        )
+        resp = self._roundtrip(srv.port, wire)
+        frame, _ = baidu_std.try_parse_frame(resp)
+        assert frame.correlation_id == 5
+        assert frame.payload == b"abc"
+
+    def test_unknown_fixed_width_field_routes_to_python(self, native_server):
+        # fixed64/fixed32 are legal proto2 the RpcMeta tables don't use:
+        # the C++ scanner must route such frames to the Python plane (whose
+        # _walk_fields skips them), not kill the connection
+        srv = native_server({"svc": {"echo": native_echo}})
+        sub = baidu_std.encode_request_submeta("svc", "echo")
+        meta = (
+            b"\x0a" + bytes([len(sub)]) + sub
+            + b"\x20\x08"  # correlation_id = 8
+            + b"\x79" + b"\x00" * 8  # field 15, wire type 1 (fixed64)
+        )
+        wire = (
+            b"PRPC"
+            + struct.pack(">II", len(meta) + 2, len(meta))
+            + meta
+            + b"hi"
+        )
+        resp = self._roundtrip(srv.port, wire)
+        frame, _ = baidu_std.try_parse_frame(resp)
+        assert frame.correlation_id == 8
+        assert frame.error_code == 0
+        assert frame.payload == b"hi"
+        assert srv._native_plane.stats()["cb_frames"] >= 1
+
+    def test_overflowing_field_length_kills_conn_only(self, native_server):
+        # a length-delimited meta field claiming a ~2^64 length must fail
+        # the bounds check (subtraction form), not wrap past it into an
+        # out-of-bounds read — the connection dies, the server survives
+        srv = native_server({"svc": {"echo": native_echo}})
+        evil = b"\x0a" + b"\xff" * 9 + b"\x01"  # field 1, len ≈ 2^64-1
+        wire = b"PRPC" + struct.pack(">II", len(evil) + 2, len(evil)) + evil + b"xx"
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        s.sendall(wire)
+        s.settimeout(5)
+        assert s.recv(1) == b""  # killed cleanly
+        s.close()
+        ch = Channel()
+        assert ch.init(
+            f"127.0.0.1:{srv.port}",
+            options=ChannelOptions(native_plane=True, protocol="baidu_std"),
+        )
+        c = ch.call_method("svc", "echo", b"alive")
+        assert c.ok() and c.response_payload == b"alive"
+
+    def test_garbage_after_prpc_magic_kills_conn_only(self, native_server):
+        srv = native_server({"svc": {"echo": native_echo}})
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        # meta_size > body_size: unrecoverable
+        s.sendall(b"PRPC" + struct.pack(">II", 1, 99))
+        s.settimeout(5)
+        assert s.recv(1) == b""  # server closed the connection
+        s.close()
+        # the server survives and keeps answering
+        ch = Channel()
+        assert ch.init(
+            f"127.0.0.1:{srv.port}",
+            options=ChannelOptions(native_plane=True, protocol="baidu_std"),
+        )
+        c = ch.call_method("svc", "echo", b"still-alive")
+        assert c.ok(), c.error_text
+        assert c.response_payload == b"still-alive"
+
+
+class TestClientWireExactness:
+    """The native client's PRPC frames must be byte-identical to
+    pack_request for the same service/method/payload/attachment."""
+
+    def _capture_one_call(self, payload: bytes, attachment: bytes, **ids):
+        lst = socket.socket()
+        lst.bind(("127.0.0.1", 0))
+        lst.listen(1)
+        port = lst.getsockname()[1]
+        got = {}
+
+        def server():
+            conn, _ = lst.accept()
+            req = _read_prpc_frame(conn)
+            got["req"] = req
+            frame, _ = baidu_std.try_parse_frame(req)
+            conn.sendall(
+                baidu_std.pack_response(None, b"ok", frame.correlation_id)
+            )
+            conn.close()
+
+        t = threading.Thread(target=server)
+        t.start()
+        nch = native_plane.NativeClientChannel(
+            "127.0.0.1", port, protocol="baidu_std"
+        )
+        try:
+            rc, ec, meta, body = nch.call(
+                "svc", "mth", payload, attachment, timeout_ms=5000, **ids
+            )
+            t.join(timeout=10)
+        finally:
+            nch.close()
+            lst.close()
+        assert rc >= 0 and ec == 0, (rc, ec)
+        assert body.to_bytes() == b"ok"
+        return got["req"]
+
+    def test_request_frame_byte_exact(self):
+        req = self._capture_one_call(b"the-payload", b"")
+        assert req == baidu_std.pack_request(
+            Meta(service="svc", method="mth"), b"the-payload",
+            correlation_id=1,
+        )
+
+    def test_request_frame_with_attachment_byte_exact(self):
+        att = b"ATTACH" * 20
+        req = self._capture_one_call(b"pp", att)
+        assert req == baidu_std.pack_request(
+            Meta(service="svc", method="mth"), b"pp", correlation_id=1,
+            attachment=att,
+        )
+
+    def test_traced_request_carries_dapper_ids_byte_exact(self):
+        # log_id + trace/span ids must reach the wire exactly as the
+        # Python packer sends them — the server parents its rpcz span
+        # into the client's trace off these fields
+        ids = dict(log_id=42, trace_id=0xDEADBEEF01, span_id=7)
+        req = self._capture_one_call(b"pp", b"", **ids)
+        assert req == baidu_std.pack_request(
+            Meta(service="svc", method="mth", **ids), b"pp",
+            correlation_id=1,
+        )
+
+
+class TestCrossClientEcho:
+    """native↔Python cross-client echo over ONE port, both wire protocols
+    live on the same native server (the reference's one-port-every-
+    protocol story, input_messenger.cpp:60-129)."""
+
+    def test_native_and_python_clients_one_port(self, native_server):
+        srv = native_server({"svc": {"echo": native_echo}})
+        port = srv.port
+        # native client, baidu_std wire
+        ch_native = Channel()
+        assert ch_native.init(
+            f"127.0.0.1:{port}",
+            options=ChannelOptions(native_plane=True, protocol="baidu_std"),
+        )
+        # pure-Python client, baidu_std wire (Socket reactor + Python codec)
+        ch_py = Channel()
+        assert ch_py.init(
+            f"127.0.0.1:{port}", options=ChannelOptions(protocol="baidu_std")
+        )
+        # native client, tbus_std wire — the same server answers each
+        # connection in its own protocol
+        ch_tbus = Channel()
+        assert ch_tbus.init(
+            f"127.0.0.1:{port}", options=ChannelOptions(native_plane=True)
+        )
+        for ch, tag in ((ch_native, b"n"), (ch_py, b"p"), (ch_tbus, b"t")):
+            c = ch.call_method("svc", "echo", b"x-" + tag, attachment=b"A" + tag)
+            assert c.ok(), c.error_text
+            assert c.response_payload == b"x-" + tag
+            assert c.response_attachment == b"A" + tag
+        # both NATIVE-client echoes were served without the interpreter;
+        # the pure-Python client's frames carry rpcz trace ids, which the
+        # C++ parser correctly routes to the Python plane (tracing
+        # semantics live there — same policy as the tbus JSON scanner).
+        # Nobody was handed off: baidu_std is a native protocol now.
+        stats = srv._native_plane.stats()
+        assert stats["native_reqs"] >= 2
+        assert stats["cb_frames"] >= 1
+        assert stats["handoffs"] == 0
+
+    def test_native_baidu_client_against_python_server(self):
+        # the native client's PRPC bytes parse on the pure-Python plane
+        # (protocol scan + baidu_std codec) and its response parses back
+        srv = Server(ServerOptions(usercode_inline=True))
+        srv.add_service("svc", {"echo": native_echo})
+        assert srv.start(0)
+        try:
+            nch = native_plane.NativeClientChannel(
+                "127.0.0.1", srv.port, protocol="baidu_std"
+            )
+            try:
+                rc, ec, meta, body = nch.call(
+                    "svc", "echo", b"cross", b"att-bytes", timeout_ms=5000
+                )
+                assert rc >= 0 and ec == 0, (rc, ec)
+                m = nch.decode_resp_meta(meta)
+                blen = len(body)
+                assert m.attachment_size == len(b"att-bytes")
+                assert body.to_bytes(blen - m.attachment_size) == b"cross"
+                assert (
+                    body.to_bytes(
+                        m.attachment_size, pos=blen - m.attachment_size
+                    )
+                    == b"att-bytes"
+                )
+            finally:
+                nch.close()
+        finally:
+            srv.stop()
+
+
+class TestPythonRouteSemantics:
+    def test_python_handler_error_text_over_prpc(self, native_server):
+        def boom(cntl, req):
+            cntl.set_failed(ErrorCode.EINTERNAL, "prpc boom")
+            return b""
+
+        srv = native_server({"svc": {"boom": boom}})
+        ch = Channel()
+        assert ch.init(
+            f"127.0.0.1:{srv.port}",
+            options=ChannelOptions(native_plane=True, protocol="baidu_std"),
+        )
+        c = ch.call_method("svc", "boom", b"")
+        assert c.failed()
+        assert c.error_code == ErrorCode.EINTERNAL
+        assert "prpc boom" in c.error_text
+
+    def test_compress_type_passthrough(self, native_server):
+        # a compressed PRPC request routes to Python (the native path
+        # never guesses at codecs), decompresses, and the response rides
+        # back compressed with the same wire compress_type
+        from incubator_brpc_tpu.rpc import Controller
+
+        srv = native_server({"svc": {"echo": lambda cntl, req: req}})
+        ch = Channel()
+        assert ch.init(
+            f"127.0.0.1:{srv.port}",
+            options=ChannelOptions(protocol="baidu_std"),
+        )
+        cntl = Controller()
+        cntl.compress_type = "gzip"
+        payload = b"z" * 4096
+        c = ch.call_method("svc", "echo", payload, cntl=cntl)
+        assert c.ok(), c.error_text
+        assert c.response_payload == payload
+        assert srv._native_plane.stats()["cb_frames"] >= 1
+
+    def test_correlation_ids_interleave(self, native_server):
+        # concurrent callers over ONE shared PRPC connection: the varint
+        # correlation ids must land each response on its own caller
+        srv = native_server({"svc": {"echo": native_echo}})
+        ch = Channel()
+        assert ch.init(
+            f"127.0.0.1:{srv.port}",
+            options=ChannelOptions(native_plane=True, protocol="baidu_std"),
+        )
+        errs = []
+
+        def worker(i):
+            for j in range(25):
+                body = b"w%d-%d" % (i, j)
+                c = ch.call_method("svc", "echo", body)
+                if c.failed() or c.response_payload != body:
+                    errs.append((i, j, c.error_text, c.response_payload))
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs[:3]
+
+
+class TestPrpcPump:
+    def test_pump_interpreter_free(self, native_server):
+        srv = native_server({"svc": {"echo": native_echo}})
+        nch = native_plane.NativeClientChannel(
+            "127.0.0.1", srv.port, protocol="baidu_std"
+        )
+        try:
+            ns = nch.pump("svc", "echo", b"x" * 64, 3000, inflight=64)
+            assert ns > 0
+            # every request of the pump dispatched natively
+            stats = srv._native_plane.stats()
+            assert stats["native_reqs"] >= 3000
+            assert stats["cb_frames"] == 0
+            # the scrapeable record landed in the prpc recorder
+            from incubator_brpc_tpu.transport.native_plane import prpc_pump_ns
+
+            assert prpc_pump_ns.sum() > 0
+        finally:
+            nch.close()

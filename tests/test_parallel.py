@@ -88,3 +88,97 @@ def test_ring_allgather_matches_native(flat_mesh):
     out = np.asarray(f(x))
     assert out.shape == (8, 2)
     np.testing.assert_allclose(out, np.arange(16.0).reshape(8, 2))
+
+
+class TestCollectiveAcceptPreAck:
+    """propose_collective's two-phase shape (ADVICE r5): every server
+    answers an explicit accept pre-ack before any party enters its
+    session — no fixed grace window, rejections surface immediately."""
+
+    def _server(self):
+        from incubator_brpc_tpu.rpc import Server, ServerOptions
+
+        srv = Server(ServerOptions(enable_collective_service=True))
+        assert srv.start(0)
+        return srv
+
+    def test_accept_phase_validates_without_running(self, monkeypatch):
+        import json as _json
+
+        from incubator_brpc_tpu.parallel import mc_collective
+        from incubator_brpc_tpu.rpc import Channel
+
+        def _boom(*a, **kw):  # the accept phase must never run a session
+            raise AssertionError("accept phase ran the session")
+
+        monkeypatch.setattr(mc_collective, "run_collective_session", _boom)
+        srv = self._server()
+        try:
+            ch = Channel()
+            assert ch.init(f"127.0.0.1:{srv.port}")
+            payload = _json.dumps(
+                {
+                    "parties": [0, 1],
+                    "index": 1,
+                    "steps": 3,
+                    "width": 4,
+                    "seed": 7,
+                    "phase": "accept",
+                }
+            ).encode()
+            cntl = ch.call_method("_tpu_transport", "collective", payload)
+            assert cntl.ok(), cntl.error_text
+            ack = _json.loads(cntl.response_payload.decode())
+            assert ack == {"accept": True, "index": 1}
+            # and a bad proposal is REJECTED at the accept phase
+            bad = _json.dumps(
+                {
+                    "parties": [0, 1],
+                    "index": 1,
+                    "steps": 0,  # out of bounds
+                    "width": 4,
+                    "seed": 7,
+                    "phase": "accept",
+                }
+            ).encode()
+            cntl = ch.call_method("_tpu_transport", "collective", bad)
+            assert cntl.failed()
+        finally:
+            srv.stop()
+            srv.join(timeout=5)
+
+    def test_propose_runs_without_grace_window(self, monkeypatch):
+        import time as _time
+
+        from incubator_brpc_tpu.parallel import mc_collective
+        from incubator_brpc_tpu.rpc import Channel
+
+        calls = []
+
+        def _stub(parties, idx, steps, width, seed):
+            calls.append(idx)
+            return np.zeros(width, np.float32), 0.001
+
+        monkeypatch.setattr(mc_collective, "run_collective_session", _stub)
+        srv = self._server()
+        try:
+            ch = Channel()
+            assert ch.init(f"127.0.0.1:{srv.port}")
+            t0 = _time.monotonic()
+            out = mc_collective.propose_collective(
+                [ch], [0, 1], client_index=0, steps=3, width=4, seed=7,
+                timeout_ms=30000,
+            )
+            elapsed = _time.monotonic() - t0
+            assert len(out["server_checksums"]) == 1
+            # client (index 0) and server party (index 1) both ran
+            assert sorted(calls) == [0, 1]
+            # the old fixed 0.5 s grace window is gone: the only fixed
+            # pause left is the short rejection watch (structural check —
+            # a tight wall-clock bound here would flake on loaded CI),
+            # plus a generous sanity ceiling on the whole stubbed round
+            assert mc_collective._REJECT_WATCH_S <= 0.1
+            assert elapsed < 5.0, f"proposal round unexpectedly slow: {elapsed}"
+        finally:
+            srv.stop()
+            srv.join(timeout=5)
