@@ -84,6 +84,22 @@ def _status(server, frame) -> Resp:
     for s in servers:
         out.append(f"server {s.listen_endpoint}")
         out.append(f"  connections: {s.connection_count()}")
+        limiter = getattr(s, "_server_limiter", None)
+        if limiter is not None:
+            from incubator_brpc_tpu.rpc.concurrency_limiter import (
+                AutoConcurrencyLimiter,
+            )
+
+            # the resolved limiter type, not the raw spec: "12" is a
+            # constant (create_concurrency_limiter accepts numeric strings)
+            kind = (
+                "auto"
+                if isinstance(limiter, AutoConcurrencyLimiter)
+                else "constant"
+            )
+            out.append(
+                f"  max_concurrency: {limiter.max_concurrency()} ({kind})"
+            )
         nreq = s.nrequest.get_value()
         plane = getattr(s, "_native_plane", None)
         if plane is not None:
@@ -109,6 +125,47 @@ def _status(server, frame) -> Resp:
                 f"p99={lat['latency_99']:.0f}us max={lat['max_latency']:.0f}us "
                 f"errors={st.nerror.get_value()}"
             )
+    return 200, "text/plain", ("\n".join(out) + "\n").encode()
+
+
+def _circuit_breakers(server, frame) -> Resp:
+    """Per-endpoint circuit-breaker state across every live LB in the
+    process (rpc/circuit_breaker.py registry): state machine position,
+    trip count, current isolation duration and the two EMA error windows
+    — the reference surfaces the same through its /connections health
+    columns; here the breaker is first-class. ``?json=1`` for machines."""
+    from incubator_brpc_tpu.rpc.circuit_breaker import breaker_registry
+
+    rows = breaker_registry.snapshot()
+    if frame.query.get("json"):
+        payload = {
+            f"{owner}|{ep}": cb.describe() for (owner, ep), cb in rows
+        }
+        return 200, "application/json", json.dumps(payload, indent=1).encode()
+    if not rows:
+        return (
+            200,
+            "text/plain",
+            b"no circuit breakers (no LB channel has completed a call)\n",
+        )
+    out = []
+    for (owner, ep), cb in rows:
+        d = cb.describe()
+        line = (
+            f"{ep} [{d['state']}] trips={d['isolated_times']} "
+            f"isolation_ms={d['isolation_duration_ms']}"
+        )
+        if "isolated_for_ms" in d:
+            line += f" isolated_for_ms={d['isolated_for_ms']:.0f}"
+        sw, lw = d["short_window"], d["long_window"]
+        line += (
+            f" short(err={sw['errors']}/{sw['samples']} "
+            f"cost={sw['ema_error_cost_us']}us)"
+            f" long(err={lw['errors']}/{lw['samples']} "
+            f"cost={lw['ema_error_cost_us']}us)"
+            f" owner={owner}"
+        )
+        out.append(line)
     return 200, "text/plain", ("\n".join(out) + "\n").encode()
 
 
@@ -530,6 +587,7 @@ _PAGES: Dict[str, object] = {
     "/brpc_metrics": _brpc_metrics,
     "/status": _status,
     "/flags": _flags,
+    "/circuit_breakers": _circuit_breakers,
     "/rpcz": _rpcz,
     "/connections": _connections,
     "/sockets": _sockets,

@@ -370,7 +370,16 @@ class LoadBalancerWithNaming:
     LoadBalancerWithNaming in details/load_balancer_with_naming.{h,cpp}).
 
     ``select_server(excluded)`` takes *socket ids* (what the channel's
-    ExcludedServers carries) and returns a connected Socket."""
+    ExcludedServers carries) and returns a connected Socket.
+
+    Per-node failure isolation (reference circuit_breaker.cpp feeding
+    SetLogOff on the node's Socket): every endpoint carries a
+    ``CircuitBreaker`` fed from the channel's end-of-RPC ``feedback``.
+    A tripped node leaves the candidate set for its (exponentially
+    doubling) isolation duration, then re-enters HALF_OPEN — and an
+    isolated node whose underlying socket health-check revives
+    (``on_revived``) re-enters early. All nodes isolated ⇒ ``select``
+    fails ⇒ the channel surfaces EHOSTDOWN."""
 
     MAX_PICK_ATTEMPTS = 3
 
@@ -383,11 +392,16 @@ class LoadBalancerWithNaming:
         server_filter=None,
         key_tag: str = "",
         conn_kwargs=None,
+        circuit_breaker: Optional[bool] = None,
     ):
         """Either ``url`` (owns a fresh NamingServiceThread) or ``ns_thread``
         (shared, not stopped by us — how PartitionChannel feeds N filtered
         views off one watcher). ``server_filter(ep) -> bool`` limits which
-        naming entries reach the LB (the reference's ns_filter seam)."""
+        naming entries reach the LB (the reference's ns_filter seam).
+        ``circuit_breaker`` None follows the ``enable_circuit_breaker``
+        flag; True/False force per-node isolation on/off."""
+        from incubator_brpc_tpu.utils.flags import get_flag
+
         self.lb = create_load_balancer(lb_name)
         if ns_thread is not None:
             self.ns_thread = ns_thread
@@ -408,6 +422,18 @@ class LoadBalancerWithNaming:
         self._socket_map = socket_map
         self._ep_by_sid: Dict[int, EndPoint] = {}
         self._map_lock = threading.Lock()
+        self._cb_enabled = (
+            bool(get_flag("enable_circuit_breaker"))
+            if circuit_breaker is None
+            else bool(circuit_breaker)
+        )
+        self._cb_tag = f"{url or lb_name}@{id(self):x}"
+        self._breakers: Dict[EndPoint, object] = {}
+        self._isolated: Dict[EndPoint, float] = {}  # ep -> monotonic deadline
+        self._cb_lock = threading.Lock()
+        # (sock, callback) pairs appended to long-lived global sockets —
+        # removed at stop() so a dead LB is not pinned by its hooks
+        self._revival_hooks: list = []
 
     def start(self) -> bool:
         if self._owns_ns and not self.ns_thread.start():
@@ -418,6 +444,135 @@ class LoadBalancerWithNaming:
     def stop(self) -> None:
         if self._owns_ns:
             self.ns_thread.stop()
+        if self._cb_enabled:
+            from incubator_brpc_tpu.rpc.circuit_breaker import breaker_registry
+
+            breaker_registry.unregister_owner(self._cb_tag)
+            # unpin this LB from the process-global sockets it hooked
+            # (sockets outlive channels; a leaked closure per dead LB
+            # would accumulate for the process lifetime)
+            with self._cb_lock:
+                hooks, self._revival_hooks = self._revival_hooks, []
+            for sock, cb in hooks:
+                try:
+                    sock.on_revived.remove(cb)
+                    sock.context.pop(f"_cb_revive_{self._cb_tag}", None)
+                except (ValueError, AttributeError):
+                    pass
+
+    # -- per-node circuit breaking ------------------------------------------
+
+    def _breaker(self, ep: EndPoint):
+        from incubator_brpc_tpu.rpc.circuit_breaker import (
+            CircuitBreaker,
+            breaker_registry,
+            ensure_breaker_gauge,
+        )
+
+        with self._cb_lock:
+            cb = self._breakers.get(ep)
+            if cb is None:
+                ensure_breaker_gauge()
+                cb = self._breakers[ep] = CircuitBreaker()
+                breaker_registry.register(
+                    self._cb_tag, f"{ep.ip}:{ep.port}", cb
+                )
+            return cb
+
+    def _isolate(self, ep: EndPoint) -> None:
+        """The node's breaker tripped: take it out of the candidate set
+        for its isolation duration, then revive HALF_OPEN. Revival is
+        both timer-driven (so the gauge/page freshen without traffic) and
+        lazily enforced in select_server (so tests need no timer races)."""
+        cb = self._breaker(ep)
+        duration_s = cb.isolation_duration_ms / 1e3
+        now = time.monotonic()
+        with self._cb_lock:
+            already = ep in self._isolated
+            self._isolated[ep] = now + duration_s
+        if not already:
+            logger.warning(
+                "circuit breaker isolated %s:%s for %.0f ms (trip #%d)",
+                ep.ip, ep.port, cb.isolation_duration_ms, cb.isolated_times,
+            )
+        from incubator_brpc_tpu.runtime.timer_thread import global_timer_thread
+
+        # a timer per deadline move: straggler failures extend the window
+        # and the previously parked timer bails on the not-yet-due check
+        # in _maybe_revive, so the EXTENDED deadline needs its own timer
+        # or an idle channel would stay 'isolated' until its next select
+        global_timer_thread().schedule(
+            lambda: self._maybe_revive(ep), delay=duration_s
+        )
+
+    def _maybe_revive(self, ep: EndPoint) -> None:
+        now = time.monotonic()
+        with self._cb_lock:
+            deadline = self._isolated.get(ep)
+            if deadline is None:
+                return
+            if deadline > now + 1e-4:
+                # re-isolated while this timer was parked: a fresh timer
+                # owns the new deadline
+                return
+            del self._isolated[ep]
+            cb = self._breakers.get(ep)
+        if cb is not None:
+            cb.reset()  # HALF_OPEN: candidate again, windows cleared
+            logger.info("circuit breaker revived %s:%s", ep.ip, ep.port)
+
+    def _revive_now(self, ep: EndPoint) -> None:
+        """Early revival — the node's socket health-check proved the peer
+        back (Socket.on_revived): no reason to sit out the rest of the
+        isolation window."""
+        with self._cb_lock:
+            if self._isolated.pop(ep, None) is None:
+                return
+            cb = self._breakers.get(ep)
+        if cb is not None:
+            cb.reset()
+
+    def _feed_breaker(self, ep: EndPoint, latency_us: float, error_code: int) -> None:
+        """One completed attempt's verdict into the node's breaker;
+        isolates on the trip TRANSITION only (stragglers completing after
+        the trip must not re-extend the deadline)."""
+        if not self._cb_enabled or error_code in (
+            ErrorCode.ECANCELED,
+            ErrorCode.EBACKUPREQUEST,
+        ):
+            return
+        cb = self._breaker(ep)
+        was_broken = cb.broken
+        if not cb.on_call_end(error_code, latency_us) and not was_broken:
+            self._isolate(ep)
+
+    def _isolated_eps(self) -> Set[EndPoint]:
+        """Currently isolated endpoints; expired isolations revive lazily
+        here (select-time), keeping revival deterministic under test."""
+        if not self._cb_enabled:
+            return set()
+        now = time.monotonic()
+        expired = []
+        with self._cb_lock:
+            live = set()
+            for ep, deadline in self._isolated.items():
+                if deadline <= now:
+                    expired.append(ep)
+                else:
+                    live.add(ep)
+        for ep in expired:
+            self._maybe_revive(ep)
+        return live
+
+    def isolated_servers(self) -> List[EndPoint]:
+        return sorted(self._isolated_eps())
+
+    def breaker_states(self) -> Dict[str, dict]:
+        """Per-endpoint breaker state (the /circuit_breakers page row
+        source for this LB)."""
+        with self._cb_lock:
+            items = list(self._breakers.items())
+        return {f"{ep.ip}:{ep.port}": cb.describe() for ep, cb in items}
 
     # NamingServiceThread observer surface (filtered pass-through to the LB)
     def add_server(self, ep: EndPoint) -> None:
@@ -427,16 +582,31 @@ class LoadBalancerWithNaming:
     def remove_server(self, ep: EndPoint) -> None:
         if self._server_filter is None or self._server_filter(ep):
             self.lb.remove_server(ep)
+            self._drop_breaker(ep)
+
+    def _drop_breaker(self, ep: EndPoint) -> None:
+        """Naming churn: a departed endpoint's breaker, isolation entry
+        and registry row go with it — a long-lived LB watching an
+        autoscaling pool must not accumulate ghosts (or hold a departed
+        node in the isolated gauge until its timer fires)."""
+        if not self._cb_enabled:
+            return
+        from incubator_brpc_tpu.rpc.circuit_breaker import breaker_registry
+
+        with self._cb_lock:
+            self._breakers.pop(ep, None)
+            self._isolated.pop(ep, None)
+        breaker_registry.unregister(self._cb_tag, f"{ep.ip}:{ep.port}")
 
     def select_server(
         self,
         excluded: Optional[Set[int]] = None,
         request_code: Optional[int] = None,
     ):
-        excluded_eps: Set[EndPoint] = set()
+        excluded_eps: Set[EndPoint] = self._isolated_eps()
         if excluded:
             with self._map_lock:
-                excluded_eps = {
+                excluded_eps |= {
                     self._ep_by_sid[sid] for sid in excluded if sid in self._ep_by_sid
                 }
         for _ in range(self.MAX_PICK_ATTEMPTS):
@@ -448,8 +618,13 @@ class LoadBalancerWithNaming:
                     ep, key_tag=self._key_tag, **self._conn_kwargs
                 )
             except OSError:
-                # select() already charged this pick (LA in-flight): settle it
+                # select() already charged this pick (LA in-flight): settle
+                # it — and a refused connect IS node evidence: it feeds the
+                # breaker too (the most common hard-down failure mode must
+                # isolate like any other, not stay in rotation burning a
+                # dial timeout per pick)
                 self.lb.feedback(ep, 0.0, ErrorCode.EFAILEDSOCKET)
+                self._feed_breaker(ep, 0.0, ErrorCode.EFAILEDSOCKET)
                 excluded_eps.add(ep)  # connect refused: try another server
                 continue
             from incubator_brpc_tpu.transport.sock import CONNECTED
@@ -458,12 +633,31 @@ class LoadBalancerWithNaming:
                 # dead and not revivable right now: treat like a refused
                 # connect instead of burning the attempt (ConnectIfNot)
                 self.lb.feedback(ep, 0.0, ErrorCode.EFAILEDSOCKET)
+                self._feed_breaker(ep, 0.0, ErrorCode.EFAILEDSOCKET)
                 excluded_eps.add(ep)
                 continue
             with self._map_lock:
                 self._ep_by_sid[sock.id] = ep
+            if self._cb_enabled:
+                self._hook_revival(sock, ep)
             return sock
         return None
+
+    def _hook_revival(self, sock, ep: EndPoint) -> None:
+        """An isolated node whose socket health-check revives re-enters
+        the candidate set early (transport/sock.py on_revived) — once per
+        socket, marked in its context."""
+        marker = f"_cb_revive_{self._cb_tag}"
+        ctx = getattr(sock, "context", None)
+        if ctx is None or marker in ctx:
+            return
+        ctx[marker] = True
+        hooks = getattr(sock, "on_revived", None)
+        if hooks is not None:
+            cb = lambda _s, _ep=ep: self._revive_now(_ep)  # noqa: E731
+            hooks.append(cb)
+            with self._cb_lock:
+                self._revival_hooks.append((sock, cb))
 
     def register_socket(self, sock, ep: EndPoint) -> None:
         """Track a secondary (pooled/short) connection under its endpoint
@@ -475,8 +669,13 @@ class LoadBalancerWithNaming:
     def feedback(self, sock, latency_us: float, error_code: int) -> None:
         with self._map_lock:
             ep = self._ep_by_sid.get(sock.id)
-        if ep is not None:
-            self.lb.feedback(ep, latency_us, error_code)
+        if ep is None:
+            return
+        self.lb.feedback(ep, latency_us, error_code)
+        # a canceled call (or a backup-superseded original, settled as
+        # EBACKUPREQUEST) says nothing about the NODE; everything else
+        # feeds the breaker's error-cost windows
+        self._feed_breaker(ep, latency_us, error_code)
 
     def settle(self, sock) -> None:
         with self._map_lock:

@@ -29,7 +29,20 @@ from incubator_brpc_tpu.rpc.combo import (
     SelectiveChannel,
     SubCall,
 )
+from incubator_brpc_tpu.rpc.circuit_breaker import (
+    CircuitBreaker,
+    breaker_registry,
+)
+from incubator_brpc_tpu.rpc.concurrency_limiter import (
+    AutoConcurrencyLimiter,
+    ConcurrencyLimiter,
+    ConstantConcurrencyLimiter,
+)
 from incubator_brpc_tpu.rpc.device_method import DeviceMethod, device_method
+from incubator_brpc_tpu.rpc.fault_injector import (
+    FaultInjector,
+    install_socket_injector,
+)
 from incubator_brpc_tpu.rpc.stream import (
     Stream,
     StreamHandler,
@@ -41,7 +54,14 @@ from incubator_brpc_tpu.transport.native_plane import native_echo, native_nop
 
 __all__ = [
     "Authenticator",
+    "AutoConcurrencyLimiter",
     "CallMapper",
+    "CircuitBreaker",
+    "ConcurrencyLimiter",
+    "ConstantConcurrencyLimiter",
+    "FaultInjector",
+    "breaker_registry",
+    "install_socket_injector",
     "Channel",
     "DynamicPartitionChannel",
     "SharedSecretAuthenticator",

@@ -984,6 +984,11 @@ class Channel:
             # in flight (controller.cpp:565-598)
             if not cntl.has_backup_request:
                 cntl.has_backup_request = True
+                # the attempts in flight RIGHT NOW are merely raced, not
+                # failed: EndRPC settles them as EBACKUPREQUEST (ignored
+                # by the circuit breaker) — later retried-away attempts
+                # still settle as genuine failures
+                cntl._backup_superseded = {s.id for s in cntl._sent_sockets}
                 if cntl._sent_sockets:
                     cntl._excluded_sockets.add(cntl._sent_sockets[-1].id)
                 self._issue_rpc(cntl)
@@ -1085,10 +1090,21 @@ class Channel:
             # every issued attempt (retries, backup duplicates) was a
             # select() — feed each back exactly once so LA's in-flight
             # accounting balances (Call::OnComplete does per-call Feedback,
-            # controller.cpp:698-777)
+            # controller.cpp:698-777). A backup-raced attempt is not a
+            # node failure (it may be healthy-but-slow, possibly even
+            # answered): exactly the sockets in flight when the backup
+            # fired settle as EBACKUPREQUEST, which the LB's circuit
+            # breaker ignores — attempts retried away on a genuine error
+            # still charge their node's error windows.
             last = cntl._sent_sockets[-1] if cntl._sent_sockets else None
+            raced = getattr(cntl, "_backup_superseded", ())
             for sock in cntl._sent_sockets:
-                code = cntl.error_code if sock is last else ErrorCode.EFAILEDSOCKET
+                if sock is last:
+                    code = cntl.error_code
+                elif sock.id in raced:
+                    code = ErrorCode.EBACKUPREQUEST
+                else:
+                    code = ErrorCode.EFAILEDSOCKET
                 self._lb.feedback(sock, cntl.latency_us, code)
         timer = global_timer_thread()
         for tid in cntl._timer_ids:

@@ -86,12 +86,30 @@ def _jax_distributed_initialized() -> bool:
 
 class MethodStatus:
     """Per-method concurrency gate + latency stats
-    (details/method_status.h:28,90-97: _nprocessing fetch_add vs
-    _max_concurrency; latency bvars fed in OnResponded)."""
+    (details/method_status.h:28,90-97: _nprocessing fetch_add vs the
+    ConcurrencyLimiter; latency bvars fed in OnResponded).
 
-    def __init__(self, full_name: str, max_concurrency: int = 0):
+    ``max_concurrency`` accepts an int (0 = unlimited) or ``"auto"`` —
+    the adaptive gradient limiter (policy/auto_concurrency_limiter.cpp)
+    fed from this method's own completion samples. ``on_limit_change``
+    is forwarded to an auto limiter so the server can push adaptive
+    limits into the native plane."""
+
+    def __init__(
+        self,
+        full_name: str,
+        max_concurrency: Union[int, str] = 0,
+        on_limit_change=None,
+    ):
+        from incubator_brpc_tpu.rpc.concurrency_limiter import (
+            create_concurrency_limiter,
+        )
+
         self.full_name = full_name
-        self.max_concurrency = max_concurrency  # 0 = unlimited
+        self._on_limit_change = on_limit_change
+        self._limiter = create_concurrency_limiter(
+            max_concurrency, on_limit_change=on_limit_change
+        )
         self._nprocessing = 0
         self._lock = threading.Lock()
         self.latency = LatencyRecorder(name=f"method_{full_name}_latency")
@@ -101,16 +119,40 @@ class MethodStatus:
     def processing(self) -> int:
         return self._nprocessing
 
+    @property
+    def max_concurrency(self) -> int:
+        """Current limit (adaptive limiters move it); 0 = unlimited."""
+        return self._limiter.max_concurrency() if self._limiter else 0
+
+    @max_concurrency.setter
+    def max_concurrency(self, value: Union[int, str]) -> None:
+        from incubator_brpc_tpu.rpc.concurrency_limiter import (
+            create_concurrency_limiter,
+        )
+
+        self._limiter = create_concurrency_limiter(
+            value, on_limit_change=self._on_limit_change
+        )
+
+    @property
+    def limiter(self):
+        return self._limiter
+
     def on_requested(self) -> bool:
         with self._lock:
-            if self.max_concurrency and self._nprocessing >= self.max_concurrency:
-                return False
             self._nprocessing += 1
-            return True
+            current = self._nprocessing
+        if self._limiter is not None and not self._limiter.on_requested(current):
+            with self._lock:
+                self._nprocessing -= 1
+            return False
+        return True
 
     def on_responded(self, error_code: int, latency_us: float) -> None:
         with self._lock:
             self._nprocessing -= 1
+        if self._limiter is not None:
+            self._limiter.on_responded(error_code, latency_us)
         if error_code == 0:
             self.latency << latency_us
         else:
@@ -199,8 +241,8 @@ class ServerOptions:
 
     def __init__(
         self,
-        max_concurrency: int = 0,
-        method_max_concurrency: int = 0,
+        max_concurrency: Union[int, str] = 0,
+        method_max_concurrency: Union[int, str] = 0,
         idle_timeout_s: float = -1,
         has_builtin_services: bool = True,
         auth=None,
@@ -219,9 +261,17 @@ class ServerOptions:
         reserved_thread_local_data: int = 0,
         enable_collective_service: Optional[bool] = None,
         collective_max_concurrency: int = 1,
+        fault_injector=None,
     ):
+        # int (0 = unlimited) or "auto" — the adaptive gradient limiter
+        # (reference AdaptiveMaxConcurrency, server.h + policy/
+        # auto_concurrency_limiter.cpp) applied server-wide / per-method
         self.max_concurrency = max_concurrency
         self.method_max_concurrency = method_max_concurrency
+        # rpc/fault_injector.FaultInjector: scripted brownouts at the
+        # frame-dispatch seam (error/delay/close before the handler runs);
+        # acts only while the ``fault_injection`` master flag is on
+        self.fault_injector = fault_injector
         self.idle_timeout_s = idle_timeout_s
         self.has_builtin_services = has_builtin_services
         self.auth = auth  # Authenticator (rpc/auth.py)
@@ -292,7 +342,19 @@ class ServerOptions:
 
 class Server:
     def __init__(self, options: Optional[ServerOptions] = None):
+        from incubator_brpc_tpu.rpc.concurrency_limiter import (
+            create_concurrency_limiter,
+        )
+
         self.options = options or ServerOptions()
+        # server-wide admission limiter (int spec or "auto"); limit moves
+        # are pushed to natively-registered methods so the C++ dispatch
+        # path honors the adaptive limit too
+        self._server_limiter = create_concurrency_limiter(
+            self.options.max_concurrency,
+            on_limit_change=self._on_server_limit_change,
+        )
+        self._limit_gauges: list = []  # PassiveStatus rows, hidden at stop
         self._methods = _MethodMap()
         self._http_handlers: Dict[str, Callable] = {}
         self._http_progressive: set = set()  # routes streaming chunked bodies
@@ -321,11 +383,33 @@ class Server:
 
     # -- registration --------------------------------------------------------
 
+    def _method_limit_pusher(self, full_name: str) -> Callable[[int], None]:
+        """on_limit_change hook for a method's adaptive limiter: keep the
+        native plane's per-request limit in step with the Python one."""
+
+        def push(new_limit: int) -> None:
+            plane = self._native_plane
+            if plane is not None:
+                plane.set_native_max_concurrency(full_name, new_limit)
+
+        return push
+
+    def _on_server_limit_change(self, new_limit: int) -> None:
+        """The server-wide adaptive limit moved: natively-registered
+        methods without their own limit follow it (the C++ plane has no
+        server-level gate, so the server-wide limit is distributed as a
+        per-method ceiling — tb_server_set_native_max_concurrency)."""
+        plane = self._native_plane
+        if plane is None:
+            return
+        for full in plane.auto_limit_targets():
+            plane.set_native_max_concurrency(full, new_limit)
+
     def add_service(
         self,
         name: str,
         handlers: Dict[str, Callable],
-        max_concurrency: Optional[int] = None,
+        max_concurrency: Union[int, str, None] = None,
         restful_mappings: str = "",
     ) -> None:
         """Register ``name.method → handler`` rows (Server::AddService builds
@@ -355,7 +439,16 @@ class Server:
                 if max_concurrency is not None
                 else self.options.method_max_concurrency
             )
-            self._methods.insert(full, MethodProperty(handler, MethodStatus(full, mc), full))
+            self._methods.insert(
+                full,
+                MethodProperty(
+                    handler,
+                    MethodStatus(
+                        full, mc, on_limit_change=self._method_limit_pusher(full)
+                    ),
+                    full,
+                ),
+            )
             dm = getattr(handler, "_device_method", None)
             if dm is not None:
                 # device-kernel methods publish to the collective-lowering
@@ -582,6 +675,22 @@ class Server:
         if use_native:
             self._native_plane = plane
             self.listen_endpoint = EndPoint(ip=ep.ip, port=port)
+            # adaptive limits reach the C++ dispatch path from day one:
+            # seed every natively-registered method with the current
+            # server-wide auto limit (updates follow via on_limit_change)
+            from incubator_brpc_tpu.rpc.concurrency_limiter import (
+                AutoConcurrencyLimiter,
+            )
+
+            if isinstance(self._server_limiter, AutoConcurrencyLimiter):
+                self._on_server_limit_change(
+                    self._server_limiter.max_concurrency()
+                )
+            for full, prop in self._methods.items():
+                if isinstance(prop.status.limiter, AutoConcurrencyLimiter):
+                    plane.set_native_max_concurrency(
+                        full, prop.status.max_concurrency
+                    )
         else:
             self._acceptor = Acceptor(
                 ep,
@@ -604,8 +713,36 @@ class Server:
             from incubator_brpc_tpu.builtin import portal
 
             portal.register_server(self)
+        self._expose_limiter_gauges()
         logger.info("server started on %s", self.listen_endpoint)
         return True
+
+    def _expose_limiter_gauges(self) -> None:
+        """Scrapeable adaptive-limit state: one gauge per auto limiter
+        (server-wide + per-method), port-scoped since one process runs
+        many servers. Hidden at stop so the names free up."""
+        from incubator_brpc_tpu.bvar import PassiveStatus
+        from incubator_brpc_tpu.rpc.concurrency_limiter import (
+            AutoConcurrencyLimiter,
+        )
+
+        port = self.port
+        if isinstance(self._server_limiter, AutoConcurrencyLimiter):
+            self._limit_gauges.append(
+                PassiveStatus(
+                    self._server_limiter.max_concurrency,
+                    name=f"server_{port}_max_concurrency",
+                )
+            )
+        for full, prop in self._methods.items():
+            lim = prop.status.limiter
+            if isinstance(lim, AutoConcurrencyLimiter):
+                self._limit_gauges.append(
+                    PassiveStatus(
+                        lim.max_concurrency,
+                        name=f"server_{port}_{full}_max_concurrency",
+                    )
+                )
 
     def _schedule_idle_reap(self) -> None:
         from incubator_brpc_tpu.runtime.timer_thread import global_timer_thread
@@ -649,6 +786,12 @@ class Server:
         if not self._started:
             return
         self._stopping = True
+        for g in self._limit_gauges:
+            try:
+                g.hide()
+            except Exception:
+                pass
+        self._limit_gauges.clear()
         if self._acceptor is not None:
             self._acceptor.stop()
         if self._native_plane is not None:
@@ -802,6 +945,29 @@ class Server:
         # SendRpcResponse off the request's protocol the same way)
         cntl._wire_protocol = getattr(frame, "wire_protocol", "tbus_std")
         cntl._mark_start()
+
+        inj = self.options.fault_injector
+        if inj is not None:
+            from incubator_brpc_tpu.rpc.fault_injector import (
+                ACTION_CLOSE,
+                ACTION_ERROR,
+            )
+            from incubator_brpc_tpu.utils.flags import get_flag as _gf
+
+            if _gf("fault_injection"):
+                # the frame-dispatch seam: a scripted brownout fails,
+                # delays (decide() sleeps) or drops this request before
+                # the handler runs — the deterministic misbehaving
+                # backend the limiter/breaker proofs drive against
+                action = inj.decide()
+                if action == ACTION_CLOSE:
+                    sock.set_failed(ErrorCode.ECLOSE, "injected close")
+                    return
+                if action == ACTION_ERROR:
+                    cntl.set_failed(inj.error_code, "injected fault")
+                    self.nerror << 1
+                    self._send_response(sock, cntl, b"")
+                    return
 
         if self._stopping:
             cntl.set_failed(ErrorCode.ELOGOFF, berror(ErrorCode.ELOGOFF))
@@ -987,54 +1153,109 @@ class Server:
         """Server-level then per-method gate; True = admitted (caller MUST
         pair with _release)."""
         with self._lock:
-            admitted_server = not (
-                self.options.max_concurrency
-                and self._nprocessing >= self.options.max_concurrency
-            )
-            if admitted_server:
-                self._nprocessing += 1
+            self._nprocessing += 1
+            current = self._nprocessing
+        admitted_server = (
+            self._server_limiter is None
+            or self._server_limiter.on_requested(current)
+        )
         if admitted_server and status.on_requested():
             return True
-        if admitted_server:  # method gate refused: undo the server add
-            with self._lock:
-                self._nprocessing -= 1
-                if self._nprocessing == 0:
-                    self._quiescent.notify_all()
+        # server or method gate refused: undo the server add
+        with self._lock:
+            self._nprocessing -= 1
+            if self._nprocessing == 0:
+                self._quiescent.notify_all()
         return False
 
     def _release(self, status: MethodStatus, cntl: Controller) -> None:
         status.on_responded(cntl.error_code, cntl.latency_us)
+        if self._server_limiter is not None:
+            self._server_limiter.on_responded(cntl.error_code, cntl.latency_us)
         with self._lock:
             self._nprocessing -= 1
             if self._nprocessing == 0:
                 self._quiescent.notify_all()
 
-    def reset_max_concurrency(self, max_concurrency: int) -> int:
-        """Change the server-level concurrency limit while RUNNING
-        (reference Server::ResetMaxConcurrency, server.h:483-488).
-        Returns the previous limit; 0 = unlimited. Takes effect on the
-        next admission check — in-flight requests are never evicted.
+    @property
+    def max_concurrency(self) -> int:
+        """Current server-wide limit (an auto limiter moves it); 0 =
+        unlimited."""
+        return (
+            self._server_limiter.max_concurrency()
+            if self._server_limiter is not None
+            else 0
+        )
 
-        Native-plane caveat: a server that STARTED with max_concurrency=0
-        registered its native-kind methods for pure-C++ dispatch, which
-        has no server-level gate — raising a server-level limit later
-        bounds the Python-routed methods only (per-method limits reach
-        the native plane, see set_method_max_concurrency)."""
+    @property
+    def fault_injector(self):
+        return self.options.fault_injector
+
+    @fault_injector.setter
+    def fault_injector(self, inj) -> None:
+        self.options.fault_injector = inj
+
+    def reset_max_concurrency(self, max_concurrency: Union[int, str]) -> Union[int, str]:
+        """Change the server-level concurrency spec while RUNNING
+        (reference Server::ResetMaxConcurrency, server.h:483-488): an int
+        (0 = unlimited) or "auto" (a FRESH adaptive limiter). Returns the
+        previous spec. Takes effect on the next admission check —
+        in-flight requests are never evicted.
+
+        Native-plane caveat: a server that STARTED without a constant
+        server-wide limit registered its native-kind methods for pure-C++
+        dispatch, which has no server-level gate — a constant limit set
+        later bounds the Python-routed methods only; an adaptive limit is
+        pushed per-method into the plane as it moves (see
+        _on_server_limit_change)."""
+        from incubator_brpc_tpu.rpc.concurrency_limiter import (
+            AutoConcurrencyLimiter,
+            create_concurrency_limiter,
+        )
+
         prev = self.options.max_concurrency
-        self.options.max_concurrency = max(0, int(max_concurrency))
+        if isinstance(max_concurrency, str):
+            spec: Union[int, str] = max_concurrency
+        else:
+            spec = max(0, int(max_concurrency))
+        self.options.max_concurrency = spec
+        self._server_limiter = create_concurrency_limiter(
+            spec, on_limit_change=self._on_server_limit_change
+        )
+        # re-seed the native plane: leaving the OLD adaptive ceiling in
+        # the C++ per-method table would keep shedding at a stale limit
+        # forever after the operator switched specs
+        if isinstance(self._server_limiter, AutoConcurrencyLimiter):
+            self._on_server_limit_change(
+                self._server_limiter.max_concurrency()
+            )
+        else:
+            # unlimited or constant: constant server-wide limits are not
+            # natively enforceable (see register_methods), so the native
+            # auto-followers revert to their registered 0 = unlimited
+            self._on_server_limit_change(0)
         return prev
 
-    def set_method_max_concurrency(self, full_name: str, n: int) -> bool:
+    def set_method_max_concurrency(self, full_name: str, n: Union[int, str]) -> bool:
         """Per-method runtime limit (reference MaxConcurrencyOf setter,
-        server.h:490): True if the method exists. Propagates to the
-        native plane, where the limit is read per request."""
+        server.h:490): an int or "auto"; True if the method exists.
+        Propagates to the native plane, where the limit is read per
+        request."""
         prop = self._methods.get(full_name)
         if prop is None:
             return False
-        prop.status.max_concurrency = max(0, int(n))
+        prop.status.max_concurrency = (
+            n if isinstance(n, str) else max(0, int(n))
+        )
         if self._native_plane is not None:
             self._native_plane.set_native_max_concurrency(
                 full_name, prop.status.max_concurrency
+            )
+            # a method with its OWN limiter must no longer follow the
+            # server-wide adaptive pushes (they would clobber the explicit
+            # cap on the C++ plane); clearing back to unlimited resumes
+            self._native_plane.set_auto_limit_target(
+                full_name, prop.status.limiter is None
             )
         return True
 

@@ -837,6 +837,13 @@ class DeviceLinkMap:
         # bounded by the distinct peers this process ever contacts
         self._key_locks: Dict[tuple, threading.Lock] = {}
         self._cred_refs: Dict[tuple, tuple] = {}  # keep id()-keyed objects alive
+        # re-handshake backoff per key: (consecutive_failures,
+        # next_allowed_monotonic). A dead peer must not be storm-redialed
+        # by every caller that wants the link — failures double the wait
+        # (device_link_backoff_initial_ms .. _max_ms), success clears it —
+        # the device-plane analog of the circuit breaker's exponential
+        # isolation (reference rdma_endpoint re-establishment discipline)
+        self._backoff: Dict[tuple, tuple] = {}
 
     def _key_lock(self, key: tuple) -> threading.Lock:
         with self._lock:
@@ -892,6 +899,21 @@ class DeviceLinkMap:
                 ds.recycle()  # free the dead link's registry slot
                 with self._lock:
                     self._links.pop(key, None)
+            # exponential re-handshake backoff: while a recent attempt to
+            # this peer failed, refuse instantly instead of dialing — the
+            # caller's retry/LB machinery routes around the peer
+            from time import monotonic as _mono
+
+            from incubator_brpc_tpu.utils.flags import get_flag as _gf
+
+            with self._lock:
+                bo = self._backoff.get(key)
+            if bo is not None and _mono() < bo[1]:
+                raise ConnectionError(
+                    f"device link to {ep.ip}:{ep.port} backing off after "
+                    f"{bo[0]} failed handshake(s) "
+                    f"({max(0.0, bo[1] - _mono()) * 1e3:.0f} ms left)"
+                )
             # The handshake rides a fresh host channel to the peer (the
             # reference's TCP-piggybacked magic+cookie) carrying the
             # caller's credentials; the global client socket map dedupes
@@ -900,41 +922,55 @@ class DeviceLinkMap:
             # cached one would freeze the first caller's timeout forever).
             from incubator_brpc_tpu.rpc.channel import Channel, ChannelOptions
 
-            boot = Channel()
-            if not boot.init(
-                EndPoint(ip=ep.ip, port=ep.port),
-                options=ChannelOptions(
-                    timeout_ms=timeout_ms,
-                    auth=auth,
-                    ssl_context=ssl_context,
-                    ssl_server_hostname=ssl_server_hostname,
-                ),
-            ):
-                raise ConnectionError(
-                    f"device-link bootstrap channel init failed for {ep}"
-                )
-            if controller == "multi":
-                from incubator_brpc_tpu.transport.mc_link import (
-                    establish_mc_link,
-                )
+            try:
+                boot = Channel()
+                if not boot.init(
+                    EndPoint(ip=ep.ip, port=ep.port),
+                    options=ChannelOptions(
+                        timeout_ms=timeout_ms,
+                        auth=auth,
+                        ssl_context=ssl_context,
+                        ssl_server_hostname=ssl_server_hostname,
+                    ),
+                ):
+                    raise ConnectionError(
+                        f"device-link bootstrap channel init failed for {ep}"
+                    )
+                if controller == "multi":
+                    from incubator_brpc_tpu.transport.mc_link import (
+                        establish_mc_link,
+                    )
 
-                ds = establish_mc_link(
-                    boot,
-                    device_index=device_index,
-                    slot_words=slot_words,
-                    window=window,
-                    timeout_ms=timeout_ms,
+                    ds = establish_mc_link(
+                        boot,
+                        device_index=device_index,
+                        slot_words=slot_words,
+                        window=window,
+                        timeout_ms=timeout_ms,
+                    )
+                else:
+                    ds = establish_device_link(
+                        boot,
+                        device_index=device_index,
+                        slot_words=slot_words,
+                        window=window,
+                        timeout_ms=timeout_ms,
+                        ack_mode=ack_mode,
+                    )
+            except Exception:
+                # failed handshake: arm/double the backoff window so the
+                # next caller fails fast instead of re-storming the peer
+                failures = (bo[0] if bo is not None else 0) + 1
+                wait_ms = min(
+                    int(_gf("device_link_backoff_initial_ms"))
+                    * (2 ** (failures - 1)),
+                    int(_gf("device_link_backoff_max_ms")),
                 )
-            else:
-                ds = establish_device_link(
-                    boot,
-                    device_index=device_index,
-                    slot_words=slot_words,
-                    window=window,
-                    timeout_ms=timeout_ms,
-                    ack_mode=ack_mode,
-                )
+                with self._lock:
+                    self._backoff[key] = (failures, _mono() + wait_ms / 1e3)
+                raise
             with self._lock:
+                self._backoff.pop(key, None)  # healthy again
                 # opportunistic sweep: recycle dead entries so a long-lived
                 # process contacting many ephemeral peers does not
                 # accumulate dead sockets in the registry

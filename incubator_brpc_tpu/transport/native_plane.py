@@ -231,6 +231,11 @@ class NativeServerPlane:
         self._stats_snap = None  # (monotonic, stats dict) for the gauges
         self._handoff_socks: set = set()  # live handed-off Python Sockets
         self._user_libs: list = []  # dlopened user-method libraries
+        self._native_names: list = []  # fulls registered for C++ dispatch
+        # natively-registered methods with no limit of their own: the
+        # server-wide ADAPTIVE limit is distributed to them per-method
+        # (the C++ plane has no server-level gate)
+        self._auto_targets: list = []
         self._stopped = False
         self.port = 0
 
@@ -239,13 +244,26 @@ class NativeServerPlane:
     def register_methods(self) -> None:
         """Register native-kind handlers (echo/nop) for pure-C++ dispatch;
         everything else stays on the per-frame Python route. Gates the
-        Python route enforces per request — the Authenticator and the
-        server-wide max_concurrency — cannot be skipped by a fast path, so
-        servers configured with either keep ALL methods on the Python
-        route (native kinds only elide work, never checks)."""
-        if (
-            self._server.options.auth is not None
-            or self._server.options.max_concurrency
+        Python route enforces per request — the Authenticator and a
+        CONSTANT server-wide max_concurrency — cannot be skipped by a fast
+        path, so servers configured with either keep ALL methods on the
+        Python route (native kinds only elide work, never checks). A
+        server-wide "auto" limit is different: it IS enforceable natively,
+        as a per-method ceiling pushed through
+        tb_server_set_native_max_concurrency every time the adaptive
+        limit moves (Server._on_server_limit_change) — the native plane
+        honors the adaptive limit without the interpreter on the path."""
+        from incubator_brpc_tpu.rpc.concurrency_limiter import (
+            AutoConcurrencyLimiter,
+        )
+
+        # gate on the RESOLVED limiter, not the raw spec: "12" is a
+        # constant limit too (create_concurrency_limiter accepts numeric
+        # strings) and must keep methods on the Python route like any
+        # other constant
+        lim = self._server._server_limiter
+        if self._server.options.auth is not None or (
+            lim is not None and not isinstance(lim, AutoConcurrencyLimiter)
         ):
             return
         for full, prop in self._server.methods().items():
@@ -254,6 +272,9 @@ class NativeServerPlane:
                 LIB.tb_server_register_native(
                     self._srv, full.encode(), kind, prop.status.max_concurrency
                 )
+                self._native_names.append(full)
+                if prop.status.limiter is None:
+                    self._auto_targets.append(full)
                 continue
             lib_spec = getattr(prop.handler, "_native_lib", None)
             if lib_spec is not None:
@@ -277,6 +298,9 @@ class NativeServerPlane:
                 )
                 if rc == 0:
                     self._user_libs.append(dll)  # keepalive
+                    self._native_names.append(full)
+                    if prop.status.limiter is None:
+                        self._auto_targets.append(full)
                 else:
                     logger.warning(
                         "native registration of %s rejected (duplicate or "
@@ -286,21 +310,52 @@ class NativeServerPlane:
 
     def set_native_max_concurrency(self, full_name: str, n: int) -> bool:
         """Runtime retune of a natively-registered method's admission
-        limit (no-op False if the method is not native)."""
-        return (
-            LIB.tb_server_set_native_max_concurrency(
-                self._srv, full_name.encode(), n
+        limit (no-op False if the method is not native). Guarded against
+        the stopped plane: a limiter update racing tb_server_destroy (a
+        straggler completion after Server.stop) must not touch freed
+        state."""
+        with self._stats_lock:
+            if self._srv is None:
+                return False
+            return (
+                LIB.tb_server_set_native_max_concurrency(
+                    self._srv, full_name.encode(), int(n)
+                )
+                == 0
             )
-            == 0
-        )
+
+    def native_method_names(self) -> list:
+        """Methods dispatched on the C++ plane (registration order)."""
+        return list(self._native_names)
+
+    def auto_limit_targets(self) -> list:
+        """Natively-registered methods that follow the server-wide
+        adaptive limit (no per-method limiter of their own)."""
+        return list(self._auto_targets)
+
+    def set_auto_limit_target(self, full_name: str, follow: bool) -> None:
+        """Flip whether a native method follows the server-wide adaptive
+        limit: a per-method limit set at runtime must STOP the server-wide
+        pushes from clobbering it (and vice versa when cleared back to
+        unlimited)."""
+        if full_name not in self._native_names:
+            return
+        if follow and full_name not in self._auto_targets:
+            self._auto_targets.append(full_name)
+        elif not follow and full_name in self._auto_targets:
+            self._auto_targets.remove(full_name)
 
     def native_max_concurrency(self, full_name: str) -> int:
-        """Current native-plane limit; -1 = not natively registered."""
-        return int(
-            LIB.tb_server_get_native_max_concurrency(
-                self._srv, full_name.encode()
+        """Current native-plane limit; -1 = not natively registered (or
+        the plane already stopped)."""
+        with self._stats_lock:
+            if self._srv is None:
+                return -1
+            return int(
+                LIB.tb_server_get_native_max_concurrency(
+                    self._srv, full_name.encode()
+                )
             )
-        )
 
     def listen(self, ip: str, port: int) -> int:
         rc = LIB.tb_server_listen(self._srv, ip.encode(), port)

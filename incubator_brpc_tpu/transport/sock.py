@@ -493,6 +493,20 @@ class Socket:
         back to the KeepWrite fiber if ``timeout`` elapses."""
         if self.state != CONNECTED:
             return ErrorCode.EFAILEDSOCKET
+        # the socket-write fault seam (rpc/fault_injector.py): the master
+        # flag gates everything, so the steady-state cost is ONE flag
+        # read (the module import happens only while injection is armed)
+        if get_flag("fault_injection"):
+            from incubator_brpc_tpu.rpc.fault_injector import socket_injector
+
+            _inj = socket_injector()
+            if _inj is not None:
+                _action = _inj.decide()
+                if _action == "close":
+                    self.set_failed(ErrorCode.EFAILEDSOCKET, "injected close")
+                    return ErrorCode.EFAILEDSOCKET
+                if _action == "error":
+                    return ErrorCode.EFAILEDSOCKET
         if isinstance(data, (bytes, bytearray, memoryview)):
             buf = IOBuf()
             buf.append(bytes(data))

@@ -18,6 +18,7 @@ EXAMPLES = [
     "examples/tls_echo.py",
     "examples/rtmp_relay.py",
     "examples/naming_failover.py",
+    "examples/overload_and_breaker.py",
     "examples/cache_clients.py",
     "examples/link_performance.py",
     "examples/http_upload.py",

@@ -420,6 +420,123 @@ class TestPythonRouteSemantics:
         assert not errs, errs[:3]
 
 
+class TestPrpcFuzzRobustness:
+    """Fuzz-shaped adversarial wire input against the C++ PRPC cutter:
+    truncated headers, oversized body_size, garbage and overflowing
+    RpcMeta varints, mid-frame connection close. The invariant is always
+    the same — the SERVER survives (no crash, no wedge): bad frames cost
+    at most their own connection (clean teardown) or route harmlessly to
+    the Python plane; the port keeps answering well-formed traffic."""
+
+    def _assert_still_serving(self, srv):
+        ch = Channel()
+        assert ch.init(
+            f"127.0.0.1:{srv.port}",
+            options=ChannelOptions(native_plane=True, protocol="baidu_std"),
+        )
+        c = ch.call_method("svc", "echo", b"probe")
+        assert c.ok(), c.error_text
+        assert c.response_payload == b"probe"
+
+    def _open(self, srv):
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        s.settimeout(5)
+        return s
+
+    def test_truncated_header_then_close(self, native_server):
+        # every prefix of a valid 12-byte header, connection closed
+        # mid-header: the cutter must just drop the conn state
+        srv = native_server({"svc": {"echo": native_echo}})
+        whole = b"PRPC" + struct.pack(">II", 10, 4)
+        for cut in range(1, len(whole)):
+            s = self._open(srv)
+            s.sendall(whole[:cut])
+            s.close()
+        self._assert_still_serving(srv)
+
+    def test_oversized_body_size_rejected(self, native_server):
+        # body_size beyond the configured max: the connection dies
+        # without the server ever allocating/buffering the claimed blob
+        srv = native_server({"svc": {"echo": native_echo}})
+        s = self._open(srv)
+        s.sendall(b"PRPC" + struct.pack(">II", 0xFFFFFFF0, 8))
+        assert s.recv(1) == b""  # killed cleanly
+        s.close()
+        self._assert_still_serving(srv)
+
+    def test_garbage_meta_varints(self, native_server):
+        # RpcMeta bytes that are pure garbage: unknown tags, truncated
+        # varints, wire-type soup — at most the connection dies; several
+        # of these decode as unknown-field frames and route to Python,
+        # which answers ENOSERVICE/EREQUEST instead of crashing
+        srv = native_server({"svc": {"echo": native_echo}})
+        metas = [
+            b"\xff" * 16,  # unterminated varint tag run
+            b"\x0a\xff",  # length-delimited field, truncated length
+            b"\x20" + b"\x80" * 11,  # cid varint longer than 10 bytes
+            b"\x07\x01\x02",  # wire type 7 (invalid)
+            bytes(range(1, 32)),  # tag/wire-type soup
+        ]
+        for meta in metas:
+            s = self._open(srv)
+            wire = b"PRPC" + struct.pack(">II", len(meta) + 2, len(meta))
+            s.sendall(wire + meta + b"xx")
+            try:
+                s.recv(4096)  # server may answer an error or close; both fine
+            except (TimeoutError, socket.timeout):
+                pass
+            s.close()
+        self._assert_still_serving(srv)
+
+    def test_overflowing_varint_field_length(self, native_server):
+        # a nested submeta whose length varint overflows 64 bits: bounds
+        # math must not wrap into an out-of-bounds read
+        srv = native_server({"svc": {"echo": native_echo}})
+        evil = b"\x0a" + b"\xff" * 10 + b"\x7f"
+        s = self._open(srv)
+        s.sendall(b"PRPC" + struct.pack(">II", len(evil) + 1, len(evil)) + evil + b"y")
+        assert s.recv(1) == b""
+        s.close()
+        self._assert_still_serving(srv)
+
+    def test_mid_frame_close_after_header(self, native_server):
+        # header promises 1000 body bytes; the peer dies after 100: the
+        # half-read frame must be discarded with the connection
+        srv = native_server({"svc": {"echo": native_echo}})
+        sub = baidu_std.encode_request_submeta("svc", "echo")
+        meta = b"\x0a" + bytes([len(sub)]) + sub + b"\x20\x05"
+        s = self._open(srv)
+        s.sendall(b"PRPC" + struct.pack(">II", len(meta) + 1000, len(meta)))
+        s.sendall(meta + b"z" * 100)  # 900 bytes short
+        s.close()
+        self._assert_still_serving(srv)
+
+    def test_mid_frame_close_inside_meta(self, native_server):
+        srv = native_server({"svc": {"echo": native_echo}})
+        s = self._open(srv)
+        s.sendall(b"PRPC" + struct.pack(">II", 600, 500) + b"\x0a\x10garb")
+        s.close()
+        self._assert_still_serving(srv)
+
+    def test_interleaved_garbage_and_valid_connections(self, native_server):
+        # a hostile client must not degrade service for a well-behaved
+        # neighbor connection open at the same time
+        srv = native_server({"svc": {"echo": native_echo}})
+        good = Channel()
+        assert good.init(
+            f"127.0.0.1:{srv.port}",
+            options=ChannelOptions(native_plane=True, protocol="baidu_std"),
+        )
+        assert good.call_method("svc", "echo", b"a").ok()
+        for i in range(8):
+            s = self._open(srv)
+            s.sendall(b"PRPC" + struct.pack(">II", 0xFFFFFFF0, i))
+            s.close()
+            c = good.call_method("svc", "echo", b"b%d" % i)
+            assert c.ok(), c.error_text
+            assert c.response_payload == b"b%d" % i
+
+
 class TestPrpcPump:
     def test_pump_interpreter_free(self, native_server):
         srv = native_server({"svc": {"echo": native_echo}})

@@ -1,0 +1,1095 @@
+"""Overload control + failure isolation (reference
+policy/auto_concurrency_limiter.cpp + circuit_breaker.cpp + the
+fault-injection proof plane).
+
+Three layers of proof:
+
+- unit: the gradient limiter driven on a SYNTHETIC clock (every
+  ``on_responded`` carries ``now_us``) — overload shrinks the limit,
+  recovery raises it, all-fail windows halve it, the periodic probe-down
+  remeasures the no-load floor; the breaker's EMA windows and exponential
+  isolation; the injector's counter-based determinism.
+- integration: a real server with ``max_concurrency="auto"`` sheds a 4x
+  flood with ELIMIT while admitted p99 stays within 2x the unloaded
+  baseline; a 3-backend round-robin channel isolates a browned-out
+  backend within the breaker's short window and revives it after the
+  fault clears — deterministic via FaultInjector, waits are bounded
+  condition polls, never bare sleeps-as-synchronization.
+- plumbing: adaptive limits pushed into the native plane
+  (tb_server_set_native_max_concurrency), the /circuit_breakers page,
+  the scrapeable gauges, device-link re-handshake backoff.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from incubator_brpc_tpu.rpc import (
+    Channel,
+    ChannelOptions,
+    Controller,
+    FaultInjector,
+    Server,
+    ServerOptions,
+    install_socket_injector,
+)
+from incubator_brpc_tpu.rpc.circuit_breaker import (
+    CircuitBreaker,
+    breaker_registry,
+)
+from incubator_brpc_tpu.rpc.concurrency_limiter import (
+    AutoConcurrencyLimiter,
+    ConstantConcurrencyLimiter,
+    create_concurrency_limiter,
+)
+from incubator_brpc_tpu.utils.flags import flag_registry, set_flag_unchecked
+from incubator_brpc_tpu.utils.status import ErrorCode
+
+
+@pytest.fixture
+def flags():
+    """Snapshot/restore any flag a test retunes — the robustness knobs are
+    process-global and must not leak into the rest of tier-1."""
+    touched = {}
+
+    def tune(name, value):
+        if name not in touched:
+            touched[name] = flag_registry.get(name)
+        set_flag_unchecked(name, value)
+
+    yield tune
+    for name, value in touched.items():
+        set_flag_unchecked(name, value)
+
+
+def wait_until(cond, timeout=10.0, interval=0.02):
+    """Bounded condition poll (allowed: the condition is the
+    synchronization; a bare sleep would not be)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# unit: the gradient limiter on a synthetic clock
+# ---------------------------------------------------------------------------
+
+
+class TestAutoLimiterUnit:
+    def _feed(self, lim, n, latency_us, interval_us, now):
+        """n completions, one per interval (so qps == 1e6/interval)."""
+        for _ in range(n):
+            now += interval_us
+            lim.on_responded(0, latency_us, now_us=now)
+        return now
+
+    def test_initial_limit_from_flag(self, flags):
+        flags("auto_cl_initial_max_concurrency", 17)
+        lim = AutoConcurrencyLimiter()
+        assert lim.max_concurrency() == 17
+        assert lim.on_requested(17)
+        assert not lim.on_requested(18)
+
+    def test_overload_shrinks_then_recovery_raises(self, flags):
+        flags("auto_cl_sampling_interval_us", 0)
+        flags("auto_cl_initial_max_concurrency", 40)
+        # keep the probe-down out of this test's horizon
+        flags("auto_cl_noload_latency_remeasure_interval_ms", 10**7)
+        lim = AutoConcurrencyLimiter()
+        now = 1_000_000
+        # healthy: 10k qps at 1ms -> Little's law concurrency ~10
+        now = self._feed(lim, 1500, 1000.0, 100, now)
+        healthy = lim.max_concurrency()
+        assert 10 <= healthy <= 14, lim.describe()
+        # saturated brownout: latency 6x the floor, throughput collapses
+        # to 2.5k qps -> the gradient walks the limit down toward ~3
+        for _ in range(15):
+            now = self._feed(lim, 250, 6000.0, 400, now)
+        overloaded = lim.max_concurrency()
+        assert overloaded < healthy, lim.describe()
+        assert overloaded <= 6, lim.describe()
+        # recovery: latency back at the floor, qps ceiling re-proven ->
+        # the limit converges back up
+        now = self._feed(lim, 1500, 1000.0, 100, now)
+        recovered = lim.max_concurrency()
+        assert recovered > overloaded, lim.describe()
+        assert recovered >= 10, lim.describe()
+
+    def test_all_fail_window_halves(self, flags):
+        flags("auto_cl_sampling_interval_us", 0)
+        flags("auto_cl_initial_max_concurrency", 32)
+        lim = AutoConcurrencyLimiter()
+        now = 1_000_000
+        for _ in range(int(flag_registry.get("auto_cl_max_sample_count"))):
+            now += 100
+            lim.on_responded(ErrorCode.EINTERNAL, 1000.0, now_us=now)
+        assert lim.max_concurrency() == 16
+
+    def test_probe_down_remeasures_floor(self, flags):
+        flags("auto_cl_sampling_interval_us", 0)
+        flags("auto_cl_initial_max_concurrency", 40)
+        flags("auto_cl_noload_latency_remeasure_interval_ms", 50)
+        # min == max: every 100th sample settles a window exactly
+        flags("auto_cl_min_sample_count", 100)
+        flags("auto_cl_max_sample_count", 100)
+        lim = AutoConcurrencyLimiter()
+        now = 1_000_000
+        now = self._feed(lim, 100, 1000.0, 100, now)
+        settled = lim.max_concurrency()
+        assert lim.describe()["min_latency_us"] > 0
+        # cross the remeasure horizon: the next settled window probes down
+        # to reduce_ratio of the limit and opens the 2-RTT drain window
+        now += 60_000
+        now = self._feed(lim, 100, 1000.0, 100, now)
+        d = lim.describe()
+        assert d["remeasuring"], d
+        assert d["max_concurrency"] < settled, d
+        # the drain passes: the floor resets and is re-measured fresh
+        now += 10_000
+        now = self._feed(lim, 201, 1000.0, 100, now)
+        d2 = lim.describe()
+        assert not d2["remeasuring"], d2
+        assert d2["min_latency_us"] > 0
+
+    def test_sampling_interval_thins_samples(self, flags):
+        flags("auto_cl_sampling_interval_us", 1000)
+        lim = AutoConcurrencyLimiter()
+        # two completions inside one interval: only the first is taken
+        lim.on_responded(0, 500.0, now_us=5_000_000)
+        lim.on_responded(0, 500.0, now_us=5_000_100)
+        assert lim._sw_succ == 1
+
+    def test_create_limiter_specs(self):
+        assert create_concurrency_limiter(0) is None
+        assert create_concurrency_limiter(None) is None
+        assert create_concurrency_limiter("constant") is None
+        assert isinstance(
+            create_concurrency_limiter(5), ConstantConcurrencyLimiter
+        )
+        assert isinstance(
+            create_concurrency_limiter("auto"), AutoConcurrencyLimiter
+        )
+        assert create_concurrency_limiter("12").max_concurrency() == 12
+        with pytest.raises(ValueError):
+            create_concurrency_limiter("sideways")
+
+
+# ---------------------------------------------------------------------------
+# unit: breaker windows + injector determinism
+# ---------------------------------------------------------------------------
+
+
+class TestCircuitBreakerUnit:
+    def test_initializing_phase_trips_on_error_count(self, flags):
+        flags("circuit_breaker_short_window_size", 50)
+        flags("circuit_breaker_short_window_error_percent", 10)
+        flags("circuit_breaker_long_window_size", 1000)
+        cb = CircuitBreaker()
+        # the initializing budget is window * percent = 5 errors
+        for _ in range(4):
+            assert cb.on_call_end(ErrorCode.EINTERNAL, 1000.0)
+        assert not cb.broken
+        assert not cb.on_call_end(ErrorCode.EINTERNAL, 1000.0)
+        assert cb.broken
+        assert cb.isolated_times == 1
+
+    def test_errors_within_budget_stay_closed(self, flags):
+        flags("circuit_breaker_short_window_size", 100)
+        flags("circuit_breaker_short_window_error_percent", 10)
+        flags("circuit_breaker_long_window_size", 1000)
+        cb = CircuitBreaker()
+        # 5% errors through the whole initializing window: healthy
+        for i in range(100):
+            code = ErrorCode.EINTERNAL if i % 20 == 0 else 0
+            assert cb.on_call_end(code, 1000.0)
+        assert not cb.broken
+
+    def test_isolation_duration_doubles_on_fast_retrip(self, flags):
+        flags("circuit_breaker_short_window_size", 20)
+        flags("circuit_breaker_min_isolation_duration_ms", 100)
+        flags("circuit_breaker_max_isolation_duration_ms", 1000)
+        cb = CircuitBreaker()
+        for _ in range(3):
+            cb.on_call_end(ErrorCode.EINTERNAL, 1000.0)
+        assert cb.broken
+        assert cb.isolation_duration_ms == 100
+        cb.reset()  # half-open
+        assert cb.state() == "half_open"
+        for _ in range(3):
+            cb.on_call_end(ErrorCode.EINTERNAL, 1000.0)
+        assert cb.broken
+        assert cb.isolation_duration_ms == 200  # doubled
+        cb.reset()
+        for _ in range(3):
+            cb.on_call_end(ErrorCode.EINTERNAL, 1000.0)
+        assert cb.isolation_duration_ms == 400
+
+    def test_ema_error_cost_decays_on_success(self, flags):
+        # window 100 @ 10%: a single error is far under the trip budget,
+        # so the breaker stays closed and keeps feeding the recorders
+        flags("circuit_breaker_short_window_size", 100)
+        cb = CircuitBreaker()
+        cb.on_call_end(0, 1000.0)
+        cb.on_call_end(ErrorCode.EINTERNAL, 1000.0)
+        cost1 = cb._short.describe()["ema_error_cost_us"]
+        assert cost1 > 0
+        for _ in range(50):
+            assert cb.on_call_end(0, 1000.0)
+        assert cb._short.describe()["ema_error_cost_us"] < cost1
+
+
+class TestFaultInjectorUnit:
+    def test_counter_schedule_is_deterministic_and_exact(self):
+        inj = FaultInjector(error_rate=0.5)
+        decisions = [inj.decide() for _ in range(100)]
+        assert decisions.count("error") == 50
+        # evenly interleaved, same positions every run
+        inj2 = FaultInjector(error_rate=0.5)
+        assert [inj2.decide() for _ in range(100)] == decisions
+
+    def test_rates_compose(self):
+        inj = FaultInjector(error_rate=0.25, delay_rate=0.25, delay_ms=0)
+        decisions = [inj.decide() for _ in range(400)]
+        assert decisions.count("error") == 100
+        # delays only fire on operations the error schedule passed over
+        assert 0 < decisions.count("delay") <= 100
+
+    def test_close_takes_priority(self):
+        inj = FaultInjector(error_rate=1.0, close_rate=1.0)
+        assert inj.decide() == "close"
+
+
+# ---------------------------------------------------------------------------
+# integration: auto limiter on a live server
+# ---------------------------------------------------------------------------
+
+
+class TestServerAutoLimiter:
+    def _start_capacity_server(self, capacity: int, work_s: float):
+        """A server whose REAL capacity is ``capacity`` concurrent
+        requests (a semaphore models the backend resource): admitted
+        requests beyond it queue, so latency genuinely inflates when the
+        limit overshoots — the world the gradient limiter regulates.
+        Each handler records its own (monotonic, span_s) so 'latency of
+        admitted requests' is measured at the server, where over-admission
+        queueing shows up, not through this 1-core host's client-side GIL
+        scheduling noise."""
+        sem = threading.Semaphore(capacity)
+        spans = []
+        span_lock = threading.Lock()
+
+        def handler(cntl, req):
+            t0 = time.perf_counter()
+            with sem:
+                time.sleep(work_s)
+            span = time.perf_counter() - t0
+            with span_lock:
+                spans.append((time.monotonic(), span))
+            return b"ok"
+
+        srv = Server(ServerOptions(max_concurrency="auto"))
+        srv.add_service("cap", {"work": handler})
+        assert srv.start(0)
+        return srv, spans
+
+    @staticmethod
+    def _p99(values):
+        values = sorted(values)
+        return values[int(len(values) * 0.99)]
+
+    def test_flood_sheds_with_bounded_latency_then_converges(self, flags):
+        flags("auto_cl_sampling_interval_us", 0)
+        # windows: 10 samples settle one (baseline serial traffic at
+        # ~19 qps settles in ~550ms), 20 cap a flood window
+        flags("auto_cl_min_sample_count", 10)
+        flags("auto_cl_max_sample_count", 20)
+        flags("auto_cl_sample_window_size_ms", 2000)
+        flags("auto_cl_initial_max_concurrency", 6)
+        flags("auto_cl_noload_latency_remeasure_interval_ms", 3600 * 1000)
+        # the qps ceiling decays toward the true (saturated) throughput
+        # faster than the production default so a seconds-long test flood
+        # reaches convergence, not just the direction of travel
+        flags("auto_cl_qps_alpha_factor_for_ema", 0.3)
+        flags("auto_cl_change_rate_of_explore_ratio", 0.06)
+        # geometry constraints of this shared 1-core host: work_s must
+        # dominate GIL scheduling noise (spans then measure queueing, the
+        # thing the limiter regulates), and capacity + the initial limit
+        # must sit BELOW the worker pool's ~8 handler slots, or the pool —
+        # not the limiter — becomes the admission gate and nothing sheds
+        capacity, work_s = 2, 0.05
+        srv, spans = self._start_capacity_server(capacity, work_s)
+        ch = Channel()
+        assert ch.init(
+            f"127.0.0.1:{srv.port}",
+            options=ChannelOptions(timeout_ms=10000, max_retry=0),
+        )
+        try:
+            # unloaded baseline: serial calls; p99 of the handler span
+            for _ in range(20):
+                c = ch.call_method("cap", "work", b"")
+                assert c.ok(), c.error_text
+            assert srv._server_limiter.describe()["min_latency_us"] > 0, (
+                "baseline window never settled", srv._server_limiter.describe(),
+            )
+            p99_base = self._p99([s for _, s in spans])
+            spans.clear()
+
+            # 4x overload flood (8 callers vs capacity 2): shed or melt
+            codes = []
+            code_lock = threading.Lock()
+            flood_s = 6.0
+            stop_at = time.monotonic() + flood_s
+
+            def flood():
+                while time.monotonic() < stop_at:
+                    c = ch.call_method("cap", "work", b"")
+                    if c.failed():
+                        with code_lock:
+                            codes.append(c.error_code)
+                        time.sleep(0.02)  # a shed caller backs off a tick
+
+            threads = [threading.Thread(target=flood) for _ in range(8)]
+            t_start = time.monotonic()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
+            assert ErrorCode.ELIMIT in codes, (
+                "flood was never shed",
+                srv._server_limiter.describe(),
+                len(spans),
+            )
+            # once the limiter has converged (last 40% of the flood), the
+            # p99 latency of ADMITTED requests is within 2x the unloaded
+            # baseline: the limit stopped queueing from forming
+            tail_from = t_start + flood_s * 0.7
+            tail = [s for t, s in spans if t >= tail_from]
+            assert tail, "no admitted requests in the flood tail"
+            p99_tail = self._p99(tail)
+            assert p99_tail <= 2.0 * p99_base, (
+                f"admitted p99 {p99_tail * 1e3:.1f}ms vs unloaded "
+                f"{p99_base * 1e3:.1f}ms (limit={srv.max_concurrency})"
+            )
+            # the limit itself converged toward true capacity, below the
+            # 6 it started from
+            assert srv.max_concurrency <= capacity * 2, srv.max_concurrency
+            limit_after_flood = srv.max_concurrency
+
+            # the flood is gone: moderate healthy traffic re-proves the
+            # floor and the limit converges back up (explore widens)
+            def limit_recovered():
+                for _ in range(10):
+                    ch.call_method("cap", "work", b"")
+                return srv.max_concurrency >= limit_after_flood
+
+            assert wait_until(limit_recovered, timeout=8.0), (
+                limit_after_flood, srv.max_concurrency,
+            )
+        finally:
+            srv.stop()
+            srv.join(5)
+
+    def test_constant_limit_still_works(self):
+        srv = Server(ServerOptions(max_concurrency=1))
+        gate = threading.Event()
+        srv.add_service("s", {"m": lambda cntl, req: (gate.wait(5), b"")[1]})
+        assert srv.start(0)
+        try:
+            ch = Channel()
+            assert ch.init(
+                f"127.0.0.1:{srv.port}",
+                options=ChannelOptions(max_retry=0, timeout_ms=8000),
+            )
+            held = threading.Thread(
+                target=lambda: ch.call_method("s", "m", b"")
+            )
+            held.start()
+            assert wait_until(lambda: srv._nprocessing >= 1, 5.0)
+            c = ch.call_method("s", "m", b"")
+            gate.set()
+            held.join(10)
+            assert c.failed() and c.error_code == ErrorCode.ELIMIT
+        finally:
+            gate.set()
+            srv.stop()
+            srv.join(5)
+
+    def test_runtime_reset_to_auto(self, flags):
+        flags("auto_cl_initial_max_concurrency", 9)
+        srv = Server()
+        srv.add_service("s", {"m": lambda cntl, req: b""})
+        assert srv.start(0)
+        try:
+            assert srv.max_concurrency == 0
+            prev = srv.reset_max_concurrency("auto")
+            assert prev == 0
+            assert srv.max_concurrency == 9
+            assert srv.reset_max_concurrency(25) == "auto"
+            assert srv.max_concurrency == 25
+        finally:
+            srv.stop()
+            srv.join(5)
+
+    def test_per_method_auto_spec(self, flags):
+        flags("auto_cl_initial_max_concurrency", 6)
+        srv = Server()
+        srv.add_service(
+            "s", {"m": lambda cntl, req: b""}, max_concurrency="auto"
+        )
+        status = srv.method_status("s", "m")
+        assert isinstance(status.limiter, AutoConcurrencyLimiter)
+        assert status.max_concurrency == 6
+        assert srv.set_method_max_concurrency("s.m", 3)
+        assert status.max_concurrency == 3
+
+
+@pytest.mark.skipif(
+    not __import__(
+        "incubator_brpc_tpu.transport.native_plane", fromlist=["NET_AVAILABLE"]
+    ).NET_AVAILABLE,
+    reason="native runtime unavailable",
+)
+class TestNativePlaneAdaptiveLimit:
+    def test_adaptive_limit_reaches_native_dispatch(self, flags):
+        from incubator_brpc_tpu.rpc import native_echo
+
+        flags("auto_cl_sampling_interval_us", 0)
+        flags("auto_cl_min_sample_count", 20)
+        flags("auto_cl_max_sample_count", 40)
+        flags("auto_cl_initial_max_concurrency", 16)
+        srv = Server(
+            ServerOptions(max_concurrency="auto", native_plane=True)
+        )
+        srv.add_service("svc", {"echo": native_echo})
+        assert srv.start(0)
+        try:
+            plane = srv._native_plane
+            assert plane is not None
+            assert "svc.echo" in plane.native_method_names()
+            # seeded at start with the initial adaptive limit
+            assert plane.native_max_concurrency("svc.echo") == 16
+            # drive the SERVER limiter with a synthetic overload (the
+            # deterministic path) and watch the push reach the C++ table
+            now = 1_000_000
+            for _ in range(20):
+                for _ in range(50):
+                    now += 400
+                    srv._server_limiter.on_responded(0, 6000.0, now_us=now)
+            new_limit = srv.max_concurrency
+            assert new_limit != 16, srv._server_limiter.describe()
+            assert plane.native_max_concurrency("svc.echo") == new_limit
+            # and the C++ dispatch path ENFORCES what was pushed: clamp to
+            # 1, hold that slot with a slow Python-routed request? native
+            # methods have no slow path — instead prove the limit value is
+            # read per request by the existing ELIMIT machinery: set 0
+            # (unlimited) and 1 and observe both accepted
+            assert plane.set_native_max_concurrency("svc.echo", 1)
+            assert plane.native_max_concurrency("svc.echo") == 1
+            ch = Channel()
+            assert ch.init(
+                f"127.0.0.1:{srv.port}",
+                options=ChannelOptions(native_plane=True),
+            )
+            c = ch.call_method("svc", "echo", b"x")
+            assert c.ok(), c.error_text
+        finally:
+            srv.stop()
+            srv.join(5)
+
+    def test_numeric_string_limit_keeps_python_route(self):
+        # "12" resolves to a CONSTANT limiter (same as 12): native-kind
+        # methods must stay on the Python route where the server-wide
+        # gate is enforced, exactly as with an int spec
+        from incubator_brpc_tpu.rpc import native_echo
+
+        srv = Server(ServerOptions(max_concurrency="12", native_plane=True))
+        srv.add_service("svc", {"echo": native_echo})
+        assert srv.start(0)
+        try:
+            assert srv._native_plane is not None
+            assert srv._native_plane.native_method_names() == []
+            assert srv.max_concurrency == 12
+        finally:
+            srv.stop()
+            srv.join(5)
+
+    def test_runtime_method_limit_stops_following_server_pushes(self, flags):
+        # a per-method limit set at runtime must not be clobbered by the
+        # next server-wide adaptive push on the C++ plane
+        from incubator_brpc_tpu.rpc import native_echo
+
+        flags("auto_cl_initial_max_concurrency", 8)
+        srv = Server(ServerOptions(max_concurrency="auto", native_plane=True))
+        srv.add_service("svc", {"echo": native_echo})
+        assert srv.start(0)
+        try:
+            plane = srv._native_plane
+            assert "svc.echo" in plane.auto_limit_targets()
+            assert srv.set_method_max_concurrency("svc.echo", 5)
+            assert plane.native_max_concurrency("svc.echo") == 5
+            assert "svc.echo" not in plane.auto_limit_targets()
+            srv._on_server_limit_change(80)  # a server-wide adaptive move
+            assert plane.native_max_concurrency("svc.echo") == 5  # kept
+            # clearing back to unlimited resumes following
+            assert srv.set_method_max_concurrency("svc.echo", 0)
+            assert "svc.echo" in plane.auto_limit_targets()
+        finally:
+            srv.stop()
+            srv.join(5)
+
+    def test_reset_away_from_auto_clears_native_ceiling(self, flags):
+        from incubator_brpc_tpu.rpc import native_echo
+
+        flags("auto_cl_initial_max_concurrency", 5)
+        srv = Server(ServerOptions(max_concurrency="auto", native_plane=True))
+        srv.add_service("svc", {"echo": native_echo})
+        assert srv.start(0)
+        try:
+            plane = srv._native_plane
+            assert plane.native_max_concurrency("svc.echo") == 5
+            # operator disables limiting: the stale adaptive ceiling must
+            # not keep shedding natively-dispatched requests
+            srv.reset_max_concurrency(0)
+            assert plane.native_max_concurrency("svc.echo") == 0
+            # and back to auto re-seeds the fresh limiter's limit
+            srv.reset_max_concurrency("auto")
+            assert plane.native_max_concurrency("svc.echo") == 5
+        finally:
+            srv.stop()
+            srv.join(5)
+
+
+# ---------------------------------------------------------------------------
+# integration: brownout recovery through the circuit breaker (acceptance)
+# ---------------------------------------------------------------------------
+
+
+class TestBrownoutRecovery:
+    def _echo_server(self, options=None):
+        srv = Server(options)
+        hits = []
+        srv.add_service(
+            "e", {"m": lambda cntl, req: (hits.append(1), b"ok")[1]}
+        )
+        assert srv.start(0)
+        return srv, hits
+
+    def test_breaker_isolates_brownout_and_revives(self, flags):
+        flags("circuit_breaker_short_window_size", 30)
+        flags("circuit_breaker_long_window_size", 300)
+        flags("circuit_breaker_min_isolation_duration_ms", 400)
+        flags("fault_injection", True)
+        flags("enable_circuit_breaker", True)
+        servers = []
+        ch = None
+        try:
+            a, hits_a = self._echo_server()
+            b, hits_b = self._echo_server()
+            # backend c browns out: 50% of its dispatches fail (injected,
+            # deterministic — every 2nd request)
+            c, hits_c = self._echo_server(
+                ServerOptions(fault_injector=FaultInjector(error_rate=0.5))
+            )
+            servers = [a, b, c]
+            url = "list://" + ",".join(
+                f"127.0.0.1:{s.port}" for s in servers
+            )
+            ch = Channel()
+            assert ch.init(
+                url, lb_name="rr",
+                options=ChannelOptions(max_retry=0, timeout_ms=4000),
+            )
+            lb = ch._lb
+
+            # phase 1: drive calls until the breaker trips. The short
+            # window (30 samples, 10%) must isolate c within its
+            # initializing budget: 3 errors = 6 calls to c = ~18 total.
+            fails_before = 0
+            for i in range(120):
+                if lb.isolated_servers():
+                    break
+                if ch.call_method("e", "m", b"x").failed():
+                    fails_before += 1
+            iso = lb.isolated_servers()
+            assert len(iso) == 1 and iso[0].port == c.port, (
+                iso, fails_before,
+            )
+            assert fails_before >= 3  # the trips that tripped it
+
+            # phase 2: with c isolated, the channel's error rate returns
+            # to <2% (here: zero) within the next short window of traffic
+            window = 30
+            fails_after = sum(
+                1
+                for _ in range(window)
+                if ch.call_method("e", "m", b"x").failed()
+            )
+            assert fails_after / window < 0.02, fails_after
+            assert lb.breaker_states()[f"127.0.0.1:{c.port}"][
+                "state"
+            ] == "isolated"
+
+            # phase 3: the fault clears; after the isolation window the
+            # node revives (half-open) and serves real traffic again
+            c.fault_injector = None
+            assert wait_until(
+                lambda: not (
+                    ch.call_method("e", "m", b"x") and lb.isolated_servers()
+                ),
+                timeout=10.0,
+            )
+            before_c = len(hits_c)
+            fails_revived = 0
+            for _ in range(60):
+                if ch.call_method("e", "m", b"x").failed():
+                    fails_revived += 1
+            assert fails_revived == 0
+            assert len(hits_c) > before_c, "revived backend got no traffic"
+            state = lb.breaker_states()[f"127.0.0.1:{c.port}"]["state"]
+            assert state in ("half_open", "closed"), state
+        finally:
+            if ch is not None and ch._lb is not None:
+                ch._lb.stop()  # unregister breakers from the global registry
+            for s in servers:
+                s.stop()
+
+    def test_all_isolated_is_ehostdown(self, flags):
+        flags("circuit_breaker_short_window_size", 20)
+        flags("circuit_breaker_min_isolation_duration_ms", 2000)
+        flags("fault_injection", True)
+        flags("enable_circuit_breaker", True)
+        srv = Server(
+            ServerOptions(fault_injector=FaultInjector(error_rate=1.0))
+        )
+        srv.add_service("e", {"m": lambda cntl, req: b"ok"})
+        assert srv.start(0)
+        try:
+            ch = Channel()
+            assert ch.init(
+                f"list://127.0.0.1:{srv.port}", lb_name="rr",
+                options=ChannelOptions(max_retry=0, timeout_ms=2000),
+            )
+            for _ in range(10):
+                c = ch.call_method("e", "m", b"x")
+                if ch._lb.isolated_servers():
+                    break
+            assert ch._lb.isolated_servers()
+            c = ch.call_method("e", "m", b"x")
+            assert c.failed() and c.error_code == ErrorCode.EHOSTDOWN, (
+                c.error_code, c.error_text,
+            )
+            ch._lb.stop()  # unregister breakers from the global registry
+        finally:
+            srv.stop()
+
+    def test_breaker_disabled_by_flag(self, flags):
+        flags("fault_injection", True)
+        flags("enable_circuit_breaker", False)
+        flags("circuit_breaker_short_window_size", 10)
+        srv = Server(
+            ServerOptions(fault_injector=FaultInjector(error_rate=1.0))
+        )
+        srv.add_service("e", {"m": lambda cntl, req: b"ok"})
+        assert srv.start(0)
+        try:
+            ch = Channel()
+            assert ch.init(
+                f"list://127.0.0.1:{srv.port}", lb_name="rr",
+                options=ChannelOptions(max_retry=0, timeout_ms=2000),
+            )
+            for _ in range(30):
+                ch.call_method("e", "m", b"x")
+            assert not ch._lb.isolated_servers()
+            ch._lb.stop()
+        finally:
+            srv.stop()
+
+    def test_stragglers_do_not_reisolate_or_extend(self, flags):
+        # completions landing AFTER the trip (the breaker reports
+        # unhealthy for all of them) must not re-extend the isolation
+        # deadline — only the trip transition isolates
+        flags("circuit_breaker_short_window_size", 10)
+        flags("circuit_breaker_min_isolation_duration_ms", 5000)
+        flags("fault_injection", True)
+        srv = Server(
+            ServerOptions(fault_injector=FaultInjector(error_rate=1.0))
+        )
+        srv.add_service("e", {"m": lambda cntl, req: b"ok"})
+        assert srv.start(0)
+        try:
+            ch = Channel()
+            assert ch.init(
+                f"list://127.0.0.1:{srv.port}", lb_name="rr",
+                options=ChannelOptions(max_retry=0, timeout_ms=2000),
+            )
+            lb = ch._lb
+            for _ in range(5):
+                ch.call_method("e", "m", b"x")
+            ep = lb.isolated_servers()[0]
+            deadline = lb._isolated[ep]
+            # straggler feedback on the already-broken breaker: the
+            # deadline must not move
+            sock = next(iter(lb._ep_by_sid))
+            class FakeSock:
+                id = sock
+            lb.feedback(FakeSock(), 1000.0, ErrorCode.EINTERNAL)
+            assert lb._isolated[ep] == deadline
+            lb.stop()
+        finally:
+            srv.stop()
+
+    def test_backup_superseded_original_spares_breaker(self, flags):
+        # the backup-raced ORIGINAL attempt settles as EBACKUPREQUEST in
+        # LB feedback: a healthy-but-slow node must not accrue error cost
+        # from backup accounting
+        flags("enable_circuit_breaker", True)
+        flags("circuit_breaker_short_window_size", 10)
+        slow_evt = threading.Event()
+
+        def slow(cntl, req):
+            slow_evt.wait(0.2)
+            return b"slow"
+
+        s1 = Server()
+        s1.add_service("e", {"m": slow})
+        assert s1.start(0)
+        s2 = Server()
+        s2.add_service("e", {"m": lambda cntl, req: b"fast"})
+        assert s2.start(0)
+        try:
+            ch = Channel()
+            assert ch.init(
+                f"list://127.0.0.1:{s1.port},127.0.0.1:{s2.port}",
+                lb_name="rr",
+                options=ChannelOptions(
+                    max_retry=1, timeout_ms=4000, backup_request_ms=20
+                ),
+            )
+            for _ in range(12):
+                c = ch.call_method("e", "m", b"x")
+                assert c.ok(), c.error_text
+            slow_evt.set()
+            # the slow node was repeatedly backup-raced but never errored:
+            # its breaker must hold zero error cost and stay closed
+            states = ch._lb.breaker_states()
+            row = states.get(f"127.0.0.1:{s1.port}")
+            if row is not None:
+                assert row["state"] == "closed", row
+                assert row["short_window"]["errors"] == 0, row
+            assert not ch._lb.isolated_servers()
+            ch._lb.stop()
+        finally:
+            slow_evt.set()
+            s1.stop()
+            s2.stop()
+
+    def test_connect_refused_feeds_breaker(self, flags):
+        # a hard-down node (connect refused) is the most common failure
+        # mode: it must accrue breaker error cost from the select path
+        # and isolate, not stay in rotation burning a dial per pick
+        import socket as pysocket
+
+        flags("enable_circuit_breaker", True)
+        flags("circuit_breaker_short_window_size", 20)
+        flags("circuit_breaker_min_isolation_duration_ms", 5000)
+        up = Server()
+        up.add_service("e", {"m": lambda cntl, req: b"ok"})
+        assert up.start(0)
+        probe = pysocket.socket()
+        probe.bind(("127.0.0.1", 0))
+        dead_port = probe.getsockname()[1]
+        probe.close()
+        try:
+            ch = Channel()
+            assert ch.init(
+                f"list://127.0.0.1:{up.port},127.0.0.1:{dead_port}",
+                lb_name="rr",
+                options=ChannelOptions(max_retry=1, timeout_ms=2000),
+            )
+            for _ in range(15):
+                c = ch.call_method("e", "m", b"x")
+                assert c.ok(), c.error_text
+                if ch._lb.isolated_servers():
+                    break
+            iso = ch._lb.isolated_servers()
+            assert iso and iso[0].port == dead_port, (
+                iso, ch._lb.breaker_states(),
+            )
+            ch._lb.stop()
+        finally:
+            up.stop()
+
+    def test_naming_churn_drops_breaker(self, flags):
+        # a departed endpoint's breaker + registry row + isolation entry
+        # go with it (autoscaling pools must not accumulate ghosts)
+        flags("enable_circuit_breaker", True)
+        srv = Server()
+        srv.add_service("e", {"m": lambda cntl, req: b"ok"})
+        assert srv.start(0)
+        try:
+            ch = Channel()
+            assert ch.init(
+                f"list://127.0.0.1:{srv.port}", lb_name="rr",
+                options=ChannelOptions(max_retry=0, timeout_ms=2000),
+            )
+            assert ch.call_method("e", "m", b"x").ok()
+            lb = ch._lb
+            ep_key = f"127.0.0.1:{srv.port}"
+            assert ep_key in lb.breaker_states()
+            from incubator_brpc_tpu.utils.endpoint import EndPoint
+
+            lb.remove_server(EndPoint(ip="127.0.0.1", port=srv.port))
+            assert ep_key not in lb.breaker_states()
+            assert not any(
+                owner == lb._cb_tag
+                for (owner, _), _cb in breaker_registry.snapshot()
+            )
+            lb.stop()
+        finally:
+            srv.stop()
+
+    def test_lb_stop_unhooks_revival_callbacks(self, flags):
+        # sockets are process-global and outlive channels: a stopped LB
+        # must remove the on_revived closures it appended, or every
+        # create/destroy channel cycle leaks one per endpoint
+        flags("enable_circuit_breaker", True)
+        srv = Server()
+        srv.add_service("e", {"m": lambda cntl, req: b"ok"})
+        assert srv.start(0)
+        try:
+            ch = Channel()
+            assert ch.init(
+                f"list://127.0.0.1:{srv.port}", lb_name="rr",
+                options=ChannelOptions(max_retry=0, timeout_ms=2000),
+            )
+            assert ch.call_method("e", "m", b"x").ok()
+            hooks = ch._lb._revival_hooks
+            assert hooks, "revival hook was never installed"
+            sock, cb = hooks[0]
+            assert cb in sock.on_revived
+            ch._lb.stop()
+            assert cb not in sock.on_revived
+            assert not ch._lb._revival_hooks
+        finally:
+            srv.stop()
+
+    def test_extended_isolation_reschedules_revival_timer(self, flags):
+        # straggler failures that EXTEND an isolation window must arm a
+        # fresh timer: an idle channel would otherwise stay isolated
+        # until its next select
+        flags("circuit_breaker_short_window_size", 10)
+        flags("circuit_breaker_min_isolation_duration_ms", 300)
+        flags("fault_injection", True)
+        srv = Server(
+            ServerOptions(fault_injector=FaultInjector(error_rate=1.0))
+        )
+        srv.add_service("e", {"m": lambda cntl, req: b"ok"})
+        assert srv.start(0)
+        try:
+            ch = Channel()
+            assert ch.init(
+                f"list://127.0.0.1:{srv.port}", lb_name="rr",
+                options=ChannelOptions(max_retry=0, timeout_ms=2000),
+            )
+            lb = ch._lb
+            for _ in range(5):
+                ch.call_method("e", "m", b"x")
+            assert lb.isolated_servers()
+            ep = lb.isolated_servers()[0]
+            # a straggler error arrives while isolated: the deadline
+            # extends and a fresh timer must own it
+            lb._isolate(ep)
+            # no traffic at all from here on: revival must be TIMER-driven
+            assert wait_until(
+                lambda: ep not in lb._isolated, timeout=5.0
+            ), lb._isolated
+            lb.stop()
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# fault-injection seams + observability plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestFaultSeams:
+    def test_socket_write_seam(self, flags):
+        flags("fault_injection", True)
+        srv = Server()
+        srv.add_service("e", {"m": lambda cntl, req: b"ok"})
+        assert srv.start(0)
+        try:
+            ch = Channel()
+            assert ch.init(
+                f"127.0.0.1:{srv.port}",
+                options=ChannelOptions(max_retry=0, timeout_ms=2000),
+            )
+            assert ch.call_method("e", "m", b"x").ok()
+            install_socket_injector(FaultInjector(error_rate=1.0))
+            try:
+                c = ch.call_method("e", "m", b"x")
+                assert c.failed(), "injected write error did not surface"
+            finally:
+                install_socket_injector(None)
+            c = ch.call_method("e", "m", b"x")
+            assert c.ok(), c.error_text
+        finally:
+            install_socket_injector(None)
+            srv.stop()
+
+    def test_master_flag_gates_everything(self, flags):
+        flags("fault_injection", False)
+        srv = Server(
+            ServerOptions(fault_injector=FaultInjector(error_rate=1.0))
+        )
+        srv.add_service("e", {"m": lambda cntl, req: b"ok"})
+        assert srv.start(0)
+        try:
+            install_socket_injector(FaultInjector(error_rate=1.0))
+            try:
+                ch = Channel()
+                assert ch.init(
+                    f"127.0.0.1:{srv.port}",
+                    options=ChannelOptions(max_retry=0, timeout_ms=2000),
+                )
+                c = ch.call_method("e", "m", b"x")
+                assert c.ok(), c.error_text  # both seams dormant
+            finally:
+                install_socket_injector(None)
+        finally:
+            srv.stop()
+
+    def test_dispatch_delay_seam(self, flags):
+        flags("fault_injection", True)
+        inj = FaultInjector(delay_rate=1.0, delay_ms=30)
+        srv = Server(ServerOptions(fault_injector=inj))
+        srv.add_service("e", {"m": lambda cntl, req: b"ok"})
+        assert srv.start(0)
+        try:
+            ch = Channel()
+            assert ch.init(
+                f"127.0.0.1:{srv.port}",
+                options=ChannelOptions(max_retry=0, timeout_ms=4000),
+            )
+            t0 = time.perf_counter()
+            c = ch.call_method("e", "m", b"x")
+            dt = time.perf_counter() - t0
+            assert c.ok() and dt >= 0.03, dt
+            assert inj.injected["delay"] >= 1
+        finally:
+            srv.stop()
+
+
+class TestObservability:
+    def test_circuit_breakers_page_renders(self, flags):
+        flags("fault_injection", True)
+        flags("circuit_breaker_short_window_size", 10)
+        flags("circuit_breaker_min_isolation_duration_ms", 5000)
+        srv = Server(
+            ServerOptions(fault_injector=FaultInjector(error_rate=1.0))
+        )
+        srv.add_service("e", {"m": lambda cntl, req: b"ok"})
+        assert srv.start(0)
+        try:
+            ch = Channel()
+            assert ch.init(
+                f"list://127.0.0.1:{srv.port}", lb_name="rr",
+                options=ChannelOptions(max_retry=0, timeout_ms=2000),
+            )
+            for _ in range(6):
+                ch.call_method("e", "m", b"x")
+            assert ch._lb.isolated_servers()
+
+            from incubator_brpc_tpu.builtin import pages
+
+            class Frame:
+                path = "/circuit_breakers"
+                query = {}
+
+            status, ctype, body = pages.handle(None, Frame())
+            text = body.decode()
+            assert status == 200
+            assert f"127.0.0.1:{srv.port}" in text
+            assert "[isolated]" in text
+
+            class JsonFrame:
+                path = "/circuit_breakers"
+                query = {"json": "1"}
+
+            status, ctype, body = pages.handle(None, JsonFrame())
+            assert status == 200 and ctype == "application/json"
+            assert b"isolated" in body
+
+            # the isolated-node gauge is scrapeable
+            from incubator_brpc_tpu.builtin.prometheus import render_metrics
+
+            metrics = render_metrics("circuit_breaker")
+            assert "circuit_breaker_isolated_count 1" in metrics, metrics
+            ch._lb.stop()
+        finally:
+            srv.stop()
+
+    def test_auto_limit_gauge_scrapeable(self, flags):
+        flags("auto_cl_initial_max_concurrency", 11)
+        srv = Server(ServerOptions(max_concurrency="auto"))
+        srv.add_service("e", {"m": lambda cntl, req: b"ok"})
+        assert srv.start(0)
+        try:
+            from incubator_brpc_tpu.builtin.prometheus import render_metrics
+
+            metrics = render_metrics(f"server_{srv.port}")
+            assert f"server_{srv.port}_max_concurrency 11" in metrics, metrics
+        finally:
+            srv.stop()
+            srv.join(5)
+            # gauges hidden at stop: the name is free for the next server
+            from incubator_brpc_tpu.builtin.prometheus import render_metrics
+
+            assert (
+                f"server_{srv.port}_max_concurrency"
+                not in render_metrics(f"server_{srv.port}")
+            )
+
+
+class TestDeviceLinkBackoff:
+    def test_rehandshake_backs_off_exponentially(self, flags):
+        import socket as pysocket
+
+        from incubator_brpc_tpu.transport.device_link import DeviceLinkMap
+
+        flags("device_link_backoff_initial_ms", 200)
+        flags("device_link_backoff_max_ms", 1000)
+        # a port with NOTHING listening: the bootstrap dial fails fast
+        probe = pysocket.socket()
+        probe.bind(("127.0.0.1", 0))
+        dead_port = probe.getsockname()[1]
+        probe.close()
+        from incubator_brpc_tpu.utils.endpoint import EndPoint
+
+        dlm = DeviceLinkMap()
+        ep = EndPoint(ip="127.0.0.1", port=dead_port)
+        with pytest.raises((OSError, ConnectionError)):
+            dlm.get_or_create(ep, timeout_ms=500)
+        # the SECOND attempt inside the backoff window fails instantly
+        # without dialing
+        t0 = time.perf_counter()
+        with pytest.raises(ConnectionError, match="backing off"):
+            dlm.get_or_create(ep, timeout_ms=500)
+        assert time.perf_counter() - t0 < 0.1
+        key = next(iter(dlm._backoff))
+        assert dlm._backoff[key][0] == 1
+        # after the window, a real (failing) attempt doubles the backoff
+        assert wait_until(
+            lambda: time.monotonic() >= dlm._backoff[key][1], timeout=2.0
+        )
+        with pytest.raises((OSError, ConnectionError)):
+            dlm.get_or_create(ep, timeout_ms=500)
+        assert dlm._backoff[key][0] == 2

@@ -26,9 +26,39 @@ def run_press(
     timeout_ms: float = 1000,
     transport: str = "tcp",
     native_plane: bool = False,
+    fault_rate: float = 0.0,
+    fault_delay_ms: float = 0.0,
 ) -> dict:
     from incubator_brpc_tpu.bvar import LatencyRecorder
     from incubator_brpc_tpu.rpc import Channel, ChannelOptions
+
+    if fault_rate > 0 or fault_delay_ms > 0:
+        # one-command brownout run: arm the deterministic FaultInjector at
+        # this process's socket-write seam (rpc/fault_injector.py) so a
+        # scripted fraction of the press traffic fails/stalls — what the
+        # limiter/breaker/retry machinery is tuned against
+        from incubator_brpc_tpu.rpc import FaultInjector, install_socket_injector
+        from incubator_brpc_tpu.utils.flags import set_flag_unchecked
+
+        if native_plane:
+            # the injector lives at the Python Socket.write seam; the C++
+            # client channel never crosses it — a "brownout" that injects
+            # nothing would be silently misleading
+            print(
+                "fault injection forces the Python plane "
+                "(--native-plane ignored for this run)",
+                file=sys.stderr,
+            )
+            native_plane = False
+
+        set_flag_unchecked("fault_injection", True)
+        install_socket_injector(
+            FaultInjector(
+                error_rate=fault_rate,
+                delay_rate=1.0 if fault_delay_ms > 0 else 0.0,
+                delay_ms=fault_delay_ms,
+            )
+        )
 
     ch = Channel()
     if not ch.init(
@@ -94,6 +124,17 @@ def main(argv=None) -> int:
         "--native-plane", action="store_true",
         help="route eligible calls through the C++ client channel",
     )
+    p.add_argument(
+        "--fault-rate", type=float, default=0.0,
+        help="inject transport-write failures on this fraction of "
+        "operations (deterministic counter schedule; drives the "
+        "FaultInjector so brownout runs are one command)",
+    )
+    p.add_argument(
+        "--fault-delay-ms", type=float, default=0.0,
+        help="inject this write-path delay (every operation) — latency "
+        "brownout for limiter/timeout tuning",
+    )
     args = p.parse_args(argv)
 
     service, _, method = args.method.rpartition(".")
@@ -115,12 +156,16 @@ def main(argv=None) -> int:
         timeout_ms=args.timeout_ms,
         transport=args.transport,
         native_plane=args.native_plane,
+        fault_rate=args.fault_rate,
+        fault_delay_ms=args.fault_delay_ms,
     )
     print(
         f"qps={stats['qps']:.0f} ok={stats['ok']} fail={stats['fail']} "
         f"avg={stats['latency_us_avg']:.0f}us p50={stats['latency_us_p50']:.0f}us "
         f"p99={stats['latency_us_p99']:.0f}us max={stats['latency_us_max']:.0f}us"
     )
+    if args.fault_rate > 0 or args.fault_delay_ms > 0:
+        return 0  # failures are the point of a brownout run
     return 0 if stats["fail"] == 0 else 1
 
 
