@@ -336,6 +336,41 @@ def bench_native_plane(results: dict) -> None:
         nchp.close()
     server.stop()
 
+    # the telemetry tax: prpc_pump_ns above runs with the completion-record
+    # ring ON (the default — per-method latency, rpcz sampling, limiter
+    # feedback for natively-dispatched requests); the same pump against a
+    # ring-less server isolates the hot path's added cost (one CAS + two
+    # clock reads + a few stores per request; acceptance: < 5%)
+    from incubator_brpc_tpu.utils.flags import flag_registry, set_flag_unchecked
+
+    old_tel = flag_registry.get("native_telemetry")
+    set_flag_unchecked("native_telemetry", False)
+    try:
+        server2 = Server(
+            ServerOptions(
+                native_plane=True, usercode_inline=True, native_loops=2
+            )
+        )
+        server2.add_service("bench", {"echo": native_echo})
+        assert server2.start(0)
+        assert server2._native_plane is not None
+        nch2 = np_mod.NativeClientChannel(
+            "127.0.0.1", server2.port, protocol="baidu_std"
+        )
+        try:
+            nch2.pump("bench", "echo", payload, 2000, inflight=64)  # warm
+            pump0 = [
+                nch2.pump("bench", "echo", payload, 100000, inflight=128)
+                for _ in range(5)
+            ]
+            _record("prpc_pump_notelem_ns", pump0)
+            results["prpc_pump_notelem_ns"] = min(pump0)
+        finally:
+            nch2.close()
+        server2.stop()
+    finally:
+        set_flag_unchecked("native_telemetry", old_tel)
+
     # pooled multi-connection large payloads (the reference's headline
     # ~2.3 GB/s same-machine >=32KB multi-connection row,
     # docs/cn/benchmark.md:106): 4 connections over a 2-loop server, 32 KiB
@@ -672,6 +707,7 @@ BASELINES = {
     "device_rpc": "bounded by window/RTT on this tunneled chip (~0.5-1s submission+readback per round under load, high variance); concurrent calls micro-batch into vmapped dispatches, which cuts dispatch COUNT — the win shows where dispatch cost dominates (local PCIe), not through a tunnel",
     "fabricnet_mfu": "vs v5e peak bf16 197 TFLOP/s",
     "native_pump_notes": "template-pack + pooled body reuse + meta memo; 1 shared core, both sides",
+    "prpc_pump_telemetry": "prpc_pump_ns runs with the native telemetry ring ON (the default: per-method latency + sampled rpcz + limiter feedback recorded in-path); prpc_pump_notelem_ns is the same pump ring-less — the delta is the instrumentation tax (acceptance < 5%)",
 }
 
 
@@ -715,6 +751,11 @@ def main() -> None:
                     ),
                     "prpc_pump_ns": round(results.get("prpc_pump_ns", 0)) or None,
                     "prpc_pump_qps": round(results.get("prpc_pump_qps", 0)) or None,
+                    # the same pump without the completion-record ring:
+                    # prpc_pump_ns minus this is the telemetry tax
+                    "prpc_pump_notelem_ns": (
+                        round(results.get("prpc_pump_notelem_ns", 0)) or None
+                    ),
                     "native_echo_32k_gbps": (
                         round(results["native_echo_32k_gbps"], 3)
                         if "native_echo_32k_gbps" in results

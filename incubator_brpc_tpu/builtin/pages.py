@@ -11,6 +11,7 @@ after the builtin table (the reference forbids shadowing builtins too).
 from __future__ import annotations
 
 import json
+import math
 from typing import Dict, Tuple
 
 Resp = Tuple[int, str, bytes]
@@ -40,9 +41,11 @@ def _dump_vars(prefix: str) -> dict:
     """Exposed bvars + flags mirrored as ``flag_<name>`` rows (the
     reference registers every gflag as a bvar, bvar/gflag.cpp) — the ONE
     source both the text and JSON dumps serve, so they cannot disagree."""
+    from incubator_brpc_tpu.builtin.prometheus import run_scrape_hooks
     from incubator_brpc_tpu.bvar.variable import dump_exposed
     from incubator_brpc_tpu.utils.flags import flag_registry
 
+    run_scrape_hooks()  # e.g. force-drain the native telemetry ring
     dumped = dump_exposed(prefix=prefix)
     for name, f in flag_registry.items():
         row = f"flag_{name}"
@@ -211,31 +214,78 @@ def _flags(server, frame) -> Resp:
 
 
 def _rpcz(server, frame) -> Resp:
-    """rpcz_service.cpp: recent sampled spans, optionally by trace id."""
-    from incubator_brpc_tpu.builtin.rpcz import rpcz_enabled, span_store
+    """rpcz_service.cpp: recent sampled spans. Queries: ``?trace_id=<hex>``
+    (one trace, rendered as an indented parent→child tree),
+    ``?min_latency_us=<n>`` (latency-ordered, like the reference's
+    latency-indexed queries), ``?error_only=1``, ``?json=1`` (the
+    machine form rpc_view --rpcz scrapes)."""
+    import json as _json
+
+    from incubator_brpc_tpu.builtin.rpcz import (
+        render_trace_tree,
+        rpcz_enabled,
+        span_line,
+        span_store,
+        span_to_dict,
+    )
+
+    want_json = frame.query.get("json") in ("1", "true")
+
+    def fail(code: int, msg: str) -> Resp:
+        # the machine contract holds on EVERY outcome: with ?json=1 a
+        # scraper gets JSON and a non-2xx, never a text blob
+        if want_json:
+            body = _json.dumps({"error": msg}) + "\n"
+            return code, "application/json", body.encode()
+        return code, "text/plain", (msg + "\n").encode()
 
     if not rpcz_enabled():
-        return (
-            200,
-            "text/plain",
-            b"rpcz is off - set flag enable_rpcz (reloadable) to true\n",
-        )
+        msg = "rpcz is off - set flag enable_rpcz (reloadable) to true"
+        if want_json:
+            return fail(503, msg)
+        return 200, "text/plain", (msg + "\n").encode()
+    error_only = frame.query.get("error_only") in ("1", "true")
+    min_latency = frame.query.get("min_latency_us")
+    if min_latency is not None:
+        try:
+            min_latency = float(min_latency)
+            if not math.isfinite(min_latency) or min_latency < 0:
+                raise ValueError
+        except ValueError:
+            return fail(400, f"bad min_latency_us {min_latency!r}")
     trace = frame.query.get("trace_id")
     if trace:
         try:
             # displayed in hex below, so parsed as hex here
             spans = span_store.by_trace(int(trace, 16))
         except ValueError:
-            return 400, "text/plain", f"bad trace_id {trace!r}\n".encode()
+            return fail(400, f"bad trace_id {trace!r}")
     else:
-        spans = span_store.recent(limit=200)
-    lines = []
-    for sp in spans:
-        lines.append(
-            f"trace={sp.trace_id:x} span={sp.span_id:x} parent={sp.parent_span_id:x} "
-            f"{sp.span_type} {sp.service}.{sp.method} error={sp.error_code} "
-            f"latency={sp.latency_us:.0f}us annotations={sp.annotations}"
+        # filtered queries search the WHOLE retained ring (the reference's
+        # latency index spans the full store); only the unfiltered
+        # "recent spans" view is windowed
+        limit = (
+            len(span_store)
+            if error_only or min_latency is not None
+            else 200
         )
+        spans = span_store.recent(limit=limit)
+    if error_only:
+        spans = [sp for sp in spans if sp.error_code != 0]
+    if min_latency is not None:
+        # the latency-ordered query: worst offenders first
+        spans = sorted(
+            (sp for sp in spans if sp.latency_us >= min_latency),
+            key=lambda sp: sp.latency_us,
+            reverse=True,
+        )
+    if want_json:
+        body = _json.dumps([span_to_dict(sp) for sp in spans]) + "\n"
+        return 200, "application/json", body.encode()
+    if trace and min_latency is None and not error_only:
+        lines = render_trace_tree(spans)
+    else:
+        lines = [span_line(sp) for sp in spans]
     return 200, "text/plain", ("\n".join(lines) + "\n").encode()
 
 

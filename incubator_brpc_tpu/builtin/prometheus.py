@@ -33,6 +33,36 @@ from incubator_brpc_tpu.bvar.window import Window
 # quantiles rendered for every summary (latency_recorder.h's percentile set)
 SUMMARY_QUANTILES = (0.5, 0.9, 0.99, 0.999)
 
+# Pre-scrape hooks: callables run (exception-safe) before every exposition
+# render so lazily-aggregated sources flush into their bvars first — the
+# native plane's telemetry ring registers its forced drain here, making a
+# scrape see completions recorded microseconds ago instead of a drain
+# interval ago.
+_scrape_hooks: list = []
+
+
+def register_scrape_hook(fn) -> None:
+    if fn not in _scrape_hooks:
+        _scrape_hooks.append(fn)
+
+
+def unregister_scrape_hook(fn) -> None:
+    try:
+        _scrape_hooks.remove(fn)
+    except ValueError:
+        pass
+
+
+def run_scrape_hooks() -> None:
+    """Flush every lazily-aggregated source into its bvars (exception-
+    safe). render_metrics runs this itself; the /vars family calls it
+    too so both read surfaces see equally fresh values."""
+    for hook in list(_scrape_hooks):
+        try:
+            hook()
+        except Exception:
+            pass  # a wedged source must not kill the scrape
+
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 # metric names must match [a-zA-Z_:][a-zA-Z0-9_:]* — bvar names are already
@@ -102,6 +132,7 @@ def render_metrics(prefix: str = "") -> str:
     """The whole exposition: one pass over the expose registry (plus the
     numeric flag mirror), sorted by name so scrapes are deterministic.
     ``prefix`` filters on the bvar (pre-sanitize) name, like /vars."""
+    run_scrape_hooks()
     out: List[str] = []
     for name, var in expose_registry.snapshot(prefix):
         mname = sanitize_metric_name(name)

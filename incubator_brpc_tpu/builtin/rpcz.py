@@ -102,29 +102,20 @@ class SpanStore:
         self._db_path = ""
 
     def submit(self, span: Span) -> None:
+        # re-check the ring-size flag per submit: ``rpcz_max_spans`` is
+        # reloadable, but deque(maxlen=...) froze the value read at
+        # construction — setting the flag later silently did nothing
+        maxlen = int(get_flag("rpcz_max_spans"))
         with self._lock:
+            if self._spans.maxlen != maxlen:
+                self._spans = deque(self._spans, maxlen=maxlen)
             self._spans.append(span)
         dbdir = str(get_flag("rpcz_database_dir"))
         if dbdir:
             self._persist(dbdir, span)
 
     def _persist(self, dbdir: str, span: Span) -> None:
-        line = json.dumps({
-            "trace_id": span.trace_id,
-            "span_id": span.span_id,
-            "parent_span_id": span.parent_span_id,
-            "type": span.span_type,
-            "service": span.service,
-            "method": span.method,
-            "remote_side": span.remote_side,
-            "log_id": span.log_id,
-            "error_code": span.error_code,
-            "start_real_us": span.start_real_us,
-            "latency_us": span.latency_us,
-            "request_size": span.request_size,
-            "response_size": span.response_size,
-            "annotations": span.annotations,
-        }) + "\n"
+        line = json.dumps(span_to_dict(span)) + "\n"
         path = os.path.join(dbdir, "rpcz.jsonl")
         with self._db_lock:
             try:
@@ -179,6 +170,132 @@ class SpanStore:
     def __len__(self) -> int:
         with self._lock:
             return len(self._spans)
+
+
+def load_spans(path: str) -> List[Span]:
+    """Read a persisted ``rpcz.jsonl`` back into ``Span`` objects — the
+    round-trip twin of ``SpanStore._persist``. JSON has no tuple type, so
+    annotation entries come back as lists; they are normalized to the
+    ``(offset_us, text)`` tuples ``Span.annotations`` holds live (the
+    asymmetry that made persisted and live spans compare unequal).
+    Malformed lines are skipped, not fatal: a rotation or crash can leave
+    a torn tail."""
+    spans: List[Span] = []
+    try:
+        fh = open(path, "r", encoding="utf-8")
+    except OSError:
+        return spans
+    with fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(d, dict):
+                continue
+            span = span_from_dict(d)
+            if span is not None:
+                spans.append(span)
+    return spans
+
+
+def span_to_dict(span: Span) -> dict:
+    """One span as THE serialization schema — shared by ``rpcz.jsonl``
+    persistence and ``/rpcz?json=1`` so ``span_from_dict`` reads either
+    source; keep this the only copy of the key set."""
+    return {
+        "trace_id": span.trace_id,
+        "span_id": span.span_id,
+        "parent_span_id": span.parent_span_id,
+        "type": span.span_type,
+        "service": span.service,
+        "method": span.method,
+        "remote_side": span.remote_side,
+        "log_id": span.log_id,
+        "error_code": span.error_code,
+        "start_real_us": span.start_real_us,
+        "latency_us": span.latency_us,
+        "request_size": span.request_size,
+        "response_size": span.response_size,
+        "annotations": [list(a) for a in span.annotations],
+    }
+
+
+def span_line(sp: Span) -> str:
+    """The one-line human rendering shared by /rpcz and rpc_view."""
+    return (
+        f"trace={sp.trace_id:x} span={sp.span_id:x} parent={sp.parent_span_id:x} "
+        f"{sp.span_type} {sp.service}.{sp.method} error={sp.error_code} "
+        f"latency={sp.latency_us:.0f}us annotations={sp.annotations}"
+    )
+
+
+def render_trace_tree(spans: List[Span]) -> List[str]:
+    """One trace as indented parent→child lines (span_id-keyed; spans
+    whose parent is outside the set — usually parent 0 — are roots).
+    Start-time ordering among siblings; cycle/orphan-safe."""
+    by_id = {sp.span_id: sp for sp in spans}
+    children: dict = {}
+    roots = []
+    for sp in sorted(spans, key=lambda s: s.start_real_us):
+        if sp.parent_span_id in by_id and sp.parent_span_id != sp.span_id:
+            children.setdefault(sp.parent_span_id, []).append(sp)
+        else:
+            roots.append(sp)
+    lines: List[str] = []
+    seen = set()
+
+    def walk(root: Span) -> None:
+        # explicit stack: a parent chain can be as deep as the ring is
+        # large (rpcz_max_spans), far past the interpreter's frame limit
+        stack = [(root, 0)]
+        while stack:
+            sp, depth = stack.pop()
+            if sp.span_id in seen:
+                continue
+            seen.add(sp.span_id)
+            lines.append("  " * depth + span_line(sp))
+            for child in reversed(children.get(sp.span_id, [])):
+                stack.append((child, depth + 1))
+
+    for root in roots:
+        walk(root)
+    for sp in spans:  # cycles with no root: still shown, flat
+        if sp.span_id not in seen:
+            walk(sp)
+    return lines
+
+
+def span_from_dict(d: dict) -> Optional[Span]:
+    """One persisted/serialized span dict (the rpcz.jsonl and
+    ``/rpcz?json=1`` schema) back into a ``Span``; None when the dict is
+    malformed."""
+    try:
+        return Span(
+            trace_id=int(d.get("trace_id", 0)),
+            span_id=int(d.get("span_id", 0)),
+            parent_span_id=int(d.get("parent_span_id", 0)),
+            span_type=str(d.get("type", SPAN_TYPE_CLIENT)),
+            service=str(d.get("service", "")),
+            method=str(d.get("method", "")),
+            remote_side=str(d.get("remote_side", "")),
+            log_id=int(d.get("log_id", 0)),
+            error_code=int(d.get("error_code", 0)),
+            start_real_us=int(d.get("start_real_us", 0)),
+            latency_us=float(d.get("latency_us", 0.0)),
+            request_size=int(d.get("request_size", 0)),
+            response_size=int(d.get("response_size", 0)),
+            annotations=[
+                (float(a[0]), str(a[1]))
+                for a in d.get("annotations", [])
+                if isinstance(a, (list, tuple)) and len(a) == 2
+            ],
+        )
+    except (TypeError, ValueError, AttributeError):
+        return None
 
 
 span_store = SpanStore()

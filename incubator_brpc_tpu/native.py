@@ -41,6 +41,23 @@ class TbusHdr(ctypes.Structure):
     ]
 
 
+class TelemetryRecord(ctypes.Structure):
+    """Mirror of tb_telemetry_record (src/tbnet/tbnet.h): one completion
+    record per natively-dispatched request, drained in batches."""
+
+    _fields_ = [
+        ("method_idx", ctypes.c_uint32),
+        ("error_code", ctypes.c_uint32),
+        ("start_ns", ctypes.c_uint64),
+        ("latency_ns", ctypes.c_uint64),
+        ("correlation_id", ctypes.c_uint64),
+        ("request_size", ctypes.c_uint32),
+        ("response_size", ctypes.c_uint32),
+        ("sampled", ctypes.c_uint32),
+        ("reserved", ctypes.c_uint32),
+    ]
+
+
 RELEASE_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_void_p)
 
 # tbnet callbacks (src/tbnet/tbnet.h): the per-frame Python route and the
@@ -236,6 +253,17 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
             [b, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p,
              ctypes.c_uint32],
         ),
+        # completion-record telemetry ring (per-method latency / rpcz /
+        # limiter feedback for natively-dispatched requests)
+        "tb_server_set_telemetry": (
+            None,
+            [b, ctypes.c_uint32, ctypes.c_uint32],
+        ),
+        "tb_server_drain_telemetry": (
+            ctypes.c_long,
+            [b, ctypes.POINTER(TelemetryRecord), ctypes.c_size_t],
+        ),
+        "tb_server_telemetry_dropped": (ctypes.c_uint64, [b]),
         "tb_server_listen": (ctypes.c_int, [b, ctypes.c_char_p, ctypes.c_int]),
         "tb_server_port": (ctypes.c_int, [b]),
         "tb_server_stop": (None, [b]),

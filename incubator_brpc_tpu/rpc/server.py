@@ -405,6 +405,29 @@ class Server:
         for full in plane.auto_limit_targets():
             plane.set_native_max_concurrency(full, new_limit)
 
+    def _on_native_completion(
+        self,
+        full_name: str,
+        error_code: int,
+        latency_us: float,
+        now_us: Optional[int] = None,
+    ) -> None:
+        """Limiter feedback for a request the C++ plane dispatched and
+        answered without the interpreter (drained from the telemetry
+        ring). Feeds the same AutoConcurrencyLimiters the Python route's
+        _release feeds — this is what lets a 100%-native server's
+        adaptive limit track load instead of holding its last pushed
+        value. Admission refusals (ELIMIT) never reach here: the Python
+        route doesn't call on_responded for refused requests either.
+        ``now_us`` is the completion's monotonic timestamp from the
+        record itself, so batch drains keep the limiter's sampling
+        windows honest."""
+        prop = self._methods.get(full_name)
+        if prop is not None and prop.status.limiter is not None:
+            prop.status.limiter.on_responded(error_code, latency_us, now_us)
+        if self._server_limiter is not None:
+            self._server_limiter.on_responded(error_code, latency_us, now_us)
+
     def add_service(
         self,
         name: str,

@@ -18,6 +18,14 @@ dispatcher, and this module is the seam between it and the Python L5:
   C++ with the GIL released; concurrent callers share one connection and
   elect a completion-pump reader (the single-connection multi-caller shape
   of the reference client).
+- The **telemetry ring** keeps the fast path observable: every natively
+  dispatched request appends a completion record (method/latency/sizes/
+  error/cid + a 1/N sample flag) to a lock-free MPSC ring in C++; the
+  drain here (background thread + forced drain on scrape/stop) fans
+  records out to per-method ``LatencyRecorder``s, sampled /rpcz server
+  spans, and ``AutoConcurrencyLimiter`` feedback — the reference feeds
+  bvar/rpcz from inside every protocol's ProcessRequest the same way
+  (docs/OBSERVABILITY.md "Native telemetry ring").
 """
 
 from __future__ import annotations
@@ -204,6 +212,29 @@ class NativeConnSock:
         return f"<NativeConnSock token={self.token:#x} remote={self.remote}>"
 
 
+def _drain_pump(plane_ref, stop_event) -> None:
+    """Background telemetry drain. Module-level with a weakref on
+    purpose: the thread must not pin an abandoned plane against GC (its
+    __del__ -> stop() is the cleanup backstop); it exits when the plane
+    is collected or stop() sets the event."""
+    from incubator_brpc_tpu.utils.flags import get_flag
+
+    while True:
+        interval = max(
+            0.005, float(get_flag("native_telemetry_drain_ms")) / 1e3
+        )
+        if stop_event.wait(interval):
+            return
+        plane = plane_ref()
+        if plane is None:
+            return
+        try:
+            plane.drain_telemetry()
+        except Exception:
+            logger.exception("native telemetry drain failed")
+        del plane  # release between ticks: don't pin across the wait
+
+
 class NativeServerPlane:
     def __init__(self, server, nloops: int = 2):
         if not NET_AVAILABLE:
@@ -219,6 +250,27 @@ class NativeServerPlane:
         LIB.tb_server_set_max_body(
             self._srv, int(get_flag("max_body_size")) + 64 * 1024
         )
+        # telemetry ring (tb_server_set_telemetry must precede listen):
+        # every natively-dispatched completion is recorded in C++ and
+        # drained here into per-method latency summaries, sampled rpcz
+        # spans, and limiter feedback — the fast path stays observable
+        # without the interpreter on it
+        self._telemetry = bool(get_flag("native_telemetry"))
+        if self._telemetry:
+            LIB.tb_server_set_telemetry(
+                self._srv,
+                int(get_flag("native_telemetry_ring_size")),
+                int(get_flag("native_telemetry_sample_every")),
+            )
+        self._tel_lock = threading.Lock()  # serializes drains (one consumer)
+        self._tel_recorders: Dict[int, LatencyRecorder] = {}  # method idx ->
+        self._tel_drained = 0  # records pulled off the ring so far
+        # 4096-record drain batches: numpy's fixed per-batch costs
+        # amortize to ~tens of ns per record (the drain shares cores
+        # with the hot path it observes)
+        self._tel_batch = (native.TelemetryRecord * 4096)()
+        self._drain_stop = threading.Event()
+        self._drain_thread: Optional[threading.Thread] = None
         # keep callback objects alive for the server's lifetime
         self._frame_cb = FRAME_FN(self._on_frame)
         self._handoff_cb = HANDOFF_FN(self._on_handoff)
@@ -269,9 +321,18 @@ class NativeServerPlane:
         for full, prop in self._server.methods().items():
             kind = _native_kind(prop.handler)
             if kind is not None:
-                LIB.tb_server_register_native(
+                rc = LIB.tb_server_register_native(
                     self._srv, full.encode(), kind, prop.status.max_concurrency
                 )
+                if rc != 0:
+                    # duplicate / key collision: the method stays on the
+                    # Python route — and must NOT claim a telemetry index
+                    # (_native_names positions mirror the C++ table)
+                    logger.warning(
+                        "native registration of %s rejected; it stays on "
+                        "the Python route", full
+                    )
+                    continue
                 self._native_names.append(full)
                 if prop.status.limiter is None:
                     self._auto_targets.append(full)
@@ -373,7 +434,260 @@ class NativeServerPlane:
             for k in ("accepted", "native_reqs", "cb_frames", "handoffs",
                       "live_conns")
         ]
+        if self._telemetry:
+            self._m_stats.append(
+                PassiveStatus(
+                    self.telemetry_dropped,
+                    name=f"native_plane_{self.port}_telemetry_dropped",
+                )
+            )
+            self._m_stats.append(
+                PassiveStatus(
+                    lambda: self._tel_drained,
+                    name=f"native_plane_{self.port}_telemetry_drained",
+                )
+            )
+            # scrapes force a drain so /brpc_metrics and /vars see
+            # completions recorded microseconds — not a drain interval —
+            # ago; the background pump covers unscraped servers.  Both
+            # hold only a WEAK reference to the plane: a started-then-
+            # abandoned plane must stay collectable so the __del__ ->
+            # stop() backstop can still fire (a bound-method hook in the
+            # module-global list would pin it for process lifetime).
+            import weakref
+
+            from incubator_brpc_tpu.builtin import prometheus
+
+            wr = weakref.ref(self)
+
+            def _scrape_drain(_wr=wr):
+                plane = _wr()
+                if plane is not None:
+                    plane.drain_telemetry()
+
+            self._scrape_hook = _scrape_drain
+            prometheus.register_scrape_hook(_scrape_drain)
+            self._drain_thread = threading.Thread(
+                target=_drain_pump,
+                args=(wr, self._drain_stop),
+                name=f"native-telemetry-{self.port}",
+                daemon=True,
+            )
+            self._drain_thread.start()
         return rc
+
+    # -- telemetry drain ---------------------------------------------------
+
+    def telemetry_dropped(self) -> int:
+        """Ring-overflow drop count (records lost to a full ring)."""
+        with self._stats_lock:
+            if self._srv is None:
+                return getattr(self, "_final_tel_dropped", 0)
+            return int(LIB.tb_server_telemetry_dropped(self._srv))
+
+    def drain_telemetry(self) -> int:
+        """Pull every completed record off the C++ ring and fan it out:
+        per-method latency summaries, sampled rpcz server spans, and
+        limiter feedback (Server._on_native_completion). Returns the
+        record count. Serialized: the background pump, scrape hooks, and
+        the stop-time flush never interleave batches."""
+        if not self._telemetry:
+            return 0
+        total = 0
+        with self._tel_lock:
+            # batch cap: a drain races live producers, and a scrape-path
+            # caller must not spin forever against a sustained flood —
+            # 256 batches (~1M records) per call, the rest next cycle
+            for _ in range(256):
+                with self._stats_lock:
+                    if self._srv is None:
+                        break
+                    n = int(
+                        LIB.tb_server_drain_telemetry(
+                            self._srv, self._tel_batch, len(self._tel_batch)
+                        )
+                    )
+                if n <= 0:
+                    break
+                # fan-out OUTSIDE _stats_lock: limiter feedback can push a
+                # new adaptive limit back down through
+                # set_native_max_concurrency, which takes _stats_lock
+                self._consume_records(self._tel_batch, n)
+                total += n
+                # loop until an EMPTY return, not a short batch: the C++
+                # drain can return fewer than it popped (clock-invalid
+                # records are discarded there), so a short batch does
+                # not mean the ring is dry
+            self._tel_drained += total
+        return total
+
+    # the drain is on the clock: at full pump rate the ring produces
+    # ~1 M records/s, so per-record Python costs are the difference
+    # between a <5% and a ~50% instrumentation tax on a shared core —
+    # everything per-record below is vectorized (numpy over the ctypes
+    # batch buffer), with Python-level loops only over the FEW records
+    # that matter individually (limiter samples, sampled spans)
+    _REC_DTYPE = None  # numpy structured dtype mirror of TelemetryRecord
+
+    @classmethod
+    def _rec_dtype(cls):
+        if cls._REC_DTYPE is None:
+            import numpy as np
+
+            cls._REC_DTYPE = np.dtype(
+                [
+                    ("method_idx", "<u4"),
+                    ("error_code", "<u4"),
+                    ("start_ns", "<u8"),
+                    ("latency_ns", "<u8"),
+                    ("correlation_id", "<u8"),
+                    ("request_size", "<u4"),
+                    ("response_size", "<u4"),
+                    ("sampled", "<u4"),
+                    ("reserved", "<u4"),
+                ]
+            )
+        return cls._REC_DTYPE
+
+    def _consume_records(self, batch, n: int) -> None:
+        import numpy as np
+
+        from incubator_brpc_tpu.builtin import rpcz as rpcz_mod
+        from incubator_brpc_tpu.rpc.concurrency_limiter import (
+            AutoConcurrencyLimiter,
+        )
+        from incubator_brpc_tpu.utils.flags import get_flag
+        from incubator_brpc_tpu.utils.status import ErrorCode as _EC
+
+        arr = np.frombuffer(batch, dtype=self._rec_dtype(), count=n)
+        names = self._native_names
+        server = self._server
+        method_ids = arr["method_idx"]
+        errors = arr["error_code"]
+        lat_us = arr["latency_ns"] * 1e-3
+        ok = errors == 0
+        server_lim = server._server_limiter
+        server_auto = isinstance(server_lim, AutoConcurrencyLimiter)
+        interval = int(get_flag("auto_cl_sampling_interval_us"))
+        methods = server.methods()
+        feed = []  # (done_us, full, error_code, latency_us) across methods
+        for idx in np.unique(method_ids):
+            if idx >= len(names):
+                continue  # table drift (never expected): drop, don't crash
+            full = names[idx]
+            mask = method_ids == idx
+            succ = mask & ok
+            nsucc = int(succ.sum())
+            if nsucc:
+                # per-method latency summary: exact count/sum/max, a
+                # strided subsample for the percentile reservoir
+                recorder = self._tel_recorders.get(int(idx))
+                if recorder is None:
+                    recorder = LatencyRecorder()
+                    base = (
+                        "native_method_"
+                        + full.replace(".", "_")
+                        + "_latency_us"
+                    )
+                    # two native planes in one process can serve the same
+                    # method name; expose() keeps the FIRST registrant
+                    # and returns False — fall back to a port-scoped name
+                    # instead of silently exposing nothing
+                    if not recorder.expose(base):
+                        recorder.expose(
+                            f"native_method_{self.port}_"
+                            + full.replace(".", "_")
+                            + "_latency_us"
+                        )
+                    self._tel_recorders[int(idx)] = recorder
+                vals = lat_us[succ]
+                # ceil stride so the subsample spans the WHOLE batch
+                # (floor would feed only the head when nsucc % 64 != 0)
+                recorder.record_batch(
+                    nsucc,
+                    float(vals.sum()),
+                    float(vals.max()),
+                    vals[:: -(-nsucc // 64)][:64].tolist(),
+                )
+            # limiter feedback — only when an adaptive limiter is actually
+            # listening (constant limits ignore on_responded entirely),
+            # decimated to its sampling interval so a 100 k-record drain
+            # feeds the handful of samples the limiter would keep anyway.
+            # ELIMIT refusals are excluded like the Python route (a
+            # refused request never reaches on_responded).
+            prop = methods.get(full)
+            method_auto = prop is not None and isinstance(
+                prop.status.limiter, AutoConcurrencyLimiter
+            )
+            if not (server_auto or method_auto):
+                continue
+            fb = mask & (errors != _EC.ELIMIT)
+            if not fb.any():
+                continue
+            done_us = (arr["start_ns"][fb] + arr["latency_ns"][fb]) // 1000
+            fb_err = errors[fb]
+            fb_lat = lat_us[fb]
+            order = np.argsort(done_us, kind="stable")
+            ts = done_us[order]
+            picks = []
+            i = 0
+            step = max(1, interval)
+            while i < len(ts) and len(picks) < 1024:
+                picks.append(order[i])
+                i = int(np.searchsorted(ts, ts[i] + step, side="left"))
+            # errors beyond the decimation still matter (all-fail
+            # halving): force-feed a bounded number of them
+            err_pos = np.flatnonzero(fb_err != 0)[:256]
+            for j in {int(p) for p in picks} | {int(p) for p in err_pos}:
+                feed.append(
+                    (int(done_us[j]), full, int(fb_err[j]), float(fb_lat[j]))
+                )
+        # ONE globally time-ordered feed across every method:
+        # on_responded's pre-lock interval check keeps only
+        # forward-moving timestamps, so feeding per-method sequences
+        # back-to-back would let the first method's newest sample mask
+        # every other method's older ones from the SHARED server limiter
+        feed.sort()
+        for done, full, err, lat in feed:
+            server._on_native_completion(full, err, lat, now_us=done)
+        if rpcz_mod.rpcz_enabled():
+            sampled_idx = np.flatnonzero(arr["sampled"] != 0)
+            if len(sampled_idx):
+                # wall/monotonic anchor: record timestamps are
+                # CLOCK_MONOTONIC ns, spans carry wall-clock start_real_us
+                wall_anchor_us = time.time() * 1e6
+                mono_anchor_ns = native.monotonic_ns()
+                for i in sampled_idx:
+                    rec = arr[int(i)]
+                    idx = int(rec["method_idx"])
+                    if idx >= len(names):
+                        continue
+                    # the 1/N flag elects; the shared token bucket still
+                    # bounds spans/second (rpcz_samples_per_second) like
+                    # every other producer — a ring-rate native flood
+                    # must not turn the drain into a disk-append loop
+                    if not rpcz_mod._limiter.grab():
+                        break
+                    service, _, method = names[idx].partition(".")
+                    rpcz_mod.span_store.submit(
+                        rpcz_mod.Span(
+                            trace_id=rpcz_mod._new_id(),
+                            span_id=rpcz_mod._new_id(),
+                            parent_span_id=0,
+                            span_type=rpcz_mod.SPAN_TYPE_SERVER,
+                            service=service,
+                            method=method,
+                            error_code=int(rec["error_code"]),
+                            start_real_us=int(
+                                wall_anchor_us
+                                - (mono_anchor_ns - int(rec["start_ns"]))
+                                / 1e3
+                            ),
+                            latency_us=float(rec["latency_ns"]) / 1e3,
+                            request_size=int(rec["request_size"]),
+                            response_size=int(rec["response_size"]),
+                        )
+                    )
 
     def _stats_snapshot(self) -> Dict[str, int]:
         """stats() memoized for ~50 ms: one /brpc_metrics scrape touches
@@ -523,6 +837,17 @@ class NativeServerPlane:
         if self._stopped:
             return
         self._stopped = True
+        self._drain_stop.set()
+        if self._drain_thread is not None:
+            self._drain_thread.join(timeout=5.0)
+            self._drain_thread = None
+        if self._telemetry:
+            from incubator_brpc_tpu.builtin import prometheus
+
+            hook = getattr(self, "_scrape_hook", None)
+            if hook is not None:
+                prometheus.unregister_scrape_hook(hook)
+                self._scrape_hook = None
         for v in getattr(self, "_m_stats", []):
             try:
                 v.hide()  # free the port-scoped names for the next plane
@@ -532,6 +857,20 @@ class NativeServerPlane:
         # destroy frees the epoll/event fds and the method table
         LIB.tb_server_stop(self._srv)
         self._final_stats = self.stats()
+        # loops quiescent: flush the telemetry tail so the last
+        # completions still reach the summaries/limiters, THEN freeze the
+        # drop counter (the flush itself can add clock-invalid discards)
+        # and free the per-method summary names
+        try:
+            self.drain_telemetry()
+            self._final_tel_dropped = self.telemetry_dropped()
+        except Exception:
+            logger.exception("final telemetry drain failed")
+        for recorder in self._tel_recorders.values():
+            try:
+                recorder.hide()
+            except Exception:
+                pass
         with self._socks_lock:
             handoffs = list(self._handoff_socks)
             self._handoff_socks.clear()

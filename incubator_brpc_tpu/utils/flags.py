@@ -131,6 +131,36 @@ define_flag(
     "binary path); 0 disables the reap",
     lambda v: v >= 0,
 )
+define_flag(
+    "native_telemetry",
+    True,
+    "per-port completion-record ring on native-plane servers: every "
+    "natively dispatched request records method/latency/sizes/error into "
+    "a lock-free MPSC ring drained into per-method latency summaries, "
+    "sampled rpcz spans, and limiter feedback (read at Server.start)",
+    lambda v: True,
+)
+define_flag(
+    "native_telemetry_ring_size",
+    8192,
+    "telemetry ring capacity in records (rounded up to a power of two); "
+    "a full ring drops records and counts them instead of blocking",
+    lambda v: v > 0,
+)
+define_flag(
+    "native_telemetry_sample_every",
+    64,
+    "every Nth native completion record is span-sampled into /rpcz "
+    "(counter-based, exact-rate; 0 disables span sampling)",
+    lambda v: v >= 0,
+)
+define_flag(
+    "native_telemetry_drain_ms",
+    100,
+    "background drain cadence of the native telemetry ring; scrapes and "
+    "Server.stop force a drain regardless",
+    lambda v: v > 0,
+)
 define_flag("rpcz_keep_span_seconds", 1800, "span retention", lambda v: v > 0)
 define_flag("rpcz_max_spans", 10000, "max spans retained in memory", lambda v: v > 0)
 define_flag(

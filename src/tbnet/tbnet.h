@@ -66,8 +66,43 @@ typedef void (*tb_handoff_fn)(void* ctx, int fd, const void* buffered,
 // on_failed hooks).  Not fired for handed-off connections.
 typedef void (*tb_closed_fn)(void* ctx, uint64_t conn_token);
 
+// One completion record per natively-dispatched request (the telemetry
+// ring's element; see tb_server_set_telemetry).  Field layout is ABI:
+// transport/native_plane.py mirrors it as a ctypes.Structure.
+typedef struct {
+  uint32_t method_idx;      // index into the server's native method table
+  uint32_t error_code;      // 0 = success (ELIMIT for admission refusals)
+  uint64_t start_ns;        // CLOCK_MONOTONIC at dispatch entry
+  uint64_t latency_ns;      // dispatch entry -> response queued
+  uint64_t correlation_id;
+  uint32_t request_size;    // payload + attachment bytes
+  uint32_t response_size;   // payload + attachment bytes (0 on error)
+  uint32_t sampled;         // counter-based 1/N sample flag (rpcz)
+  uint32_t reserved;
+} tb_telemetry_record;
+
 // ---- server ----
 tb_server* tb_server_create(int nloops);
+// Enable the per-port completion-record ring: every natively dispatched
+// request appends ONE tb_telemetry_record into a lock-free MPSC ring of
+// `capacity` slots (rounded up to a power of two); when the ring is full
+// the record is dropped and a counter incremented — the hot path never
+// blocks on the observer.  Every sample_every'th record (counter-based,
+// 0 = never) carries sampled=1, the rpcz span election.  Call BEFORE
+// tb_server_listen; later calls are ignored.  capacity 0 = disabled.
+void tb_server_set_telemetry(tb_server* s, uint32_t capacity,
+                             uint32_t sample_every);
+// Pop up to max_records completed records into `out`; returns the count
+// RETURNED, which can be less than what was popped (clock-invalid
+// records are discarded and counted as dropped) — callers must drain
+// until 0, not until a short batch.  Safe against concurrent loop-thread
+// producers; drains race each other safely but the Python side still
+// serializes them (single consumer).
+long tb_server_drain_telemetry(tb_server* s, tb_telemetry_record* out,
+                               size_t max_records);
+// Records lost: ring overflow + clock-invalid discards at drain
+// (0 when telemetry is disabled).
+uint64_t tb_server_telemetry_dropped(const tb_server* s);
 void tb_server_set_frame_cb(tb_server* s, tb_frame_fn cb, void* ctx);
 void tb_server_set_handoff_cb(tb_server* s, tb_handoff_fn cb, void* ctx);
 void tb_server_set_closed_cb(tb_server* s, tb_closed_fn cb, void* ctx);

@@ -19,3 +19,28 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tuned_flags():
+    """Snapshot/restore any process-global flag a test retunes — shared
+    by every test file that tweaks flags (rpcz, telemetry, auto_cl...),
+    so one implementation owns the restore discipline."""
+    from incubator_brpc_tpu.utils.flags import (
+        flag_registry,
+        set_flag_unchecked,
+    )
+
+    touched = {}
+
+    def tune(name, value):
+        if name not in touched:
+            touched[name] = flag_registry.get(name)
+        set_flag_unchecked(name, value)
+
+    yield tune
+    for name, value in touched.items():
+        set_flag_unchecked(name, value)

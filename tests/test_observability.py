@@ -690,3 +690,334 @@ class TestRpcViewMetrics:
 
     def test_content_type_constant(self):
         assert CONTENT_TYPE.startswith("text/plain")
+
+
+# -- native telemetry ring (PR 4) ---------------------------------------------
+#
+# The C++ dispatch plane records every natively-answered request into a
+# lock-free completion ring (src/tbnet); transport/native_plane.py drains
+# it into per-method latency summaries, sampled rpcz server spans, and
+# adaptive-limiter feedback. These tests drive PURE-native PRPC floods
+# (cb_frames stays 0 — no interpreter on the request path) and assert the
+# observability plane still sees everything.
+
+from incubator_brpc_tpu.transport import native_plane as np_mod  # noqa: E402
+
+# (flag snapshot/restore comes from the shared ``tuned_flags`` fixture
+# in conftest.py)
+
+
+@pytest.mark.skipif(
+    not np_mod.NET_AVAILABLE, reason="native runtime unavailable"
+)
+class TestNativeTelemetry:
+    def _native_server(self, service: str, **opts):
+        from incubator_brpc_tpu.rpc import native_echo
+
+        srv = Server(ServerOptions(native_plane=True, **opts))
+        srv.add_service(service, {"echo": native_echo})
+        assert srv.start(0)
+        assert srv._native_plane is not None, "native plane did not engage"
+        return srv
+
+    def test_per_method_summary_advances_pure_native(self, tuned_flags):
+        # flood over the baidu_std C++ fast path, then SCRAPE: the
+        # /brpc_metrics render must force-drain the ring (scrape hook) and
+        # show the per-method summary — without one Python-routed request
+        tuned_flags("native_telemetry", True)
+        tuned_flags("native_telemetry_sample_every", 0)
+        srv = self._native_server("telsvc1")
+        try:
+            ch = np_mod.NativeClientChannel(
+                "127.0.0.1", srv.port, protocol="baidu_std"
+            )
+            try:
+                ch.pump("telsvc1", "echo", b"y" * 64, 1000, inflight=32)
+            finally:
+                ch.close()
+            _, _, body = _fetch(srv, "/brpc_metrics?prefix=native_method_")
+            text = body.decode()
+            name = "native_method_telsvc1_echo_latency_us"
+            assert f"# TYPE {name} summary" in text
+            assert _sample_value(text, f"{name}_count") == 1000
+            assert f'{name}{{quantile="0.99"}}' in text
+            stats = srv._native_plane.stats()
+            assert stats["native_reqs"] >= 1000
+            assert stats["cb_frames"] == 0, "flood was not pure-native"
+        finally:
+            srv.stop()
+
+    def test_sampled_spans_land_at_configured_rate(self, tuned_flags):
+        from incubator_brpc_tpu.builtin.rpcz import span_store
+
+        tuned_flags("native_telemetry", True)
+        tuned_flags("native_telemetry_sample_every", 8)
+        tuned_flags("enable_rpcz", True)
+        # the shared rpcz token bucket ALSO bounds native spans/second;
+        # raise it so this test observes the exact 1/N election alone
+        tuned_flags("rpcz_samples_per_second", 10_000_000)
+        srv = self._native_server("telsvc2")
+        try:
+            ch = np_mod.NativeClientChannel(
+                "127.0.0.1", srv.port, protocol="baidu_std"
+            )
+            try:
+                ch.pump("telsvc2", "echo", b"z" * 32, 800, inflight=32)
+            finally:
+                ch.close()
+            srv._native_plane.drain_telemetry()
+            spans = [
+                sp
+                for sp in span_store.recent(limit=20000)
+                if sp.service == "telsvc2"
+            ]
+            # counter-based 1/N sampling is exact: ticks 0,8,16,...
+            assert len(spans) == 800 // 8
+            sp = spans[0]
+            assert sp.span_type == "server" and sp.method == "echo"
+            assert sp.trace_id != 0 and sp.span_id != 0
+            assert sp.request_size == 32 and sp.response_size == 32
+            assert sp.latency_us >= 0
+            assert srv._native_plane.stats()["cb_frames"] == 0
+        finally:
+            srv.stop()
+
+    def test_adaptive_limit_moves_without_python_route(self, tuned_flags):
+        # the PR 3 blind spot: a 100%-native server used to hold its last
+        # pushed limit because the adaptive signal came only from
+        # Python-routed completions. The telemetry drain closes it: the
+        # limiter must move off its seed from native completions alone,
+        # and the new limit must land back in the C++ admission table.
+        tuned_flags("native_telemetry", True)
+        tuned_flags("native_telemetry_sample_every", 0)
+        tuned_flags("auto_cl_initial_max_concurrency", 40)
+        tuned_flags("auto_cl_sampling_interval_us", 20)
+        tuned_flags("auto_cl_min_sample_count", 20)
+        tuned_flags("auto_cl_max_sample_count", 100)
+        tuned_flags("auto_cl_sample_window_size_ms", 50)
+        srv = self._native_server("telsvc3", max_concurrency="auto")
+        plane = srv._native_plane
+        try:
+            assert "telsvc3.echo" in plane.native_method_names()
+            seed = 40
+            assert srv.max_concurrency == seed
+            assert plane.native_max_concurrency("telsvc3.echo") == seed
+            ch = np_mod.NativeClientChannel(
+                "127.0.0.1", srv.port, protocol="baidu_std"
+            )
+            try:
+                for _ in range(4):
+                    ch.pump("telsvc3", "echo", b"q" * 16, 5000, inflight=16)
+                    plane.drain_telemetry()
+            finally:
+                ch.close()
+            assert srv.max_concurrency != seed, (
+                "adaptive limit never moved off its seed despite a "
+                "pure-native flood"
+            )
+            # the moved limit is pushed back into the C++ admission table
+            assert (
+                plane.native_max_concurrency("telsvc3.echo")
+                == srv.max_concurrency
+            )
+            assert plane.stats()["cb_frames"] == 0, "flood was not pure-native"
+        finally:
+            srv.stop()
+
+    def test_ring_overflow_drops_instead_of_stalling(self, tuned_flags):
+        tuned_flags("native_telemetry", True)
+        tuned_flags("native_telemetry_sample_every", 0)
+        tuned_flags("native_telemetry_ring_size", 64)
+        tuned_flags("native_telemetry_drain_ms", 60000)  # bg pump idles
+        srv = self._native_server("telsvc4")
+        plane = srv._native_plane
+        try:
+            ch = np_mod.NativeClientChannel(
+                "127.0.0.1", srv.port, protocol="baidu_std"
+            )
+            try:
+                # 2000 completions into a 64-slot ring with nobody
+                # draining: the hot path must keep answering (drop, not
+                # block) and count what it sheds
+                ch.pump("telsvc4", "echo", b"w" * 8, 2000, inflight=32)
+                dropped = plane.telemetry_dropped()
+                assert dropped > 0
+                drained = plane.drain_telemetry()
+                assert 0 < drained <= 64
+                # the server is still alive and answering
+                rc, err, _, body = ch.call("telsvc4", "echo", b"alive")
+                assert rc >= 0 and err == 0 and body.to_bytes() == b"alive"
+            finally:
+                ch.close()
+            assert plane.telemetry_dropped() + plane._tel_drained >= 2000
+        finally:
+            srv.stop()
+
+    def test_telemetry_disabled_records_nothing(self, tuned_flags):
+        tuned_flags("native_telemetry", False)
+        srv = self._native_server("telsvc5")
+        plane = srv._native_plane
+        try:
+            ch = np_mod.NativeClientChannel(
+                "127.0.0.1", srv.port, protocol="baidu_std"
+            )
+            try:
+                ch.pump("telsvc5", "echo", b"n" * 8, 200, inflight=16)
+            finally:
+                ch.close()
+            assert plane.drain_telemetry() == 0
+            assert plane.telemetry_dropped() == 0
+            assert plane._tel_recorders == {}
+        finally:
+            srv.stop()
+
+
+# -- satellites: SpanStore reload/round-trip + /rpcz query upgrades -----------
+
+
+class TestSpanStoreSatellites:
+    def test_rpcz_max_spans_reload_applies(self, tuned_flags):
+        # deque(maxlen=...) froze the flag value read at construction;
+        # submit() must re-check it so a runtime retune takes effect
+        from incubator_brpc_tpu.builtin.rpcz import Span, SpanStore
+
+        tuned_flags("rpcz_max_spans", 10)
+        store = SpanStore()
+        for i in range(10):
+            store.submit(Span(trace_id=i + 1, span_id=i + 1))
+        assert len(store) == 10
+        tuned_flags("rpcz_max_spans", 4)
+        store.submit(Span(trace_id=100, span_id=100))
+        assert len(store) == 4  # shrank live, newest kept
+        assert store.recent(limit=10)[-1].trace_id == 100
+        tuned_flags("rpcz_max_spans", 6)
+        for i in range(6):
+            store.submit(Span(trace_id=200 + i, span_id=200 + i))
+        assert len(store) == 6  # grew live
+
+    def test_json_mode_rejects_cleanly_when_rpcz_off(
+        self, portal_server, tuned_flags
+    ):
+        # a machine consumer must get JSON and a non-2xx, never a 200
+        # text blob it cannot parse
+        tuned_flags("enable_rpcz", False)
+        status, headers, body = _fetch(portal_server, "/rpcz?json=1")
+        assert status == 503
+        assert "json" in headers.get("content-type", "")
+        assert "rpcz is off" in json.loads(body.decode())["error"]
+
+    def test_load_spans_round_trips_persisted_spans(
+        self, tuned_flags, tmp_path
+    ):
+        from incubator_brpc_tpu.builtin.rpcz import (
+            Span,
+            SpanStore,
+            load_spans,
+        )
+
+        tuned_flags("rpcz_database_dir", str(tmp_path))
+        store = SpanStore()
+        span = Span(
+            trace_id=0xFEED,
+            span_id=0xBEEF,
+            parent_span_id=0x1,
+            span_type="server",
+            service="persist",
+            method="echo",
+            remote_side="127.0.0.1:9",
+            log_id=7,
+            error_code=3,
+            start_real_us=123456789,
+            latency_us=42.5,
+            request_size=10,
+            response_size=20,
+        )
+        span.annotations.append((1.25, "queued"))
+        span.annotations.append((2.5, "done"))
+        store.submit(span)
+        store.close_db()
+        loaded = load_spans(str(tmp_path / "rpcz.jsonl"))
+        assert len(loaded) == 1
+        # dataclass equality covers every field — including annotations
+        # normalized back to the (offset, text) TUPLES live spans hold
+        # (the JSON round trip turned them into lists before this PR)
+        assert loaded[0] == span
+        assert isinstance(loaded[0].annotations[0], tuple)
+
+    def test_load_spans_skips_torn_lines(self, tmp_path):
+        from incubator_brpc_tpu.builtin.rpcz import load_spans
+
+        p = tmp_path / "rpcz.jsonl"
+        p.write_text(
+            '{"trace_id": 1, "span_id": 2, "type": "server"}\n'
+            '{"trace_id": 3, "span_id":'  # torn tail (crash mid-write)
+        )
+        loaded = load_spans(str(p))
+        assert len(loaded) == 1 and loaded[0].trace_id == 1
+        assert load_spans(str(tmp_path / "missing.jsonl")) == []
+
+
+class TestRpczQueries:
+    @pytest.fixture
+    def trace_server(self, portal_server, tuned_flags):
+        from incubator_brpc_tpu.builtin.rpcz import Span, span_store
+
+        tuned_flags("enable_rpcz", True)
+        span_store.clear()
+        mk = Span
+        span_store.submit(mk(
+            trace_id=0xABC, span_id=1, parent_span_id=0, span_type="server",
+            service="q", method="root", latency_us=900, start_real_us=100,
+        ))
+        span_store.submit(mk(
+            trace_id=0xABC, span_id=2, parent_span_id=1, span_type="client",
+            service="q", method="child1", latency_us=300, start_real_us=200,
+        ))
+        span_store.submit(mk(
+            trace_id=0xABC, span_id=3, parent_span_id=1, span_type="client",
+            service="q", method="child2", latency_us=100, error_code=7,
+            start_real_us=300,
+        ))
+        span_store.submit(mk(
+            trace_id=0xABC, span_id=4, parent_span_id=2, span_type="server",
+            service="q", method="grandchild", latency_us=50,
+            start_real_us=400,
+        ))
+        yield portal_server
+        span_store.clear()
+
+    def test_trace_id_renders_parent_child_tree(self, trace_server):
+        _, _, body = _fetch(trace_server, "/rpcz?trace_id=abc")
+        lines = body.decode().splitlines()
+        assert len(lines) == 4
+        assert lines[0].startswith("trace=abc span=1")  # root, no indent
+        assert lines[1].startswith("  trace=abc span=2")
+        assert lines[2].startswith("    trace=abc span=4")  # under child1
+        assert lines[3].startswith("  trace=abc span=3")
+
+    def test_min_latency_filter_is_latency_ordered(self, trace_server):
+        _, _, body = _fetch(trace_server, "/rpcz?min_latency_us=200")
+        lines = body.decode().splitlines()
+        assert len(lines) == 2
+        assert "span=1" in lines[0] and "span=2" in lines[1]  # worst first
+
+    def test_error_only_filter(self, trace_server):
+        _, _, body = _fetch(trace_server, "/rpcz?error_only=1")
+        lines = [ln for ln in body.decode().splitlines() if ln]
+        assert len(lines) == 1 and "error=7" in lines[0]
+
+    def test_json_mode_serves_span_dicts(self, trace_server):
+        status, headers, body = _fetch(trace_server, "/rpcz?json=1")
+        assert status == 200 and "json" in headers.get("content-type", "")
+        rows = json.loads(body.decode())
+        assert len(rows) == 4
+        by_span = {r["span_id"]: r for r in rows}
+        assert by_span[3]["error_code"] == 7
+        assert by_span[1]["type"] == "server"
+        assert by_span[1]["latency_us"] == 900
+
+    def test_bad_query_values_rejected(self, trace_server):
+        status, _, _ = _fetch(trace_server, "/rpcz?min_latency_us=abc")
+        assert status == 400
+        status, _, _ = _fetch(trace_server, "/rpcz?trace_id=zzz")
+        assert status == 400

@@ -50,19 +50,11 @@ from incubator_brpc_tpu.utils.status import ErrorCode
 
 
 @pytest.fixture
-def flags():
-    """Snapshot/restore any flag a test retunes — the robustness knobs are
-    process-global and must not leak into the rest of tier-1."""
-    touched = {}
-
-    def tune(name, value):
-        if name not in touched:
-            touched[name] = flag_registry.get(name)
-        set_flag_unchecked(name, value)
-
-    yield tune
-    for name, value in touched.items():
-        set_flag_unchecked(name, value)
+def flags(tuned_flags):
+    """Snapshot/restore any flag a test retunes — delegates to the shared
+    ``tuned_flags`` fixture (conftest.py) so ONE implementation owns the
+    restore discipline; kept under the historical local name."""
+    yield tuned_flags
 
 
 def wait_until(cond, timeout=10.0, interval=0.02):

@@ -198,6 +198,73 @@ class TestView:
             front.stop()
 
 
+class TestViewRpcz:
+    """rpc_view --rpcz: the scrape-side twin of --metrics for the
+    tracing plane (fetches /rpcz?json=1, prints spans or one trace
+    tree)."""
+
+    @pytest.fixture
+    def traced_server(self, echo_server, tuned_flags):
+        from incubator_brpc_tpu.builtin.rpcz import Span, span_store
+
+        server, _ = echo_server
+        tuned_flags("enable_rpcz", True)
+        span_store.clear()
+        span_store.submit(Span(
+            trace_id=0xBEE, span_id=1, parent_span_id=0, span_type="server",
+            service="tool", method="root", latency_us=500, start_real_us=10,
+        ))
+        span_store.submit(Span(
+            trace_id=0xBEE, span_id=2, parent_span_id=1, span_type="client",
+            service="tool", method="leaf", latency_us=100, error_code=9,
+            start_real_us=20,
+        ))
+        yield server
+        span_store.clear()
+
+    def test_rpcz_mode_prints_recent_spans(self, traced_server, capsys):
+        from tools.rpc_view import main as view_main
+
+        rc = view_main(
+            ["--rpcz", "--target", f"127.0.0.1:{traced_server.port}"]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "2 spans" in out
+        assert "tool.root" in out and "tool.leaf" in out
+
+    def test_rpcz_mode_assembles_trace_tree(self, traced_server, capsys):
+        from tools.rpc_view import main as view_main
+
+        rc = view_main([
+            "--rpcz", "--target", f"127.0.0.1:{traced_server.port}",
+            "--trace-id", "bee",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0
+        lines = [ln for ln in out.splitlines() if "trace=" in ln]
+        assert lines[0].startswith("trace=bee span=1")
+        assert lines[1].startswith("  trace=bee span=2")  # child indented
+
+    def test_rpcz_mode_filters(self, traced_server, capsys):
+        from tools.rpc_view import main as view_main
+
+        rc = view_main([
+            "--rpcz", "--target", f"127.0.0.1:{traced_server.port}",
+            "--error-only",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "1 spans" in out and "error=9" in out
+
+    def test_rpcz_mode_bad_target(self, capsys):
+        from tools.rpc_view import main as view_main
+
+        assert view_main(["--rpcz", "--target", "not-a-target"]) == 2
+        # unreachable port: a clean error, not a traceback
+        assert view_main(["--rpcz", "--target", "127.0.0.1:1"]) == 1
+
+
 class TestParallelHttp:
     def test_fetches_portal_urls_concurrently(self, echo_server):
         from tools.parallel_http import fetch_all

@@ -20,6 +20,12 @@ Two modes:
     python tools/rpc_view.py --metrics --target 127.0.0.1:8000
     python tools/rpc_view.py --metrics --target 127.0.0.1:8000 \
         --interval 5 --prefix method_
+
+  Scrape /rpcz?json=1 — recent sampled spans, or one assembled trace
+  tree (the scrape-side twin of --metrics for the tracing plane):
+    python tools/rpc_view.py --rpcz --target 127.0.0.1:8000
+    python tools/rpc_view.py --rpcz --target 127.0.0.1:8000 \
+        --trace-id 1f00dbeef --min-latency-us 500 --error-only
 """
 
 from __future__ import annotations
@@ -166,6 +172,69 @@ def metrics_mode(target: str, interval: float, prefix: str = "") -> int:
     return 0
 
 
+def scrape_rpcz(
+    target: str,
+    trace_id: str = "",
+    min_latency_us: float = None,
+    error_only: bool = False,
+):
+    """GET /rpcz?json=1 against host:port -> list of Span objects."""
+    from urllib.parse import urlencode
+
+    from incubator_brpc_tpu.builtin.rpcz import span_from_dict
+    from incubator_brpc_tpu.protocol.http import http_call
+
+    host, _, port = target.rpartition(":")
+    query = [("json", "1")]
+    if trace_id:
+        query.append(("trace_id", trace_id))
+    if min_latency_us is not None:
+        # urlencode, not f-strings: %g renders 1e6 as "1e+06" and a bare
+        # '+' decodes to a space on the server side
+        query.append(("min_latency_us", f"{min_latency_us:g}"))
+    if error_only:
+        query.append(("error_only", "1"))
+    path = "/rpcz?" + urlencode(query)
+    status, _, body = http_call(host, int(port), path, timeout=15)
+    if status != 200:
+        raise OSError(f"GET {path} -> {status}: {body[:200].decode(errors='replace')}")
+    return [
+        sp
+        for sp in (span_from_dict(d) for d in json.loads(body.decode()))
+        if sp is not None
+    ]
+
+
+def rpcz_mode(
+    target: str,
+    trace_id: str = "",
+    min_latency_us: float = None,
+    error_only: bool = False,
+) -> int:
+    """Print a target's recent sampled spans (or one assembled trace as
+    an indented parent→child tree when --trace-id is given)."""
+    from incubator_brpc_tpu.builtin.rpcz import render_trace_tree, span_line
+
+    host, _, port = target.rpartition(":")
+    if not host or not port.isdigit():
+        print(f"bad --target {target!r} (want host:port)", file=sys.stderr)
+        return 2
+    try:
+        spans = scrape_rpcz(target, trace_id, min_latency_us, error_only)
+    except (OSError, ValueError) as e:
+        # carries the server's reason too (e.g. the 503 "rpcz is off" body)
+        print(f"rpc_view: rpcz scrape of {target} failed: {e}", file=sys.stderr)
+        return 1
+    if trace_id and min_latency_us is None and not error_only:
+        lines = render_trace_tree(spans)
+    else:
+        lines = [span_line(sp) for sp in spans]
+    print(f"# /rpcz of {target} — {len(spans)} spans")
+    for line in lines:
+        print(line)
+    return 0
+
+
 def make_proxy_server(target: str):
     """Build (but do not start) the rpc_view front server: every path
     relays to the target's portal, renderings are tagged with the origin
@@ -296,8 +365,36 @@ def main(argv=None) -> int:
     p.add_argument(
         "--prefix", default="", help="metrics mode: only metrics with this prefix"
     )
+    p.add_argument(
+        "--rpcz",
+        action="store_true",
+        help="scrape /rpcz?json=1 from --target and print recent spans "
+        "(or one trace tree with --trace-id)",
+    )
+    p.add_argument(
+        "--trace-id",
+        default="",
+        help="rpcz mode: assemble and print this trace (hex) as a tree",
+    )
+    p.add_argument(
+        "--min-latency-us",
+        type=float,
+        default=None,
+        help="rpcz mode: only spans at least this slow (latency-ordered)",
+    )
+    p.add_argument(
+        "--error-only",
+        action="store_true",
+        help="rpcz mode: only spans that ended in an error",
+    )
     args = p.parse_args(argv)
 
+    if args.rpcz:
+        if not args.target:
+            p.error("--rpcz requires --target host:port")
+        return rpcz_mode(
+            args.target, args.trace_id, args.min_latency_us, args.error_only
+        )
     if args.metrics:
         if not args.target:
             p.error("--metrics requires --target host:port")
