@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+from incubator_brpc_tpu.parallel.compat import axis_size
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from incubator_brpc_tpu.parallel.collective import ring_stream
@@ -136,7 +138,7 @@ def _mlp_tp(w_in_l, w_out_l, x):
 def _ring_context(x: jnp.ndarray) -> jnp.ndarray:
     """Sequence-parallel global context via the sp ring (streaming RPC
     lowering): fold per-shard sequence means around the ring."""
-    sp = lax.axis_size("sp")
+    sp = axis_size("sp")
     local = jnp.mean(x, axis=1)  # (mb, d)
 
     def fold(acc, received):
@@ -154,7 +156,7 @@ def _moe(moe_w1, moe_w2, gate_w, x):
     rank-local experts, and exchanged back (all_to_all is an involution for
     equal tiles).
     """
-    ep = lax.axis_size("ep")
+    ep = axis_size("ep")
     e_local = moe_w1.shape[0]
     mb, sl, d = x.shape
     t = mb * sl
@@ -207,7 +209,7 @@ def _stage_fn(sp_params, heads, x):
 def _pipeline(stage, xs):
     """GPipe over 'pp': scan of M + pp - 1 ticks; stage handoff is a
     ppermute ring (streaming-RPC frame to the right neighbor each tick)."""
-    pp = lax.axis_size("pp")
+    pp = axis_size("pp")
     sidx = lax.axis_index("pp")
     m = xs.shape[0]
     perm = [(i, (i + 1) % pp) for i in range(pp)]
@@ -260,12 +262,13 @@ def _local_loss(cfg: FabricNetConfig, params, x, y):
 def make_forward_step(cfg: FabricNetConfig, mesh: jax.sharding.Mesh):
     """Jitted sharded forward: (params, x) -> (B, S, d) output."""
     x_spec, _ = batch_specs()
-    fwd = jax.shard_map(
+    from incubator_brpc_tpu.parallel.compat import shard_map_compat
+
+    fwd = shard_map_compat(
         partial(_local_forward, cfg),
         mesh=mesh,
         in_specs=(param_specs(cfg.heads), x_spec),
         out_specs=x_spec,
-        check_vma=False,
     )
     return jax.jit(fwd)
 
@@ -274,12 +277,13 @@ def make_train_step(cfg: FabricNetConfig, mesh: jax.sharding.Mesh):
     """Jitted FULL training step (forward + backward + SGD update) with all
     five parallelism axes live. Returns (step, init_fn)."""
     x_spec, y_spec = batch_specs()
-    loss_fn = jax.shard_map(
+    from incubator_brpc_tpu.parallel.compat import shard_map_compat
+
+    loss_fn = shard_map_compat(
         partial(_local_loss, cfg),
         mesh=mesh,
         in_specs=(param_specs(cfg.heads), x_spec, y_spec),
         out_specs=P(),
-        check_vma=False,
     )
 
     def step(params, x, y):

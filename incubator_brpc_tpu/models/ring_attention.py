@@ -55,7 +55,9 @@ def ring_attention(
     """Exact attention with K/V ringing over ``axis``. Call inside
     shard_map with q/k/v sharded on their sequence dim; shapes per rank:
     (B, T_local, H, D). Returns (B, T_local, H, D)."""
-    sp = lax.axis_size(axis)
+    from incubator_brpc_tpu.parallel.compat import axis_size
+
+    sp = axis_size(axis)
     idx = lax.axis_index(axis)
     perm = [(i, (i + 1) % sp) for i in range(sp)]
     b, t, h, d = q.shape
@@ -134,12 +136,13 @@ def make_ring_attention_step(mesh: jax.sharding.Mesh, causal: bool = True):
     kept sequence-only here since this layer IS the sp showcase)."""
     spec = P(None, "sp", None, None)
 
-    fn = jax.shard_map(
+    from incubator_brpc_tpu.parallel.compat import shard_map_compat
+
+    fn = shard_map_compat(
         partial(ring_attention, axis="sp", causal=causal),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=False,
     )
     jitted = jax.jit(fn)
 

@@ -79,28 +79,32 @@ class FileNamingService(NamingService):
         super().__init__(service_name)
         self.path = service_name
         self.poll_interval_s = float(get_flag("ns_refresh_interval_s"))
-        self._last_mtime: Optional[float] = None
+        self._last_raw: Optional[bytes] = None
 
     def get_servers(self) -> Optional[List[EndPoint]]:
-        """None on unchanged file OR any transient error — a failed stat/read
+        """None on unchanged content OR any transient error — a failed read
         must keep the previous server list, never wipe it (the reference
-        keeps serving the last good list across NS hiccups)."""
+        keeps serving the last good list across NS hiccups). Change is
+        detected on the BYTES, not st_mtime: several filesystems (and this
+        container's) keep second-granularity mtimes, so a same-size rewrite
+        within one tick is invisible to stat — and a server list is small
+        enough that re-reading it every poll costs nothing."""
         try:
-            mtime = os.stat(self.path).st_mtime_ns
+            with open(self.path, "rb") as f:
+                raw = f.read()
         except OSError:
             return None
-        if mtime == self._last_mtime:
+        if raw == self._last_raw:
             return None
         servers: List[EndPoint] = []
         try:
-            with open(self.path) as f:
-                for line in f:
-                    line = line.split("#", 1)[0].strip()
-                    if line:
-                        servers.append(_parse_node(line))
-        except (OSError, ValueError):
-            return None  # mtime NOT recorded: retried next tick
-        self._last_mtime = mtime
+            for line in raw.decode().splitlines():
+                line = line.split("#", 1)[0].strip()
+                if line:
+                    servers.append(_parse_node(line))
+        except (ValueError, UnicodeDecodeError):
+            return None  # content NOT recorded: retried next tick
+        self._last_raw = raw
         return servers
 
 

@@ -1,0 +1,57 @@
+"""shard_map across JAX generations — one import seam for every plane.
+
+The repo runs on whatever jax the environment bakes in: new builds expose
+``jax.shard_map`` and spell the replication check ``check_vma``; older
+builds only have ``jax.experimental.shard_map.shard_map`` and spell it
+``check_rep`` (and the deprecation shim raises AttributeError for the
+public name, so a bare ``jax.shard_map`` call dies at trace time). Every
+collective in the tree wants the same thing — "shard_map with the
+replication check off, wherever it lives" — so they all route through
+here instead of each guessing the API.
+"""
+
+from __future__ import annotations
+
+
+def resolve_shard_map():
+    """The callable, wherever this jax build keeps it."""
+    try:
+        from jax import shard_map  # JAX >= 0.8
+        return shard_map
+    except (ImportError, AttributeError):  # older JAX (accelerated deprecation)
+        from jax.experimental.shard_map import shard_map
+        return shard_map
+
+
+def axis_size(name: str) -> int:
+    """Static size of a bound mesh axis, inside shard_map. New jax spells
+    it ``lax.axis_size``; older builds special-case ``psum`` of a Python
+    constant to the same static int."""
+    from jax import lax
+
+    try:
+        return lax.axis_size(name)
+    except AttributeError:  # pragma: no cover — older JAX
+        return lax.psum(1, name)
+
+
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check: bool = False):
+    """``shard_map`` with the replication check spelled for THIS jax.
+
+    ``check=False`` (the default, and what every caller here wants: the
+    all-gather/replicated outputs the combo lowerings produce are exactly
+    what newer jax cannot statically infer) maps to ``check_vma=False``
+    on new builds and ``check_rep=False`` on old ones.
+    """
+    sm = resolve_shard_map()
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    if check:
+        return sm(f, **kwargs)
+    try:
+        return sm(f, check_vma=False, **kwargs)
+    except TypeError:
+        pass
+    try:
+        return sm(f, check_rep=False, **kwargs)
+    except TypeError:  # pragma: no cover — neither spelling: default checks
+        return sm(f, **kwargs)

@@ -109,16 +109,75 @@ def _run_observed_session(span, party_ids, own_index, steps, width, seed):
 
 
 def _devices_by_id(ids: List[int]):
-    import jax
+    from incubator_brpc_tpu.parallel.mc_dispatch import (
+        _devices_by_id as _impl,
+    )
 
-    by_id = {d.id: d for d in jax.devices()}
-    try:
-        return [by_id[i] for i in ids]
-    except KeyError as e:
-        raise ValueError(
-            f"device id {e} not in this process's global view "
-            f"(is jax.distributed initialized everywhere?)"
-        )
+    return _impl(ids)
+
+
+# -- pmean as ONE registered method on the collective method plane -------------
+#
+# The session machinery itself lives in parallel/mc_dispatch.py and is
+# kernel-agnostic: a session names a registered device method and every
+# party fingerprint-validates it before entering lockstep. pmean — the
+# original canned demo — survives as just one such method: the kernel
+# below reinterprets the row bytes as float32, pmeans over the party
+# axis, and writes the bytes back. It is width-independent (geometry is
+# the DeviceMethod's), so one source mints a DeviceMethod per requested
+# width via the resolver — identical fingerprints in every process that
+# imports this module.
+
+PMEAN_SERVICE = "_collective"
+PMEAN_METHOD = "pmean"
+
+
+def _pmean_bytes_kernel(data, n):
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.lax.bitcast_convert_type(data.reshape(-1, 4), jnp.float32)
+    m = jax.lax.pmean(f, "par")
+    return jax.lax.bitcast_convert_type(m, jnp.uint8).reshape(-1), n
+
+
+_pmean_dms: dict = {}
+_pmean_lock = __import__("threading").Lock()
+
+
+def _pmean_dm(width_bytes: int):
+    from incubator_brpc_tpu.rpc.device_method import DeviceMethod
+
+    with _pmean_lock:
+        dm = _pmean_dms.get(width_bytes)
+        if dm is None:
+            dm = DeviceMethod(_pmean_bytes_kernel, width=width_bytes)
+            _pmean_dms[width_bytes] = dm
+        return dm
+
+
+def _resolve_pmean(service: str, method: str, width):
+    """mc_dispatch method resolver: mints the pmean DeviceMethod for any
+    float32-aligned width, so sessions of arbitrary geometry resolve the
+    same fingerprint everywhere without a Server registration."""
+    if (
+        service == PMEAN_SERVICE
+        and method == PMEAN_METHOD
+        and isinstance(width, int)
+        and width > 0
+        and width % 4 == 0
+    ):
+        return _pmean_dm(width)
+    return None
+
+
+def _install_resolver() -> None:
+    from incubator_brpc_tpu.parallel import mc_dispatch
+
+    mc_dispatch.register_method_resolver(_resolve_pmean)
+
+
+_install_resolver()
 
 
 def run_collective_session(
@@ -131,53 +190,27 @@ def run_collective_session(
     """Run this party's half of the session; returns (final own shard,
     elapsed seconds). Every party calls this with identical arguments
     except ``own_index`` — the programs must match or the collectives
-    cannot rendezvous."""
-    import jax
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    cannot rendezvous. Since the collective method plane landed this is a
+    thin float32 veneer over ``mc_dispatch.run_dispatch_session`` with
+    the registered pmean method: one step pulls every party toward the
+    global mean, the invariant each party verifies independently."""
+    from incubator_brpc_tpu.parallel.mc_dispatch import run_dispatch_session
 
-    try:
-        from jax import shard_map
-    except ImportError:  # pragma: no cover — older JAX
-        from jax.experimental.shard_map import shard_map
-
-    devices = _devices_by_id(party_ids)
-    n = len(devices)
-    mesh = Mesh(np.asarray(devices), ("party",))
-    sharding = NamedSharding(mesh, P("party"))
-
-    def body(x):
-        # pmean: one step pulls every party to the global mean — the
-        # invariant each party verifies independently. A real workload
-        # swaps in its own kernel (psum gradients, all-to-all experts…);
-        # the session machinery is kernel-agnostic.
-        return shard_map(
-            lambda s: jax.lax.pmean(s, "party"),
-            mesh=mesh,
-            in_specs=P("party"),
-            out_specs=P("party"),
-        )(x)
-
-    step_fn = jax.jit(body, out_shardings=sharding)
-
-    # party i's deterministic initial operand (seed makes the expected
-    # global mean computable on every side without communication)
-    init = _party_operand(seed, own_index, width)
-    shard = jax.device_put(init[None, :], devices[own_index])
-    x = jax.make_array_from_single_device_arrays(
-        (n, width), sharding, [shard]
+    dm = _pmean_dm(4 * width)
+    # every party's operand derives from the seed, so each side can stage
+    # whatever shards it addresses without communication (exactly its own
+    # row in the mc deployment; all rows in a single-controller run)
+    operands = [
+        _party_operand(seed, i, width).tobytes()
+        for i in range(len(party_ids))
+    ]
+    own_row, own_n, elapsed = run_dispatch_session(
+        party_ids, own_index, dm, operands, steps,
+        service=PMEAN_SERVICE, method=PMEAN_METHOD,
     )
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        x = step_fn(x)  # chained: operands stay resident, XLA pipelines
-    own = None
-    for s in x.addressable_shards:
-        # a process can address several mesh devices (single-controller
-        # runs): OUR shard is the one on devices[own_index], not whichever
-        # the iterator yields last
-        if s.device == devices[own_index]:
-            own = np.asarray(s.data).reshape(-1)
-    elapsed = time.perf_counter() - t0
-    assert own is not None
+    own = np.frombuffer(
+        bytes(np.asarray(own_row[:own_n], dtype=np.uint8)), dtype=np.float32
+    ).copy()
     collective_sessions << 1
     collective_steps << steps
     collective_session_us << elapsed * 1e6

@@ -1,0 +1,668 @@
+"""Collective method plane — ANY registered device method, fabric-wide.
+
+`parallel/mc_collective.py` proved the pipelined cross-controller session
+shape (schedule once over the host plane, run K lockstep shard_map steps
+with operands device-resident through the chain) — but its kernel was
+hardcoded pmean, a canned demo. The single-controller fused dispatch
+(`rpc/combo.py`) already runs arbitrary user-registered device methods
+(`rpc/device_method.py`) with fingerprint validation, and the mc handshake
+advertises those fingerprints (`transport/mc_link.py`) — this module
+closes that loop, the way the reference transport carries *arbitrary*
+registered methods rather than one canned op (protocol.h:64-158):
+
+- **A session names a (service, method) pair.** The proposal carries the
+  pair, the kernel fingerprint the proposer resolved, the row geometry,
+  the step count and each party's initial operand. Nothing about the
+  kernel's body crosses the wire — only its identity.
+- **Every party validates before entering lockstep.** Each party — the
+  proposer included — resolves the pair against its LOCAL registry and
+  compares fingerprints. A mismatch (same name, different kernel — the
+  divergence that would silently corrupt a lockstep chain) is a clean
+  reject on the control stream: the proposer surfaces it before any
+  party dispatches a collective that could never rendezvous.
+- **The shared step binds the resolved kernel.** All parties jit the
+  IDENTICAL program: ``shard_map`` over ``Mesh(parties, ("par",))`` —
+  the SAME axis name the single-controller fused dispatch binds, so a
+  kernel that reduces over the axis (psum gradients, all-to-all experts)
+  behaves identically on both planes — applied K times with the chain's
+  operands never leaving the devices.
+- **N parties, convergent close.** The proposal fans out over the star
+  (one host channel per remote party), a barrier collects every accept,
+  and the final step count is the monotone max of every party's accept
+  target — the 2-party close dance's ``max(targets)`` join generalized
+  to N. All parties dispatch exactly ``final`` steps; each run response
+  echoes the count and the proposer asserts convergence.
+
+`ParallelChannel._fused_dispatch` lowers through this plane when its
+sub-channels resolve to multi-controller links (one shard_map dispatch is
+impossible across controllers — the client cannot place bytes on
+non-addressable devices), so the single-controller fused path and the
+cross-process path present ONE API: register a device method, call the
+combo channel, and the transport picks the lowering.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from incubator_brpc_tpu.bvar import Adder, LatencyRecorder
+from incubator_brpc_tpu.utils.flags import define_flag, get_flag
+
+logger = logging.getLogger(__name__)
+
+define_flag(
+    "mc_dispatch_min_steps",
+    0,
+    "minimum step count this party accepts into a collective-method "
+    "session: its accept ack raises the session target to at least this "
+    "(the proposer folds every target with max — the N-party join)",
+    lambda v: v >= 0,
+)
+
+DISPATCH_METHOD = "collective_dispatch"
+
+# Bounds a proposal must sit inside before anything is resolved or run
+# (mirrors mc_collective's admission checks).
+MAX_STEPS = 100_000
+MAX_WIDTH = 1 << 20
+MAX_PARTIES = 1024
+
+# How long the proposer watches freshly-dispatched RUN proposals for an
+# instant bounce before entering its own session (see mc_collective's
+# _REJECT_WATCH_S — same rationale, same bound).
+_REJECT_WATCH_S = 0.05
+
+# plane-level observability: sessions/steps/errors/rejects across every
+# kernel, plus a latency summary; per-kernel counters are minted lazily
+# below so /vars and /brpc_metrics can tell WHICH methods ride the plane
+dispatch_sessions = Adder(name="mc_dispatch_sessions")
+dispatch_steps = Adder(name="mc_dispatch_steps")
+dispatch_errors = Adder(name="mc_dispatch_errors")
+dispatch_rejects = Adder(name="mc_dispatch_rejects")
+dispatch_session_us = LatencyRecorder(name="mc_dispatch_session_us")
+
+_method_counters: Dict[Tuple[str, str], Adder] = {}
+_method_counters_lock = threading.Lock()
+
+
+def _method_counter(service: str, method: str) -> Adder:
+    """Per-kernel session counter (``mc_dispatch_<svc>_<m>_sessions``),
+    minted on first use — the bvar registry keeps it scrapeable."""
+    key = (service, method)
+    with _method_counters_lock:
+        ctr = _method_counters.get(key)
+        if ctr is None:
+            safe = "_".join(
+                "".join(c if c.isalnum() else "_" for c in part)
+                for part in key
+            )
+            ctr = Adder(name=f"mc_dispatch_{safe}_sessions")
+            _method_counters[key] = ctr
+        return ctr
+
+
+# -- kernel resolution ---------------------------------------------------------
+
+# Fallback resolvers for builtin kernels that are minted per-geometry
+# rather than registered by a Server (mc_collective's pmean installs one).
+# Signature: (service, method, width_bytes) -> Optional[DeviceMethod].
+_resolvers: List[Callable] = []
+
+
+def register_method_resolver(fn: Callable) -> None:
+    if fn not in _resolvers:
+        _resolvers.append(fn)
+
+
+def resolve_method(service: str, method: str, width: Optional[int] = None):
+    """Resolve (service, method) to this process's DeviceMethod: the
+    process-global registry first (what Server.add_service fills), then
+    the builtin resolvers. ``width`` (row bytes) must match the resolved
+    geometry — a session whose parties disagree on geometry could never
+    exchange shards."""
+    from incubator_brpc_tpu.rpc.device_method import lookup_device_method
+
+    dm = lookup_device_method(service, method)
+    if dm is None:
+        for r in list(_resolvers):
+            dm = r(service, method, width)
+            if dm is not None:
+                break
+    if dm is None:
+        return None
+    if width is not None and dm.width != width:
+        return None
+    return dm
+
+
+def _devices_by_id(ids: List[int]):
+    import jax
+
+    by_id = {d.id: d for d in jax.devices()}
+    try:
+        return [by_id[i] for i in ids]
+    except KeyError as e:
+        raise ValueError(
+            f"device id {e} not in this process's global view "
+            f"(is jax.distributed initialized everywhere?)"
+        )
+
+
+# -- the shared lockstep step --------------------------------------------------
+
+
+_step_cache: Dict[tuple, tuple] = {}  # (fp, party ids) -> (step_fn, dm)
+_step_cache_lock = threading.Lock()
+
+
+def _make_step(dm, mesh, sharding, party_ids):
+    """The identical jitted program every party dispatches: one shard_map
+    application of the resolved kernel over the party axis. Axis name
+    "par" matches the single-controller fused dispatch (rpc/combo.py), so
+    axis-reducing kernels produce the same bytes on both planes. Cached
+    per (kernel fingerprint, party set): the ParallelChannel lowering
+    runs one session per combo CALL, and re-tracing every call would put
+    XLA compilation on the request path (combo's _fused_cache, here)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from incubator_brpc_tpu.parallel.compat import shard_map_compat
+
+    key = (dm.fingerprint(), tuple(party_ids))
+    with _step_cache_lock:
+        cached = _step_cache.get(key)
+        if cached is not None and cached[1] is not dm:
+            cached = None  # same name re-registered with a new DeviceMethod
+        if cached is None:
+
+            def body(data, ns):
+                out, m = dm.kernel(data[0], ns[0])
+                return out[None], m[None]
+
+            wrapped = shard_map_compat(
+                body, mesh=mesh, in_specs=(P("par"), P("par")),
+                out_specs=(P("par"), P("par")),
+            )
+            cached = (
+                jax.jit(wrapped, out_shardings=(sharding, sharding)), dm
+            )
+            _step_cache[key] = cached
+    return cached[0]
+
+
+def run_dispatch_session(
+    party_ids: List[int],
+    own_index: int,
+    dm,
+    operands: List[bytes],
+    steps: int,
+    service: str = "?",
+    method: str = "?",
+) -> Tuple[np.ndarray, int, float]:
+    """Run this party's side of a K-step session of ``dm``'s kernel;
+    returns (own final row, own final n, elapsed seconds). Every party
+    calls this with identical arguments except ``own_index`` — the jitted
+    programs must match or the collectives cannot rendezvous. Each party
+    device-places the shards it can ADDRESS: in the multi-controller
+    deployment that is exactly its own row (the peers' devices are
+    visible but not addressable — they contribute their shards from their
+    own processes); in a single-controller run one call owns every shard
+    and the session degenerates to the full computation. Operands stay
+    device-resident across the chain: only the initial device_put and the
+    final fetch cross the host boundary, and XLA pipelines the K
+    dispatches (the ack/credit discipline is the response barrier the
+    proposer collects — no per-step coordination)."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = _devices_by_id(party_ids)
+    n = len(devices)
+    if len(operands) != n:
+        raise ValueError("one operand per party required")
+    mesh = Mesh(np.asarray(devices), ("par",))
+    sharding = NamedSharding(mesh, P("par"))
+    step_fn = _make_step(dm, mesh, sharding, party_ids)
+
+    addressable = sharding.addressable_devices
+    own_dev = devices[own_index]
+    if own_dev not in addressable:
+        raise ValueError(
+            f"party {own_index} device {own_dev} is not addressable from "
+            f"this process"
+        )
+    row_shards, n_shards = [], []
+    for i, dev in enumerate(devices):
+        if dev not in addressable:
+            continue
+        row, nn = dm.pack(operands[i])
+        row_shards.append(jax.device_put(row[None, :], dev))
+        n_shards.append(
+            jax.device_put(np.asarray([nn], dtype=np.int32), dev)
+        )
+    x = jax.make_array_from_single_device_arrays(
+        (n, dm.width), sharding, row_shards
+    )
+    ns = jax.make_array_from_single_device_arrays((n,), sharding, n_shards)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        x, ns = step_fn(x, ns)  # chained: operands never leave the devices
+    own_row = own_n = None
+    for s in x.addressable_shards:
+        # a process can address several mesh devices (single-controller
+        # runs): OUR shard is the one on devices[own_index]
+        if s.device == own_dev:
+            own_row = np.asarray(s.data).reshape(-1)
+    for s in ns.addressable_shards:
+        if s.device == own_dev:
+            own_n = int(np.asarray(s.data).reshape(-1)[0])
+    elapsed = time.perf_counter() - t0
+    assert own_row is not None and own_n is not None
+    dispatch_sessions << 1
+    dispatch_steps << steps
+    dispatch_session_us << elapsed * 1e6
+    _method_counter(service, method) << 1
+    return own_row, own_n, elapsed
+
+
+# -- rpcz spans (annotated with method identity) -------------------------------
+
+
+def _start_session_span(
+    service: str,
+    method: str,
+    fingerprint: str,
+    party_ids: List[int],
+    own_index: int,
+    steps: int,
+    trace_id: int = 0,
+    parent_span_id: int = 0,
+):
+    from incubator_brpc_tpu.builtin.rpcz import (
+        SPAN_TYPE_COLLECTIVE,
+        start_custom_span,
+    )
+
+    span = start_custom_span(
+        SPAN_TYPE_COLLECTIVE,
+        service,
+        method,
+        trace_id=trace_id,
+        parent_span_id=parent_span_id,
+    )
+    if span is not None:
+        span.annotate(
+            f"method={service}.{method} fingerprint={fingerprint} "
+            f"steps={steps} index={own_index} parties={party_ids}"
+        )
+    return span
+
+
+def _end_session_span(span, error_code: int = 0) -> None:
+    from incubator_brpc_tpu.builtin.rpcz import end_custom_span
+
+    end_custom_span(span, error_code=error_code)
+
+
+# -- server half ---------------------------------------------------------------
+
+
+def _validate_proposal(req: dict):
+    """Shared accept/run admission: bounds, then kernel identity. Returns
+    (party_ids, own_index, steps, dm, err) where err is (code, text) on
+    rejection — the clean control-stream reject that keeps a divergent
+    party out of lockstep."""
+    from incubator_brpc_tpu.utils.status import ErrorCode
+
+    try:
+        party_ids = [int(i) for i in req["parties"]]
+        own_index = int(req["index"])
+        steps = int(req["steps"])
+        width = int(req["width"])
+        service = str(req["service"])
+        method = str(req["method"])
+        fingerprint = str(req["fingerprint"])
+    except (ValueError, KeyError, TypeError) as e:
+        return None, None, None, None, (
+            ErrorCode.EREQUEST, f"bad dispatch proposal: {e}"
+        )
+    if not (
+        0 < steps <= MAX_STEPS
+        and 0 < width <= MAX_WIDTH
+        and 1 < len(party_ids) <= MAX_PARTIES
+        and 0 <= own_index < len(party_ids)
+        and len(set(party_ids)) == len(party_ids)
+    ):
+        return None, None, None, None, (
+            ErrorCode.EREQUEST, "dispatch proposal out of bounds"
+        )
+    dm = resolve_method(service, method, width)
+    if dm is None:
+        dispatch_rejects << 1
+        return None, None, None, None, (
+            ErrorCode.ENOMETHOD,
+            f"no device method {service}.{method} with width {width} "
+            f"registered in this process",
+        )
+    ours = dm.fingerprint()
+    if ours != fingerprint:
+        # same name, different kernel: entering lockstep would run a
+        # program the proposer never named — reject before any dispatch
+        dispatch_rejects << 1
+        return None, None, None, None, (
+            ErrorCode.EREQUEST,
+            f"device method fingerprint mismatch for {service}.{method}: "
+            f"proposal {fingerprint} vs local {ours}",
+        )
+    try:
+        _devices_by_id(party_ids)
+    except ValueError as e:
+        return None, None, None, None, (ErrorCode.EREQUEST, str(e))
+    return party_ids, own_index, steps, dm, None
+
+
+def make_dispatch_handler(server):
+    """Server half of ``_tpu_transport.collective_dispatch``: validate a
+    session proposal against the local registry (accept phase — nothing
+    runs), or bind the resolved kernel and run this party's side of the
+    lockstep chain (run phase), answering with the final shard."""
+
+    def collective_dispatch(cntl, request: bytes) -> bytes:
+        try:
+            req = json.loads(request.decode())
+        except ValueError as e:
+            from incubator_brpc_tpu.utils.status import ErrorCode
+
+            cntl.set_failed(ErrorCode.EREQUEST, f"undecodable proposal: {e}")
+            return b""
+        party_ids, own_index, steps, dm, err = _validate_proposal(req)
+        if err is not None:
+            cntl.set_failed(*err)
+            return b""
+        service, method = str(req["service"]), str(req["method"])
+        floor = int(get_flag("mc_dispatch_min_steps"))
+        if req.get("phase") != "accept" and steps < floor:
+            # the accept ack raised our target to the floor; a run
+            # proposal below it means the proposer did not fold this
+            # party's target — reject rather than silently dispatch a
+            # count the accept never agreed to (the close-barrier echo
+            # below only proves the VALIDATED count was run)
+            from incubator_brpc_tpu.utils.status import ErrorCode
+
+            dispatch_rejects << 1
+            cntl.set_failed(
+                ErrorCode.EREQUEST,
+                f"run proposal steps {steps} below this party's accepted "
+                f"floor {floor}",
+            )
+            return b""
+        if req.get("phase") == "accept":
+            # Nothing is run or reserved; ``target`` lets this party RAISE
+            # the step count (mc_dispatch_min_steps — e.g. a pipeline-depth
+            # floor). The proposer folds every target with max — the
+            # 2-party close dance's max(targets) join, generalized to N.
+            target = min(
+                max(steps, int(get_flag("mc_dispatch_min_steps"))), MAX_STEPS
+            )
+            return json.dumps(
+                {"accept": True, "index": own_index, "target": target}
+            ).encode()
+        try:
+            operands = [
+                base64.b64decode(op) for op in req.get("operands", [])
+            ]
+            if len(operands) != len(party_ids):
+                raise ValueError("one operand per party required")
+            for op in operands:
+                if len(op) > dm.width:
+                    raise ValueError(
+                        f"operand of {len(op)}B exceeds width {dm.width}"
+                    )
+        except (ValueError, TypeError) as e:
+            from incubator_brpc_tpu.utils.status import ErrorCode
+
+            cntl.set_failed(ErrorCode.EREQUEST, f"bad operands: {e}")
+            return b""
+        span = _start_session_span(
+            service, method, dm.fingerprint(), party_ids, own_index, steps,
+            trace_id=cntl.trace_id, parent_span_id=cntl.span_id,
+        )
+        try:
+            own_row, own_n, elapsed = run_dispatch_session(
+                party_ids, own_index, dm, operands, steps,
+                service=service, method=method,
+            )
+        except Exception as e:
+            dispatch_errors << 1
+            from incubator_brpc_tpu.utils.status import ErrorCode
+
+            _end_session_span(span, error_code=ErrorCode.EINTERNAL)
+            logger.exception("dispatch session failed")
+            cntl.set_failed(ErrorCode.EINTERNAL, f"dispatch session: {e!r}")
+            return b""
+        _end_session_span(span)
+        return json.dumps(
+            {
+                "result": base64.b64encode(
+                    dm.unpack(own_row, own_n)
+                ).decode(),
+                "steps": steps,
+                "elapsed_s": elapsed,
+                "index": own_index,
+            }
+        ).encode()
+
+    return collective_dispatch
+
+
+# -- client half: the N-party session scheduler --------------------------------
+
+
+def propose_dispatch(
+    channels,
+    party_ids: List[int],
+    service: str,
+    method: str,
+    operands: List[bytes],
+    steps: int = 1,
+    proposer_index: Optional[int] = None,
+    timeout_ms: float = 120000,
+) -> dict:
+    """Schedule an N-party session of a registered device method.
+
+    ``party_ids`` are global device ids in mesh order; ``operands[i]`` is
+    party i's initial row. ``channels[j]`` is a host channel to the
+    server playing the j-th REMOTE party index (every index except
+    ``proposer_index``; with ``proposer_index=None`` the proposer is a
+    pure scheduler and every party is remote — the ParallelChannel
+    lowering's shape). Returns ``{"results": [bytes per party],
+    "final_steps": k, "elapsed_s": proposer's chain seconds or None}``.
+
+    Three phases over the star:
+    1. accept fan-out + barrier — every party resolves the (service,
+       method) pair locally and fingerprint-checks it; any reject
+       surfaces HERE, before lockstep. ``final = max(all targets)``.
+    2. run fan-out (async — every party must be dispatching before any
+       can finish) with a short rejection watch, then the proposer's own
+       chain if it participates.
+    3. completion barrier — every response must echo ``final`` (the
+       convergent close: all parties dispatched exactly the same count).
+    """
+    import threading as _threading
+
+    from incubator_brpc_tpu.rpc.controller import Controller
+    from incubator_brpc_tpu.transport.device_link import HANDSHAKE_SERVICE
+
+    n = len(party_ids)
+    remote_indexes = [i for i in range(n) if i != proposer_index]
+    if len(remote_indexes) != len(channels):
+        raise ValueError("one channel per remote party required")
+    if len(operands) != n:
+        raise ValueError("one operand per party required")
+    dm = resolve_method(service, method)
+    if dm is None:
+        raise LookupError(
+            f"device method {service}.{method} not registered locally "
+            f"(the proposer validates against its own registry too)"
+        )
+    fingerprint = dm.fingerprint()
+    for op in operands:
+        if len(op) > dm.width:
+            raise ValueError(
+                f"operand of {len(op)}B exceeds method width {dm.width}"
+            )
+
+    def proposal(idx: int, nsteps: int, phase: str = "") -> bytes:
+        d = {
+            "parties": party_ids,
+            "index": idx,
+            "steps": nsteps,
+            "width": dm.width,
+            "service": service,
+            "method": method,
+            "fingerprint": fingerprint,
+        }
+        if phase:
+            d["phase"] = phase
+        else:
+            # the FULL operand list: each party device-places only the
+            # shards it can address (its own, in the mc deployment), but
+            # a single-controller party owns every shard and needs them
+            d["operands"] = [
+                base64.b64encode(op).decode() for op in operands
+            ]
+        return json.dumps(d).encode()
+
+    def _call(ch, payload):
+        cntl = Controller(timeout_ms=timeout_ms)
+        cntl._force_host = True  # scheduling rides the host plane
+        ev = _threading.Event()
+        ch.call_method(
+            HANDSHAKE_SERVICE,
+            DISPATCH_METHOD,
+            payload,
+            cntl=cntl,
+            done=lambda c, _ev=ev: _ev.set(),
+        )
+        return cntl, ev
+
+    # Phase 1 — accept barrier + the monotone-max step-count join
+    accepts = [
+        _call(ch, proposal(idx, steps, phase="accept"))
+        for ch, idx in zip(channels, remote_indexes)
+    ]
+    deadline = time.monotonic() + timeout_ms / 1000.0
+    final = steps
+    for cntl, ev in accepts:
+        if not ev.wait(max(0.0, deadline - time.monotonic())):
+            raise TimeoutError("dispatch peer never acknowledged proposal")
+        if cntl.failed():
+            raise RuntimeError(
+                f"dispatch proposal rejected: {cntl.error_text}"
+            )
+        ack = json.loads(cntl.response_payload.decode())
+        final = max(final, int(ack.get("target", steps)))
+
+    # Phase 2 — run fan-out (async: a sync proposal would deadlock — the
+    # first party's collective blocks on parties never told to start)
+    pending = [
+        _call(ch, proposal(idx, final))
+        for ch, idx in zip(channels, remote_indexes)
+    ]
+    if proposer_index is not None:
+        # Rejection watch before committing OUR device to a collective
+        # that could never rendezvous. A scheduler-only proposer skips
+        # it: it runs no collective, and phase 3 surfaces the same
+        # rejects — burning a fixed 50 ms there would tax every
+        # mc-lowered ParallelChannel call (and the LB latency feedback).
+        watch_deadline = time.monotonic() + _REJECT_WATCH_S
+        while time.monotonic() < watch_deadline:
+            for cntl, ev in pending:
+                if ev.is_set() and cntl.failed():
+                    raise RuntimeError(
+                        f"dispatch proposal rejected: {cntl.error_text}"
+                    )
+            if all(ev.is_set() for _c, ev in pending):
+                break  # every run already answered; nothing to watch
+            time.sleep(0.005)
+    own_elapsed = None
+    results: List[Optional[bytes]] = [None] * n
+    if proposer_index is not None:
+        span = _start_session_span(
+            service, method, fingerprint, party_ids, proposer_index, final
+        )
+        try:
+            own_row, own_n, own_elapsed = run_dispatch_session(
+                party_ids, proposer_index, dm, operands,
+                final, service=service, method=method,
+            )
+        except Exception:
+            dispatch_errors << 1
+            from incubator_brpc_tpu.utils.status import ErrorCode
+
+            _end_session_span(span, error_code=ErrorCode.EINTERNAL)
+            raise
+        _end_session_span(span)
+        results[proposer_index] = dm.unpack(own_row, own_n)
+
+    # Phase 3 — completion barrier; every response must echo ``final``
+    deadline = time.monotonic() + timeout_ms / 1000.0
+    for (cntl, ev), idx in zip(pending, remote_indexes):
+        if not ev.wait(max(0.0, deadline - time.monotonic())):
+            raise TimeoutError("dispatch peer never completed")
+        if cntl.failed():
+            raise RuntimeError(f"dispatch peer failed: {cntl.error_text}")
+        resp = json.loads(cntl.response_payload.decode())
+        # each party echoes the count it validated AND ran (a proposal
+        # below the party's accepted floor is rejected, never silently
+        # re-counted) — a mismatch here means a corrupted or stale
+        # proposal reached that party
+        if int(resp.get("steps", -1)) != final:
+            raise RuntimeError(
+                f"party {idx} dispatched {resp.get('steps')} steps, "
+                f"agreed final was {final} — close did not converge"
+            )
+        results[idx] = base64.b64decode(resp["result"])
+    return {"results": results, "final_steps": final, "elapsed_s": own_elapsed}
+
+
+# -- the ParallelChannel lowering ----------------------------------------------
+
+mc_lowered_dispatches = Adder(name="parallel_channel_mc_lowered")
+
+
+def lower_parallel_call(
+    channels,
+    devices,
+    service: str,
+    method: str,
+    requests: List[bytes],
+    timeout_ms: float,
+) -> List[bytes]:
+    """One combo call lowered onto the method plane: the sub-channels'
+    server devices form the party axis (channel order — the same order
+    the single-controller fused dispatch stacks, so merges are
+    byte-identical), each party's operand is its sub-request, the
+    proposer is a pure scheduler (its process cannot address any party
+    device), and one 1-step session replaces the host fan-out. Returns
+    per-sub response bytes in channel order."""
+    if not timeout_ms or timeout_ms <= 0:
+        timeout_ms = 120000.0
+    out = propose_dispatch(
+        channels,
+        [d.id for d in devices],
+        service,
+        method,
+        requests,
+        steps=1,
+        proposer_index=None,
+        timeout_ms=timeout_ms,
+    )
+    mc_lowered_dispatches << 1
+    return out["results"]

@@ -96,7 +96,13 @@ class ParallelChannel:
     (parallel/collective.fanout) returns every response in a single
     collective — the SURVEY §2.5 lowering of this row ("ParallelChannel
     fan-out/merge → all-gather across pod replicas"; BASELINE configs
-    #3/#4). Both paths run the same jitted kernel, so fused and host
+    #3/#4). When the sub-channels resolve to MULTI-CONTROLLER links the
+    single dispatch is impossible (operand bytes cannot be placed on
+    non-addressable devices), so the call lowers through the collective
+    method plane instead: a 1-step N-party session of the same kernel,
+    scheduled over the host plane (parallel/mc_dispatch.py) — one API,
+    the transport picks the lowering. Every path runs the same jitted
+    kernel over the same "par" axis, so fused, mc-lowered and host
     fan-out produce byte-identical merged responses; any precondition
     miss or dispatch failure falls back to the host path silently."""
 
@@ -273,6 +279,7 @@ class ParallelChannel:
                 if pch._lb is not None:
                     pch._lb.settle(pds)
 
+        links = []
         for _i, (ch, _merger, sub) in subs:
             if sub.service is not None or sub.method is not None:
                 # a mapper that redirects a sub-call to a different method
@@ -304,10 +311,47 @@ class ParallelChannel:
                 _settle_probes()
                 return None
             devices.append(link.devices[1])
+            links.append(link)
         ids = [getattr(d, "id", None) for d in devices]
         if len(set(ids)) != len(ids):
             _settle_probes()
             return None  # shared devices cannot form the collective axis
+        # multi-controller sub-links cannot take the single-dispatch fuse
+        # (this process cannot place operand bytes on non-addressable
+        # devices) — they lower through the collective method plane
+        # instead: one 1-step N-party session of the SAME kernel over the
+        # same axis, scheduled over the host plane (parallel/mc_dispatch)
+        mc = [getattr(lk, "own_side", None) is not None for lk in links]
+        if any(mc):
+            if not all(mc):
+                _settle_probes()
+                return None  # mixed planes cannot form one party axis
+            t0 = _time.perf_counter()
+            try:
+                from incubator_brpc_tpu.parallel import mc_dispatch
+
+                outs = mc_dispatch.lower_parallel_call(
+                    [ch for _i, (ch, _m, _s) in subs],
+                    devices,
+                    service,
+                    method,
+                    requests,
+                    timeout_ms=cntl.timeout_ms,
+                )
+            except Exception:
+                logger.exception(
+                    "mc collective lowering failed; using host fan-out"
+                )
+                _settle_probes()
+                return None
+            latency_us = (_time.perf_counter() - t0) * 1e6
+            for pch, pds in probed:
+                if pch._lb is not None:
+                    pch._lb.feedback(pds, latency_us, 0)
+            merged = b""
+            for pos, (_i, (ch, merger, _sub)) in enumerate(subs):
+                merged = merger.merge(merged, outs[pos])
+            return merged
         t0 = _time.perf_counter()
         try:
             rows_out, ns_out = self._fused_dispatch(dm, devices, requests)
@@ -335,12 +379,8 @@ class ParallelChannel:
         import numpy as np
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-        try:
-            from jax import shard_map  # JAX >= 0.8
-        except ImportError:  # pragma: no cover — older JAX
-            from jax.experimental.shard_map import shard_map
-
         from incubator_brpc_tpu.parallel import collective
+        from incubator_brpc_tpu.parallel.compat import shard_map_compat
 
         n = len(devices)
         key = (
@@ -362,15 +402,13 @@ class ParallelChannel:
                 # side lowered to the ICI collective)
                 return collective.fanout(out, "par"), collective.fanout(m, "par")
 
-            sm_kwargs = dict(
-                mesh=mesh, in_specs=(P("par"), P("par")), out_specs=(P(), P())
+            # the all_gather makes outputs replicated, which the static
+            # replication check cannot always infer — compat turns it off
+            # under whichever spelling (check_vma/check_rep) this jax has
+            wrapped = shard_map_compat(
+                body, mesh=mesh, in_specs=(P("par"), P("par")),
+                out_specs=(P(), P()),
             )
-            try:
-                # the all_gather makes outputs replicated, but newer JAX
-                # cannot statically infer that — disable the check
-                wrapped = shard_map(body, check_vma=False, **sm_kwargs)
-            except TypeError:  # older JAX: no check_vma kwarg
-                wrapped = shard_map(body, **sm_kwargs)
             fused = jax.jit(wrapped)
             cached = (fused, data_sh, mesh, dm)
             self._fused_cache[key] = cached

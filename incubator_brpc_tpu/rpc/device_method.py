@@ -123,6 +123,16 @@ def lookup_device_method(service: str, method: str) -> Optional[DeviceMethod]:
         return _registry.get((service, method))
 
 
+def registry_fingerprints() -> Dict[str, str]:
+    """Snapshot of every registered method's identity ("svc.m" ->
+    fingerprint) — what a multi-controller handshake advertises so the
+    peer can validate session proposals and collective lowerings against
+    a name it has actually seen (transport/mc_link.py)."""
+    with _registry_lock:
+        items = list(_registry.items())
+    return {f"{s}.{m}": dm.fingerprint() for (s, m), dm in items}
+
+
 def device_method(kernel: Callable, width: int = DEFAULT_WIDTH) -> Callable:
     """Wrap a device kernel into a host RPC handler.
 

@@ -646,6 +646,10 @@ class Server:
                 COLLECTIVE_METHOD,
                 make_collective_handler,
             )
+            from incubator_brpc_tpu.parallel.mc_dispatch import (
+                DISPATCH_METHOD,
+                make_dispatch_handler,
+            )
 
             co = f"{HANDSHAKE_SERVICE}.{COLLECTIVE_METHOD}"
             if co not in self._methods:
@@ -658,6 +662,22 @@ class Server:
                             max(0, self.options.collective_max_concurrency),
                         ),
                         co,
+                    ),
+                )
+            # the collective METHOD plane (general kernel dispatch) shares
+            # the opt-in and the admission limit with the legacy session
+            # service — one deployment decision covers both
+            cd = f"{HANDSHAKE_SERVICE}.{DISPATCH_METHOD}"
+            if cd not in self._methods:
+                self._methods.insert(
+                    cd,
+                    MethodProperty(
+                        make_dispatch_handler(self),
+                        MethodStatus(
+                            cd,
+                            max(0, self.options.collective_max_concurrency),
+                        ),
+                        cd,
                     ),
                 )
         hs = f"{HANDSHAKE_SERVICE}.{HANDSHAKE_METHOD}"

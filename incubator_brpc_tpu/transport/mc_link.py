@@ -452,6 +452,11 @@ def accept_mc_handshake(server, cntl, req: dict) -> bytes:
         messenger=server._messenger,
         context={"server": server},
     )
+    # fingerprint consumption is symmetric: the client's advertised
+    # device methods land on the server-side socket too, so EITHER end
+    # can validate a (service, method) session proposal or a collective
+    # lowering against what its peer actually registered
+    ds.device_methods = dict(req.get("device_methods") or {})
     server._device_socks.append(ds)
 
     def _forget(sock, _server=server):
@@ -496,6 +501,8 @@ def establish_mc_link(
     client_dev = local[device_index % len(local)]
     handler = _ControlHandler()
     ctrl = stream_create(StreamOptions(handler=handler))
+    from incubator_brpc_tpu.rpc.device_method import registry_fingerprints
+
     payload = json.dumps(
         {
             "controller": "multi",
@@ -503,6 +510,9 @@ def establish_mc_link(
             "client_device": client_dev.id,
             "slot_words": slot_words,
             "window": window,
+            # symmetric advertisement (see accept_mc_handshake): the
+            # collective method plane validates proposals against these
+            "device_methods": registry_fingerprints(),
         }
     ).encode()
     cntl = Controller(timeout_ms=timeout_ms)

@@ -29,6 +29,58 @@ import sys
 import time
 
 
+# -- the collective-method-plane test kernels ---------------------------------
+#
+# Module-level so every worker process minting a DeviceMethod from them
+# resolves the SAME fingerprint (module.qualname + source + geometry) —
+# the property the session accept phase validates. Integer arithmetic
+# end-to-end, so results are bit-exact across planes and processes.
+
+SESSION_WIDTH = 512
+
+
+def _scale_psum_kernel(data, n):
+    """psum + elementwise — a user kernel that actually exercises the
+    party axis (axis name 'par', shared by the fused single-controller
+    dispatch and the mc session plane)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    x = data.astype(jnp.int32)
+    s = lax.psum(x, "par")
+    return ((3 * s + x) % 256).astype(jnp.uint8), n
+
+
+def _scale_psum_kernel_wrong(data, n):
+    """Same name, different body — the divergence the fingerprint check
+    must reject before any party enters lockstep."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    x = data.astype(jnp.int32)
+    s = lax.psum(x, "par")
+    return ((5 * s + x) % 256).astype(jnp.uint8), n
+
+
+def session_expected(operands, steps: int, width: int = SESSION_WIDTH):
+    """Host-side model of the K-step _scale_psum_kernel chain: exact
+    integer arithmetic, so every party's device result must match these
+    bytes bit-for-bit."""
+    import numpy as np
+
+    rows, ns = [], []
+    for op in operands:
+        row = np.zeros(width, np.int64)
+        row[: len(op)] = np.frombuffer(op, np.uint8)
+        rows.append(row)
+        ns.append(len(op))
+    x = np.stack(rows)
+    for _ in range(steps):
+        s = x.sum(axis=0)
+        x = (3 * s[None, :] + x) % 256
+    return [bytes(x[i, : ns[i]].astype(np.uint8)) for i in range(len(rows))]
+
+
 def _force_local_device_count(n: int) -> None:
     """MUST run before jax backends initialize: each worker owns exactly
     ``n`` local virtual CPU devices (the parent harness may carry an
@@ -95,6 +147,17 @@ def run_server(args) -> int:
     pid = args.proc_id
     server.add_service(
         "part", {"get": lambda cntl, req: b"p%d:" % pid + req}
+    )
+    # a user-registered device method for the collective method plane:
+    # sessions name ("dsvc", "scale") and every party fingerprint-checks
+    # it; --wrong-kernel swaps the body to prove the mismatch reject
+    from incubator_brpc_tpu.rpc import device_method as _device_method
+
+    kernel = (
+        _scale_psum_kernel_wrong if args.wrong_kernel else _scale_psum_kernel
+    )
+    server.add_service(
+        "dsvc", {"scale": _device_method(kernel, width=SESSION_WIDTH)}
     )
     server.add_service("Admin", {"Quit": _quit})
     assert server.start(args.rpc_port)
@@ -182,9 +245,7 @@ def run_client(args) -> int:
     print("CLIENT_OK " + json.dumps(stats), flush=True)
     # release the peer so both processes reach the coordination service's
     # exit barrier together (see run_server)
-    host = Channel()
-    assert host.init(f"127.0.0.1:{args.rpc_port}")
-    host.call_method("Admin", "Quit", b"", cntl=Controller(timeout_ms=10000))
+    _quit_servers([args.rpc_port])
     return 0
 
 
@@ -274,10 +335,40 @@ def run_fabric_client(args) -> int:
             "parties": len(party_ids),
         }
 
+    # ParallelChannel lowering THROUGH the collective method plane: the
+    # sub-channels resolve to multi-controller links, so the fused path
+    # cannot single-dispatch — it schedules a 1-step N-party session of
+    # the registered kernel instead (rpc/combo.py -> parallel/mc_dispatch)
+    mc_low = None
+    if args.mc_lowering_check:
+        import numpy as _np2
+
+        from incubator_brpc_tpu.rpc.device_method import (
+            DeviceMethod,
+            register_device_method,
+        )
+
+        # the PROPOSER validates against its local registry too
+        register_device_method(
+            "dsvc", "scale", DeviceMethod(_scale_psum_kernel, width=SESSION_WIDTH)
+        )
+        req = bytes(range(48))
+        cntl = pc.call_method(
+            "dsvc", "scale", req, cntl=Controller(timeout_ms=60000)
+        )
+        assert cntl.ok(), f"mc-lowered call failed: {cntl.error_text}"
+        assert getattr(cntl, "collective_fused", False), (
+            "mc lowering not taken (fell back to host fan-out)"
+        )
+        want = b"".join(session_expected([req] * n, steps=1))
+        assert cntl.response_payload == want, "mc-lowered merge diverged"
+        mc_low = {"bytes": len(cntl.response_payload), "parties": n}
+
     links = [sub[0]._device_sock.link for sub in pc._subs]
     stats = {
         "n_rpcs": args.n_rpcs,
         "collective": coll,
+        "mc_lowered": mc_low,
         "links": [
             {
                 "devices": [str(d) for d in lk.devices],
@@ -306,11 +397,115 @@ def run_fabric_client(args) -> int:
         time.sleep(0.05)
     assert all(_settled(lk) for lk in links), "a link's close dance hung"
     print("CLIENT_OK " + json.dumps(stats), flush=True)
-    # release every server so all N processes reach the exit barrier
+    _quit_servers(ports)
+    return 0
+
+
+def _connect_all(ports, deadline_s: float = 90.0):
+    """One warm host channel per server port, retrying the first echo
+    until each server has bound (jax.distributed's init barrier ran, but
+    RPC ports come up independently). Returns the channels or None after
+    printing CLIENT_FAIL."""
+    from incubator_brpc_tpu.rpc import Channel, Controller
+
+    chans = []
+    deadline = time.monotonic() + deadline_s
+    for p in ports:
+        hc = Channel()
+        assert hc.init(f"127.0.0.1:{p}")
+        while True:
+            c = hc.call_method(
+                "EchoService", "Echo", b"up", cntl=Controller(timeout_ms=60000)
+            )
+            if c.ok():
+                break
+            if time.monotonic() > deadline:
+                print(f"CLIENT_FAIL connect {p}: {c.error_text}", flush=True)
+                return None
+            time.sleep(0.2)
+        chans.append(hc)
+    return chans
+
+
+def _quit_servers(ports) -> None:
+    """Release every server so all processes reach the coordination
+    service's exit barrier together (see run_server) — the one shutdown
+    protocol, shared by every client role."""
+    from incubator_brpc_tpu.rpc import Channel, Controller
+
     for p in ports:
         host = Channel()
         assert host.init(f"127.0.0.1:{p}")
         host.call_method("Admin", "Quit", b"", cntl=Controller(timeout_ms=10000))
+
+
+def run_session_client(args) -> int:
+    """N-party collective-method-plane client: propose a K-step session of
+    the user-registered ("dsvc", "scale") kernel to every server process
+    (plain host channels — no device links needed: the session IS the
+    data plane), run our own party's chain, and verify every party's
+    result bit-for-bit against the host-side integer model."""
+    _init_distributed(args.coord_port, args.proc_id, args.nprocs)
+    import jax
+
+    from incubator_brpc_tpu.parallel.mc_dispatch import propose_dispatch
+    from incubator_brpc_tpu.rpc.device_method import (
+        DeviceMethod,
+        register_device_method,
+    )
+
+    # the proposer validates (service, method) against its LOCAL registry
+    # exactly like every accepting party
+    register_device_method(
+        "dsvc", "scale", DeviceMethod(_scale_psum_kernel, width=SESSION_WIDTH)
+    )
+    ports = [int(p) for p in args.rpc_ports.split(",")]
+    party_ids = sorted(d.id for d in jax.devices())
+    client_index = party_ids.index(jax.local_devices()[0].id)
+    n = len(party_ids)
+    assert len(ports) == n - 1
+    chans = _connect_all(ports)
+    if chans is None:
+        return 1
+    # per-party operands with DIFFERENT lengths: proves both the operand
+    # routing and the n-passthrough across the chain
+    operands = [
+        bytes((7 * i + j) % 256 for j in range(64 + 8 * i)) for i in range(n)
+    ]
+    steps = args.collective_steps or 4
+    if args.expect_reject:
+        # one server registered a different body under the same name: the
+        # accept phase must reject CLEANLY, before any lockstep entry
+        try:
+            propose_dispatch(
+                chans, party_ids, "dsvc", "scale", operands,
+                steps=steps, proposer_index=client_index, timeout_ms=60000,
+            )
+        except RuntimeError as e:
+            assert "fingerprint mismatch" in str(e), e
+            print(
+                "CLIENT_OK " + json.dumps({"rejected": True, "parties": n}),
+                flush=True,
+            )
+            _quit_servers(ports)
+            return 0
+        print("CLIENT_FAIL mismatch was not rejected", flush=True)
+        return 1
+    out = propose_dispatch(
+        chans, party_ids, "dsvc", "scale", operands,
+        steps=steps, proposer_index=client_index, timeout_ms=120000,
+    )
+    want = session_expected(operands, out["final_steps"])
+    for i, (got, exp) in enumerate(zip(out["results"], want)):
+        assert got == exp, f"party {i} diverged from the integer model"
+    stats = {
+        "parties": n,
+        "steps": out["final_steps"],
+        "per_step_ms": out["elapsed_s"] / out["final_steps"] * 1e3,
+        "method": "dsvc.scale",
+    }
+    print("CLIENT_OK " + json.dumps(stats), flush=True)
+    _quit_servers(ports)
     return 0
 
 
@@ -490,6 +685,122 @@ def orchestrate_peer_death(die_after: int = 3, timeout: float = 240.0):
     )
 
 
+def run_probe(args) -> int:
+    """Capability probe body: join the group, run ONE 2-device collective,
+    report. Everything the mc plane needs, nothing it doesn't — fails in
+    seconds on backends that cannot run multi-process computations."""
+    _init_distributed(args.coord_port, args.proc_id, args.nprocs)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from incubator_brpc_tpu.parallel.compat import shard_map_compat
+
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = sorted(jax.devices(), key=lambda d: d.id)
+    mesh = Mesh(np.asarray(devices), ("p",))
+    sharding = NamedSharding(mesh, P("p"))
+    own = jax.local_devices()[0]
+    idx = [d.id for d in devices].index(own.id)
+    fn = jax.jit(
+        shard_map_compat(
+            lambda x: jax.lax.psum(x, "p"),
+            mesh=mesh, in_specs=P("p"), out_specs=P("p"),
+        ),
+        out_shardings=sharding,
+    )
+    shard = jax.device_put(jnp.asarray([[float(idx + 1)]]), own)
+    x = jax.make_array_from_single_device_arrays(
+        (len(devices), 1), sharding, [shard]
+    )
+    out = fn(x)
+    for s in out.addressable_shards:
+        total = float(np.asarray(s.data).reshape(-1)[0])
+        expect = sum(range(1, len(devices) + 1))
+        assert total == expect, (total, expect)
+    print("PROBE_OK", flush=True)
+    return 0
+
+
+_mp_capable: dict = {}
+
+
+def multiprocess_capable(timeout: float = 120.0) -> bool:
+    """Fast module-scoped capability gate: can this jax backend run a
+    cross-process collective at all? One tiny 2-process psum decides (a
+    backend without multi-process computations fails it in seconds);
+    cached process-wide so every suite pays at most one probe."""
+    if "ok" not in _mp_capable:
+        import subprocess
+
+        coord = _free_ports(1)[0]
+        repo = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        procs = [
+            subprocess.Popen(
+                [
+                    sys.executable, "-m",
+                    "incubator_brpc_tpu.transport.mc_worker", "probe",
+                    "--coord-port", str(coord), "--nprocs", "2",
+                    "--proc-id", str(i),
+                ],
+                cwd=repo, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True,
+            )
+            for i in range(2)
+        ]
+        ok = True
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out = ""
+            ok = ok and p.returncode == 0 and "PROBE_OK" in (out or "")
+        _mp_capable["ok"] = ok
+    return _mp_capable["ok"]
+
+
+def orchestrate_session(
+    n_parties: int = 3,
+    steps: int = 4,
+    wrong_kernel: bool = False,
+    timeout: float = 300.0,
+):
+    """Spawn ``n_parties - 1`` server processes + one session client (all
+    one jax.distributed group) and run an N-party collective-method-plane
+    session of the user kernel. ``wrong_kernel`` arms ONE server with a
+    same-name/different-body kernel so the fingerprint reject path is
+    what the run proves. Returns the client's session stats."""
+    ports = _free_ports(n_parties)
+    coord, rpc_ports = ports[0], ports[1:]
+    specs = []
+    for i in range(n_parties - 1):
+        argv = [
+            "--coord-port", str(coord), "--nprocs", str(n_parties),
+            "--proc-id", str(i), "--rpc-port", str(rpc_ports[i]),
+        ]
+        if wrong_kernel and i == 0:
+            argv.append("--wrong-kernel")
+        specs.append((f"server{i}", "server", tuple(argv)))
+    client = [
+        "--coord-port", str(coord), "--nprocs", str(n_parties),
+        "--proc-id", str(n_parties - 1),
+        "--rpc-ports", ",".join(map(str, rpc_ports)),
+        "--collective-steps", str(steps),
+    ]
+    if wrong_kernel:
+        client.append("--expect-reject")
+    specs.append(("session-client", "session-client", tuple(client)))
+    return _orchestrate(
+        specs, label=f"{n_parties}-party session", timeout=timeout
+    )
+
+
 def orchestrate_fabric(n_servers: int = 2, extra=(), timeout: float = 300.0):
     """Spawn ``n_servers`` server processes + one fabric client (all in one
     jax.distributed group) and return the client's per-link stats."""
@@ -529,7 +840,12 @@ def main(argv=None) -> int:
 
     faulthandler.register(signal.SIGUSR1)
     ap = argparse.ArgumentParser()
-    ap.add_argument("role", choices=["server", "client", "fabric-client"])
+    ap.add_argument(
+        "role",
+        choices=[
+            "server", "client", "fabric-client", "session-client", "probe",
+        ],
+    )
     ap.add_argument("--coord-port", type=int, required=True)
     ap.add_argument("--rpc-port", type=int, default=0)
     ap.add_argument("--rpc-ports", type=str, default="")  # fabric client
@@ -542,6 +858,10 @@ def main(argv=None) -> int:
     ap.add_argument("--collective-steps", type=int, default=0)  # fabric
     ap.add_argument("--die-after-rpcs", type=int, default=0)  # server fault
     ap.add_argument("--expect-peer-death", action="store_true")  # client
+    # collective method plane (parallel/mc_dispatch):
+    ap.add_argument("--wrong-kernel", action="store_true")  # server
+    ap.add_argument("--expect-reject", action="store_true")  # session client
+    ap.add_argument("--mc-lowering-check", action="store_true")  # fabric
     args = ap.parse_args(argv)
     if args.proc_id < 0:
         # pair convention: server is the coordinator, client is last
@@ -551,6 +871,10 @@ def main(argv=None) -> int:
         return run_server(args)
     if args.role == "fabric-client":
         return run_fabric_client(args)
+    if args.role == "session-client":
+        return run_session_client(args)
+    if args.role == "probe":
+        return run_probe(args)
     return run_client(args)
 
 

@@ -24,6 +24,13 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long multi-process orchestrations (tier-1 runs -m 'not slow')",
+    )
+
+
 @pytest.fixture
 def tuned_flags():
     """Snapshot/restore any process-global flag a test retunes — shared

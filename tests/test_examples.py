@@ -29,6 +29,8 @@ EXAMPLES = [
     "examples/cascade_echo.py",
     "examples/selective_echo.py",
     "examples/asynchronous_echo.py",
+    "examples/ubrpc_compack.py",
+    "examples/nshead_extension.py",
 ]
 
 

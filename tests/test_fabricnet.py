@@ -94,6 +94,16 @@ def test_heads_zero_ring_mean_path():
 
 
 def test_graft_entry_dryrun():
+    # the dryrun's multi-controller gate needs real cross-process
+    # collectives; probe that capability in seconds instead of letting
+    # the pair burn its whole handshake deadline on a backend without it
+    # (the driver still runs dryrun_multichip directly, probe-free)
+    import pytest
+
+    from incubator_brpc_tpu.transport.mc_worker import multiprocess_capable
+
+    if not multiprocess_capable():
+        pytest.skip("jax backend cannot run multi-process computations")
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)

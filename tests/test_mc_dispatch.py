@@ -1,0 +1,625 @@
+"""Collective method plane (parallel/mc_dispatch.py): ANY registered
+device method runs a pipelined N-party session with fingerprint
+validation — pmean is just one registered method on the plane.
+
+Two tiers:
+- in-process tests on the virtual 8-device mesh (single controller, every
+  party device addressable): the proposal/accept/run/close machinery, the
+  fingerprint reject, the convergent N-party step join, and the
+  byte-identity contract against the single-controller fused dispatch;
+- subprocess tests (real jax.distributed processes, the deployment the
+  plane exists for), gated by the same fast capability probe as
+  tests/test_mc_link.py.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from incubator_brpc_tpu.transport.mc_worker import (
+    SESSION_WIDTH,
+    _scale_psum_kernel,
+    session_expected,
+)
+
+_FABRIC_UNSUPPORTED = "Multiprocess computations aren't implemented"
+
+
+@pytest.fixture(scope="module")
+def shard_map_capable():
+    """In-process sessions dispatch shard_map over the virtual mesh; skip
+    the module in one cheap step where this jax cannot trace it at all
+    (the test_parallel.py probe pattern, via the compat seam)."""
+    import jax
+
+    from incubator_brpc_tpu.parallel.compat import resolve_shard_map
+
+    try:
+        resolve_shard_map()
+    except ImportError:
+        pytest.skip("no shard_map in this jax build")
+    if len(jax.devices()) < 4:
+        pytest.skip("needs a 4+ device mesh")
+    return True
+
+
+@pytest.fixture
+def registered_scale(shard_map_capable):
+    """("dsvc", "scale") bound to the psum+elementwise kernel in THIS
+    process's registry (proposer and in-process servers share it)."""
+    from incubator_brpc_tpu.rpc.device_method import (
+        DeviceMethod,
+        register_device_method,
+        lookup_device_method,
+    )
+
+    dm = DeviceMethod(_scale_psum_kernel, width=SESSION_WIDTH)
+    prev = lookup_device_method("dsvc", "scale")
+    register_device_method("dsvc", "scale", dm)
+    yield dm
+    if prev is not None:
+        register_device_method("dsvc", "scale", prev)
+
+
+def _collective_servers(n, width=SESSION_WIDTH, kernel=_scale_psum_kernel):
+    """n servers on distinct mesh devices, each registering the kernel as
+    a device method AND serving the collective method plane."""
+    from incubator_brpc_tpu.rpc import Server, ServerOptions, device_method
+
+    servers = []
+    for i in range(n):
+        s = Server(
+            ServerOptions(
+                device_index=i + 1,
+                usercode_inline=True,
+                enable_collective_service=True,
+                collective_max_concurrency=0,
+            )
+        )
+        s.add_service("dsvc", {"scale": device_method(kernel, width=width)})
+        assert s.start(0)
+        servers.append(s)
+    return servers
+
+
+def _host_channels(servers):
+    from incubator_brpc_tpu.rpc import Channel
+
+    chans = []
+    for s in servers:
+        ch = Channel()
+        assert ch.init(f"127.0.0.1:{s.port}")
+        chans.append(ch)
+    return chans
+
+
+class TestProposalValidation:
+    """Accept-phase admission: the clean control-stream reject."""
+
+    def _proposal(self, dm, parties, **over):
+        d = {
+            "parties": parties,
+            "index": 1,
+            "steps": 2,
+            "width": dm.width,
+            "service": "dsvc",
+            "method": "scale",
+            "fingerprint": dm.fingerprint(),
+            "phase": "accept",
+        }
+        d.update(over)
+        return json.dumps(d).encode()
+
+    def test_accept_validates_fingerprint(self, registered_scale):
+        import jax
+
+        from incubator_brpc_tpu.rpc import Controller
+        from incubator_brpc_tpu.utils.status import ErrorCode
+
+        dm = registered_scale
+        parties = [d.id for d in jax.devices()[:3]]
+        servers = _collective_servers(1)
+        try:
+            (ch,) = _host_channels(servers)
+
+            ok = ch.call_method(
+                "_tpu_transport", "collective_dispatch",
+                self._proposal(dm, parties),
+                cntl=Controller(timeout_ms=30000),
+            )
+            assert ok.ok(), ok.error_text
+            ack = json.loads(ok.response_payload.decode())
+            assert ack["accept"] is True and ack["target"] == 2
+
+            # same name, different fingerprint -> clean reject, no lockstep
+            bad = ch.call_method(
+                "_tpu_transport", "collective_dispatch",
+                self._proposal(dm, parties, fingerprint="deadbeef00000000"),
+                cntl=Controller(timeout_ms=30000),
+            )
+            assert bad.failed()
+            assert bad.error_code == ErrorCode.EREQUEST
+            assert "fingerprint mismatch" in bad.error_text
+
+            # unknown method name -> ENOMETHOD
+            miss = ch.call_method(
+                "_tpu_transport", "collective_dispatch",
+                self._proposal(dm, parties, method="nosuch"),
+                cntl=Controller(timeout_ms=30000),
+            )
+            assert miss.failed()
+            assert miss.error_code == ErrorCode.ENOMETHOD
+
+            # geometry mismatch (width disagrees with the registration)
+            geo = ch.call_method(
+                "_tpu_transport", "collective_dispatch",
+                self._proposal(dm, parties, width=dm.width * 2),
+                cntl=Controller(timeout_ms=30000),
+            )
+            assert geo.failed()
+
+            # out-of-bounds proposal
+            oob = ch.call_method(
+                "_tpu_transport", "collective_dispatch",
+                self._proposal(dm, parties, steps=0),
+                cntl=Controller(timeout_ms=30000),
+            )
+            assert oob.failed()
+            assert oob.error_code == ErrorCode.EREQUEST
+        finally:
+            for s in servers:
+                s.stop()
+                s.join(timeout=5)
+
+    def test_run_phase_enforces_accept_floor(
+        self, registered_scale, tuned_flags
+    ):
+        """A run proposal below this party's accepted step floor means
+        the proposer never folded our accept target — clean reject, not
+        a silent dispatch of an un-agreed count (what keeps the phase-3
+        close-barrier echo meaningful)."""
+        import jax
+
+        from incubator_brpc_tpu.rpc import Controller
+        from incubator_brpc_tpu.utils.status import ErrorCode
+
+        tuned_flags("mc_dispatch_min_steps", 6)
+        dm = registered_scale
+        parties = [d.id for d in jax.devices()[:2]]
+        servers = _collective_servers(1)
+        try:
+            (ch,) = _host_channels(servers)
+            low = ch.call_method(
+                "_tpu_transport", "collective_dispatch",
+                self._proposal(dm, parties, steps=2, phase=None),
+                cntl=Controller(timeout_ms=30000),
+            )
+            assert low.failed()
+            assert low.error_code == ErrorCode.EREQUEST
+            assert "floor" in low.error_text
+        finally:
+            for s in servers:
+                s.stop()
+                s.join(timeout=5)
+
+    def test_reject_counter_advances(self, registered_scale):
+        import jax
+
+        from incubator_brpc_tpu.parallel.mc_dispatch import dispatch_rejects
+        from incubator_brpc_tpu.rpc import Controller
+
+        dm = registered_scale
+        parties = [d.id for d in jax.devices()[:2]]
+        servers = _collective_servers(1)
+        try:
+            (ch,) = _host_channels(servers)
+            before = dispatch_rejects.get_value()
+            bad = ch.call_method(
+                "_tpu_transport", "collective_dispatch",
+                self._proposal(dm, parties, fingerprint="0" * 16),
+                cntl=Controller(timeout_ms=30000),
+            )
+            assert bad.failed()
+            assert dispatch_rejects.get_value() == before + 1
+        finally:
+            for s in servers:
+                s.stop()
+                s.join(timeout=5)
+
+
+class TestInProcessSessions:
+    """The scheduler machinery with every party addressable (single
+    controller): proposal fan-out, accept barrier, run barrier, merge."""
+
+    def test_user_kernel_session_matches_integer_model(
+        self, registered_scale
+    ):
+        import jax
+
+        from incubator_brpc_tpu.parallel.mc_dispatch import propose_dispatch
+
+        servers = _collective_servers(2)
+        try:
+            chans = _host_channels(servers)
+            party_ids = [jax.devices()[1].id, jax.devices()[2].id]
+            operands = [bytes(range(40)), bytes(range(100, 180))]
+            out = propose_dispatch(
+                chans, party_ids, "dsvc", "scale", operands,
+                steps=3, proposer_index=None, timeout_ms=60000,
+            )
+            assert out["final_steps"] == 3
+            assert out["results"] == session_expected(operands, 3)
+        finally:
+            for s in servers:
+                s.stop()
+                s.join(timeout=5)
+
+    def test_nparty_close_converges_on_max_target(
+        self, registered_scale, tuned_flags
+    ):
+        """One party demands a deeper pipeline (mc_dispatch_min_steps):
+        its accept raises the target, the proposer folds max over ALL
+        targets, and every party dispatches exactly the raised count —
+        the 2-party close dance's monotone join at N parties."""
+        import jax
+
+        from incubator_brpc_tpu.parallel.mc_dispatch import propose_dispatch
+
+        tuned_flags("mc_dispatch_min_steps", 5)
+        servers = _collective_servers(3)
+        try:
+            chans = _host_channels(servers)
+            party_ids = [d.id for d in jax.devices()[1:4]]
+            operands = [b"a" * 10, b"b" * 20, b"c" * 30]
+            out = propose_dispatch(
+                chans, party_ids, "dsvc", "scale", operands,
+                steps=2, proposer_index=None, timeout_ms=60000,
+            )
+            # proposed 2, every accept answered max(2, 5) = 5
+            assert out["final_steps"] == 5
+            assert out["results"] == session_expected(operands, 5)
+        finally:
+            for s in servers:
+                s.stop()
+                s.join(timeout=5)
+
+    def test_byte_identical_with_single_controller_fused_dispatch(
+        self, registered_scale
+    ):
+        """The contract that makes the two planes ONE API: the same
+        kernel, same axis name, same party order — the session's merged
+        bytes equal the single-controller fused dispatch's merge."""
+        import jax
+
+        from incubator_brpc_tpu.parallel.mc_dispatch import propose_dispatch
+        from incubator_brpc_tpu.rpc import Channel, ChannelOptions, Controller
+        from incubator_brpc_tpu.rpc.combo import ParallelChannel, SubCall
+
+        operands = [bytes([i * 3]) * (20 + i) for i in range(3)]
+
+        class PerIndexMapper:
+            def map(self, i, nchan, service, method, request):
+                return SubCall(request=operands[i])
+
+        servers = _collective_servers(3)
+        try:
+            pc = ParallelChannel(fuse_device_calls=True)
+            for s in servers:
+                ch = Channel()
+                assert ch.init(
+                    f"127.0.0.1:{s.port}",
+                    options=ChannelOptions(transport="tpu", timeout_ms=60000),
+                )
+                pc.add_channel(ch, call_mapper=PerIndexMapper())
+            fused = pc.call_method(
+                "dsvc", "scale", b"ignored", cntl=Controller(timeout_ms=60000)
+            )
+            assert fused.ok(), fused.error_text
+            assert getattr(fused, "collective_fused", False), (
+                "single-controller fused path not taken"
+            )
+
+            chans = _host_channels(servers)
+            party_ids = [d.id for d in jax.devices()[1:4]]
+            out = propose_dispatch(
+                chans, party_ids, "dsvc", "scale", operands,
+                steps=1, proposer_index=None, timeout_ms=60000,
+            )
+            assert b"".join(out["results"]) == fused.response_payload
+            assert fused.response_payload == b"".join(
+                session_expected(operands, 1)
+            )
+        finally:
+            for s in servers:
+                s.stop()
+                s.join(timeout=5)
+
+    def test_proposer_as_party_and_per_kernel_counters(
+        self, registered_scale
+    ):
+        """The proposer runs its own chain when it owns a party device;
+        plane + per-kernel bvars advance."""
+        import jax
+
+        from incubator_brpc_tpu.parallel.mc_dispatch import (
+            _method_counter,
+            dispatch_sessions,
+            propose_dispatch,
+        )
+
+        sessions_before = dispatch_sessions.get_value()
+        kernel_before = _method_counter("dsvc", "scale").get_value()
+        servers = _collective_servers(1)
+        try:
+            chans = _host_channels(servers)
+            # proposer plays party 0 on device 0; the server plays party 1
+            party_ids = [jax.devices()[0].id, jax.devices()[1].id]
+            operands = [b"proposer-side", b"server-side!!"]
+            out = propose_dispatch(
+                chans, party_ids, "dsvc", "scale", operands,
+                steps=2, proposer_index=0, timeout_ms=60000,
+            )
+            assert out["elapsed_s"] is not None
+            assert out["results"] == session_expected(operands, 2)
+        finally:
+            for s in servers:
+                s.stop()
+                s.join(timeout=5)
+        # proposer + server each ran one session
+        assert dispatch_sessions.get_value() >= sessions_before + 2
+        assert _method_counter("dsvc", "scale").get_value() >= kernel_before + 2
+
+    def test_pmean_is_just_one_registered_method(self, shard_map_capable):
+        """mc_collective rides the plane: its resolver mints the pmean
+        method per width, and run_collective_session converges to the
+        global mean through mc_dispatch.run_dispatch_session."""
+        import jax
+
+        from incubator_brpc_tpu.parallel import mc_dispatch
+        from incubator_brpc_tpu.parallel.mc_collective import (
+            PMEAN_METHOD,
+            PMEAN_SERVICE,
+            expected_mean,
+            run_collective_session,
+        )
+
+        width = 32
+        dm = mc_dispatch.resolve_method(PMEAN_SERVICE, PMEAN_METHOD, 4 * width)
+        assert dm is not None and dm.width == 4 * width
+        # the resolver is deterministic: same width -> same fingerprint
+        dm2 = mc_dispatch.resolve_method(PMEAN_SERVICE, PMEAN_METHOD, 4 * width)
+        assert dm2.fingerprint() == dm.fingerprint()
+
+        party_ids = [d.id for d in jax.devices()[:4]]
+        own, elapsed = run_collective_session(
+            party_ids, own_index=2, steps=1, width=width, seed=11
+        )
+        np.testing.assert_allclose(
+            own, expected_mean(11, len(party_ids), width), atol=1e-5
+        )
+
+    def test_span_carries_method_identity(
+        self, registered_scale, tuned_flags
+    ):
+        """rpcz spans on the plane name the kernel they ran."""
+        import jax
+
+        from incubator_brpc_tpu.builtin.rpcz import span_store
+        from incubator_brpc_tpu.parallel.mc_dispatch import propose_dispatch
+
+        tuned_flags("enable_rpcz", True)
+        span_store.clear()
+        servers = _collective_servers(1)
+        try:
+            chans = _host_channels(servers)
+            party_ids = [jax.devices()[0].id, jax.devices()[1].id]
+            propose_dispatch(
+                chans, party_ids, "dsvc", "scale", [b"x" * 8, b"y" * 8],
+                steps=1, proposer_index=0, timeout_ms=60000,
+            )
+            spans = [
+                s
+                for s in span_store.recent(limit=500)
+                if s.span_type == "collective"
+            ]
+            assert spans, "no collective span sampled"
+            notes = " ".join(
+                text for s in spans for _, text in s.annotations
+            )
+            assert "method=dsvc.scale" in notes
+            assert "fingerprint=" in notes
+        finally:
+            span_store.clear()
+            for s in servers:
+                s.stop()
+                s.join(timeout=5)
+
+
+class TestMcLoweringRouting:
+    """ParallelChannel's plane choice, isolated from real links: stub
+    sockets whose links look multi-controller (own_side set) must route
+    the call into mc_dispatch.lower_parallel_call; mixed planes and a
+    failing lowering must fall back to the host fan-out silently."""
+
+    class _FakeLink:
+        def __init__(self, dev, mc=True):
+            self._mesh = object()
+            self.devices = [None, dev]
+            if mc:
+                self.own_side = 0
+
+    class _FakeSock:
+        def __init__(self, link, fp_map):
+            self.link = link
+            self.device_methods = fp_map
+
+    class _FakeChannel:
+        def __init__(self, ds):
+            class _O:
+                transport = "tpu"
+
+            self._options = _O()
+            self._lb = None
+            self._ds = ds
+            self.host_calls = 0
+
+        def _pick_socket(self, cntl):
+            return self._ds
+
+        def call_method(self, service, method, request, cntl=None, done=None):
+            self.host_calls += 1
+            cntl.response_payload = b"host:" + request
+            if done:
+                done(cntl)
+            return cntl
+
+    class _Dev:
+        def __init__(self, i):
+            self.id = i
+
+    def _pc(self, registered_scale, mc_flags):
+        from incubator_brpc_tpu.rpc.combo import ParallelChannel
+
+        pc = ParallelChannel(fuse_device_calls=True)
+        for i, mc in enumerate(mc_flags):
+            link = self._FakeLink(self._Dev(100 + i), mc=mc)
+            ds = self._FakeSock(
+                link, {"dsvc.scale": registered_scale.fingerprint()}
+            )
+            pc.add_channel(self._FakeChannel(ds))
+        return pc
+
+    def test_all_mc_links_route_to_method_plane(
+        self, registered_scale, monkeypatch
+    ):
+        from incubator_brpc_tpu.parallel import mc_dispatch
+        from incubator_brpc_tpu.rpc import Controller
+
+        seen = {}
+
+        def fake_lower(channels, devices, service, method, requests, timeout_ms):
+            seen["devices"] = [d.id for d in devices]
+            seen["requests"] = list(requests)
+            seen["pair"] = (service, method)
+            return [b"mc:" + r for r in requests]
+
+        monkeypatch.setattr(mc_dispatch, "lower_parallel_call", fake_lower)
+        pc = self._pc(registered_scale, [True, True])
+        cntl = pc.call_method(
+            "dsvc", "scale", b"req", cntl=Controller(timeout_ms=5000)
+        )
+        assert cntl.ok(), cntl.error_text
+        assert getattr(cntl, "collective_fused", False) is True
+        # merged in channel-index order from the per-party session results
+        assert cntl.response_payload == b"mc:reqmc:req"
+        assert seen["pair"] == ("dsvc", "scale")
+        assert seen["devices"] == [100, 101]
+        assert all(ch.host_calls == 0 for ch, _m, _r in pc._subs)
+
+    def test_mixed_planes_fall_back_to_host(
+        self, registered_scale, monkeypatch
+    ):
+        from incubator_brpc_tpu.parallel import mc_dispatch
+        from incubator_brpc_tpu.rpc import Controller
+
+        def boom(*a, **kw):  # the lowering must not even be attempted
+            raise AssertionError("mixed planes must not lower")
+
+        monkeypatch.setattr(mc_dispatch, "lower_parallel_call", boom)
+        pc = self._pc(registered_scale, [True, False])
+        cntl = pc.call_method(
+            "dsvc", "scale", b"req", cntl=Controller(timeout_ms=5000)
+        )
+        assert cntl.ok(), cntl.error_text
+        assert getattr(cntl, "collective_fused", False) is False
+        assert cntl.response_payload == b"host:reqhost:req"
+
+    def test_failed_lowering_falls_back_to_host(
+        self, registered_scale, monkeypatch
+    ):
+        from incubator_brpc_tpu.parallel import mc_dispatch
+        from incubator_brpc_tpu.rpc import Controller
+
+        def fail_lower(*a, **kw):
+            raise RuntimeError("peer rejected")
+
+        monkeypatch.setattr(mc_dispatch, "lower_parallel_call", fail_lower)
+        pc = self._pc(registered_scale, [True, True])
+        cntl = pc.call_method(
+            "dsvc", "scale", b"req", cntl=Controller(timeout_ms=5000)
+        )
+        assert cntl.ok(), cntl.error_text
+        assert getattr(cntl, "collective_fused", False) is False
+        assert cntl.response_payload == b"host:reqhost:req"
+
+
+# -- the real deployment: separate OS processes --------------------------------
+
+
+@pytest.fixture(scope="module")
+def fabric_capable():
+    """Fast capability probe: one tiny 2-process psum (seconds on a
+    backend that refuses multi-process computations) decides whether the
+    real-subprocess tier can run at all — no doomed full orchestrations
+    burning their handshake deadlines."""
+    from incubator_brpc_tpu.transport.mc_worker import multiprocess_capable
+
+    if not multiprocess_capable():
+        pytest.skip(f"jax backend: {_FABRIC_UNSUPPORTED}")
+    return True
+
+
+def test_three_process_user_kernel_session(fabric_capable):
+    """The tentpole end to end: a user-registered device method (psum +
+    elementwise — NOT pmean) pipelines a multi-step session across three
+    real processes, fingerprint-validated, every party's bytes matching
+    the exact integer model (= the single-controller fused dispatch's
+    math, asserted bitwise in TestInProcessSessions)."""
+    from incubator_brpc_tpu.transport.mc_worker import orchestrate_session
+
+    stats, transcript = orchestrate_session(n_parties=3, steps=4)
+    assert stats["parties"] == 3, transcript
+    assert stats["steps"] == 4
+    assert stats["method"] == "dsvc.scale"
+    assert stats["per_step_ms"] < 250, stats
+
+
+def test_fingerprint_mismatch_rejects_cleanly(fabric_capable):
+    """One process registered a same-name/different-body kernel: the
+    accept phase must reject before ANY party enters lockstep (a clean
+    RuntimeError on the proposer, no wedge, workers exit 0)."""
+    from incubator_brpc_tpu.transport.mc_worker import orchestrate_session
+
+    stats, transcript = orchestrate_session(
+        n_parties=3, steps=4, wrong_kernel=True
+    )
+    assert stats.get("rejected") is True, transcript
+
+
+def test_parallel_channel_lowers_through_mc_plane(fabric_capable):
+    """ParallelChannel over multi-controller links: the fused path cannot
+    single-dispatch across controllers, so it schedules a 1-step session
+    on the method plane — one API, transport picks the lowering."""
+    from incubator_brpc_tpu.transport.mc_worker import orchestrate_fabric
+
+    stats, transcript = orchestrate_fabric(
+        n_servers=2, extra=("--n-rpcs", "2", "--mc-lowering-check")
+    )
+    assert stats["mc_lowered"] is not None, transcript
+    assert stats["mc_lowered"]["parties"] == 2
+
+
+@pytest.mark.slow
+def test_eight_party_session(fabric_capable):
+    """Fabric scale: 8 real processes, one pipelined session of the user
+    kernel (the dryrun_multichip collective_8proc gate, runnable
+    standalone)."""
+    from incubator_brpc_tpu.transport.mc_worker import orchestrate_session
+
+    stats, transcript = orchestrate_session(n_parties=8, steps=8, timeout=420)
+    assert stats["parties"] == 8, transcript
+    assert stats["steps"] >= 8
+    assert stats["per_step_ms"] < 500, stats
