@@ -1,0 +1,29 @@
+# Repo-level developer entry points.
+#
+#   make lint  — fabriclint: FFI signature cross-check, hot-path purity,
+#                flag/bvar registry lint, callback keepalive, tb_* return
+#                audit (tools/fabriclint; also runs inside tier-1 via
+#                tests/test_static_analysis.py)
+#   make san   — sanitizer harness: ASAN+UBSAN over the native test
+#                subset, TSAN over the telemetry-ring stress (probe-gated:
+#                skips cleanly where the toolchain lacks support)
+#   make native — the plain native runtime build (src/build/libtbutil.so)
+#   make test  — the tier-1 test suite
+#
+# docs/ANALYSIS.md documents the rules and the exemption annotation.
+
+PY ?= python
+
+lint:
+	$(PY) -m tools.fabriclint
+
+san:
+	$(PY) -m tools.fabriclint.san
+
+native:
+	$(MAKE) -C src
+
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
+
+.PHONY: lint san native test

@@ -38,6 +38,11 @@ SPAN_TYPE_SERVER = "server"
 # queries, parented into the proposing RPC's trace
 SPAN_TYPE_COLLECTIVE = "collective"
 
+# start_real_us values below this are clearly not wall time (synthetic
+# test clocks, replayed traces): such spans are exempt from age
+# retention and only bounded by the ring size.  1e15 us ~ 2001-09-09.
+_WALL_EPOCH_US = 1e15
+
 _tls = threading.local()  # .parent_span: active server span on this thread
 
 
@@ -106,9 +111,44 @@ class SpanStore:
         # reloadable, but deque(maxlen=...) froze the value read at
         # construction — setting the flag later silently did nothing
         maxlen = int(get_flag("rpcz_max_spans"))
+        # age retention (rpcz_keep_span_seconds, reference span.cpp keeps
+        # spans ~30 min): prune entries whose COMPLETION is more than the
+        # horizon before the HOST clock.  Spans are submitted at
+        # completion, so the deque is completion-ordered (start order is
+        # not — a long span submits after shorter ones that started
+        # later) and the popleft walk is amortized O(1).  The horizon
+        # deliberately comes from the host, not the incoming span's
+        # producer clock: the store is process-global, so one span with a
+        # skewed/synthetic clock must never purge everyone else's.
+        # Symmetrically, spans whose own clock is clearly not wall time
+        # (synthetic test fixtures, replayed traces — anything before
+        # ``_WALL_EPOCH_US``) are exempt from age pruning and only bound
+        # by the ring size.
+        horizon_us = (
+            time.time() - float(get_flag("rpcz_keep_span_seconds"))
+        ) * 1e6
+
         with self._lock:
             if self._spans.maxlen != maxlen:
                 self._spans = deque(self._spans, maxlen=maxlen)
+            # walk stale wall-clock spans off the left; exempt
+            # (non-wall-time) heads are set aside so they don't shield
+            # stale spans behind them, then restored in order.  The
+            # set-aside is capped so a synthetic-heavy store (tests)
+            # keeps submit O(1) amortized — production stores hold no
+            # exempt spans and never touch the cap.
+            exempt_heads = []
+            while self._spans and len(exempt_heads) < 128:
+                head = self._spans[0]
+                if head.start_real_us <= _WALL_EPOCH_US:
+                    exempt_heads.append(self._spans.popleft())
+                    continue
+                if head.start_real_us + head.latency_us < horizon_us:
+                    self._spans.popleft()
+                    continue
+                break  # completion-ordered: the rest are fresher
+            while exempt_heads:
+                self._spans.appendleft(exempt_heads.pop())
             self._spans.append(span)
         dbdir = str(get_flag("rpcz_database_dir"))
         if dbdir:

@@ -17,7 +17,14 @@ import subprocess
 import threading
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_LIB_PATH = os.path.join(_REPO_ROOT, "src", "build", "libtbutil.so")
+# TBNET_LIB points the loader at an alternate build of the same ABI — the
+# sanitizer harness (tools/fabriclint/san.py) sets it to the ASAN/TSAN
+# .so; an override is never auto-built (a missing path must fail loudly
+# into the pure-Python fallback, not silently rebuild the plain lib).
+_LIB_OVERRIDE = os.environ.get("TBNET_LIB") or None
+_LIB_PATH = _LIB_OVERRIDE or os.path.join(
+    _REPO_ROOT, "src", "build", "libtbutil.so"
+)
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -84,287 +91,297 @@ HANDOFF_FN = ctypes.CFUNCTYPE(
 CLOSED_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_uint64)
 
 
-def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
-    b = ctypes.c_void_p
-    sigs = {
-        "tb_set_block_size": (None, [ctypes.c_size_t]),
-        "tb_block_size": (ctypes.c_size_t, []),
-        "tb_block_pool_stats": (
-            None,
-            [ctypes.POINTER(ctypes.c_size_t), ctypes.POINTER(ctypes.c_size_t)],
-        ),
-        "tb_iobuf_read_burst": (ctypes.c_size_t, []),
-        "tb_iobuf_create": (b, []),
-        "tb_iobuf_handle_pool_stats": (
-            None,
-            [ctypes.POINTER(ctypes.c_size_t), ctypes.POINTER(ctypes.c_size_t)],
-        ),
-        "tb_iobuf_destroy": (None, [b]),
-        "tb_iobuf_clear": (None, [b]),
-        "tb_iobuf_size": (ctypes.c_size_t, [b]),
-        "tb_iobuf_block_count": (ctypes.c_size_t, [b]),
-        "tb_iobuf_append": (None, [b, ctypes.c_char_p, ctypes.c_size_t]),
-        "tb_iobuf_append_external": (
-            None,
-            [b, ctypes.c_void_p, ctypes.c_size_t, RELEASE_FN, ctypes.c_void_p],
-        ),
-        "tb_iobuf_append_iobuf": (None, [b, b]),
-        "tb_iobuf_cutn": (ctypes.c_size_t, [b, b, ctypes.c_size_t]),
-        "tb_iobuf_popn": (ctypes.c_size_t, [b, ctypes.c_size_t]),
-        "tb_iobuf_copy_to": (
-            ctypes.c_size_t,
-            [b, ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t],
-        ),
-        "tb_iobuf_refs": (ctypes.c_int, [b, ctypes.POINTER(_Ref), ctypes.c_int]),
-        "tb_iobuf_block_shared_count": (ctypes.c_int, [b, ctypes.c_size_t]),
-        "tb_iobuf_cut_into_fd": (
-            ctypes.c_long,
-            [b, ctypes.c_int, ctypes.c_size_t],
-        ),
-        "tb_iobuf_append_from_fd": (
-            ctypes.c_long,
-            [b, ctypes.c_int, ctypes.c_size_t],
-        ),
-        "tb_iobuf_append_from_fd_bulk": (
-            ctypes.c_long,
-            [b, ctypes.c_int, ctypes.c_size_t, ctypes.c_size_t],
-        ),
-        "tb_region_register": (
-            ctypes.c_int,
-            [ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t],
-        ),
-        "tb_iobuf_append_from_region": (
-            ctypes.c_int,
-            [b, ctypes.c_int, ctypes.c_char_p, ctypes.c_size_t],
-        ),
-        "tb_region_free_blocks": (ctypes.c_size_t, [ctypes.c_int]),
-        "tb_crc32": (
-            ctypes.c_uint32,
-            [ctypes.c_uint32, ctypes.c_char_p, ctypes.c_size_t],
-        ),
-        "tb_crc32c": (
-            ctypes.c_uint32,
-            [ctypes.c_uint32, ctypes.c_void_p, ctypes.c_size_t],
-        ),
-        "tb_iobuf_crc32c": (
-            ctypes.c_uint32,
-            [b, ctypes.c_uint32, ctypes.c_size_t, ctypes.c_size_t],
-        ),
-        "tb_tbus_peek": (ctypes.c_int, [b, ctypes.POINTER(TbusHdr)]),
-        "tb_tbus_cut": (
-            ctypes.c_int,
-            [b, ctypes.POINTER(TbusHdr), ctypes.c_void_p, b],
-        ),
-        "tb_tbus_pack": (
-            None,
-            [
-                b,
-                ctypes.c_void_p,
-                ctypes.c_size_t,
-                ctypes.c_void_p,
-                ctypes.c_size_t,
-                ctypes.c_void_p,
-                ctypes.c_size_t,
-                ctypes.c_uint32,
-                ctypes.c_uint32,
-                ctypes.c_uint32,
-                ctypes.c_uint32,
-                ctypes.c_int,
-            ],
-        ),
-        "tb_fast_rand": (ctypes.c_uint64, []),
-        "tb_fast_rand_less_than": (ctypes.c_uint64, [ctypes.c_uint64]),
-        "tb_monotonic_ns": (ctypes.c_uint64, []),
-        "tb_respool_create": (b, [ctypes.c_size_t]),
-        "tb_respool_destroy": (None, [b]),
-        "tb_respool_get": (b, [b, ctypes.POINTER(ctypes.c_uint64)]),
-        "tb_respool_address": (b, [b, ctypes.c_uint64]),
-        "tb_respool_return": (ctypes.c_int, [b, ctypes.c_uint64]),
-        "tb_respool_live": (ctypes.c_size_t, [b]),
-        "tb_objpool_create": (b, [ctypes.c_size_t]),
-        "tb_objpool_destroy": (None, [b]),
-        "tb_objpool_get": (b, [b]),
-        "tb_objpool_return": (None, [b, ctypes.c_void_p]),
-        "tb_objpool_live": (ctypes.c_size_t, [b]),
-        "tb_objpool_free_count": (ctypes.c_size_t, [b]),
-        "tb_flatmap_create": (b, [ctypes.c_size_t]),
-        "tb_flatmap_destroy": (None, [b]),
-        "tb_flatmap_insert": (
-            ctypes.c_int,
-            [b, ctypes.c_uint64, ctypes.c_uint64],
-        ),
-        "tb_flatmap_get": (
-            ctypes.c_int,
-            [b, ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64)],
-        ),
-        "tb_flatmap_erase": (ctypes.c_int, [b, ctypes.c_uint64]),
-        "tb_flatmap_size": (ctypes.c_size_t, [b]),
-        "tb_flatmap_capacity": (ctypes.c_size_t, [b]),
-        "tb_cimap_create": (b, [ctypes.c_size_t]),
-        "tb_cimap_destroy": (None, [b]),
-        "tb_cimap_set": (
-            ctypes.c_int,
-            [b, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
-             ctypes.c_size_t],
-        ),
-        "tb_cimap_get": (
-            ctypes.c_long,
-            [b, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
-             ctypes.c_size_t],
-        ),
-        "tb_cimap_erase": (ctypes.c_int, [b, ctypes.c_char_p, ctypes.c_size_t]),
-        "tb_cimap_size": (ctypes.c_size_t, [b]),
-        "tb_cimap_key_at": (
-            ctypes.c_long,
-            [b, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t],
-        ),
-        "tb_mru_create": (b, [ctypes.c_size_t]),
-        "tb_mru_destroy": (None, [b]),
-        "tb_mru_put": (ctypes.c_int, [b, ctypes.c_uint64, ctypes.c_uint64]),
-        "tb_mru_get": (
-            ctypes.c_int,
-            [b, ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64)],
-        ),
-        "tb_mru_size": (ctypes.c_size_t, [b]),
-        # ---- tbnet (src/tbnet): native network plane ----
-        "tb_server_create": (b, [ctypes.c_int]),
-        "tb_server_set_frame_cb": (None, [b, FRAME_FN, ctypes.c_void_p]),
-        "tb_server_set_handoff_cb": (None, [b, HANDOFF_FN, ctypes.c_void_p]),
-        "tb_server_set_closed_cb": (None, [b, CLOSED_FN, ctypes.c_void_p]),
-        "tb_server_set_max_body": (None, [b, ctypes.c_size_t]),
-        "tb_server_get_native_max_concurrency": (
-            ctypes.c_long,
-            [b, ctypes.c_char_p],
-        ),
-        "tb_server_set_native_max_concurrency": (
-            ctypes.c_int,
-            [b, ctypes.c_char_p, ctypes.c_uint32],
-        ),
-        "tb_server_register_native": (
-            ctypes.c_int,
-            [b, ctypes.c_char_p, ctypes.c_int, ctypes.c_uint32],
-        ),
-        # user C callback methods: int (*)(void* ud, const char* req,
-        # size_t len, char** resp, size_t* resp_len) — the fn pointer is
-        # passed as a raw void* (dlsym'd from a user .so, or a ctypes
-        # CFUNCTYPE cast down)
-        "tb_server_register_native_fn": (
-            ctypes.c_int,
-            [b, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p,
-             ctypes.c_uint32],
-        ),
-        # completion-record telemetry ring (per-method latency / rpcz /
-        # limiter feedback for natively-dispatched requests)
-        "tb_server_set_telemetry": (
-            None,
-            [b, ctypes.c_uint32, ctypes.c_uint32],
-        ),
-        "tb_server_drain_telemetry": (
-            ctypes.c_long,
-            [b, ctypes.POINTER(TelemetryRecord), ctypes.c_size_t],
-        ),
-        "tb_server_telemetry_dropped": (ctypes.c_uint64, [b]),
-        "tb_server_listen": (ctypes.c_int, [b, ctypes.c_char_p, ctypes.c_int]),
-        "tb_server_port": (ctypes.c_int, [b]),
-        "tb_server_stop": (None, [b]),
-        "tb_server_destroy": (None, [b]),
-        "tb_server_stats": (
-            None,
-            [b] + [ctypes.POINTER(ctypes.c_uint64)] * 5,
-        ),
-        "tb_conn_respond": (
-            ctypes.c_int,
-            [
-                ctypes.c_uint64,
-                ctypes.c_void_p,
-                ctypes.c_size_t,
-                ctypes.c_void_p,
-                ctypes.c_size_t,
-                ctypes.c_void_p,
-                ctypes.c_size_t,
-                ctypes.c_uint32,
-                ctypes.c_uint32,
-                ctypes.c_uint32,
-                ctypes.c_uint32,
-            ],
-        ),
-        "tb_conn_write": (ctypes.c_int, [ctypes.c_uint64, b]),
-        "tb_conn_peer": (
-            ctypes.c_int,
-            [ctypes.c_uint64, ctypes.c_char_p, ctypes.c_size_t],
-        ),
-        "tb_conn_close": (ctypes.c_int, [ctypes.c_uint64]),
-        "tb_channel_connect": (
+# The declared C ABI: name -> (restype, argtypes), one entry per
+# extern "C" function in src/tbutil/tbutil.h and src/tbnet/tbnet.h.
+# Module-level (not hidden inside _declare) so fabriclint's FFI checker
+# (tools/fabriclint/ffi_check.py) can cross-check every entry against the
+# parsed headers — count, width, and signedness drift here corrupts
+# silently at runtime, so it must fail loudly at lint time instead.
+b = ctypes.c_void_p  # shorthand: any opaque native handle
+SIGNATURES = {
+    "tb_set_block_size": (None, [ctypes.c_size_t]),
+    "tb_block_size": (ctypes.c_size_t, []),
+    "tb_block_pool_stats": (
+        None,
+        [ctypes.POINTER(ctypes.c_size_t), ctypes.POINTER(ctypes.c_size_t)],
+    ),
+    "tb_iobuf_read_burst": (ctypes.c_size_t, []),
+    "tb_iobuf_create": (b, []),
+    "tb_iobuf_handle_pool_stats": (
+        None,
+        [ctypes.POINTER(ctypes.c_size_t), ctypes.POINTER(ctypes.c_size_t)],
+    ),
+    "tb_iobuf_destroy": (None, [b]),
+    "tb_iobuf_clear": (None, [b]),
+    "tb_iobuf_size": (ctypes.c_size_t, [b]),
+    "tb_iobuf_block_count": (ctypes.c_size_t, [b]),
+    "tb_iobuf_append": (None, [b, ctypes.c_char_p, ctypes.c_size_t]),
+    "tb_iobuf_append_external": (
+        None,
+        [b, ctypes.c_void_p, ctypes.c_size_t, RELEASE_FN, ctypes.c_void_p],
+    ),
+    "tb_iobuf_append_iobuf": (None, [b, b]),
+    "tb_iobuf_cutn": (ctypes.c_size_t, [b, b, ctypes.c_size_t]),
+    "tb_iobuf_popn": (ctypes.c_size_t, [b, ctypes.c_size_t]),
+    "tb_iobuf_copy_to": (
+        ctypes.c_size_t,
+        [b, ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t],
+    ),
+    "tb_iobuf_refs": (ctypes.c_int, [b, ctypes.POINTER(_Ref), ctypes.c_int]),
+    "tb_iobuf_block_shared_count": (ctypes.c_int, [b, ctypes.c_size_t]),
+    "tb_iobuf_cut_into_fd": (
+        ctypes.c_long,
+        [b, ctypes.c_int, ctypes.c_size_t],
+    ),
+    "tb_iobuf_append_from_fd": (
+        ctypes.c_long,
+        [b, ctypes.c_int, ctypes.c_size_t],
+    ),
+    "tb_iobuf_append_from_fd_bulk": (
+        ctypes.c_long,
+        [b, ctypes.c_int, ctypes.c_size_t, ctypes.c_size_t],
+    ),
+    "tb_region_register": (
+        ctypes.c_int,
+        [ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t],
+    ),
+    "tb_iobuf_append_from_region": (
+        ctypes.c_int,
+        [b, ctypes.c_int, ctypes.c_char_p, ctypes.c_size_t],
+    ),
+    "tb_region_free_blocks": (ctypes.c_size_t, [ctypes.c_int]),
+    "tb_crc32": (
+        ctypes.c_uint32,
+        [ctypes.c_uint32, ctypes.c_char_p, ctypes.c_size_t],
+    ),
+    "tb_crc32c": (
+        ctypes.c_uint32,
+        [ctypes.c_uint32, ctypes.c_void_p, ctypes.c_size_t],
+    ),
+    "tb_iobuf_crc32c": (
+        ctypes.c_uint32,
+        [b, ctypes.c_uint32, ctypes.c_size_t, ctypes.c_size_t],
+    ),
+    "tb_tbus_peek": (ctypes.c_int, [b, ctypes.POINTER(TbusHdr)]),
+    "tb_tbus_cut": (
+        ctypes.c_int,
+        [b, ctypes.POINTER(TbusHdr), ctypes.c_void_p, b],
+    ),
+    "tb_tbus_pack": (
+        None,
+        [
             b,
-            [ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
-             ctypes.POINTER(ctypes.c_int)],
-        ),
-        # wire protocol: 0 = tbus_std (default), 1 = baidu_std (PRPC);
-        # must be set before the first send
-        "tb_channel_set_protocol": (ctypes.c_int, [b, ctypes.c_int]),
-        "tb_channel_call": (
-            ctypes.c_long,
-            [
-                b,
-                ctypes.c_void_p,
-                ctypes.c_size_t,
-                ctypes.c_void_p,
-                ctypes.c_size_t,
-                ctypes.c_void_p,
-                ctypes.c_size_t,
-                ctypes.c_uint32,
-                b,
-                ctypes.c_void_p,
-                ctypes.c_size_t,
-                ctypes.POINTER(ctypes.c_uint32),
-                ctypes.POINTER(ctypes.c_uint32),
-                ctypes.c_int,
-            ],
-        ),
-        "tb_channel_send": (
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_uint32,
+            ctypes.c_uint32,
+            ctypes.c_uint32,
+            ctypes.c_uint32,
+            ctypes.c_int,
+        ],
+    ),
+    "tb_fast_rand": (ctypes.c_uint64, []),
+    "tb_fast_rand_less_than": (ctypes.c_uint64, [ctypes.c_uint64]),
+    "tb_monotonic_ns": (ctypes.c_uint64, []),
+    "tb_respool_create": (b, [ctypes.c_size_t]),
+    "tb_respool_destroy": (None, [b]),
+    "tb_respool_get": (b, [b, ctypes.POINTER(ctypes.c_uint64)]),
+    "tb_respool_address": (b, [b, ctypes.c_uint64]),
+    "tb_respool_return": (ctypes.c_int, [b, ctypes.c_uint64]),
+    "tb_respool_live": (ctypes.c_size_t, [b]),
+    "tb_objpool_create": (b, [ctypes.c_size_t]),
+    "tb_objpool_destroy": (None, [b]),
+    "tb_objpool_get": (b, [b]),
+    "tb_objpool_return": (None, [b, ctypes.c_void_p]),
+    "tb_objpool_live": (ctypes.c_size_t, [b]),
+    "tb_objpool_free_count": (ctypes.c_size_t, [b]),
+    "tb_flatmap_create": (b, [ctypes.c_size_t]),
+    "tb_flatmap_destroy": (None, [b]),
+    "tb_flatmap_insert": (
+        ctypes.c_int,
+        [b, ctypes.c_uint64, ctypes.c_uint64],
+    ),
+    "tb_flatmap_get": (
+        ctypes.c_int,
+        [b, ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64)],
+    ),
+    "tb_flatmap_erase": (ctypes.c_int, [b, ctypes.c_uint64]),
+    "tb_flatmap_size": (ctypes.c_size_t, [b]),
+    "tb_flatmap_capacity": (ctypes.c_size_t, [b]),
+    "tb_cimap_create": (b, [ctypes.c_size_t]),
+    "tb_cimap_destroy": (None, [b]),
+    "tb_cimap_set": (
+        ctypes.c_int,
+        [b, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+         ctypes.c_size_t],
+    ),
+    "tb_cimap_get": (
+        ctypes.c_long,
+        [b, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+         ctypes.c_size_t],
+    ),
+    "tb_cimap_erase": (ctypes.c_int, [b, ctypes.c_char_p, ctypes.c_size_t]),
+    "tb_cimap_size": (ctypes.c_size_t, [b]),
+    "tb_cimap_key_at": (
+        ctypes.c_long,
+        [b, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t],
+    ),
+    "tb_mru_create": (b, [ctypes.c_size_t]),
+    "tb_mru_destroy": (None, [b]),
+    "tb_mru_put": (ctypes.c_int, [b, ctypes.c_uint64, ctypes.c_uint64]),
+    "tb_mru_get": (
+        ctypes.c_int,
+        [b, ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64)],
+    ),
+    "tb_mru_size": (ctypes.c_size_t, [b]),
+    # ---- tbnet (src/tbnet): native network plane ----
+    "tb_server_create": (b, [ctypes.c_int]),
+    "tb_server_set_frame_cb": (None, [b, FRAME_FN, ctypes.c_void_p]),
+    "tb_server_set_handoff_cb": (None, [b, HANDOFF_FN, ctypes.c_void_p]),
+    "tb_server_set_closed_cb": (None, [b, CLOSED_FN, ctypes.c_void_p]),
+    "tb_server_set_max_body": (None, [b, ctypes.c_size_t]),
+    "tb_server_get_native_max_concurrency": (
+        ctypes.c_long,
+        [b, ctypes.c_char_p],
+    ),
+    "tb_server_set_native_max_concurrency": (
+        ctypes.c_int,
+        [b, ctypes.c_char_p, ctypes.c_uint32],
+    ),
+    "tb_server_register_native": (
+        ctypes.c_int,
+        [b, ctypes.c_char_p, ctypes.c_int, ctypes.c_uint32],
+    ),
+    # user C callback methods: int (*)(void* ud, const char* req,
+    # size_t len, char** resp, size_t* resp_len) — the fn pointer is
+    # passed as a raw void* (dlsym'd from a user .so, or a ctypes
+    # CFUNCTYPE cast down)
+    # fabriclint: allow(ffi-callback) fn arrives as a dlsym'd void* from a user .so by design; its layout contract is NATIVE_METHOD_FN, checked against the tb_native_fn typedef globally
+    "tb_server_register_native_fn": (
+        ctypes.c_int,
+        [b, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p,
+         ctypes.c_uint32],
+    ),
+    # completion-record telemetry ring (per-method latency / rpcz /
+    # limiter feedback for natively-dispatched requests)
+    "tb_server_set_telemetry": (
+        None,
+        [b, ctypes.c_uint32, ctypes.c_uint32],
+    ),
+    "tb_server_drain_telemetry": (
+        ctypes.c_long,
+        [b, ctypes.POINTER(TelemetryRecord), ctypes.c_size_t],
+    ),
+    "tb_server_telemetry_dropped": (ctypes.c_uint64, [b]),
+    "tb_server_listen": (ctypes.c_int, [b, ctypes.c_char_p, ctypes.c_int]),
+    "tb_server_port": (ctypes.c_int, [b]),
+    "tb_server_stop": (None, [b]),
+    "tb_server_destroy": (None, [b]),
+    "tb_server_stats": (
+        None,
+        [b] + [ctypes.POINTER(ctypes.c_uint64)] * 5,
+    ),
+    "tb_conn_respond": (
+        ctypes.c_int,
+        [
             ctypes.c_uint64,
-            [
-                b,
-                ctypes.c_void_p,
-                ctypes.c_size_t,
-                ctypes.c_void_p,
-                ctypes.c_size_t,
-                ctypes.c_void_p,
-                ctypes.c_size_t,
-                ctypes.c_uint32,
-                ctypes.POINTER(ctypes.c_int),
-            ],
-        ),
-        "tb_channel_recv": (
-            ctypes.c_long,
-            [
-                b,
-                ctypes.POINTER(ctypes.c_uint64),
-                b,
-                ctypes.c_void_p,
-                ctypes.c_size_t,
-                ctypes.POINTER(ctypes.c_uint32),
-                ctypes.POINTER(ctypes.c_uint32),
-                ctypes.c_int,
-            ],
-        ),
-        "tb_channel_error": (ctypes.c_int, [b]),
-        "tb_channel_destroy": (None, [b]),
-        "tb_channel_pump": (
-            ctypes.c_long,
-            [
-                b,
-                ctypes.c_void_p,
-                ctypes.c_size_t,
-                ctypes.c_void_p,
-                ctypes.c_size_t,
-                ctypes.c_int,
-                ctypes.c_int,
-                ctypes.c_int,
-            ],
-        ),
-    }
-    for name, (restype, argtypes) in sigs.items():
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_uint32,
+            ctypes.c_uint32,
+            ctypes.c_uint32,
+            ctypes.c_uint32,
+        ],
+    ),
+    "tb_conn_write": (ctypes.c_int, [ctypes.c_uint64, b]),
+    "tb_conn_peer": (
+        ctypes.c_int,
+        [ctypes.c_uint64, ctypes.c_char_p, ctypes.c_size_t],
+    ),
+    "tb_conn_close": (ctypes.c_int, [ctypes.c_uint64]),
+    "tb_channel_connect": (
+        b,
+        [ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+         ctypes.POINTER(ctypes.c_int)],
+    ),
+    # wire protocol: 0 = tbus_std (default), 1 = baidu_std (PRPC);
+    # must be set before the first send
+    "tb_channel_set_protocol": (ctypes.c_int, [b, ctypes.c_int]),
+    "tb_channel_call": (
+        ctypes.c_long,
+        [
+            b,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_uint32,
+            b,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_int,
+        ],
+    ),
+    "tb_channel_send": (
+        ctypes.c_uint64,
+        [
+            b,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_int),
+        ],
+    ),
+    "tb_channel_recv": (
+        ctypes.c_long,
+        [
+            b,
+            ctypes.POINTER(ctypes.c_uint64),
+            b,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_int,
+        ],
+    ),
+    "tb_channel_error": (ctypes.c_int, [b]),
+    "tb_channel_destroy": (None, [b]),
+    "tb_channel_pump": (
+        ctypes.c_long,
+        [
+            b,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+        ],
+    ),
+}
+del b
+
+
+def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
+    for name, (restype, argtypes) in SIGNATURES.items():
         fn = getattr(lib, name)
         fn.restype = restype
         fn.argtypes = argtypes
@@ -395,8 +412,12 @@ def load():
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH) and not _build():
-            return None
+        if not os.path.exists(_LIB_PATH):
+            # an override must exist as given: building the PLAIN lib
+            # here would burn ~a minute producing a .so the override
+            # path will never load
+            if _LIB_OVERRIDE is not None or not _build():
+                return None
         try:
             _lib = _declare(ctypes.CDLL(_LIB_PATH))
         except OSError:
@@ -413,7 +434,8 @@ def load():
                 "libtbutil.so is stale (missing symbol); rebuilding for the "
                 "next process and using the pure-Python fallback in this one"
             )
-            _build()
+            if _LIB_OVERRIDE is None:  # never rebuild over an override
+                _build()
             return None
         return _lib
 

@@ -185,6 +185,7 @@ class NativeConnSock:
             self.state = 1  # FAILED
             self.error_code = code
             self.error_text = reason
+        # fabriclint: allow(ffi-unchecked) -1 means the token is already stale — the connection died under us, which is exactly the state set_failed wants
         LIB.tb_conn_close(self.token)
         for cb in list(self.on_failed):
             try:
@@ -485,6 +486,7 @@ class NativeServerPlane:
                 return getattr(self, "_final_tel_dropped", 0)
             return int(LIB.tb_server_telemetry_dropped(self._srv))
 
+    # fabriclint: hotpath
     def drain_telemetry(self) -> int:
         """Pull every completed record off the C++ ring and fan it out:
         per-method latency summaries, sampled rpcz server spans, and
@@ -494,11 +496,14 @@ class NativeServerPlane:
         if not self._telemetry:
             return 0
         total = 0
+        # fabriclint: allow(hotpath-lock) consumer-side serialization: one acquisition per drain call (not per record), required by the single-consumer ring contract
         with self._tel_lock:
             # batch cap: a drain races live producers, and a scrape-path
             # caller must not spin forever against a sustained flood —
             # 256 batches (~1M records) per call, the rest next cycle
+            # fabriclint: allow(hotpath-loop) bounded at 256 batches per call; per-RECORD work stays vectorized in _consume_records
             for _ in range(256):
+                # fabriclint: allow(hotpath-lock) guards the native handle against tb_server_destroy; once per 4096-record batch, not per record
                 with self._stats_lock:
                     if self._srv is None:
                         break
@@ -549,6 +554,7 @@ class NativeServerPlane:
             )
         return cls._REC_DTYPE
 
+    # fabriclint: hotpath
     def _consume_records(self, batch, n: int) -> None:
         import numpy as np
 
@@ -571,6 +577,7 @@ class NativeServerPlane:
         interval = int(get_flag("auto_cl_sampling_interval_us"))
         methods = server.methods()
         feed = []  # (done_us, full, error_code, latency_us) across methods
+        # fabriclint: allow(hotpath-loop) iterates DISTINCT method indices (bounded by the native method table), never records
         for idx in np.unique(method_ids):
             if idx >= len(names):
                 continue  # table drift (never expected): drop, don't crash
@@ -632,12 +639,14 @@ class NativeServerPlane:
             picks = []
             i = 0
             step = max(1, interval)
+            # fabriclint: allow(hotpath-loop) decimation walk: one searchsorted jump per limiter SAMPLE, capped at 1024 — O(picks log n), not O(records)
             while i < len(ts) and len(picks) < 1024:
                 picks.append(order[i])
                 i = int(np.searchsorted(ts, ts[i] + step, side="left"))
             # errors beyond the decimation still matter (all-fail
             # halving): force-feed a bounded number of them
             err_pos = np.flatnonzero(fb_err != 0)[:256]
+            # fabriclint: allow(hotpath-loop) bounded by the decimated picks (1024) + forced errors (256), not by batch size
             for j in {int(p) for p in picks} | {int(p) for p in err_pos}:
                 feed.append(
                     (int(done_us[j]), full, int(fb_err[j]), float(fb_lat[j]))
@@ -648,6 +657,7 @@ class NativeServerPlane:
         # back-to-back would let the first method's newest sample mask
         # every other method's older ones from the SHARED server limiter
         feed.sort()
+        # fabriclint: allow(hotpath-loop) feed is the decimated limiter sample set (<=1280 per method), already bounded above
         for done, full, err, lat in feed:
             server._on_native_completion(full, err, lat, now_us=done)
         if rpcz_mod.rpcz_enabled():
@@ -657,6 +667,7 @@ class NativeServerPlane:
                 # CLOCK_MONOTONIC ns, spans carry wall-clock start_real_us
                 wall_anchor_us = time.time() * 1e6
                 mono_anchor_ns = native.monotonic_ns()
+                # fabriclint: allow(hotpath-loop) iterates 1/N sample-flagged records only, and breaks as soon as the rpcz token bucket runs dry
                 for i in sampled_idx:
                     rec = arr[int(i)]
                     idx = int(rec["method_idx"])
@@ -712,6 +723,7 @@ class NativeServerPlane:
                 self._socks[token] = s
             return s
 
+    # fabriclint: hotpath
     def _on_frame(self, _ctx, token, cid_lo, cid_hi, flags, error_code,
                   meta_ptr, meta_len, body_h) -> None:
         from incubator_brpc_tpu.iobuf import IOBuf
@@ -740,6 +752,7 @@ class NativeServerPlane:
             if att > blen:
                 # consumed, unrecoverable: kill the connection (the Python
                 # messenger's FatalParseError path)
+                # fabriclint: allow(ffi-unchecked) the conn is being killed for a fatal parse; a stale token means it is already dead — both outcomes are the goal
                 LIB.tb_conn_close(token)
                 return
             payload = body.to_bytes(blen - att)
@@ -759,6 +772,7 @@ class NativeServerPlane:
         except Exception:
             logger.exception("native frame dispatch failed")
 
+    # fabriclint: hotpath
     def _dispatch(self, sock: NativeConnSock, frame) -> None:
         """Mirror of InputMessenger._process_one for pre-cut frames."""
         from incubator_brpc_tpu import protocol as proto_pkg
@@ -944,6 +958,10 @@ class NativeClientChannel:
         if protocol not in _CH_PROTO:
             raise ValueError(f"unsupported native protocol {protocol!r}")
         err = ctypes.c_int(0)
+        self._meta_cache: Dict[tuple, bytes] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+        self._inflight = 0  # calls inside C; destroy only when drained
         self._ch = LIB.tb_channel_connect(
             ip.encode(), port, connect_timeout_ms, ctypes.byref(err)
         )
@@ -951,11 +969,14 @@ class NativeClientChannel:
             raise OSError(err.value, f"connect {ip}:{port} failed")
         self.protocol = protocol
         if protocol != "tbus_std":
-            LIB.tb_channel_set_protocol(self._ch, _CH_PROTO[protocol])
-        self._meta_cache: Dict[tuple, bytes] = {}
-        self._lock = threading.Lock()
-        self._closed = False
-        self._inflight = 0  # calls inside C; destroy only when drained
+            if LIB.tb_channel_set_protocol(self._ch, _CH_PROTO[protocol]) != 0:
+                # the C++ side refused the protocol id: the channel would
+                # silently speak tbus_std — fail construction instead
+                LIB.tb_channel_destroy(self._ch)
+                self._ch = None
+                raise ValueError(
+                    f"native channel rejected protocol {protocol!r}"
+                )
         # reusable per-thread response-meta buffer: a fresh 64 KB
         # create_string_buffer per call costs more than the whole native
         # round trip

@@ -1021,3 +1021,50 @@ class TestRpczQueries:
         assert status == 400
         status, _, _ = _fetch(trace_server, "/rpcz?trace_id=zzz")
         assert status == 400
+
+
+class TestSpanRetention:
+    """rpcz_keep_span_seconds: age pruning against the HOST clock, with
+    non-wall-time (synthetic/replayed) spans exempt — one skewed
+    producer must never purge the process-global store."""
+
+    def _span(self, start_us, span_id):
+        from incubator_brpc_tpu.builtin.rpcz import Span
+
+        return Span(
+            trace_id=0xF0, span_id=span_id, parent_span_id=0,
+            span_type="server", service="r", method="m",
+            latency_us=10, start_real_us=start_us,
+        )
+
+    def test_wall_clock_spans_age_out_synthetic_spans_survive(
+        self, tuned_flags
+    ):
+        import time as _time
+
+        from incubator_brpc_tpu.builtin.rpcz import SpanStore
+
+        tuned_flags("rpcz_keep_span_seconds", 60)
+        store = SpanStore()
+        now_us = _time.time() * 1e6
+        store.submit(self._span(100, 1))  # synthetic clock: exempt
+        store.submit(self._span(now_us - 120e6, 2))  # 2 min old: stale
+        store.submit(self._span(now_us - 1e6, 3))  # fresh
+        store.submit(self._span(now_us, 4))  # triggers the prune
+        ids = [s.span_id for s in store.recent()]
+        assert 2 not in ids, ids  # aged out past the 60 s horizon
+        assert {1, 3, 4} <= set(ids), ids  # exempt + fresh survive
+
+    def test_skewed_future_span_cannot_purge_the_store(self, tuned_flags):
+        import time as _time
+
+        from incubator_brpc_tpu.builtin.rpcz import SpanStore
+
+        tuned_flags("rpcz_keep_span_seconds", 60)
+        store = SpanStore()
+        now_us = _time.time() * 1e6
+        store.submit(self._span(now_us, 1))
+        # a producer 10 hours in the future: must not evict span 1
+        store.submit(self._span(now_us + 36000e6, 2))
+        ids = {s.span_id for s in store.recent()}
+        assert ids == {1, 2}, ids

@@ -1,0 +1,490 @@
+"""fabriclint — FFI-boundary & hot-path static analysis (tools/fabriclint).
+
+Two kinds of tests:
+
+1. **The repo is clean**: every checker runs over the live tree inside
+   tier-1 and must report zero unannotated violations.  These tests ARE
+   the lint gate — a PR that drifts a ctypes signature, adds a dead
+   flag, or puts a per-record loop on a hotpath function fails here.
+2. **The checkers work**: seeded mutations (a width change in one
+   tbnet.h signature, a dropped argument, a struct field resize...)
+   must flip the FFI checker red; synthetic sources prove each hotpath/
+   keepalive/errcheck rule fires and each annotation form is enforced.
+
+The sanitizer harness (`make san`) is exercised by slow, probe-gated
+tests at the bottom: where the toolchain supports ASAN/TSAN they run
+the real thing; elsewhere they skip cleanly.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from tools.fabriclint import (
+    RULES,
+    cdecl,
+    errcheck,
+    ffi_check,
+    hotpath,
+    lifetime,
+    registry_lint,
+    run_all,
+    scan_annotations,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fmt(violations):
+    return "\n".join(str(v) for v in violations)
+
+
+# ---------------------------------------------------------------------------
+# 1. the live tree is clean (the lint gate)
+# ---------------------------------------------------------------------------
+
+
+class TestRepoIsClean:
+    def test_ffi_signatures_match_headers(self):
+        vs = ffi_check.check()
+        assert not vs, _fmt(vs)
+
+    def test_hotpath_functions_are_pure(self):
+        vs = hotpath.check()
+        assert not vs, _fmt(vs)
+
+    def test_flag_and_bvar_registries(self):
+        vs = registry_lint.check()
+        assert not vs, _fmt(vs)
+
+    def test_ffi_callbacks_have_keepalives(self):
+        vs = lifetime.check()
+        assert not vs, _fmt(vs)
+
+    def test_tb_error_codes_checked_or_voided(self):
+        vs = errcheck.check()
+        assert not vs, _fmt(vs)
+
+    def test_run_all_aggregate(self):
+        vs = run_all()
+        assert not vs, _fmt(vs)
+
+
+# ---------------------------------------------------------------------------
+# 2a. the header parser models the real headers completely
+# ---------------------------------------------------------------------------
+
+
+class TestHeaderParser:
+    @pytest.fixture(scope="class")
+    def merged(self):
+        return ffi_check.parse_repo_headers()
+
+    def test_every_declaration_parsed(self, merged):
+        assert merged.unparsed == []
+
+    def test_function_count_matches_sigs(self, merged):
+        from incubator_brpc_tpu import native
+
+        assert set(merged.funcs) == set(native.SIGNATURES)
+
+    def test_telemetry_record_is_48_bytes(self, merged):
+        assert merged.structs["tb_telemetry_record"].size_bits == 48 * 8
+
+    def test_callback_typedefs_present(self, merged):
+        assert {
+            "tb_frame_fn",
+            "tb_handoff_fn",
+            "tb_closed_fn",
+            "tb_native_fn",
+            "tb_release_fn",
+        } <= set(merged.funcptrs)
+
+    def test_one_line_extern_c_declaration_still_parses(self):
+        # the one-line form must not vanish: it either parses (and then
+        # trips ffi-unbound) or lands in unparsed — never silently gone
+        src = (
+            'extern "C" int tb_one_liner(int x);\n'
+            'extern "C" {\n'
+            "int tb_block_form(int y);\n"
+            "}\n"
+        )
+        h = cdecl.parse_header("/synthetic.h", text=src)
+        assert set(h.funcs) == {"tb_one_liner", "tb_block_form"}
+        assert h.unparsed == []
+
+    def test_scalar_canonicalization(self, merged):
+        h = merged
+        t = cdecl.parse_type("const char*", h)
+        assert t.kind == "ptr" and t.pointee == "char"
+        t = cdecl.parse_type("uint64_t", h)
+        assert (t.bits, t.signed_) == (64, False)
+        t = cdecl.parse_type("long", h)
+        assert (t.bits, t.signed_) == (64, True)
+        assert cdecl.parse_type("tb_iobuf*", h).pointee == "opaque:tb_iobuf"
+
+
+# ---------------------------------------------------------------------------
+# 2b. seeded mutations flip the FFI checker red (the meta-tests)
+# ---------------------------------------------------------------------------
+
+
+class TestFfiCheckerCatchesDrift:
+    @pytest.fixture(scope="class")
+    def tbnet_text(self):
+        with open(os.path.join(REPO, "src", "tbnet", "tbnet.h")) as fh:
+            return fh.read()
+
+    def _mutate(self, text, old, new):
+        assert old in text, f"mutation anchor missing: {old!r}"
+        return text.replace(old, new)
+
+    def test_width_change_in_one_signature(self, tbnet_text):
+        # the acceptance-criterion mutation: int -> long on
+        # tb_server_listen's port parameter (32 -> 64 bits)
+        mut = self._mutate(tbnet_text, "const char* ip, int port)",
+                           "const char* ip, long port)")
+        vs = ffi_check.check(tbnet_text=mut)
+        assert any(
+            v.rule == "ffi-type" and "tb_server_listen" in v.message
+            for v in vs
+        ), _fmt(vs)
+
+    def test_signedness_change(self, tbnet_text):
+        mut = self._mutate(
+            tbnet_text,
+            "uint64_t tb_server_telemetry_dropped",
+            "int64_t tb_server_telemetry_dropped",
+        )
+        vs = ffi_check.check(tbnet_text=mut)
+        assert any(
+            v.rule == "ffi-type"
+            and "tb_server_telemetry_dropped" in v.message
+            and "signedness" in v.message
+            for v in vs
+        ), _fmt(vs)
+
+    def test_dropped_argument(self, tbnet_text):
+        mut = self._mutate(
+            tbnet_text,
+            "void tb_server_set_telemetry(tb_server* s, uint32_t capacity,\n"
+            "                             uint32_t sample_every);",
+            "void tb_server_set_telemetry(tb_server* s, uint32_t capacity);",
+        )
+        vs = ffi_check.check(tbnet_text=mut)
+        assert any(v.rule == "ffi-arity" for v in vs), _fmt(vs)
+
+    def test_callback_layout_change(self, tbnet_text):
+        mut = self._mutate(tbnet_text, "uint32_t cid_lo,\n                            uint32_t cid_hi, uint32_t flags",
+                           "uint64_t cid_lo,\n                            uint32_t cid_hi, uint32_t flags")
+        vs = ffi_check.check(tbnet_text=mut)
+        assert any(v.rule == "ffi-callback" for v in vs), _fmt(vs)
+
+    def test_struct_field_resize(self, tbnet_text):
+        mut = self._mutate(
+            tbnet_text, "uint32_t request_size;", "uint64_t request_size;"
+        )
+        vs = ffi_check.check(tbnet_text=mut)
+        struct_vs = [v for v in vs if v.rule == "ffi-struct"]
+        # the 48-byte ABI is mirrored twice: ctypes Structure AND the
+        # numpy drain dtype — both must scream
+        assert any("TelemetryRecord" in v.message or "ctypes" in v.message
+                   or "offset" in v.message for v in struct_vs), _fmt(vs)
+        assert any("numpy" in v.message for v in struct_vs), _fmt(vs)
+
+    def test_removed_declaration_is_ffi_missing(self, tbnet_text):
+        mut = self._mutate(
+            tbnet_text, "int tb_server_port(const tb_server* s);", ""
+        )
+        vs = ffi_check.check(tbnet_text=mut)
+        assert any(
+            v.rule == "ffi-missing" and "tb_server_port" in v.message
+            for v in vs
+        ), _fmt(vs)
+
+    def test_new_unbound_export_is_flagged(self, tbnet_text):
+        mut = self._mutate(
+            tbnet_text,
+            "int tb_server_port(const tb_server* s);",
+            "int tb_server_port(const tb_server* s);\n"
+            "int tb_server_shiny_new_api(tb_server* s);",
+        )
+        vs = ffi_check.check(tbnet_text=mut)
+        assert any(
+            v.rule == "ffi-unbound" and "tb_server_shiny_new_api" in v.message
+            for v in vs
+        ), _fmt(vs)
+
+
+# ---------------------------------------------------------------------------
+# 2c. annotation grammar is enforced
+# ---------------------------------------------------------------------------
+
+
+class TestAnnotations:
+    def test_allow_reason_must_be_nonempty(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text("# fabriclint: allow(hotpath-loop)\nx = 1\n")
+        ann = scan_annotations(str(p))
+        assert len(ann.bad) == 1 and ann.bad[0].rule == "bad-allow"
+        assert "no reason" in ann.bad[0].message
+
+    def test_allow_unknown_rule_is_flagged(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text("# fabriclint: allow(no-such-rule) because\n")
+        ann = scan_annotations(str(p))
+        assert len(ann.bad) == 1 and "unknown rule" in ann.bad[0].message
+
+    def test_allow_inside_string_literal_is_ignored(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text('s = "# fabriclint: allow(hotpath-loop)"\n')
+        ann = scan_annotations(str(p))
+        assert not ann.bad and not ann.allows
+
+    def test_rules_list_is_closed(self):
+        assert "hotpath-lock" in RULES and "ffi-unchecked" in RULES
+
+
+# ---------------------------------------------------------------------------
+# 2d. hotpath purity rules fire (synthetic sources)
+# ---------------------------------------------------------------------------
+
+_HOTPATH_BAD = '''
+import threading, logging, time
+logger = logging.getLogger(__name__)
+
+# fabriclint: hotpath
+def drain(self, records):
+    with self._lock:
+        pass
+    self._lock.acquire()
+    logger.info("tick")
+    print("tick")
+    time.sleep(0.1)
+    for r in records:
+        pass
+    squares = [r * r for r in records]
+    while records:
+        records.pop()
+'''
+
+_HOTPATH_OK = '''
+import logging
+logger = logging.getLogger(__name__)
+
+# fabriclint: hotpath
+def drain(self, arr):
+    total = arr.sum()
+    # fabriclint: allow(hotpath-loop) bounded by distinct methods, not records
+    for m in set(arr.tolist()):
+        total += m
+    try:
+        total /= len(arr)
+    except ZeroDivisionError:
+        logger.exception("error paths may log")
+    return total
+
+def unmarked(records):
+    for r in records:  # no marker: not on the hot path
+        pass
+'''
+
+
+class TestHotpathRules:
+    def test_all_rules_fire(self):
+        vs = hotpath.check_source("/synthetic/bad.py", _HOTPATH_BAD)
+        rules = sorted({v.rule for v in vs})
+        assert rules == [
+            "hotpath-io", "hotpath-lock", "hotpath-log", "hotpath-loop",
+        ], _fmt(vs)
+        loops = [v for v in vs if v.rule == "hotpath-loop"]
+        assert len(loops) == 3  # for + comprehension + while
+
+    def test_allows_and_handlers_and_unmarked(self):
+        vs = hotpath.check_source("/synthetic/ok.py", _HOTPATH_OK)
+        assert not vs, _fmt(vs)
+
+    def test_detached_marker_is_flagged(self):
+        src = "# fabriclint: hotpath\n\n\nx = 1\n"
+        vs = hotpath.check_source("/synthetic/detached.py", src)
+        assert len(vs) == 1 and "not attached" in vs[0].message
+
+
+# ---------------------------------------------------------------------------
+# 2e. keepalive + errcheck rules fire (synthetic sources)
+# ---------------------------------------------------------------------------
+
+_KEEPALIVE_BAD = '''
+from incubator_brpc_tpu.native import FRAME_FN, LIB
+
+def start(srv, handler):
+    LIB.tb_server_set_frame_cb(srv, FRAME_FN(handler), None)
+'''
+
+_KEEPALIVE_LOCAL = '''
+from incubator_brpc_tpu.native import FRAME_FN, LIB
+
+def start(srv, handler):
+    cb = FRAME_FN(handler)  # dies with this frame
+    LIB.tb_server_set_frame_cb(srv, cb, None)
+'''
+
+_KEEPALIVE_OK = '''
+from incubator_brpc_tpu.native import FRAME_FN, LIB
+
+class Plane:
+    def __init__(self, srv, handler):
+        self._cb = FRAME_FN(handler)
+        LIB.tb_server_set_frame_cb(srv, self._cb, None)
+'''
+
+_ERRCHECK_SRC = '''
+from incubator_brpc_tpu.native import LIB
+
+def f(token, srv):
+    LIB.tb_conn_close(token)                      # discarded: violation
+    rc = LIB.tb_server_listen(srv, b"0.0.0.0", 0)  # checked: fine
+    LIB.tb_server_stop(srv)                        # void restype: fine
+    # fabriclint: allow(ffi-unchecked) teardown path, stale token expected
+    LIB.tb_conn_close(token)
+    return rc
+'''
+
+
+class TestLifetimeAndErrcheck:
+    def test_inline_callback_is_flagged(self):
+        vs = lifetime.check_source("/synthetic/ka.py", _KEEPALIVE_BAD)
+        assert len(vs) == 1 and vs[0].rule == "ffi-keepalive", _fmt(vs)
+
+    def test_frame_local_callback_is_flagged(self):
+        vs = lifetime.check_source("/synthetic/ka.py", _KEEPALIVE_LOCAL)
+        assert len(vs) == 1 and vs[0].rule == "ffi-keepalive", _fmt(vs)
+
+    def test_self_attribute_keepalive_passes(self):
+        vs = lifetime.check_source("/synthetic/ka.py", _KEEPALIVE_OK)
+        assert not vs, _fmt(vs)
+
+    def test_frame_local_holder_attribute_is_flagged(self):
+        # holder dies with the frame even though the access spells like
+        # an attribute — only module-level receivers are retained
+        src = (
+            "from incubator_brpc_tpu.native import FRAME_FN, LIB\n"
+            "def start(srv, make_holder, h):\n"
+            "    holder = make_holder(h)\n"
+            "    LIB.tb_server_set_frame_cb(srv, holder.cb, None)\n"
+        )
+        vs = lifetime.check_source("/synthetic/ka.py", src)
+        assert len(vs) == 1 and vs[0].rule == "ffi-keepalive", _fmt(vs)
+
+    def test_discarded_return_flagged_checked_and_voided_pass(self):
+        vs = errcheck.check_source("/synthetic/ec.py", _ERRCHECK_SRC)
+        assert len(vs) == 1 and vs[0].rule == "ffi-unchecked", _fmt(vs)
+        assert vs[0].line == 5
+
+
+# ---------------------------------------------------------------------------
+# 2f. registry rules fire (synthetic package trees)
+# ---------------------------------------------------------------------------
+
+
+class TestRegistryRules:
+    def _pkg_file(self, tmp_path, name, source):
+        d = tmp_path / "incubator_brpc_tpu"
+        d.mkdir(exist_ok=True)
+        p = d / name
+        p.write_text(source)
+        return str(p)
+
+    def test_dead_flag_flagged_read_flag_passes(self, tmp_path):
+        p = self._pkg_file(
+            tmp_path, "flags_mod.py",
+            'from incubator_brpc_tpu.utils.flags import define_flag, get_flag\n'
+            'define_flag("zombie_knob", 1, "never read")\n'
+            'define_flag("live_knob", 2, "read below")\n'
+            'def f():\n    return get_flag("live_knob")\n',
+        )
+        vs = registry_lint.check_flags([p])
+        assert len(vs) == 1 and vs[0].rule == "flag-dead", _fmt(vs)
+        assert "zombie_knob" in vs[0].message
+
+    def test_dict_get_does_not_mask_dead_flag(self, tmp_path):
+        # a plain dict .get("name") sharing the flag's name is NOT a
+        # flag read — only get_flag aliases / flag_registry.get count
+        p = self._pkg_file(
+            tmp_path, "flags_mod.py",
+            'from incubator_brpc_tpu.utils.flags import define_flag\n'
+            'from incubator_brpc_tpu.utils.flags import flag_registry\n'
+            'define_flag("shadow_knob", 1, "read only as a dict key")\n'
+            'define_flag("registry_knob", 2, "read via the registry")\n'
+            'def f(ctx):\n'
+            '    _ = ctx.get("shadow_knob")\n'
+            '    return flag_registry.get("registry_knob")\n',
+        )
+        vs = registry_lint.check_flags([p])
+        assert len(vs) == 1 and vs[0].rule == "flag-dead", _fmt(vs)
+        assert "shadow_knob" in vs[0].message
+
+    def test_flag_without_help_flagged(self, tmp_path):
+        p = self._pkg_file(
+            tmp_path, "flags_mod.py",
+            'from incubator_brpc_tpu.utils.flags import define_flag, get_flag\n'
+            'define_flag("mute_knob", 1)\n'
+            'def f():\n    return get_flag("mute_knob")\n',
+        )
+        vs = registry_lint.check_flags([p])
+        assert len(vs) == 1 and vs[0].rule == "flag-undocumented", _fmt(vs)
+
+    def test_invalid_bvar_name_flagged(self, tmp_path):
+        p = self._pkg_file(
+            tmp_path, "bvars_mod.py",
+            'from incubator_brpc_tpu.bvar import Adder\n'
+            'bad = Adder(name="native plane calls")\n',
+        )
+        vs = registry_lint.check_bvars([p])
+        assert any(v.rule == "bvar-name" for v in vs), _fmt(vs)
+
+    def test_undocumented_native_bvar_flagged(self, tmp_path):
+        p = self._pkg_file(
+            tmp_path, "bvars_mod.py",
+            'from incubator_brpc_tpu.bvar import Adder\n'
+            'x = Adder(name="native_totally_new_counter")\n'
+            'y = Adder(name="unprefixed_counter_is_fine")\n',
+        )
+        vs = registry_lint.check_bvars([p])
+        assert len(vs) == 1 and vs[0].rule == "bvar-undocumented", _fmt(vs)
+        assert "native_totally_new_counter" in vs[0].message
+
+    def test_documented_native_bvar_passes(self, tmp_path):
+        p = self._pkg_file(
+            tmp_path, "bvars_mod.py",
+            'from incubator_brpc_tpu.bvar import Adder\n'
+            'x = Adder(name="native_client_calls")\n',
+        )
+        vs = registry_lint.check_bvars([p])
+        assert not vs, _fmt(vs)
+
+
+# ---------------------------------------------------------------------------
+# 3. sanitizer harness (slow; probe-gated like the multiprocess tiers)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestSanitizers:
+    def test_asan_ubsan_native_subset(self):
+        from tools.fabriclint import san
+
+        ok, detail = san.probe("asan")
+        if not ok:
+            pytest.skip(f"asan unsupported here: {detail}")
+        assert san.run_asan() == 0
+
+    def test_tsan_ring_stress(self):
+        from tools.fabriclint import san
+
+        ok, detail = san.probe("tsan")
+        if not ok:
+            pytest.skip(f"tsan unsupported here: {detail}")
+        assert san.run_tsan() == 0

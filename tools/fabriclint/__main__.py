@@ -1,0 +1,41 @@
+"""CLI: ``python -m tools.fabriclint`` (the ``make lint`` entry point).
+
+Runs all five passes over the repo and prints violations one per line
+(``path:line: [rule] message``); exits 1 when any survive their
+annotations.  ``--rule <name>`` filters the output to one rule family;
+``--list-rules`` prints the rule ids.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    from tools.fabriclint import RULES, run_all
+
+    ap = argparse.ArgumentParser(prog="fabriclint")
+    ap.add_argument("--rule", help="only report this rule id")
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print rule ids and exit"
+    )
+    args = ap.parse_args(argv)
+    if args.list_rules:
+        for r in RULES:
+            print(r)
+        return 0
+    violations = run_all()
+    if args.rule:
+        violations = [v for v in violations if v.rule == args.rule]
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"fabriclint: {len(violations)} violation(s)", file=sys.stderr)
+        return 1
+    print("fabriclint: clean", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

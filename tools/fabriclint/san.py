@@ -1,0 +1,276 @@
+"""Sanitizer harness for the native plane (`make san`).
+
+The reference hardens its C++ core with gtest suites run under
+ASAN/TSAN in CI; this repo's native plane is driven from Python, so the
+harness builds sanitizer variants of libtbutil.so (src/Makefile `asan`/
+`tsan` targets), points the ctypes loader at them via ``TBNET_LIB``,
+preloads the matching runtime into the interpreter, and runs:
+
+- **ASAN+UBSAN**: the native test subset (tests/test_native_plane.py,
+  tests/test_native_baidu.py) — heap errors, UB (UBSAN findings are
+  fatal via -fno-sanitize-recover).
+- **TSAN**: the telemetry-ring multi-producer stress
+  (TestTelemetryRingStress) at a reduced burn — the lock-free
+  structures' race coverage.  Only the instrumented C++ is tracked;
+  the uninstrumented interpreter is invisible to TSAN, so reports
+  are tbnet/tbutil races, not Python noise.
+
+Support is probed, not assumed: no g++, no sanitizer runtime, or a
+runtime that cannot be preloaded into Python → the run SKIPS cleanly
+(exit 0 with a [skip] line), matching the tier-1 tests' probe-gated
+skip.  A failure in a supported environment exits nonzero.
+
+Suppressions: tools/fabriclint/tsan.supp is committed and carries ONE
+justified entry — the glibc ``_dl_deallocate_tls`` TLS-teardown class,
+whose futex synchronization lives in uninstrumented libc and is
+invisible to TSAN (full rationale in the file).  Every report in
+instrumented code itself gets fixed, not suppressed.
+
+Usage::
+
+    python -m tools.fabriclint.san            # both sanitizers
+    python -m tools.fabriclint.san --asan     # ASAN/UBSAN subset only
+    python -m tools.fabriclint.san --tsan     # TSAN ring stress only
+    python -m tools.fabriclint.san --probe    # report support and exit
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+from typing import Optional, Tuple
+
+from tools.fabriclint import REPO_ROOT
+
+SRC_DIR = os.path.join(REPO_ROOT, "src")
+ASAN_SO = os.path.join(SRC_DIR, "build", "libtbutil_asan.so")
+TSAN_SO = os.path.join(SRC_DIR, "build", "libtbutil_tsan.so")
+TSAN_SUPP = os.path.join(REPO_ROOT, "tools", "fabriclint", "tsan.supp")
+
+ASAN_TESTS = ["tests/test_native_plane.py", "tests/test_native_baidu.py"]
+TSAN_TEST = "tests/test_native_plane.py::TestTelemetryRingStress"
+
+_PROBE_SRC = 'extern "C" int fabriclint_probe(void) { return 7; }\n'
+
+
+def _cxx() -> Optional[str]:
+    return shutil.which(os.environ.get("CXX", "g++"))
+
+
+def _runtime_of(cxx: str, lib: str) -> Optional[str]:
+    """Resolve the preloadable sanitizer runtime (libasan.so.N...)."""
+
+    try:
+        out = subprocess.run(
+            [cxx, f"-print-file-name={lib}"],
+            capture_output=True, text=True, timeout=30,
+        ).stdout.strip()
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if not out or out == lib:
+        return None
+    real = os.path.realpath(out)
+    return real if os.path.exists(real) else None
+
+
+def probe(kind: str) -> Tuple[bool, str]:
+    """(supported, detail) — can this toolchain build a ``kind``-sanitized
+    .so AND preload its runtime into a fresh interpreter?"""
+
+    cxx = _cxx()
+    if cxx is None:
+        return False, "no C++ compiler on PATH"
+    flag = {"asan": "address", "tsan": "thread"}[kind]
+    rt = _runtime_of(cxx, {"asan": "libasan.so", "tsan": "libtsan.so"}[kind])
+    if rt is None:
+        return False, f"lib{flag[:1]}san runtime not found"
+    with tempfile.TemporaryDirectory(prefix="fabriclint-san-") as td:
+        src = os.path.join(td, "probe.cc")
+        so = os.path.join(td, "probe.so")
+        with open(src, "w") as fh:
+            fh.write(_PROBE_SRC)
+        try:
+            rc = subprocess.run(
+                [cxx, "-shared", "-fPIC", f"-fsanitize={flag}", "-o", so, src],
+                capture_output=True, timeout=120,
+            ).returncode
+        except (OSError, subprocess.SubprocessError):
+            return False, "sanitized compile failed"
+        if rc != 0 or not os.path.exists(so):
+            return False, "sanitized compile failed"
+        env = dict(os.environ, LD_PRELOAD=rt)
+        env.setdefault("ASAN_OPTIONS", "detect_leaks=0")
+        try:
+            r = subprocess.run(
+                [
+                    sys.executable, "-c",
+                    "import ctypes, sys;"
+                    f"l = ctypes.CDLL({so!r});"
+                    "sys.exit(0 if l.fabriclint_probe() == 7 else 3)",
+                ],
+                capture_output=True, timeout=120, env=env,
+            )
+        except (OSError, subprocess.SubprocessError):
+            return False, "python-under-sanitizer probe failed"
+        if r.returncode != 0:
+            return False, "sanitizer runtime cannot preload into python"
+    return True, rt
+
+
+def _build(target: str) -> bool:
+    try:
+        r = subprocess.run(
+            ["make", "-C", SRC_DIR, target],
+            capture_output=True, text=True, timeout=600,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return False
+    if r.returncode != 0:
+        sys.stderr.write(r.stdout[-2000:] + r.stderr[-2000:])
+    return r.returncode == 0
+
+
+def _pytest(args, env) -> Tuple[int, str]:
+    cmd = [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider"]
+    cmd += args
+    full_env = dict(os.environ)
+    full_env.update(env)
+    full_env.setdefault("JAX_PLATFORMS", "cpu")
+    timeout_s = int(os.environ.get("FABRICLINT_SAN_TIMEOUT", "1800"))
+    try:
+        r = subprocess.run(
+            cmd, capture_output=True, text=True, cwd=REPO_ROOT,
+            env=full_env, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired as e:
+        # a hung sanitized run (e.g. a TSAN-visible deadlock — exactly
+        # the bug class this harness hunts) is a FAILURE, not a crash
+        out = (e.stdout or b"").decode("utf-8", "replace") if isinstance(
+            e.stdout, bytes
+        ) else (e.stdout or "")
+        return 124, out + f"\n[san] run exceeded {timeout_s}s and was killed"
+    return r.returncode, r.stdout + r.stderr
+
+
+def _last_line(out: str) -> str:
+    lines = [ln for ln in out.splitlines() if ln.strip()]
+    return lines[-1].strip() if lines else "(no output)"
+
+
+def _preflight_native(env) -> Optional[str]:
+    """The sanitized .so must actually load — a silent pure-Python
+    fallback would 'pass' the whole run without testing anything."""
+
+    full_env = dict(os.environ)
+    full_env.update(env)
+    r = subprocess.run(
+        [
+            sys.executable, "-c",
+            "from incubator_brpc_tpu import native; "
+            "import sys; sys.exit(0 if native.NATIVE_AVAILABLE else 4)",
+        ],
+        capture_output=True, text=True, cwd=REPO_ROOT, env=full_env,
+        timeout=300,
+    )
+    if r.returncode != 0:
+        return f"sanitized library did not load: {r.stderr[-500:]}"
+    return None
+
+
+def run_asan() -> int:
+    ok, detail = probe("asan")
+    if not ok:
+        print(f"[skip] asan: {detail}")
+        return 0
+    rt = detail
+    if not _build("asan"):
+        print("[FAIL] asan: build failed")
+        return 1
+    env = {
+        "TBNET_LIB": ASAN_SO,
+        "LD_PRELOAD": rt,
+        "ASAN_OPTIONS": (
+            "detect_leaks=0:abort_on_error=1:verify_asan_link_order=0"
+        ),
+        "UBSAN_OPTIONS": "print_stacktrace=1:halt_on_error=1",
+    }
+    err = _preflight_native(env)
+    if err:
+        print(f"[FAIL] asan: {err}")
+        return 1
+    rc, out = _pytest(ASAN_TESTS + ["-m", "not slow"], env)
+    bad = (
+        rc != 0
+        or "ERROR: AddressSanitizer" in out
+        or "runtime error:" in out
+    )
+    tail = "\n".join(out.splitlines()[-15:])
+    if bad:
+        print(f"[FAIL] asan/ubsan native subset:\n{tail}")
+        return 1
+    print(f"[ok] asan/ubsan native subset: {_last_line(out)}")
+    return 0
+
+
+def run_tsan() -> int:
+    ok, detail = probe("tsan")
+    if not ok:
+        print(f"[skip] tsan: {detail}")
+        return 0
+    rt = detail
+    if not _build("tsan"):
+        print("[FAIL] tsan: build failed")
+        return 1
+    env = {
+        "TBNET_LIB": TSAN_SO,
+        "LD_PRELOAD": rt,
+        # exitcode=66 turns any report into a hard failure even with the
+        # default halt_on_error=0 (all reports print, then the run fails)
+        "TSAN_OPTIONS": f"exitcode=66:suppressions={TSAN_SUPP}",
+        # reduced burn: TSAN costs ~20x; 4x400 still crosses every
+        # producer/consumer/ring-full interleaving the full test does
+        "TBNET_STRESS_THREADS": "4",
+        "TBNET_STRESS_N": "400",
+    }
+    err = _preflight_native(env)
+    if err:
+        print(f"[FAIL] tsan: {err}")
+        return 1
+    rc, out = _pytest([TSAN_TEST], env)
+    bad = rc != 0 or "WARNING: ThreadSanitizer" in out
+    tail = "\n".join(out.splitlines()[-15:])
+    if bad:
+        print(f"[FAIL] tsan ring stress:\n{tail}")
+        return 1
+    print(f"[ok] tsan ring stress: {_last_line(out)}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fabriclint.san")
+    ap.add_argument("--asan", action="store_true")
+    ap.add_argument("--tsan", action="store_true")
+    ap.add_argument(
+        "--probe", action="store_true", help="report support and exit"
+    )
+    args = ap.parse_args(argv)
+    if args.probe:
+        for kind in ("asan", "tsan"):
+            ok, detail = probe(kind)
+            print(f"{kind}: {'supported' if ok else 'UNSUPPORTED'} ({detail})")
+        return 0
+    run_both = not (args.asan or args.tsan)
+    rc = 0
+    if args.asan or run_both:
+        rc |= run_asan()
+    if args.tsan or run_both:
+        rc |= run_tsan()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
