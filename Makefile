@@ -1,21 +1,34 @@
 # Repo-level developer entry points.
 #
-#   make lint  — fabriclint: FFI signature cross-check, hot-path purity,
+#   make lint  — fabriclint (FFI signature cross-check, hot-path purity,
 #                flag/bvar registry lint, callback keepalive, tb_* return
-#                audit (tools/fabriclint; also runs inside tier-1 via
-#                tests/test_static_analysis.py)
+#                audit) AND fabricverify (lock-order graph, lifecycle
+#                balance, protocol model checking); both run, exit codes
+#                merged (tools/fabriclint + tools/fabricverify; the same
+#                checks run inside tier-1 via tests/test_static_analysis.py)
+#   make verify-models — the explicit-state model checker alone, with
+#                per-model state counts on stdout
 #   make san   — sanitizer harness: ASAN+UBSAN over the native test
-#                subset, TSAN over the telemetry-ring stress (probe-gated:
-#                skips cleanly where the toolchain lacks support)
+#                subset, TSAN over the telemetry-ring stress and the
+#                scheduler (worker_pool + timer_thread) contention stress
+#                (probe-gated: skips cleanly where the toolchain lacks
+#                support)
 #   make native — the plain native runtime build (src/build/libtbutil.so)
 #   make test  — the tier-1 test suite
 #
-# docs/ANALYSIS.md documents the rules and the exemption annotation.
+# docs/ANALYSIS.md documents the rules, the exemption annotation, and the
+# generated lock hierarchy.
 
 PY ?= python
 
 lint:
-	$(PY) -m tools.fabriclint
+	@rc=0; \
+	$(PY) -m tools.fabriclint || rc=1; \
+	$(PY) -m tools.fabricverify || rc=1; \
+	exit $$rc
+
+verify-models:
+	$(PY) -m tools.fabricverify.modelcheck
 
 san:
 	$(PY) -m tools.fabriclint.san
@@ -26,4 +39,4 @@ native:
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
 
-.PHONY: lint san native test
+.PHONY: lint verify-models san native test

@@ -434,6 +434,12 @@ class LoadBalancerWithNaming:
         # (sock, callback) pairs appended to long-lived global sockets —
         # removed at stop() so a dead LB is not pinned by its hooks
         self._revival_hooks: list = []
+        # ep -> latest armed revival timer id, unscheduled at stop(): a
+        # parked timer holds a closure over this LB for the whole
+        # isolation window otherwise — a stopped LB would be pinned (and
+        # its _maybe_revive fired into torn-down state) per isolated node
+        self._revive_timers: Dict[EndPoint, int] = {}
+        self._stopped = False
 
     def start(self) -> bool:
         if self._owns_ns and not self.ns_thread.start():
@@ -442,8 +448,26 @@ class LoadBalancerWithNaming:
         return True
 
     def stop(self) -> None:
+        self._stopped = True
+        # detach from the naming thread FIRST: a shared watcher (the
+        # PartitionChannel shape) keeps running after this LB dies, and a
+        # still-registered observer would keep feeding it server churn
+        try:
+            self.ns_thread.remove_observer(self)
+        except AttributeError:
+            pass  # duck-typed test doubles without observer tracking
         if self._owns_ns:
             self.ns_thread.stop()
+        if self._cb_enabled:
+            from incubator_brpc_tpu.runtime.timer_thread import (
+                global_timer_thread,
+            )
+
+            with self._cb_lock:
+                timers, self._revive_timers = dict(self._revive_timers), {}
+                self._isolated.clear()
+            for tid in timers.values():
+                global_timer_thread().unschedule(tid)
         if self._cb_enabled:
             from incubator_brpc_tpu.rpc.circuit_breaker import breaker_registry
 
@@ -484,6 +508,11 @@ class LoadBalancerWithNaming:
         for its isolation duration, then revive HALF_OPEN. Revival is
         both timer-driven (so the gauge/page freshen without traffic) and
         lazily enforced in select_server (so tests need no timer races)."""
+        if self._stopped:
+            # a trip verdict racing stop(): arming a timer / re-registering
+            # the breaker here would undo stop()'s cleanup (and leak the
+            # registry row under a dead owner tag for the process lifetime)
+            return
         cb = self._breaker(ep)
         duration_s = cb.isolation_duration_ms / 1e3
         now = time.monotonic()
@@ -500,12 +529,23 @@ class LoadBalancerWithNaming:
         # a timer per deadline move: straggler failures extend the window
         # and the previously parked timer bails on the not-yet-due check
         # in _maybe_revive, so the EXTENDED deadline needs its own timer
-        # or an idle channel would stay 'isolated' until its next select
-        global_timer_thread().schedule(
+        # or an idle channel would stay 'isolated' until its next select.
+        # The latest id per ep is kept so stop() can cancel it (an older
+        # superseded timer no-ops at fire on the deadline check).
+        tid = global_timer_thread().schedule(
             lambda: self._maybe_revive(ep), delay=duration_s
         )
+        with self._cb_lock:
+            old = self._revive_timers.get(ep)
+            self._revive_timers[ep] = tid
+        if old is not None:
+            # the superseded timer would only no-op at fire (deadline
+            # moved), but left armed it pins this LB past stop()
+            global_timer_thread().unschedule(old)
 
     def _maybe_revive(self, ep: EndPoint) -> None:
+        if self._stopped:
+            return  # a straggler timer must not resurrect a dead LB
         now = time.monotonic()
         with self._cb_lock:
             deadline = self._isolated.get(ep)
@@ -513,9 +553,10 @@ class LoadBalancerWithNaming:
                 return
             if deadline > now + 1e-4:
                 # re-isolated while this timer was parked: a fresh timer
-                # owns the new deadline
+                # owns the new deadline (and the _revive_timers entry)
                 return
             del self._isolated[ep]
+            self._revive_timers.pop(ep, None)
             cb = self._breakers.get(ep)
         if cb is not None:
             cb.reset()  # HALF_OPEN: candidate again, windows cleared
@@ -536,7 +577,7 @@ class LoadBalancerWithNaming:
         """One completed attempt's verdict into the node's breaker;
         isolates on the trip TRANSITION only (stragglers completing after
         the trip must not re-extend the deadline)."""
-        if not self._cb_enabled or error_code in (
+        if self._stopped or not self._cb_enabled or error_code in (
             ErrorCode.ECANCELED,
             ErrorCode.EBACKUPREQUEST,
         ):
@@ -596,6 +637,13 @@ class LoadBalancerWithNaming:
         with self._cb_lock:
             self._breakers.pop(ep, None)
             self._isolated.pop(ep, None)
+            tid = self._revive_timers.pop(ep, None)
+        if tid is not None:
+            from incubator_brpc_tpu.runtime.timer_thread import (
+                global_timer_thread,
+            )
+
+            global_timer_thread().unschedule(tid)
         breaker_registry.unregister(self._cb_tag, f"{ep.ip}:{ep.port}")
 
     def select_server(

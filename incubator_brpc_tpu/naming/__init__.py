@@ -255,6 +255,17 @@ class NamingServiceThread:
         for ep in current:
             obs.add_server(ep)
 
+    def remove_observer(self, obs) -> None:
+        """Detach an observer. A shared NamingServiceThread outlives the
+        LBs watching it (PartitionChannel feeds N filtered views off one
+        watcher) — a stopped LB must unhook itself or it keeps receiving
+        add/remove callbacks and is pinned for the watcher's lifetime."""
+        with self._lock:
+            try:
+                self._observers.remove(obs)
+            except ValueError:
+                pass
+
     def servers(self) -> List[EndPoint]:
         with self._lock:
             return list(self._current)

@@ -94,6 +94,7 @@ class MemcacheClient:
         self._rbuf = b""
         self._sock = Socket.connect(remote, timeout=timeout)
         self._sock.messenger = self
+        # fabriclint: allow(lifecycle-callback) bound-method hook on a socket this client OWNS (created here, closed with the client) — hook and owner share one lifetime
         self._sock.on_failed.append(self._on_socket_failed)
 
     def process(self, sock) -> None:

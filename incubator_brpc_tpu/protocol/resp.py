@@ -124,6 +124,7 @@ class RedisClient:
         # raw reader: RESP is not header-sized, so bypass InputMessenger
         # and consume the socket's read buffer directly
         self._sock.messenger = self
+        # fabriclint: allow(lifecycle-callback) bound-method hook on a socket this client OWNS (created here, closed with the client) — hook and owner share one lifetime
         self._sock.on_failed.append(self._on_socket_failed)
         if password is not None:
             # the RedisAuthenticator contract: AUTH is the FIRST command on

@@ -453,6 +453,7 @@ class RtmpServerConnection:
         self._in_bytes = 0
         self._acked = 0
         self._peer_window = 0
+        # fabriclint: allow(lifecycle-callback) bound-method hook on the connection this stream wraps — hook and owner share the connection's lifetime
         sock.on_failed.append(self._on_socket_failed)
 
     # -- outbound ----------------------------------------------------------

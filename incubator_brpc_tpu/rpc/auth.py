@@ -92,6 +92,7 @@ def attach_credential(meta, sock, auth: Optional[Authenticator]) -> None:
         return
     if not sock.context.get("auth_revive_hooked"):
         sock.context["auth_revive_hooked"] = True
+        # fabriclint: allow(lifecycle-callback) module-level stateless fn, hooked once per socket (context flag), pins nothing and dies with the socket
         sock.on_revived.append(_clear_on_revive)
     if sock.context.get("auth_done"):
         return

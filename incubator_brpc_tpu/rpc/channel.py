@@ -101,6 +101,7 @@ def _track_inflight(sock, cid: int) -> None:
                     f"connection to {sk.remote} failed with the call in flight",
                 )
 
+        # fabriclint: allow(lifecycle-callback) closure reads only the failing socket's own context, hooked once per socket, dies with it — pins no channel state
         sock.on_failed.append(_fail_inflight)
     cids.add(cid)
 
@@ -935,6 +936,7 @@ class Channel:
                         f"connection to {s.remote} failed with the call in flight",
                     )
 
+            # fabriclint: allow(lifecycle-callback) closure reads only the failing socket's own context, hooked once per socket (guarded by http_pending creation), dies with it
             sock.on_failed.append(_fail_fifo)
         pool = global_worker_pool()
         with lock:

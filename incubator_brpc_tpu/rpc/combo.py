@@ -844,6 +844,10 @@ class DynamicPartitionChannel:
 
     def stop(self) -> None:
         if self._ns_thread is not None:
+            # detach before stop — observer symmetry with init(): were the
+            # watcher ever shared, a stopped channel must not keep
+            # receiving (and acting on) scheme churn
+            self._ns_thread.remove_observer(self)
             self._ns_thread.stop()
 
     # NamingServiceThread observer: build a scheme on first sighting

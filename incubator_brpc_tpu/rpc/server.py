@@ -744,6 +744,7 @@ class Server:
             )
             self.listen_endpoint = self._acceptor.endpoint
         self._stopping = False
+        self._idle_reap_timer_id = None
         self._started = True
         if self.options.idle_timeout_s > 0:
             if self._acceptor is not None:
@@ -791,14 +792,23 @@ class Server:
         from incubator_brpc_tpu.runtime.timer_thread import global_timer_thread
         from incubator_brpc_tpu.runtime.worker_pool import global_worker_pool
 
+        if self._stopping:
+            # a scan that was mid-flight when stop() ran must not re-arm:
+            # it would overwrite the None stop() just stored and pin the
+            # stopped server for another idle_timeout_s/2
+            return
+
         # scan at half the timeout so a connection is reaped at most 1.5x
         # late (the reference's idle-connection reaper bthread,
         # Acceptor::CloseIdleConnections acceptor.cpp:111 /
         # Socket::ReleaseReferenceIfIdle socket.cpp:887). The timer
         # callback only spawns — set_failed does syscalls and runs user
-        # on_failed hooks, too heavy for the shared TimerThread.
+        # on_failed hooks, too heavy for the shared TimerThread. The id
+        # is kept so stop() can cancel the parked scan: an armed reap
+        # timer otherwise pins this server (closure -> self) for up to
+        # idle_timeout_s/2 past stop and fires into torn-down state.
         delay = max(0.05, self.options.idle_timeout_s / 2)
-        global_timer_thread().schedule(
+        self._idle_reap_timer_id = global_timer_thread().schedule(
             lambda: global_worker_pool().spawn(self._reap_idle),
             delay=delay,
         )
@@ -829,6 +839,14 @@ class Server:
         if not self._started:
             return
         self._stopping = True
+        tid = getattr(self, "_idle_reap_timer_id", None)
+        if tid is not None:
+            self._idle_reap_timer_id = None
+            from incubator_brpc_tpu.runtime.timer_thread import (
+                global_timer_thread,
+            )
+
+            global_timer_thread().unschedule(tid)
         for g in self._limit_gauges:
             try:
                 g.hide()
@@ -898,6 +916,7 @@ class Server:
             obj = self._session_pool.borrow()
             ctx["_session_local_data"] = obj
             if sock.state == CONNECTED:
+                # fabriclint: allow(lifecycle-callback) the hook IS the give-back path; the socket is owned by this server's acceptor, which fails every connection at stop — firing it
                 sock.on_failed.append(self._session_give_back)
             else:
                 # failed before the hook could land (set_failed iterates a

@@ -57,6 +57,7 @@ def _timeout_fire(w: _Waiter) -> None:
             # inline on the single TimerThread, and blocking it would delay
             # every other timeout in the process.
             if not w.event.is_set():
+                # fabriclint: allow(lifecycle-timer) self-terminating chase: re-arms only inside the two-lock-wide requeue transit window and exits once w.home lands or a wake set the event — no owner exists to unschedule it
                 global_timer_thread().schedule(
                     lambda: _timeout_fire(w), delay=0.0002
                 )

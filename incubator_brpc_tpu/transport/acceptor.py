@@ -142,6 +142,7 @@ class Acceptor:
                 )
                 with self._conn_lock:
                     self._connections[sock.id] = sock
+                # fabriclint: allow(lifecycle-callback) self-pruning map hook on a connection this acceptor owns and fails at stop — the hook dies with the socket it cleans up after
                 sock.on_failed.append(self._forget)
                 if self._on_connection is not None:
                     try:

@@ -1066,6 +1066,7 @@ def make_handshake_handler(server):
                 pass
             sock.recycle()
 
+        # fabriclint: allow(lifecycle-callback) self-pruning hook: removes the dead link from the server list and recycles it — firing the hook IS the teardown, and the server fails every device sock at stop
         ds.on_failed.append(_forget)
         return json.dumps(
             {

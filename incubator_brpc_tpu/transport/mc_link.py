@@ -466,6 +466,7 @@ def accept_mc_handshake(server, cntl, req: dict) -> bytes:
             pass
         sock.recycle()
 
+    # fabriclint: allow(lifecycle-callback) self-pruning hook: drops the dead DeviceSocket from server._device_socks and recycles it — the server fails every device sock at stop, firing it
     ds.on_failed.append(_forget)
     return json.dumps(
         {

@@ -828,6 +828,7 @@ class NativeServerPlane:
                 self._handoff_socks.add(sock)
             # self-pruning: a dead handed-off connection must not pin its
             # Socket (and buffers) for the server's lifetime
+            # fabriclint: allow(lifecycle-callback) self-pruning set hook on a handed-off connection this plane owns; plane stop closes the socks, firing it
             sock.on_failed.append(self._forget_handoff)
         except Exception:
             logger.exception("native handoff failed")

@@ -82,6 +82,7 @@ def when_drained(sock, action, stalls: int = 0, last_unwritten: int = -1) -> Non
     if drained or stalls > 200:
         action(sock)
     else:
+        # fabriclint: allow(lifecycle-timer) self-terminating retry chain: every path either runs action() or re-arms, and the stall cap (200 ticks ~ 2s) bounds the chain — no cancel point exists to unschedule from
         global_timer_thread().schedule(
             lambda: when_drained(sock, action, stalls, unwritten), delay=0.01
         )
@@ -1020,6 +1021,7 @@ class Socket:
         short connect probe occupies a fiber."""
         from incubator_brpc_tpu.runtime.timer_thread import global_timer_thread
 
+        # fabriclint: allow(lifecycle-timer) self-terminating probe chain: _health_probe re-arms only while state == FAILED and exits on revive/recycle — one armed timer per failed socket, ended by the state machine, not a cancel
         global_timer_thread().schedule(
             lambda: self._pool.spawn(self._health_probe),
             delay=self.health_check_interval,

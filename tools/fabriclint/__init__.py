@@ -51,6 +51,22 @@ REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 
+# Rules owned by the sibling concurrency checker (tools/fabricverify).
+# They are registered here because the two tools share ONE annotation
+# grammar: a single ``# fabriclint: allow(<rule>) <reason>`` scanner must
+# recognize every rule either tool can fire, or a legitimate fabricverify
+# exemption would be reported as bad-allow by fabriclint and vice versa.
+VERIFY_RULES = (
+    "lock-cycle",       # cycle in the global lock-ordering graph
+    "lock-unmodeled",   # a lock primitive the analyzer could not bind
+    "lifecycle-borrow",     # SimpleDataPool.borrow with no give_back path
+    "lifecycle-timer",      # TimerThread.schedule with no unschedule path
+    "lifecycle-callback",   # hook registration with no teardown removal
+    "model-stuck",          # reachable model state with no enabled action
+    "model-unsafe",         # reachable state violating a safety property
+    "model-unrevivable",    # state from which recovery is unreachable
+)
+
 RULES = (
     "ffi-missing",      # sigs entry with no header declaration
     "ffi-unbound",      # header function with no sigs entry
@@ -70,7 +86,7 @@ RULES = (
     "ffi-keepalive",
     "ffi-unchecked",
     "bad-allow",
-)
+) + VERIFY_RULES
 
 
 @dataclass
@@ -83,6 +99,23 @@ class Violation:
     def __str__(self) -> str:
         rel = os.path.relpath(self.path, REPO_ROOT)
         return f"{rel}:{self.line}: [{self.rule}] {self.message}"
+
+
+def to_records(violations: Iterable["Violation"]) -> List[Dict[str, object]]:
+    """Violations as ``{rule, file, line, reason}`` records — the
+    machine-readable report schema shared by ``--json`` on fabriclint and
+    fabricverify, stable so CI tooling can diff violation sets across
+    commits (files repo-relative, one record per violation)."""
+
+    return [
+        {
+            "rule": v.rule,
+            "file": os.path.relpath(v.path, REPO_ROOT),
+            "line": v.line,
+            "reason": v.message,
+        }
+        for v in violations
+    ]
 
 
 _ALLOW_RE = re.compile(

@@ -11,9 +11,12 @@ preloads the matching runtime into the interpreter, and runs:
   fatal via -fno-sanitize-recover).
 - **TSAN**: the telemetry-ring multi-producer stress
   (TestTelemetryRingStress) at a reduced burn — the lock-free
-  structures' race coverage.  Only the instrumented C++ is tracked;
-  the uninstrumented interpreter is invisible to TSAN, so reports
-  are tbnet/tbutil races, not Python noise.
+  structures' race coverage — plus the scheduler contention stress
+  (TestSchedulerContentionStress: worker_pool + timer_thread
+  schedule/unschedule storm against stop) under the same sanitized
+  interpreter.  Only the instrumented C++ is tracked; the
+  uninstrumented interpreter is invisible to TSAN, so reports are
+  tbnet/tbutil races, not Python noise.
 
 Support is probed, not assumed: no g++, no sanitizer runtime, or a
 runtime that cannot be preloaded into Python → the run SKIPS cleanly
@@ -52,7 +55,14 @@ TSAN_SO = os.path.join(SRC_DIR, "build", "libtbutil_tsan.so")
 TSAN_SUPP = os.path.join(REPO_ROOT, "tools", "fabriclint", "tsan.supp")
 
 ASAN_TESTS = ["tests/test_native_plane.py", "tests/test_native_baidu.py"]
-TSAN_TEST = "tests/test_native_plane.py::TestTelemetryRingStress"
+TSAN_TESTS = [
+    # the lock-free telemetry ring under multi-producer fire (PR 6)
+    "tests/test_native_plane.py::TestTelemetryRingStress",
+    # the scheduler plane: worker_pool + timer_thread schedule/unschedule
+    # storm racing stop (the dynamic complement of fabricverify's static
+    # lock-order pass)
+    "tests/test_runtime_stress.py::TestSchedulerContentionStress",
+]
 
 _PROBE_SRC = 'extern "C" int fabriclint_probe(void) { return 7; }\n'
 
@@ -235,18 +245,20 @@ def run_tsan() -> int:
         # producer/consumer/ring-full interleaving the full test does
         "TBNET_STRESS_THREADS": "4",
         "TBNET_STRESS_N": "400",
+        "SCHED_STRESS_THREADS": "4",
+        "SCHED_STRESS_N": "200",
     }
     err = _preflight_native(env)
     if err:
         print(f"[FAIL] tsan: {err}")
         return 1
-    rc, out = _pytest([TSAN_TEST], env)
+    rc, out = _pytest(TSAN_TESTS, env)
     bad = rc != 0 or "WARNING: ThreadSanitizer" in out
     tail = "\n".join(out.splitlines()[-15:])
     if bad:
-        print(f"[FAIL] tsan ring stress:\n{tail}")
+        print(f"[FAIL] tsan ring + scheduler stress:\n{tail}")
         return 1
-    print(f"[ok] tsan ring stress: {_last_line(out)}")
+    print(f"[ok] tsan ring + scheduler stress: {_last_line(out)}")
     return 0
 
 

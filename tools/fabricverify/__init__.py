@@ -1,0 +1,78 @@
+"""fabricverify — lock-order, lifecycle, and state-machine verification
+for the concurrency plane.
+
+PR 6's fabriclint made the FFI boundary machine-checked; this sibling
+does the same for the repo's *concurrency* discipline, which until now
+was tested only on happy interleavings:
+
+- **lockorder** (lockorder.py): every lock acquisition site
+  (``with self._lock:``, ``.acquire()``, ``Condition`` construction)
+  across ``incubator_brpc_tpu/`` is extracted into named lock entities;
+  an intraprocedural call graph propagates "locks acquired while
+  holding" edges into one global lock-ordering graph.  Cycles are
+  violations (``lock-cycle``); the acyclic result is rendered as the
+  documented lock hierarchy in docs/ANALYSIS.md.
+- **lifecycle** (lifecycle.py): borrow/give_back balance for
+  ``SimpleDataPool``, schedule/unschedule balance for ``TimerThread``
+  ids, and registration/removal balance for callback hooks
+  (``on_failed``/``on_revived`` appends, naming observers, scrape
+  hooks).  The PR 3 ``on_revived`` leak and the PR 1 scrape-vs-stop
+  UAF were both this class; the pass makes them structural errors.
+- **modelcheck** (modelcheck.py + models.py): a small-scope exhaustive
+  explorer (bounded parties/steps, message reorder + drop + duplicate)
+  over extracted models of the mc_dispatch session protocol and the
+  circuit-breaker state machine, asserting no stuck session, close
+  convergence, and breaker revivability from every reachable state.
+
+Exemptions use fabriclint's grammar — the SAME marker, the same
+enforced-non-empty reason::
+
+    # fabriclint: allow(<rule>) <why the rule does not apply here>
+
+fabricverify's rule ids are registered in ``tools.fabriclint.RULES`` so
+one annotation scanner serves both tools.  Run everything:
+``python -m tools.fabricverify`` (or ``make lint``, which merges the
+fabriclint and fabricverify exit codes); the model checker alone:
+``make verify-models``.  The same checks run inside tier-1 via
+tests/test_static_analysis.py.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+# Shared plumbing: one Violation type, one annotation grammar, one file
+# walker.  fabricverify's rules live in fabriclint.RULES (see VERIFY_RULES
+# there) so a single scan validates every allow() in the tree.
+from tools.fabriclint import (  # noqa: F401  (re-exported surface)
+    REPO_ROOT,
+    Violation,
+    allowed,
+    iter_py_files,
+    scan_annotations,
+    to_records,
+)
+
+# The rule ids this tool owns — defined once, in fabriclint.VERIFY_RULES
+# (where they register into the shared RULES grammar); re-exported here
+# so --list-rules/--rule filtering can never drift from the scanner.
+from tools.fabriclint import VERIFY_RULES as RULES  # noqa: E402
+
+
+def run_all() -> List[Violation]:
+    """Run all three passes; returns unexempted violations."""
+
+    from tools.fabricverify import lifecycle, lockorder, modelcheck
+
+    out: List[Violation] = []
+    out.extend(lockorder.check())
+    out.extend(lifecycle.check())
+    out.extend(modelcheck.check())
+    seen = set()
+    unique: List[Violation] = []
+    for v in out:
+        key = (v.rule, v.path, v.line, v.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(v)
+    return unique

@@ -1,0 +1,72 @@
+"""CLI: ``python -m tools.fabricverify`` (half of the ``make lint``
+entry point, merged with fabriclint's exit code).
+
+Runs the lock-order, lifecycle, and model-checking passes and prints
+violations one per line; exits 1 when any survive their annotations.
+
+- ``--json``: machine-readable report — a JSON array of
+  ``{rule, file, line, reason}`` records on stdout (the same schema as
+  ``python -m tools.fabriclint --json``), so CI tooling can diff
+  violation sets across commits.
+- ``--rule <name>`` filters to one rule id; ``--list-rules`` prints the
+  ids this tool owns.
+- ``--write-docs`` regenerates the lock-hierarchy section of
+  docs/ANALYSIS.md from the current tree and exits (0 = unchanged,
+  2 = rewritten).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    from tools.fabricverify import RULES, run_all, to_records
+
+    ap = argparse.ArgumentParser(prog="fabricverify")
+    ap.add_argument("--rule", help="only report this rule id")
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print rule ids and exit"
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit {rule, file, line, reason} records as a JSON array",
+    )
+    ap.add_argument(
+        "--write-docs", action="store_true",
+        help="regenerate the docs/ANALYSIS.md lock hierarchy and exit",
+    )
+    args = ap.parse_args(argv)
+    if args.list_rules:
+        for r in RULES:
+            print(r)
+        return 0
+    if args.write_docs:
+        from tools.fabricverify import lockorder
+
+        changed = lockorder.write_docs()
+        print(
+            "docs/ANALYSIS.md lock hierarchy "
+            + ("rewritten" if changed else "already current"),
+            file=sys.stderr,
+        )
+        return 2 if changed else 0
+    violations = run_all()
+    if args.rule:
+        violations = [v for v in violations if v.rule == args.rule]
+    if args.json:
+        print(json.dumps(to_records(violations), indent=2))
+        return 1 if violations else 0
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"fabricverify: {len(violations)} violation(s)", file=sys.stderr)
+        return 1
+    print("fabricverify: clean", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,379 @@
+"""Lifecycle-balance lint — borrow/give_back, schedule/unschedule,
+register/remove.
+
+Three resource disciplines in this codebase are acquire/release pairs
+living in *different* functions, which no test of either function alone
+can check — and both shipped leak classes came from exactly this shape
+(the PR 3 ``on_revived`` closure leak: LB stop never removed its hooks
+from process-global sockets; the PR 1 scrape-vs-stop UAF: a drain hook
+outliving its plane).  This pass makes the balance structural:
+
+- ``lifecycle-borrow`` — every ``SimpleDataPool.borrow()`` result must
+  either reach ``give_back`` in the same function, or be *stored* (an
+  attribute, a context dict key) such that some function in the module
+  that calls ``give_back`` mentions the storage key — the teardown path
+  provably reaches the borrow.  An ownership transfer the analyzer
+  cannot see carries ``# fabriclint: allow(lifecycle-borrow) <who owns
+  it and where it dies>``.
+- ``lifecycle-timer`` — every ``TimerThread.schedule(...)`` id must be
+  stored, and the storage key must be mentioned by a function in the
+  module that calls ``unschedule`` (the owner's stop/close path).  A
+  *discarded* id can never be canceled: the armed timer pins its
+  closure (and everything the closure captures — a whole LB, a whole
+  server) until it fires, and fires into torn-down state.
+  Self-terminating reschedule chains (health-check probes, drain
+  watchers) are the legitimate exception — annotated, with the
+  termination condition as the reason.
+- ``lifecycle-callback`` — every hook registration
+  (``sock.on_failed.append``/``on_revived.append``, naming
+  ``add_observer``, prometheus ``register_scrape_hook``) must have a
+  matching removal form in the same module (``.remove`` on the same
+  hook, ``remove_observer``, ``unregister_scrape_hook``).  Hooks whose
+  lifetime is the *hooked object's own* (the socket dies, the hook dies
+  with it, and the closure pins nothing beyond the socket) are
+  annotated with that ownership argument as the reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from tools.fabricverify import Violation, allowed, scan_annotations
+from tools.fabricverify.lockorder import _attr_chain, iter_pkg_files
+
+# hook list attributes whose .append is a registration needing a .remove
+_HOOK_ATTRS = ("on_failed", "on_revived")
+# paired registration/removal call names (by function/method name)
+_PAIRED_CALLS = {
+    "add_observer": "remove_observer",
+    "register_scrape_hook": "unregister_scrape_hook",
+}
+
+
+def _mentions(fn: ast.AST) -> Set[str]:
+    """Every identifier-ish token a function mentions: Name ids,
+    attribute names, and string constants — the key universe the balance
+    matcher searches."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            out.add(node.attr)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            out.add(node.value)
+    return out
+
+
+def _enclosing_functions(tree: ast.Module) -> List[ast.AST]:
+    return [
+        n
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+
+
+def _own_walk(fn: ast.AST):
+    """Walk a function body WITHOUT descending into nested defs — every
+    call is attributed to its innermost function exactly once."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _call_name(node: ast.Call) -> str:
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return ""
+
+
+def _is_timer_schedule(node: ast.Call) -> bool:
+    if not (isinstance(node.func, ast.Attribute) and node.func.attr == "schedule"):
+        return False
+    chain = _attr_chain(node.func)
+    return any("timer" in part.lower() for part in chain[:-1])
+
+
+@dataclass
+class _ModuleScan:
+    path: str
+    tree: Optional[ast.Module] = None
+    # functions that call unschedule / give_back, with their mention sets
+    unschedule_mentions: List[Set[str]] = field(default_factory=list)
+    give_back_mentions: List[Set[str]] = field(default_factory=list)
+    removal_hooks: Set[str] = field(default_factory=set)  # on_failed/on_revived
+    removal_calls: Set[str] = field(default_factory=set)  # remove_observer etc.
+
+
+def _scan_module(path: str, source: str) -> Optional[_ModuleScan]:
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return None
+    scan = _ModuleScan(path=path, tree=tree)
+    for fn in _enclosing_functions(tree):
+        calls = [n for n in ast.walk(fn) if isinstance(n, ast.Call)]
+        names = {_call_name(c) for c in calls}
+        if "unschedule" in names:
+            scan.unschedule_mentions.append(_mentions(fn))
+        if "give_back" in names:
+            scan.give_back_mentions.append(_mentions(fn))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name in _PAIRED_CALLS.values():
+            scan.removal_calls.add(name)
+        if name == "remove" and isinstance(node.func, ast.Attribute):
+            chain = _attr_chain(node.func)
+            if len(chain) >= 2 and chain[-2] in _HOOK_ATTRS:
+                scan.removal_hooks.add(chain[-2])
+    return scan
+
+
+def _storage_keys_of(var: str, fn: ast.AST) -> Set[str]:
+    """Where a local ``var`` (a borrowed object / timer id) is stored:
+    attribute names it is assigned to, subscript string keys, and the
+    receiving list attr of ``X.append(var)``."""
+    keys: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            is_var = isinstance(node.value, ast.Name) and node.value.id == var
+            if not is_var:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute):
+                    keys.add(tgt.attr)
+                elif isinstance(tgt, ast.Subscript):
+                    sl = tgt.slice
+                    if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                        keys.add(sl.value)
+                    else:
+                        # keyed container (``self._revive_timers[ep] = tid``):
+                        # the container attr is the storage key
+                        base = _attr_chain(tgt.value)
+                        if base:
+                            keys.add(base[-1])
+        elif isinstance(node, ast.Call):
+            if (
+                _call_name(node) == "append"
+                and node.args
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id == var
+                and isinstance(node.func, ast.Attribute)
+            ):
+                chain = _attr_chain(node.func)
+                if len(chain) >= 2:
+                    keys.add(chain[-2])
+    return keys
+
+
+def _schedule_storage(node: ast.Call, parents: Dict[ast.AST, ast.AST]):
+    """How a ``schedule(...)`` result is captured: ('attr'|'sub'|'append',
+    key), ('local', name), or None when the id is discarded."""
+    parent = parents.get(node)
+    if isinstance(parent, ast.Assign):
+        tgt = parent.targets[0]
+        if isinstance(tgt, ast.Attribute):
+            return ("attr", tgt.attr)
+        if isinstance(tgt, ast.Name):
+            return ("local", tgt.id)
+        if isinstance(tgt, ast.Subscript):
+            sl = tgt.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                return ("sub", sl.value)
+            base = _attr_chain(tgt.value)
+            if base:
+                return ("attr", base[-1])
+    if (
+        isinstance(parent, ast.Call)
+        and _call_name(parent) == "append"
+        and isinstance(parent.func, ast.Attribute)
+    ):
+        chain = _attr_chain(parent.func)
+        if len(chain) >= 2:
+            return ("append", chain[-2])
+    if isinstance(parent, ast.Return):
+        # the id escapes to the caller — the caller owns the balance
+        return ("return", "")
+    return None
+
+
+def check_source(path: str, source: str) -> List[Violation]:
+    ann = scan_annotations(path, source)
+    out: List[Violation] = list(ann.bad)
+    scan = _scan_module(path, source)
+    if scan is None or scan.tree is None:
+        return out
+
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(scan.tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+
+    def timer_balanced(key: str) -> bool:
+        return any(key in m for m in scan.unschedule_mentions)
+
+    def borrow_balanced(keys: Set[str]) -> bool:
+        return any(
+            keys & m for m in scan.give_back_mentions
+        )
+
+    for fn in _enclosing_functions(scan.tree):
+        fn_calls = [n for n in _own_walk(fn) if isinstance(n, ast.Call)]
+        fn_call_names = {_call_name(c) for c in fn_calls}
+
+        for node in fn_calls:
+            # -- lifecycle-timer ------------------------------------------
+            if _is_timer_schedule(node):
+                line = node.lineno
+                if allowed(ann, "lifecycle-timer", line):
+                    continue
+                storage = _schedule_storage(node, parents)
+                if storage is None:
+                    out.append(
+                        Violation(
+                            "lifecycle-timer", path, line,
+                            "timer id from schedule() is discarded — the "
+                            "armed timer can never be unscheduled and pins "
+                            "its closure until it fires (store the id and "
+                            "unschedule on the owner's stop path, or "
+                            "allow(lifecycle-timer) with the chain's "
+                            "termination condition as the reason)",
+                        )
+                    )
+                    continue
+                kind, key = storage
+                if kind == "return":
+                    continue
+                if kind == "local":
+                    # a local id is fine if unscheduled here, or if it is
+                    # stored onward under a key the teardown path mentions
+                    if "unschedule" in fn_call_names:
+                        continue
+                    onward = _storage_keys_of(key, fn)
+                    if onward and any(timer_balanced(k) for k in onward):
+                        continue
+                    out.append(
+                        Violation(
+                            "lifecycle-timer", path, line,
+                            f"timer id stored in local {key!r} with no "
+                            "unschedule in the same function and no onward "
+                            "storage a teardown path mentions — it dies "
+                            "with the frame and the timer outlives it",
+                        )
+                    )
+                    continue
+                if not timer_balanced(key):
+                    out.append(
+                        Violation(
+                            "lifecycle-timer", path, line,
+                            f"timer id stored under {key!r} but no "
+                            "unschedule-calling function in this module "
+                            "mentions that key — the owner's stop/close "
+                            "path cannot cancel this timer",
+                        )
+                    )
+                continue
+
+            # -- lifecycle-borrow -----------------------------------------
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "borrow"
+                and not node.args
+            ):
+                line = node.lineno
+                if allowed(ann, "lifecycle-borrow", line):
+                    continue
+                parent = parents.get(node)
+                var = None
+                if isinstance(parent, ast.Assign) and isinstance(
+                    parent.targets[0], ast.Name
+                ):
+                    var = parent.targets[0].id
+                if var is None:
+                    # borrowed object not even captured — unreturnable
+                    out.append(
+                        Violation(
+                            "lifecycle-borrow", path, line,
+                            "borrow() result is not captured — the object "
+                            "can never reach give_back",
+                        )
+                    )
+                    continue
+                if "give_back" in fn_call_names:
+                    continue  # balanced locally (try/finally or linear)
+                keys = _storage_keys_of(var, fn)
+                if keys and borrow_balanced(keys):
+                    continue
+                out.append(
+                    Violation(
+                        "lifecycle-borrow", path, line,
+                        f"borrowed object {var!r} neither reaches give_back "
+                        "in this function nor is stored under a key any "
+                        "give_back-calling function in this module mentions"
+                        " — the pool leaks one object per call "
+                        "(allow(lifecycle-borrow) for a true ownership "
+                        "transfer, naming the owner)",
+                    )
+                )
+                continue
+
+            # -- lifecycle-callback ---------------------------------------
+            name = _call_name(node)
+            if name in _PAIRED_CALLS:
+                line = node.lineno
+                if allowed(ann, "lifecycle-callback", line):
+                    continue
+                removal = _PAIRED_CALLS[name]
+                if removal not in scan.removal_calls:
+                    out.append(
+                        Violation(
+                            "lifecycle-callback", path, line,
+                            f"{name}() here has no {removal}() anywhere in "
+                            "this module — the registered object outlives "
+                            "its owner (the registration pins it until the "
+                            "registry dies)",
+                        )
+                    )
+                continue
+            if (
+                name == "append"
+                and isinstance(node.func, ast.Attribute)
+            ):
+                chain = _attr_chain(node.func)
+                if len(chain) >= 2 and chain[-2] in _HOOK_ATTRS:
+                    hook = chain[-2]
+                    line = node.lineno
+                    if allowed(ann, "lifecycle-callback", line):
+                        continue
+                    if hook not in scan.removal_hooks:
+                        out.append(
+                            Violation(
+                                "lifecycle-callback", path, line,
+                                f"{hook}.append() here has no "
+                                f"{hook}.remove() anywhere in this module — "
+                                "the hook (and everything its closure "
+                                "captures) lives as long as the hooked "
+                                "object (allow(lifecycle-callback) when "
+                                "that IS the intended lifetime, saying why)",
+                            )
+                        )
+    return out
+
+
+def check(paths: Optional[List[str]] = None) -> List[Violation]:
+    out: List[Violation] = []
+    for path in paths if paths is not None else iter_pkg_files():
+        with open(path, "r") as fh:
+            source = fh.read()
+        out.extend(check_source(path, source))
+    return out

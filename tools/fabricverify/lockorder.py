@@ -1,0 +1,1089 @@
+"""Lock-order analyzer — a global lock-ordering graph for the package.
+
+~40 classes across ``lb/``, ``runtime/``, ``bvar/``, ``transport/`` and
+``builtin/`` guard state with ``threading.Lock``/``RLock``/``Condition``,
+and nothing enforced an acquisition order between them: a PR that takes
+lock B under lock A in one file and A under B in another compiles, passes
+every single-threaded test, and deadlocks in production.  This pass makes
+the order a checked artifact:
+
+1. **Lock entities.**  Every lock *construction* site in the package is
+   bound to a named entity: ``self._x = threading.Lock()`` inside class
+   ``C`` becomes ``module.C._x`` (one entity per class attribute — all
+   instances share the ordering discipline), module globals become
+   ``module._name``, function locals ``module.func.<name>``.
+   ``threading.Condition(self._lock)`` aliases the wrapped lock's entity
+   (waiting on the condition IS holding that lock).  A construction the
+   analyzer cannot bind is itself a violation (``lock-unmodeled``) —
+   the coverage contract is *every* site, allowlist-free.
+2. **Acquisitions.**  ``with <lock>:`` scopes, ``.acquire()`` /
+   ``.release()`` pairs.  Lock expressions resolve through the enclosing
+   class (``self._lock``, including same-module bases), module globals,
+   tracked local assignments, then a repo-unique attribute-name match
+   (``sock._wlock`` → ``Socket._wlock``); an attribute name owned by
+   several classes becomes one conservative *family* entity (``*._lock``).
+3. **Edges.**  Acquiring B while holding A adds edge A→B.  An
+   intraprocedural call graph (self-calls, module functions, imported
+   names, repo-unique method names minus a builtin-shadowing blacklist)
+   propagates callee lock effects: calling ``f`` while holding A adds
+   A→every lock ``f`` may (transitively) acquire.  ``with`` over a call
+   (``with self._dbd.read():``) holds the callee's effects for the body.
+4. **Verdict.**  Cycles (incl. self-loops — re-acquiring a
+   non-reentrant entity through a call chain) are ``lock-cycle``
+   violations.  The acyclic graph is rendered as the documented lock
+   hierarchy in docs/ANALYSIS.md (``--write-docs`` regenerates; a tier-1
+   test keeps the doc in sync with the tree).
+
+Exemptions: ``# fabriclint: allow(lock-cycle) <reason>`` on an
+acquisition line removes the edges that line contributes (annotate the
+acquisition that intentionally inverts, with the protocol that makes it
+safe as the reason); ``allow(lock-unmodeled)`` on a construction site.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.fabricverify import (
+    REPO_ROOT,
+    Violation,
+    allowed,
+    scan_annotations,
+)
+
+PKG = "incubator_brpc_tpu"
+PKG_ROOT = os.path.join(REPO_ROOT, PKG)
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+# Method/function names never resolved by the unique-name fallback: they
+# shadow list/dict/set/str/file/socket/threading protocol names, so an
+# attribute call through an *unindexed* receiver (a file object, a deque)
+# would be mis-bound to whatever package class happens to define the name.
+_RESOLVE_BLACKLIST = {
+    "get", "pop", "append", "appendleft", "popleft", "remove", "add",
+    "discard", "clear", "update", "copy", "extend", "insert", "index",
+    "count", "sort", "reverse", "join", "split", "strip", "encode",
+    "decode", "format", "items", "keys", "values", "setdefault",
+    "read", "write", "close", "flush", "tell", "seek", "readline",
+    "send", "recv", "sendall", "connect", "bind", "listen", "accept",
+    "set", "is_set", "wait", "wait_for", "notify", "notify_all",
+    "acquire", "release", "locked", "start", "run", "stop", "put",
+    "empty", "full", "qsize", "cancel", "result", "done", "shutdown",
+    "fileno", "load", "store", "exchange", "search", "match", "group",
+}
+
+# local variable names treated as locks when nothing else resolves them
+# (the `for lk in wrappers: lk.acquire()` / `lock = ...` idioms)
+_LOCKISH_HINTS = ("lock", "mutex", "cond", "sem")
+
+
+def _is_lockish_name(name: str) -> bool:
+    low = name.lower()
+    return low in ("lk", "lck") or any(h in low for h in _LOCKISH_HINTS)
+
+
+@dataclass
+class LockEntity:
+    key: str                 # canonical id, e.g. "transport/sock.Socket._wlock"
+    kind: str                # class-attr | module-global | local | dict-key | family | site
+    path: str = ""
+    line: int = 0
+    alias_of: Optional[str] = None   # Condition(some_lock) wraps that entity
+
+    def __hash__(self):  # entities are interned by key
+        return hash(self.key)
+
+
+@dataclass
+class _ClassInfo:
+    name: str
+    bases: List[str]
+    methods: Dict[str, ast.AST] = field(default_factory=dict)
+    lock_attrs: Dict[str, str] = field(default_factory=dict)  # attr -> entity key
+    # attr -> class name it is an instance of (``self._dbd =
+    # DoublyBufferedData(...)`` / the AnnAssign annotation)
+    attr_instances: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class _ModuleIndex:
+    path: str
+    rel: str                  # repo-relative, no .py — "transport/sock"
+    tree: ast.Module = None
+    classes: Dict[str, _ClassInfo] = field(default_factory=dict)
+    functions: Dict[str, ast.AST] = field(default_factory=dict)  # qualname -> node
+    imports: Dict[str, str] = field(default_factory=dict)  # alias -> module/name
+    sites: List[Tuple[int, str]] = field(default_factory=list)  # (line, entity key)
+    unmodeled: List[int] = field(default_factory=list)
+    # module-level singletons: name -> ctor name (``span_store = SpanStore()``)
+    instance_raw: Dict[str, str] = field(default_factory=dict)
+    # lock attrs set on objects other than self (``server._hub_lock = Lock()``)
+    foreign_attrs: Dict[str, str] = field(default_factory=dict)  # attr -> key
+
+
+@dataclass
+class Analysis:
+    entities: Dict[str, LockEntity] = field(default_factory=dict)
+    # (holder, acquired) -> (path, line) of one witnessing acquisition
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = field(default_factory=dict)
+    modules: Dict[str, _ModuleIndex] = field(default_factory=dict)
+    violations: List[Violation] = field(default_factory=list)
+    # function id -> transitive set of entity keys it may acquire
+    effects: Dict[Tuple[str, str], Set[str]] = field(default_factory=dict)
+
+    def site_count(self) -> int:
+        return sum(len(m.sites) + len(m.unmodeled) for m in self.modules.values())
+
+
+def _rel_of(path: str) -> str:
+    rel = os.path.relpath(path, REPO_ROOT)
+    if rel.endswith(".py"):
+        rel = rel[:-3]
+    if rel.startswith(PKG + "/"):  # entity keys read better unprefixed
+        rel = rel[len(PKG) + 1:]
+    return rel
+
+
+def _canon(entity: Dict[str, LockEntity], key: str) -> str:
+    """Follow Condition→lock aliases to the canonical entity key."""
+    seen = set()
+    while key in entity and entity[key].alias_of and key not in seen:
+        seen.add(key)
+        key = entity[key].alias_of
+    return key
+
+
+def iter_pkg_files() -> List[str]:
+    out = []
+    for dirpath, dirnames, filenames in os.walk(PKG_ROOT):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for f in sorted(filenames):
+            if f.endswith(".py"):
+                out.append(os.path.join(dirpath, f))
+    return out
+
+
+def _attr_chain(node: ast.AST) -> List[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    if isinstance(node, ast.Call):
+        # call-on-call chains: global_timer_thread().schedule — keep the
+        # trailing attrs, mark the base as a call
+        inner = _attr_chain(node.func)
+        if inner:
+            parts.append("()" + inner[-1])
+            parts.reverse()
+            return parts
+    return []
+
+
+def _is_lock_ctor(node: ast.Call, idx: _ModuleIndex) -> Optional[str]:
+    """Return the ctor name if this Call constructs a threading primitive."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        if fn.value.id == "threading" and fn.attr in _LOCK_CTORS:
+            return fn.attr
+    if isinstance(fn, ast.Name) and fn.id in _LOCK_CTORS:
+        # only when imported from threading (``from threading import Lock``)
+        if idx.imports.get(fn.id, "").startswith("threading."):
+            return fn.id
+    return None
+
+
+# ---------------------------------------------------------------------------
+# pass A: index modules — classes, functions, lock construction sites
+# ---------------------------------------------------------------------------
+
+
+def _index_module(path: str, source: str, entities: Dict[str, LockEntity]):
+    idx = _ModuleIndex(path=path, rel=_rel_of(path))
+    try:
+        idx.tree = ast.parse(source)
+    except SyntaxError:
+        return idx
+    for node in ast.walk(idx.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                idx.imports[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                idx.imports[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def index_func(fn: ast.AST, qual: str) -> None:
+        idx.functions[qual] = fn
+        for st in ast.walk(fn):
+            if st is fn:
+                continue
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if idx.functions.get(f"{qual}.{st.name}") is None:
+                    index_func(st, f"{qual}.{st.name}")
+
+    for node in idx.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            index_func(node, node.name)
+        elif isinstance(node, ast.ClassDef):
+            ci = _ClassInfo(
+                name=node.name,
+                bases=[b.id for b in node.bases if isinstance(b, ast.Name)],
+            )
+            idx.classes[node.name] = ci
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    ci.methods[sub.name] = sub
+                    index_func(sub, f"{node.name}.{sub.name}")
+        elif isinstance(node, ast.Assign):
+            # module-level singleton: ``span_store = SpanStore()``
+            if (
+                len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Name)
+            ):
+                idx.instance_raw[node.targets[0].id] = node.value.func.id
+
+    # self-attr instances: ``self._dbd = DoublyBufferedData(...)`` (or the
+    # AnnAssign annotation) — lets ``self._dbd.read()`` resolve precisely
+    for ci in idx.classes.values():
+        for m in ci.methods.values():
+            for st in ast.walk(m):
+                tgt = val = None
+                if isinstance(st, ast.Assign) and len(st.targets) == 1:
+                    tgt, val = st.targets[0], st.value
+                elif isinstance(st, ast.AnnAssign):
+                    tgt, val = st.target, st.value
+                if not (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    continue
+                cname = None
+                if isinstance(st, ast.AnnAssign):
+                    a = st.annotation
+                    if isinstance(a, ast.Subscript):
+                        a = a.value
+                    if isinstance(a, ast.Name):
+                        cname = a.id
+                if (
+                    cname is None
+                    and isinstance(val, ast.Call)
+                    and isinstance(val.func, ast.Name)
+                ):
+                    cname = val.func.id
+                if cname is not None and tgt.attr not in ci.attr_instances:
+                    ci.attr_instances[tgt.attr] = cname
+
+    _bind_ctor_sites(idx, entities)
+    return idx
+
+
+def _bind_ctor_sites(idx: _ModuleIndex, entities: Dict[str, LockEntity]) -> None:
+    """Bind every lock-primitive construction to a named entity."""
+    if idx.tree is None:
+        return
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(idx.tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+
+    def enclosing(node, kinds):
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, kinds):
+                return cur
+            cur = parents.get(cur)
+        return None
+
+    def new_entity(key, kind, line, alias_of=None):
+        if key not in entities:
+            entities[key] = LockEntity(
+                key=key, kind=kind, path=idx.path, line=line, alias_of=alias_of
+            )
+        return key
+
+    deferred_aliases: List[Tuple[str, str, ast.Call]] = []
+
+    for node in ast.walk(idx.tree):
+        if not (isinstance(node, ast.Call) and _is_lock_ctor(node, idx)):
+            continue
+        line = node.lineno
+        ctor = _is_lock_ctor(node, idx)
+        assign = enclosing(node, (ast.Assign, ast.AnnAssign))
+        func = enclosing(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        cls = enclosing(node, ast.ClassDef)
+        key = None
+        kind = "site"
+        if assign is not None:
+            targets = assign.targets if isinstance(assign, ast.Assign) else [assign.target]
+            tgt = targets[0] if targets else None
+            if (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+                and cls is not None
+            ):
+                key = f"{idx.rel}.{cls.name}.{tgt.attr}"
+                kind = "class-attr"
+                idx.classes[cls.name].lock_attrs[tgt.attr] = key
+            elif isinstance(tgt, ast.Attribute) and isinstance(
+                tgt.value, ast.Name
+            ):
+                # lock pinned on a foreign object (``server._hub_lock = …``)
+                key = f"{idx.rel}.<{tgt.value.id}>.{tgt.attr}"
+                kind = "foreign-attr"
+                idx.foreign_attrs[tgt.attr] = key
+            elif isinstance(tgt, ast.Name) and func is None:
+                key = f"{idx.rel}.{tgt.id}"
+                kind = "module-global"
+            elif isinstance(tgt, ast.Name) and func is not None:
+                key = f"{idx.rel}.{func.name}.<{tgt.id}>"
+                kind = "local"
+            elif isinstance(tgt, ast.Subscript):
+                base = _attr_chain(tgt.value)
+                key = f"{idx.rel}.{'.'.join(base) or 'map'}[*]"
+                kind = "dict-key"
+        if key is None:
+            # ctor as an argument — e.g. ctx.setdefault("_fifo_lock", Lock())
+            call = enclosing(node, ast.Call)
+            if (
+                call is not None
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr == "setdefault"
+                and call.args
+                and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, str)
+            ):
+                key = f"{idx.rel}[{call.args[0].value}]"
+                kind = "dict-key"
+        if key is None:
+            idx.unmodeled.append(line)
+            continue
+        new_entity(key, kind, line)
+        idx.sites.append((line, key))
+        # Condition(self._lock) wraps an existing lock: same entity
+        if ctor == "Condition" and node.args:
+            arg = node.args[0]
+            if (
+                isinstance(arg, ast.Attribute)
+                and isinstance(arg.value, ast.Name)
+                and arg.value.id == "self"
+                and cls is not None
+            ):
+                deferred_aliases.append((key, f"{idx.rel}.{cls.name}.{arg.attr}", node))
+
+    for key, target, _node in deferred_aliases:
+        if target in entities and target != key:
+            entities[key].alias_of = target
+
+
+# ---------------------------------------------------------------------------
+# pass B: per-function summaries (acquisitions + calls, with held sets)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _FuncSummary:
+    fid: Tuple[str, str]              # (module rel, qualname)
+    acquires: List[Tuple[str, int, Tuple[str, ...]]] = field(default_factory=list)
+    calls: List[Tuple[Tuple[Tuple[str, str], ...], Tuple[str, ...], int]] = field(
+        default_factory=list
+    )
+    # lock entities this function RETURNS (``def _key_lock(...): return lk``):
+    # ``with f():`` then holds the returned lock, not f's transient internals
+    returns: Set[str] = field(default_factory=set)
+    is_gen: bool = False              # generators (@contextmanager) hold
+    #                                   their internal locks across the yield
+
+
+class _Resolver:
+    """Cross-module name resolution tables."""
+
+    def __init__(self, modules: Dict[str, _ModuleIndex], entities):
+        self.modules = modules
+        self.entities = entities
+        # lock attr name -> [entity keys] across every class
+        self.attr_map: Dict[str, List[str]] = {}
+        # method name -> [(module rel, qualname)]
+        self.method_map: Dict[str, List[Tuple[str, str]]] = {}
+        # module function name -> [(module rel, qualname)]
+        self.func_map: Dict[str, List[Tuple[str, str]]] = {}
+        # class name -> [(module rel, _ClassInfo)]
+        self.class_map: Dict[str, List[Tuple[str, _ClassInfo]]] = {}
+        self.by_rel: Dict[str, _ModuleIndex] = {}
+        for m in modules.values():
+            self.by_rel[m.rel] = m
+            for ci in m.classes.values():
+                self.class_map.setdefault(ci.name, []).append((m.rel, ci))
+                for attr, key in ci.lock_attrs.items():
+                    self.attr_map.setdefault(attr, []).append(key)
+                for name in ci.methods:
+                    self.method_map.setdefault(name, []).append(
+                        (m.rel, f"{ci.name}.{name}")
+                    )
+            for attr, key in m.foreign_attrs.items():
+                self.attr_map.setdefault(attr, []).append(key)
+            for qual in m.functions:
+                if "." not in qual:
+                    self.func_map.setdefault(qual, []).append((m.rel, qual))
+
+    def _class_of(self, mod: _ModuleIndex, cname: str):
+        """(module rel, _ClassInfo) for a class name seen in ``mod``."""
+        if cname in mod.classes:
+            return (mod.rel, mod.classes[cname])
+        target = mod.imports.get(cname, "")
+        if target.startswith(PKG + "."):
+            last = target.rsplit(".", 1)[-1]
+            for rel, ci in self.class_map.get(last, ()):
+                return (rel, ci)
+        cands = self.class_map.get(cname, ())
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    def _instance_class(self, mod: _ModuleIndex, name: str):
+        """Resolve a module-level singleton name to its class."""
+        ctor = mod.instance_raw.get(name)
+        if ctor is not None:
+            return self._class_of(mod, ctor)
+        target = mod.imports.get(name, "")
+        if target.startswith(PKG + "."):
+            # from pkg.mod import breaker_registry — chase the singleton
+            # assignment in its home module
+            mod_path, last = target.rsplit(".", 1)
+            rel = mod_path[len(PKG) + 1:].replace(".", "/")
+            home = self.by_rel.get(rel) or self.by_rel.get(f"{rel}/__init__")
+            if home is not None and last in home.instance_raw:
+                return self._class_of(home, home.instance_raw[last])
+        return None
+
+    def _method_on(self, owner, name: str):
+        """[(module rel, qualname)] for method ``name`` on class ``owner``
+        (searching same-module bases)."""
+        rel, ci = owner
+        mod = self.by_rel.get(rel)
+        seen = set()
+        while ci is not None and ci.name not in seen:
+            seen.add(ci.name)
+            if name in ci.methods:
+                return [(rel, f"{ci.name}.{name}")]
+            nxt = None
+            if mod is not None:
+                for b in ci.bases:
+                    if b in mod.classes:
+                        nxt = mod.classes[b]
+                        break
+            ci = nxt
+        return []
+
+    def family(self, attr: str) -> str:
+        key = f"*.{attr}"
+        if key not in self.entities:
+            self.entities[key] = LockEntity(key=key, kind="family")
+        return key
+
+    def resolve_lock_attr(self, attr: str) -> Optional[str]:
+        owners = self.attr_map.get(attr)
+        if not owners:
+            return None
+        if len(set(owners)) == 1:
+            return owners[0]
+        return self.family(attr)
+
+    def resolve_call(
+        self, node: ast.Call, mod: _ModuleIndex, cls: Optional[_ClassInfo]
+    ) -> List[Tuple[str, str]]:
+        fn = node.func
+        if isinstance(fn, ast.Name):
+            name = fn.id
+            # local class instantiation -> __init__
+            if name in mod.classes and "__init__" in mod.classes[name].methods:
+                return [(mod.rel, f"{name}.__init__")]
+            if name in mod.functions and "." not in name:
+                return [(mod.rel, name)]
+            target = mod.imports.get(name, "")
+            if target.startswith(PKG + "."):
+                # from pkg.mod import f  -> resolve f in that module
+                parts = target[len(PKG) + 1:].split(".")
+                fname = parts[-1]
+                cands = [
+                    c for c in self.func_map.get(fname, ())
+                ] + [c for c in self.method_map.get(fname, ())]
+                if len(cands) == 1:
+                    return cands
+            # repo-unique module function by bare name (imports move around)
+            cands = self.func_map.get(name, ())
+            if len(cands) == 1:
+                return list(cands)
+            return []
+        if isinstance(fn, ast.Attribute):
+            name = fn.attr
+            base = fn.value
+            if isinstance(base, ast.Name) and base.id == "self" and cls is not None:
+                got = self._method_on((mod.rel, cls), name)
+                if got:
+                    return got
+                # typed self-attr? (``self.<a>.<m>()`` with one level)
+            if isinstance(base, ast.Name) and base.id != "self":
+                owner = self._instance_class(mod, base.id)
+                if owner is not None:
+                    got = self._method_on(owner, name)
+                    if got:
+                        return got
+            if (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"
+                and cls is not None
+            ):
+                # ``self._dbd.read()`` via the attr's recorded instance class
+                # (searching same-module bases — _SnapshotLB owns _dbd)
+                cname = None
+                info, seen = cls, set()
+                while info is not None and info.name not in seen:
+                    seen.add(info.name)
+                    cname = info.attr_instances.get(base.attr)
+                    if cname is not None:
+                        break
+                    info = next(
+                        (mod.classes[b] for b in info.bases if b in mod.classes),
+                        None,
+                    )
+                if cname is not None:
+                    owner = self._class_of(mod, cname)
+                    if owner is not None:
+                        got = self._method_on(owner, name)
+                        if got:
+                            return got
+            if name in _RESOLVE_BLACKLIST:
+                return []
+            cands = list(self.method_map.get(name, ())) + list(
+                self.func_map.get(name, ())
+            )
+            if len(cands) == 1:
+                return cands
+            return []
+        return []
+
+
+class _FuncVisitor:
+    """Walk one function body tracking the held-lock stack."""
+
+    def __init__(self, summary, mod, cls, resolver, ann):
+        self.s = summary
+        self.mod = mod
+        self.cls = cls
+        self.r = resolver
+        self.ann = ann
+        self.held: List[str] = []
+        self.locals: Dict[str, str] = {}  # local var -> entity key
+
+    # -- lock expression resolution ----------------------------------------
+
+    def resolve_lock(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            if expr.id in self.locals:
+                return self.locals[expr.id]
+            mg = f"{self.mod.rel}.{expr.id}"
+            if mg in self.r.entities:
+                return mg
+            if _is_lockish_name(expr.id):
+                return self._local_entity(expr.id)
+            return None
+        chain = _attr_chain(expr)
+        if not chain:
+            return None
+        attr = chain[-1]
+        if chain[0] == "self" and len(chain) == 2 and self.cls is not None:
+            info = self.cls
+            seen = set()
+            while info is not None and info.name not in seen:
+                seen.add(info.name)
+                if attr in info.lock_attrs:
+                    return info.lock_attrs[attr]
+                info = next(
+                    (
+                        self.mod.classes[b]
+                        for b in info.bases
+                        if b in self.mod.classes
+                    ),
+                    None,
+                )
+            # self attr that is not a known lock of this class: fall through
+        resolved = self.r.resolve_lock_attr(attr)
+        if resolved is not None:
+            return resolved
+        if _is_lockish_name(attr):
+            return self.r.family(attr)
+        return None
+
+    def _local_entity(self, name: str) -> str:
+        key = f"{self.mod.rel}.{self.s.fid[1]}.<{name}>"
+        if key not in self.r.entities:
+            self.r.entities[key] = LockEntity(key=key, kind="local")
+        self.locals[name] = key
+        return key
+
+    # -- events -------------------------------------------------------------
+
+    def _acquire(self, key: str, line: int) -> None:
+        key = _canon(self.r.entities, key)
+        self.s.acquires.append((key, line, tuple(self.held)))
+        self.held.append(key)
+
+    def _release(self, key: str) -> None:
+        key = _canon(self.r.entities, key)
+        for i in range(len(self.held) - 1, -1, -1):
+            if self.held[i] == key:
+                del self.held[i]
+                return
+
+    def _record_call(self, node: ast.Call) -> None:
+        cands = self.r.resolve_call(node, self.mod, self.cls)
+        if cands and self.held:
+            self.s.calls.append((tuple(cands), tuple(self.held), node.lineno))
+
+    # -- the walk -----------------------------------------------------------
+
+    def visit_body(self, stmts) -> None:
+        for st in stmts:
+            self.visit(st)
+
+    def visit(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return  # nested defs analyzed as their own functions
+        if isinstance(node, ast.With):
+            self._visit_with(node)
+            return
+        if isinstance(node, ast.Assign):
+            self._visit_assign(node)
+            return
+        if isinstance(node, ast.Call):
+            self._visit_call(node)
+            return
+        if isinstance(node, (ast.Yield, ast.YieldFrom)):
+            self.s.is_gen = True
+        if isinstance(node, ast.Return) and node.value is not None:
+            key = None
+            if isinstance(node.value, (ast.Attribute, ast.Name)):
+                key = self.resolve_lock_no_synth(node.value)
+            if key is not None:
+                self.s.returns.add(_canon(self.r.entities, key))
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+
+    def _visit_assign(self, node: ast.Assign) -> None:
+        # track `x = <lock expr>` so later `with x:` resolves
+        self.visit(node.value)
+        name_tgt = next(
+            (t for t in node.targets if isinstance(t, ast.Name)), None
+        )
+        if name_tgt is not None:
+            key = None
+            if isinstance(node.value, (ast.Attribute, ast.Name)):
+                key = self.resolve_lock_no_synth(node.value)
+            elif isinstance(node.value, ast.Call) and _is_lock_ctor(
+                node.value, self.mod
+            ):
+                key = self.resolve_lock(name_tgt)
+            if key is not None:
+                self.locals[name_tgt.id] = _canon(self.r.entities, key)
+
+    def resolve_lock_no_synth(self, expr) -> Optional[str]:
+        """Resolve without inventing local/family entities (assignment
+        tracking must not turn every `x = self.foo` into a lock)."""
+        if isinstance(expr, ast.Name):
+            if expr.id in self.locals:
+                return self.locals[expr.id]
+            mg = f"{self.mod.rel}.{expr.id}"
+            return mg if mg in self.r.entities else None
+        chain = _attr_chain(expr)
+        if not chain:
+            return None
+        attr = chain[-1]
+        if chain[0] == "self" and len(chain) == 2 and self.cls is not None:
+            if attr in self.cls.lock_attrs:
+                return self.cls.lock_attrs[attr]
+        owners = self.r.attr_map.get(attr)
+        if owners and len(set(owners)) == 1:
+            return owners[0]
+        return None
+
+    def _visit_with(self, node: ast.With) -> None:
+        acquired: List[str] = []
+        call_effect_holds: List[str] = []
+        for item in node.items:
+            ctx = item.context_expr
+            key = None
+            if isinstance(ctx, (ast.Attribute, ast.Name)):
+                key = self.resolve_lock(ctx)
+            if key is not None:
+                # an allow(lock-cycle) on this line removes the edges the
+                # acquisition would contribute (both directions)
+                if not allowed(self.ann, "lock-cycle", node.lineno):
+                    self._acquire(key, node.lineno)
+                    acquired.append(key)
+            elif isinstance(ctx, ast.Call):
+                # `with self._dbd.read():` — hold the callee's lock effects
+                # for the body (context-manager approximation) and record
+                # the call itself
+                self.visit(ctx)
+                cands = self.r.resolve_call(ctx, self.mod, self.cls)
+                if cands:
+                    if self.held:
+                        self.s.calls.append(
+                            (tuple(cands), tuple(self.held), ctx.lineno)
+                        )
+                    marker = f"@cm:{ctx.lineno}:" + ",".join(
+                        f"{m}:{q}" for m, q in cands
+                    )
+                    self.held.append(marker)
+                    call_effect_holds.append(marker)
+            else:
+                self.visit(ctx)
+        self.visit_body(node.body)
+        for key in reversed(acquired):
+            self._release(key)
+        for marker in reversed(call_effect_holds):
+            if marker in self.held:
+                self.held.remove(marker)
+
+    def _visit_call(self, node: ast.Call) -> None:
+        chain = _attr_chain(node.func)
+        if chain and chain[-1] == "acquire" and len(chain) > 1:
+            recv = node.func.value
+            key = self.resolve_lock(recv) if isinstance(
+                recv, (ast.Attribute, ast.Name)
+            ) else None
+            if key is not None:
+                if not allowed(self.ann, "lock-cycle", node.lineno):
+                    self._acquire(key, node.lineno)
+                for a in node.args:
+                    self.visit(a)
+                return
+        if chain and chain[-1] == "release" and len(chain) > 1:
+            recv = node.func.value
+            key = None
+            if isinstance(recv, (ast.Attribute, ast.Name)):
+                key = self.resolve_lock_no_synth(recv) or (
+                    self.resolve_lock(recv)
+                )
+            if key is not None:
+                self._release(key)
+                return
+        self._record_call(node)
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+
+
+# ---------------------------------------------------------------------------
+# pass C: effect propagation + edge construction + cycles
+# ---------------------------------------------------------------------------
+
+
+def analyze(paths: Optional[List[str]] = None) -> Analysis:
+    an = Analysis()
+    files = paths if paths is not None else iter_pkg_files()
+    sources: Dict[str, str] = {}
+    for path in files:
+        with open(path, "r") as fh:
+            sources[path] = fh.read()
+        an.modules[path] = _index_module(path, sources[path], an.entities)
+
+    resolver = _Resolver(an.modules, an.entities)
+
+    summaries: Dict[Tuple[str, str], _FuncSummary] = {}
+    anns = {}
+    for path, mod in an.modules.items():
+        if mod.tree is None:
+            continue
+        ann = scan_annotations(path, sources[path])
+        anns[path] = ann
+        for qual, fn in mod.functions.items():
+            cls = None
+            if "." in qual:
+                cname = qual.split(".")[0]
+                cls = mod.classes.get(cname)
+            s = _FuncSummary(fid=(mod.rel, qual))
+            v = _FuncVisitor(s, mod, cls, resolver, ann)
+            v.visit_body(fn.body)
+            summaries[s.fid] = s
+
+    # transitive lock effects per function (fixed point)
+    effects: Dict[Tuple[str, str], Set[str]] = {
+        fid: {a for a, _l, _h in s.acquires} for fid, s in summaries.items()
+    }
+    changed = True
+    while changed:
+        changed = False
+        for fid, s in summaries.items():
+            cur = effects[fid]
+            before = len(cur)
+            for cands, _held, _line in s.calls:
+                for c in cands:
+                    cur |= effects.get(c, set())
+            if len(cur) != before:
+                changed = True
+    an.effects = effects
+
+    def expand_held(held: Tuple[str, ...]) -> List[str]:
+        out: List[str] = []
+        for h in held:
+            if h.startswith("@cm:"):
+                for part in h.split(":", 2)[2].split(","):
+                    m, q = part.split(":", 1)
+                    s = summaries.get((m, q))
+                    if s is not None and s.returns and not s.is_gen:
+                        # ``with f():`` over a lock-returning helper holds
+                        # the RETURNED lock; f's internal acquisitions are
+                        # transient (covered by the call edge at the call)
+                        out.extend(s.returns)
+                    else:
+                        out.extend(effects.get((m, q), ()))
+            else:
+                out.append(h)
+        return out
+
+    def add_edge(a: str, b: str, path: str, line: int) -> None:
+        if a == b and an.entities.get(a, LockEntity(a, "")).kind == "family":
+            # two different locks sharing an ambiguous family name are not
+            # evidence of re-acquisition — only precise self-loops count
+            return
+        an.edges.setdefault((a, b), (path, line))
+
+    for fid, s in summaries.items():
+        mod_path = next(
+            p for p, m in an.modules.items() if m.rel == fid[0]
+        )
+        for acquired, line, held in s.acquires:
+            for h in expand_held(held):
+                add_edge(h, acquired, mod_path, line)
+        for cands, held, line in s.calls:
+            flat = expand_held(held)
+            if not flat:
+                continue
+            callee_locks: Set[str] = set()
+            for c in cands:
+                callee_locks |= effects.get(c, set())
+            for h in flat:
+                for l in callee_locks:
+                    add_edge(h, l, mod_path, line)
+
+    # unmodeled construction sites
+    for path, mod in an.modules.items():
+        ann = anns.get(path)
+        for line in mod.unmodeled:
+            if ann is None or not allowed(ann, "lock-unmodeled", line):
+                an.violations.append(
+                    Violation(
+                        "lock-unmodeled", path, line,
+                        "lock primitive constructed here could not be bound "
+                        "to a named entity — name it (assign to an attribute "
+                        "or variable) or allow(lock-unmodeled) with a reason",
+                    )
+                )
+
+    _find_cycles(an)
+    return an
+
+
+def _find_cycles(an: Analysis) -> None:
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in an.edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    # iterative Tarjan SCC
+    index_counter = [0]
+    stack: List[str] = []
+    lowlink: Dict[str, int] = {}
+    index: Dict[str, int] = {}
+    on_stack: Dict[str, bool] = {}
+    sccs: List[List[str]] = []
+
+    for root in sorted(graph):
+        if root in index:
+            continue
+        work = [(root, iter(sorted(graph[root])))]
+        index[root] = lowlink[root] = index_counter[0]
+        index_counter[0] += 1
+        stack.append(root)
+        on_stack[root] = True
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for succ in it:
+                if succ not in index:
+                    index[succ] = lowlink[succ] = index_counter[0]
+                    index_counter[0] += 1
+                    stack.append(succ)
+                    on_stack[succ] = True
+                    work.append((succ, iter(sorted(graph[succ]))))
+                    advanced = True
+                    break
+                elif on_stack.get(succ):
+                    lowlink[node] = min(lowlink[node], index[succ])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                lowlink[parent] = min(lowlink[parent], lowlink[node])
+            if lowlink[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack[w] = False
+                    comp.append(w)
+                    if w == node:
+                        break
+                sccs.append(comp)
+
+    for comp in sccs:
+        cyclic = len(comp) > 1 or (
+            len(comp) == 1 and comp[0] in graph.get(comp[0], ())
+        )
+        if not cyclic:
+            continue
+        comp = sorted(comp)
+        # witness: one edge inside the SCC, for file:line anchoring
+        witness = None
+        for (a, b), site in sorted(an.edges.items()):
+            if a in comp and b in comp:
+                witness = site
+                break
+        path, line = witness if witness else ("<graph>", 1)
+        an.violations.append(
+            Violation(
+                "lock-cycle", path, line,
+                "lock-ordering cycle: " + " -> ".join(comp + [comp[0]])
+                + " (two code paths acquire these locks in opposite "
+                "orders; break the cycle or allow(lock-cycle) the "
+                "inverting acquisition with the protocol that makes it "
+                "safe)",
+            )
+        )
+
+
+# ---------------------------------------------------------------------------
+# public surface
+# ---------------------------------------------------------------------------
+
+
+def check(paths: Optional[List[str]] = None) -> List[Violation]:
+    an = analyze(paths)
+    return list(an.violations)
+
+
+DOC_BEGIN = "<!-- fabricverify:lock-hierarchy:begin -->"
+DOC_END = "<!-- fabricverify:lock-hierarchy:end -->"
+
+
+def render_hierarchy(an: Optional[Analysis] = None) -> str:
+    """The acyclic lock-ordering graph as the documented hierarchy:
+    topological levels (level 0 may be held while acquiring any deeper
+    level; never the reverse), one line per ordered entity with its
+    outgoing order edges, then the leaf locks that never nest."""
+
+    if an is None:
+        an = analyze()
+    succ: Dict[str, Set[str]] = {}
+    nodes: Set[str] = set()
+    for (a, b) in an.edges:
+        succ.setdefault(a, set()).add(b)
+        nodes.add(a)
+        nodes.add(b)
+    indeg = {n: 0 for n in nodes}
+    for a, bs in succ.items():
+        for b in bs:
+            indeg[b] += 1
+    # Kahn levels (cycles, if any, are reported separately and excluded)
+    levels: List[List[str]] = []
+    remaining = dict(indeg)
+    frontier = sorted(n for n, d in remaining.items() if d == 0)
+    seen: Set[str] = set()
+    while frontier:
+        levels.append(frontier)
+        seen |= set(frontier)
+        nxt: Dict[str, int] = {}
+        for n in frontier:
+            for b in succ.get(n, ()):
+                remaining[b] -= 1
+        frontier = sorted(
+            n for n, d in remaining.items() if d == 0 and n not in seen
+        )
+    lines = [
+        "Generated by `python -m tools.fabricverify --write-docs` — do not",
+        "edit by hand; a tier-1 test keeps this section in sync with the",
+        "tree.  `A -> B` means some code path acquires B while holding A,",
+        "so B must never be held while acquiring A.  Levels are a valid",
+        "acquisition order: take locks strictly downward.",
+        "",
+        f"- lock construction sites modeled: **{an.site_count()}**",
+        f"- lock entities: **{len(an.entities)}**"
+        f" ({sum(1 for e in an.entities.values() if e.kind == 'family')}"
+        " ambiguous families)",
+        f"- order edges: **{len(an.edges)}**",
+        "",
+    ]
+    for i, level in enumerate(levels):
+        lines.append(f"**Level {i}**")
+        lines.append("")
+        for n in level:
+            outs = sorted(succ.get(n, ()))
+            if outs:
+                lines.append(f"- `{n}` → " + ", ".join(f"`{o}`" for o in outs))
+            else:
+                lines.append(f"- `{n}`")
+        lines.append("")
+    solo = sorted(
+        k for k, e in an.entities.items()
+        if k not in nodes and e.alias_of is None and e.kind != "family"
+    )
+    lines.append(
+        f"**Unordered** ({len(solo)} entities never nested with another "
+        "lock; any order is safe today — an edge appearing here in a "
+        "future run means new nesting was introduced):"
+    )
+    lines.append("")
+    lines.append(", ".join(f"`{s}`" for s in solo))
+    lines.append("")
+    return "\n".join(lines)
+
+
+def write_docs(doc_path: Optional[str] = None) -> bool:
+    """Regenerate the lock-hierarchy section of docs/ANALYSIS.md between
+    the begin/end markers. Returns True if the file changed."""
+
+    if doc_path is None:
+        doc_path = os.path.join(REPO_ROOT, "docs", "ANALYSIS.md")
+    with open(doc_path, "r") as fh:
+        text = fh.read()
+    body = render_hierarchy()
+    begin = text.index(DOC_BEGIN) + len(DOC_BEGIN)
+    end = text.index(DOC_END)
+    new = text[:begin] + "\n" + body + text[end:]
+    if new != text:
+        with open(doc_path, "w") as fh:
+            fh.write(new)
+        return True
+    return False
+
+
+def documented_hierarchy(doc_path: Optional[str] = None) -> str:
+    """The committed hierarchy section (between the markers)."""
+    if doc_path is None:
+        doc_path = os.path.join(REPO_ROOT, "docs", "ANALYSIS.md")
+    with open(doc_path, "r") as fh:
+        text = fh.read()
+    begin = text.index(DOC_BEGIN) + len(DOC_BEGIN)
+    end = text.index(DOC_END)
+    return text[begin:end].strip()

@@ -1,0 +1,222 @@
+"""Explicit-state model checker — small-scope exhaustive exploration.
+
+The repo's multi-party protocols (the mc_dispatch session dance, the
+circuit breaker's trip/revive machine) are proven in tests on a handful
+of *happy* interleavings; the reference substitutes years of production
+soak.  This checker substitutes *exhaustion at small scope*: every
+reachable state of a bounded model (3 parties, 2 steps, ≤1 drop, ≤1
+duplicate — thousands of states) is visited, and three property classes
+are asserted on ALL of them:
+
+- **no stuck state** (``model-stuck``): every reachable non-terminal
+  state has at least one enabled action.  A deadlock on a path with no
+  environment drops is a protocol bug, full stop.
+- **safety** (``model-unsafe``): the model's ``invariant`` holds in
+  every reachable state and ``terminal_ok`` in every terminal one
+  (close convergence, monotone join, duration caps, durable-recovery
+  reset).
+- **revivability** (``model-unrevivable``): for models with a goal set
+  (the breaker's CLOSED), the goal is reachable from EVERY reachable
+  state — no one-way door into permanent isolation.
+
+Violations are anchored at the *modeled source file* (the protocol the
+model extracts), with the counterexample trace in the message — the
+checker's red is a statement about the protocol as implemented, and the
+fix belongs there (or, if the model itself drifted from the code, in
+models.py; either way the tree stays red until they agree).
+
+Standalone: ``python -m tools.fabricverify.modelcheck`` (the
+``make verify-models`` entry) prints per-model state counts — the
+explored-space size is part of the test log so a collapsed exploration
+(a model accidentally gutted to three states) is visible in review.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from tools.fabricverify import REPO_ROOT, Violation
+from tools.fabricverify.models import BreakerModel, SessionModel
+
+_MAX_STATES = 500_000  # runaway-model backstop, far above the bounded scopes
+
+
+@dataclass
+class Result:
+    model_name: str
+    states: int = 0
+    transitions: int = 0
+    violations: List[Violation] = field(default_factory=list)
+    # state -> (predecessor state, action label) for counterexample traces
+    parent: Dict[tuple, Tuple[Optional[tuple], str]] = field(
+        default_factory=dict
+    )
+
+    def trace(self, state: tuple, limit: int = 12) -> str:
+        labels: List[str] = []
+        cur = state
+        while cur in self.parent and len(labels) < 64:
+            prev, label = self.parent[cur]
+            if prev is None:
+                break
+            labels.append(label)
+            cur = prev
+        labels.reverse()
+        if len(labels) > limit:
+            labels = labels[:3] + [f"... {len(labels) - 6} steps ..."] + labels[-3:]
+        return " -> ".join(labels) if labels else "<initial>"
+
+
+def _anchor(model) -> Tuple[str, int]:
+    src = getattr(model, "source", None)
+    if src:
+        return os.path.join(REPO_ROOT, src), 1
+    import tools.fabricverify.models as m
+
+    return m.__file__, 1
+
+
+def explore(model, max_states: int = _MAX_STATES) -> Result:
+    """BFS the full reachable space, checking properties as states are
+    discovered (the counterexample is then a shortest path)."""
+
+    res = Result(model_name=model.name)
+    path, line = _anchor(model)
+    init = model.initial_state()
+    frontier = [init]
+    res.parent[init] = (None, "")
+    seen = {init}
+
+    def report(rule: str, state: tuple, msg: str) -> None:
+        res.violations.append(
+            Violation(
+                rule, path, line,
+                f"[{model.name}] {msg} (trace: {res.trace(state)})",
+            )
+        )
+
+    while frontier:
+        nxt: List[tuple] = []
+        for s in frontier:
+            res.states += 1
+            bad = model.invariant(s)
+            if bad:
+                report("model-unsafe", s, bad)
+                continue  # don't expand past a safety violation
+            terminal = model.is_terminal(s)
+            acts = model.actions(s)
+            if terminal:
+                tbad = model.terminal_ok(s)
+                if tbad:
+                    report("model-unsafe", s, tbad)
+                continue
+            if not acts:
+                report(
+                    "model-stuck", s,
+                    "reachable state has no enabled action — the protocol "
+                    "is deadlocked with no environment fault pending",
+                )
+                continue
+            for label, s2 in acts:
+                res.transitions += 1
+                if s2 not in seen:
+                    seen.add(s2)
+                    res.parent[s2] = (s, label)
+                    nxt.append(s2)
+            if res.states + len(nxt) > max_states:
+                report(
+                    "model-unsafe", s,
+                    f"exploration exceeded {max_states} states — the model "
+                    "scope is unbounded; tighten its constants",
+                )
+                return res
+        frontier = nxt
+
+    # reachability (revivability): the goal set must be reachable from
+    # every reachable state.  Computed as a backward fixed point over the
+    # forward edges re-derived per state (models are cheap).
+    if hasattr(model, "is_goal") and not res.violations:
+        can_reach = {s for s in seen if model.is_goal(s)}
+        changed = True
+        succs = {
+            s: [s2 for _l, s2 in model.actions(s)]
+            for s in seen
+            if not model.is_terminal(s)
+        }
+        while changed:
+            changed = False
+            for s, outs in succs.items():
+                if s not in can_reach and any(o in can_reach for o in outs):
+                    can_reach.add(s)
+                    changed = True
+        dead = sorted(seen - can_reach, key=lambda s: res.trace(s))
+        if dead:
+            report(
+                "model-unrevivable", dead[0],
+                f"{len(dead)} reachable state(s) cannot reach the goal "
+                "(recovery) set — a one-way door into permanent "
+                "isolation",
+            )
+    return res
+
+
+def default_models() -> List[object]:
+    """The shipped scope: the acceptance-criterion 3-party/2-step session
+    space (with a floor spread that exercises the max-join) plus the full
+    breaker machine."""
+    return [
+        SessionModel(n_parties=3, steps=2, floors=(0, 1, 3)),
+        BreakerModel(),
+    ]
+
+
+def check(models: Optional[List[object]] = None) -> List[Violation]:
+    out: List[Violation] = []
+    for model in models if models is not None else default_models():
+        out.extend(explore(model).violations)
+    return out
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="fabricverify.modelcheck")
+    ap.add_argument(
+        "--parties", type=int, default=3,
+        help="session model party count (default 3)",
+    )
+    ap.add_argument(
+        "--steps", type=int, default=2,
+        help="session model proposed step count (default 2)",
+    )
+    args = ap.parse_args(argv)
+    models = [
+        SessionModel(
+            n_parties=args.parties,
+            steps=args.steps,
+            floors=tuple(
+                min(i * 2, args.steps + 1) for i in range(args.parties)
+            ),
+        ),
+        BreakerModel(),
+    ]
+    rc = 0
+    for model in models:
+        res = explore(model)
+        status = "ok" if not res.violations else "FAIL"
+        print(
+            f"[{status}] {model.name}: {res.states} states, "
+            f"{res.transitions} transitions explored"
+        )
+        for v in res.violations:
+            print(f"  {v}")
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,378 @@
+"""Extracted protocol models for the explicit-state checker.
+
+A model is a hand-extracted, exhaustively-explorable twin of a protocol
+implemented in the package.  Extraction rules (see docs/ANALYSIS.md,
+"writing a model for the checker"):
+
+- State is a flat immutable tuple — every field that influences a
+  branch in the real code, nothing that doesn't (payload bytes,
+  latencies and ids are abstracted away; *counts and phases* stay).
+- Every nondeterministic choice the real system faces (message
+  delivery order, drops, duplicates, timer firings) is an explicit
+  ``actions()`` branch, so the explorer visits ALL interleavings that
+  the bounded scope admits — the substitute for production soak.
+- Known-bad variants are constructor flags (``drop_close_echo=True``),
+  NOT separate models: the meta-tests instantiate the mutation and
+  assert the checker flips red, proving the property actually binds.
+
+Two models ship:
+
+- :class:`SessionModel` — the mc_dispatch N-party session protocol
+  (parallel/mc_dispatch.py): accept fan-out + barrier, the monotone
+  ``final = max(proposed, all targets)`` join, run fan-out, and the
+  convergent close barrier where every party echoes ``final``.  The
+  environment may reorder (inherent — delivery picks any in-flight
+  message), drop (≤ ``max_drops``) and duplicate (≤ ``max_dups``)
+  messages.  The proposer may time out ONLY when something was actually
+  dropped — so a deadlock on a drop-free path is a protocol bug, not an
+  abstracted timeout.
+- :class:`BreakerModel` — the circuit-breaker state machine
+  (rpc/circuit_breaker.py + the LB isolation dance in lb/__init__.py):
+  closed → trip → isolated → (elapse | early socket revive) →
+  half_open → (window successes → closed with duration reset) |
+  (error → re-trip with doubled, capped duration).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+# ---------------------------------------------------------------------------
+# mc_dispatch session protocol
+# ---------------------------------------------------------------------------
+
+# party phases
+P_IDLE, P_ACCEPTED, P_RAN = 0, 1, 2
+# proposer phases
+PR_ACCEPT_WAIT, PR_RUN_WAIT, PR_DONE, PR_ABORTED = 0, 1, 2, 3
+
+REJECT = -1  # run_resp payload for a below-floor run proposal
+
+
+class SessionModel:
+    """State = (proposer_phase, final, acks, echoes, parties, msgs,
+    drops_used, dups_used) — all tuples/ints, hashable.
+
+    - ``acks``/``echoes``: tuples of per-party values (None until heard).
+    - ``parties``: tuple of (phase, target_or_ran_steps).
+    - ``msgs``: sorted tuple of in-flight (kind, party, value) triples —
+      a multiset; delivery picks ANY element, which IS reorder.
+
+    Mutations (each one seeded bug the meta-tests prove the checker
+    catches):
+
+    - ``drop_close_echo``: a party that ran its chain never sends the
+      close-barrier echo — the real-code analog of a lost/forgotten
+      ``run_resp``; the proposer waits forever on a drop-free path.
+    - ``min_join``: the proposer folds accept targets with ``min``
+      instead of ``max`` — a party with a higher floor gets a run
+      proposal below what it accepted and rejects (the run-phase floor
+      check mc_dispatch enforces), so a drop-free session aborts.
+    - ``no_floor_reject``: with ``min_join``, parties also skip the
+      floor check and silently run fewer steps than they accepted —
+      the close barrier then sees non-convergent echoes.
+    """
+
+    name = "mc_dispatch_session"
+    source = "incubator_brpc_tpu/parallel/mc_dispatch.py"
+
+    M_ACCEPT_REQ, M_ACCEPT_ACK, M_RUN_REQ, M_RUN_RESP = 0, 1, 2, 3
+
+    def __init__(
+        self,
+        n_parties: int = 3,
+        steps: int = 2,
+        floors: Tuple[int, ...] = (0, 1, 3),
+        max_drops: int = 1,
+        max_dups: int = 1,
+        drop_close_echo: bool = False,
+        min_join: bool = False,
+        no_floor_reject: bool = False,
+    ):
+        assert len(floors) == n_parties
+        self.n = n_parties
+        self.steps = steps
+        self.floors = floors
+        self.max_drops = max_drops
+        self.max_dups = max_dups
+        self.drop_close_echo = drop_close_echo
+        self.min_join = min_join
+        self.no_floor_reject = no_floor_reject
+
+    def initial_state(self):
+        msgs = tuple(
+            sorted((self.M_ACCEPT_REQ, i, self.steps) for i in range(self.n))
+        )
+        return (
+            PR_ACCEPT_WAIT,
+            0,                                  # final (0 = not joined yet)
+            (None,) * self.n,                   # accept acks
+            (None,) * self.n,                   # close echoes
+            ((P_IDLE, 0),) * self.n,
+            msgs,
+            0,                                  # drops used
+            0,                                  # dups used
+        )
+
+    # -- helpers -------------------------------------------------------------
+
+    @staticmethod
+    def _without(msgs, m):
+        out = list(msgs)
+        out.remove(m)
+        return tuple(out)
+
+    @staticmethod
+    def _with(msgs, *new):
+        return tuple(sorted(msgs + tuple(new)))
+
+    def is_terminal(self, s) -> bool:
+        phase, _f, _a, _e, _p, msgs, _d, _du = s
+        return phase in (PR_DONE, PR_ABORTED) and not msgs
+
+    def actions(self, s) -> List[Tuple[str, tuple]]:
+        phase, final, acks, echoes, parties, msgs, drops, dups = s
+        out: List[Tuple[str, tuple]] = []
+        for m in sorted(set(msgs)):
+            out.append((f"deliver{m}", self._deliver(s, m)))
+            if drops < self.max_drops:
+                out.append(
+                    (f"drop{m}",
+                     (phase, final, acks, echoes, parties,
+                      self._without(msgs, m), drops + 1, dups))
+                )
+            if dups < self.max_dups:
+                out.append(
+                    (f"dup{m}",
+                     (phase, final, acks, echoes, parties,
+                      self._with(msgs, m), drops, dups + 1))
+                )
+        # the proposer's deadline: enabled only when the environment
+        # actually lost something — a drop-free path must make progress
+        # through protocol actions alone
+        if phase in (PR_ACCEPT_WAIT, PR_RUN_WAIT) and drops > 0:
+            out.append(
+                ("timeout",
+                 (PR_ABORTED, final, acks, echoes, parties, msgs, drops, dups))
+            )
+        return out
+
+    def _deliver(self, s, m) -> tuple:
+        phase, final, acks, echoes, parties, msgs, drops, dups = s
+        msgs = self._without(msgs, m)
+        kind, i, val = m
+
+        if kind == self.M_ACCEPT_REQ:
+            # party admission: its ack may RAISE the target to its floor
+            # (mc_dispatch_min_steps); duplicates re-ack idempotently
+            target = max(val, self.floors[i])
+            pphase, _ = parties[i]
+            newp = parties
+            if pphase == P_IDLE:
+                newp = (
+                    parties[:i] + ((P_ACCEPTED, target),) + parties[i + 1:]
+                )
+            msgs = self._with(msgs, (self.M_ACCEPT_ACK, i, target))
+            return (phase, final, acks, echoes, newp, msgs, drops, dups)
+
+        if kind == self.M_ACCEPT_ACK:
+            if phase != PR_ACCEPT_WAIT or acks[i] is not None:
+                return (phase, final, acks, echoes, parties, msgs, drops, dups)
+            acks = acks[:i] + (val,) + acks[i + 1:]
+            if all(a is not None for a in acks):
+                # the N-party join: monotone max (the seeded min_join
+                # mutation folds with min — non-monotone, violating what
+                # parties accepted)
+                fold = min if self.min_join else max
+                final = fold(self.steps, *[a for a in acks])
+                msgs = self._with(
+                    msgs,
+                    *[(self.M_RUN_REQ, j, final) for j in range(self.n)],
+                )
+                return (
+                    PR_RUN_WAIT, final, acks, echoes, parties, msgs, drops,
+                    dups,
+                )
+            return (phase, final, acks, echoes, parties, msgs, drops, dups)
+
+        if kind == self.M_RUN_REQ:
+            pphase, target = parties[i]
+            if pphase == P_ACCEPTED:
+                if val < self.floors[i] and not self.no_floor_reject:
+                    # run proposal below this party's accepted floor:
+                    # clean reject on the control stream
+                    msgs = self._with(msgs, (self.M_RUN_RESP, i, REJECT))
+                    return (
+                        phase, final, acks, echoes, parties, msgs, drops,
+                        dups,
+                    )
+                ran = val
+                parties = parties[:i] + ((P_RAN, ran),) + parties[i + 1:]
+                if not self.drop_close_echo:
+                    msgs = self._with(msgs, (self.M_RUN_RESP, i, ran))
+                return (phase, final, acks, echoes, parties, msgs, drops, dups)
+            if pphase == P_RAN:
+                # duplicate run proposal: idempotent re-echo of what ran
+                if not self.drop_close_echo:
+                    msgs = self._with(
+                        msgs, (self.M_RUN_RESP, i, parties[i][1])
+                    )
+                return (phase, final, acks, echoes, parties, msgs, drops, dups)
+            # run before accept cannot happen (the ack caused the run
+            # fan-out); delivered to an idle party it is ignored
+            return (phase, final, acks, echoes, parties, msgs, drops, dups)
+
+        # M_RUN_RESP
+        if phase != PR_RUN_WAIT or echoes[i] is not None:
+            return (phase, final, acks, echoes, parties, msgs, drops, dups)
+        if val == REJECT:
+            return (PR_ABORTED, final, acks, echoes, parties, msgs, drops, dups)
+        echoes = echoes[:i] + (val,) + echoes[i + 1:]
+        if all(e is not None for e in echoes):
+            ok = all(e == final for e in echoes)
+            return (
+                PR_DONE if ok else PR_ABORTED,
+                final, acks, echoes, parties, msgs, drops, dups,
+            )
+        return (phase, final, acks, echoes, parties, msgs, drops, dups)
+
+    # -- properties ----------------------------------------------------------
+
+    def invariant(self, s) -> str:
+        """Safety on every reachable state; '' when fine."""
+        _ph, final, _a, _e, parties, _m, _d, _du = s
+        for i, (pphase, val) in enumerate(parties):
+            if pphase == P_RAN and val < self.floors[i]:
+                return (
+                    f"party {i} ran {val} steps, below its accepted floor "
+                    f"{self.floors[i]} — the join was not monotone"
+                )
+        return ""
+
+    def terminal_ok(self, s) -> str:
+        """Checked on terminal states; '' when fine."""
+        phase, final, _a, echoes, parties, _m, drops, _du = s
+        if phase == PR_DONE:
+            expect = max(self.steps, *self.floors)
+            if final != expect:
+                return (
+                    f"session closed with final={final}, but the monotone "
+                    f"join of proposed={self.steps} and floors="
+                    f"{self.floors} is {expect}"
+                )
+            for i, (pphase, ran) in enumerate(parties):
+                if pphase != P_RAN or ran != final:
+                    return (
+                        f"close converged but party {i} state is "
+                        f"{(pphase, ran)}, expected ran {final}"
+                    )
+        if drops == 0 and phase != PR_DONE:
+            return (
+                "drop-free path ended without a converged close "
+                f"(proposer phase {phase}) — the protocol aborted or "
+                "diverged with no environment fault to blame"
+            )
+        return ""
+
+
+# ---------------------------------------------------------------------------
+# circuit-breaker state machine
+# ---------------------------------------------------------------------------
+
+B_CLOSED, B_ISOLATED, B_HALF_OPEN = 0, 1, 2
+
+
+class BreakerModel:
+    """State = (mode, duration_level, half_open_successes).
+
+    ``duration_level`` walks min..max by doubling (the exponential
+    isolation); ``half_open_successes`` counts the clean-traffic window
+    that makes a recovery durable (resetting the level to min).
+
+    Mutations:
+
+    - ``reset_keeps_broken``: revive does not clear the broken flag —
+      the node can never serve again (the checker's reachability pass
+      reports every isolated state as unrevivable).
+    - ``no_duration_reset``: a durable recovery keeps the doubled
+      duration — violating the "durable recovery resets to min"
+      safety property encoded in ``invariant``.
+    - ``no_revive_timer``: isolation never arms a revive transition —
+      the pre-PR-3-review bug class (extended deadlines without a fresh
+      timer left idle channels isolated); isolated states deadlock.
+    """
+
+    name = "circuit_breaker"
+    source = "incubator_brpc_tpu/rpc/circuit_breaker.py"
+
+    def __init__(
+        self,
+        min_level: int = 1,
+        max_level: int = 8,
+        window: int = 2,
+        reset_keeps_broken: bool = False,
+        no_duration_reset: bool = False,
+        no_revive_timer: bool = False,
+    ):
+        self.min_level = min_level
+        self.max_level = max_level
+        self.window = window
+        self.reset_keeps_broken = reset_keeps_broken
+        self.no_duration_reset = no_duration_reset
+        self.no_revive_timer = no_revive_timer
+
+    def initial_state(self):
+        return (B_CLOSED, self.min_level, 0)
+
+    def is_terminal(self, s) -> bool:
+        return False  # the breaker runs forever; liveness is reachability
+
+    def actions(self, s) -> List[Tuple[str, tuple]]:
+        mode, level, succ = s
+        out: List[Tuple[str, tuple]] = []
+        if mode == B_CLOSED:
+            out.append(("success", (B_CLOSED, level, 0)))
+            # trip from closed: isolate at the CURRENT level (doubling
+            # punishes only re-trips before a durable recovery)
+            out.append(("trip", (B_ISOLATED, level, 0)))
+        elif mode == B_ISOLATED:
+            revived = (
+                B_HALF_OPEN if not self.reset_keeps_broken else B_ISOLATED,
+                level,
+                0,
+            )
+            if not self.no_revive_timer:
+                out.append(("elapse", revived))
+                # early revival: the socket health-check proved the peer
+                # back before the window ran out (Socket.on_revived)
+                out.append(("early_revive", revived))
+        else:  # B_HALF_OPEN
+            nsucc = succ + 1
+            if nsucc >= self.window:
+                lvl = level if self.no_duration_reset else self.min_level
+                out.append(("durable_recovery", (B_CLOSED, lvl, 0)))
+            else:
+                out.append(("success", (B_HALF_OPEN, level, nsucc)))
+            out.append(
+                ("retrip",
+                 (B_ISOLATED, min(level * 2, self.max_level), 0))
+            )
+        return out
+
+    def invariant(self, s) -> str:
+        mode, level, succ = s
+        if level > self.max_level:
+            return f"isolation duration level {level} exceeds the cap"
+        if mode == B_CLOSED and level != self.min_level:
+            return (
+                f"closed (durably recovered) at duration level {level} — "
+                "a durable recovery must reset the penalty to the minimum"
+            )
+        return ""
+
+    def terminal_ok(self, s) -> str:
+        return ""
+
+    # goal set for the reachability (revivability) check
+    def is_goal(self, s) -> bool:
+        return s[0] == B_CLOSED
