@@ -25,10 +25,47 @@ def _index(server, frame) -> Resp:
 
 
 def _health(server, frame) -> Resp:
-    # health_service.cpp: plain OK unless the server is stopping
+    # health_service.cpp: plain OK unless the server is stopping — or
+    # lame-duck draining (the LB/naming side's signal to stop picking
+    # this node while its in-flight work finishes)
+    if server is not None and getattr(server, "lame_duck", False):
+        return 503, "text/plain", b"lame-duck"
     if server is not None and not server.running:
         return 503, "text/plain", b"stopping"
     return 200, "text/plain", b"OK"
+
+
+def _quitquitquit(server, frame) -> Resp:
+    """The reference's /quitquitquit graceful-quit trigger: flip this
+    server into lame duck (stop accepting, fail /health, drain in-flight
+    RPCs and open sessions, then stop). ``?grace_s=`` overrides the
+    ``lame_duck_grace_s`` flag for this drain.
+
+    Gated behind the reloadable ``enable_quitquitquit`` flag (default
+    OFF — an unauthenticated remote stop must be opt-in, the /dir
+    discipline)."""
+    from incubator_brpc_tpu.utils.flags import get_flag
+
+    if not get_flag("enable_quitquitquit"):
+        return (
+            403,
+            "text/plain",
+            b"quitquitquit is off - set flag enable_quitquitquit "
+            b"(default off: this endpoint stops the server)\n",
+        )
+    if server is None:
+        return 400, "text/plain", b"no owning server\n"
+    grace = None
+    if "grace_s" in frame.query:
+        try:
+            grace = float(frame.query["grace_s"])
+        except ValueError:
+            return 400, "text/plain", b"bad grace_s\n"
+        if grace <= 0:
+            return 400, "text/plain", b"grace_s must be > 0\n"
+    if server.enter_lame_duck(grace) is None and not server.lame_duck:
+        return 409, "text/plain", b"server is not running\n"
+    return 200, "text/plain", b"lame-duck drain started\n"
 
 
 def _version(server, frame) -> Resp:
@@ -630,6 +667,7 @@ _PAGES: Dict[str, object] = {
     "/": _index,
     "/index": _index,
     "/health": _health,
+    "/quitquitquit": _quitquitquit,
     "/version": _version,
     "/vars": _vars,
     "/vars.json": _vars_json,

@@ -580,6 +580,13 @@ class LoadBalancerWithNaming:
         if self._stopped or not self._cb_enabled or error_code in (
             ErrorCode.ECANCELED,
             ErrorCode.EBACKUPREQUEST,
+            # cooperative fabric-failure answers say nothing about the
+            # NODE's health: a survivor answering ESESSION is reporting a
+            # PEER's death (charging it would trip breakers on every
+            # healthy party of an aborted session), and EDEADLINE is the
+            # server faithfully shedding the CLIENT's expired budget
+            ErrorCode.ESESSION,
+            ErrorCode.EDEADLINE,
         ):
             return
         cb = self._breaker(ep)

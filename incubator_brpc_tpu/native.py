@@ -285,6 +285,11 @@ SIGNATURES = {
         None,
         [b] + [ctypes.POINTER(ctypes.c_uint64)] * 5,
     ),
+    "tb_server_deadline_sheds": (ctypes.c_uint64, [b]),
+    # lame-duck: stop accepting while live connections drain
+    "tb_server_pause_accept": (None, [b]),
+    # idle reap for native ports (returns connections culled)
+    "tb_server_close_idle": (ctypes.c_long, [b, ctypes.c_uint64]),
     "tb_conn_respond": (
         ctypes.c_int,
         [
@@ -315,6 +320,12 @@ SIGNATURES = {
     # wire protocol: 0 = tbus_std (default), 1 = baidu_std (PRPC);
     # must be set before the first send
     "tb_channel_set_protocol": (ctypes.c_int, [b, ctypes.c_int]),
+    # counter-scheduled client fault injection (fail/close/delay every
+    # Nth call; the native analog of the Socket.write seam)
+    "tb_channel_set_fault": (
+        ctypes.c_int,
+        [b] + [ctypes.c_uint32] * 5,
+    ),
     "tb_channel_call": (
         ctypes.c_long,
         [

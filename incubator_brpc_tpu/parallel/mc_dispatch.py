@@ -66,6 +66,16 @@ define_flag(
     lambda v: v >= 0,
 )
 
+define_flag(
+    "mc_dispatch_session_deadline_ms",
+    0,
+    "default per-session deadline for collective-method sessions: a "
+    "session older than this aborts fabric-wide with ESESSION (every "
+    "party watches its own copy, so a partitioned party still unwedges); "
+    "0 = inherit the proposal's RPC timeout",
+    lambda v: v >= 0,
+)
+
 DISPATCH_METHOD = "collective_dispatch"
 
 # Bounds a proposal must sit inside before anything is resolved or run
@@ -74,11 +84,6 @@ MAX_STEPS = 100_000
 MAX_WIDTH = 1 << 20
 MAX_PARTIES = 1024
 
-# How long the proposer watches freshly-dispatched RUN proposals for an
-# instant bounce before entering its own session (see mc_collective's
-# _REJECT_WATCH_S — same rationale, same bound).
-_REJECT_WATCH_S = 0.05
-
 # plane-level observability: sessions/steps/errors/rejects across every
 # kernel, plus a latency summary; per-kernel counters are minted lazily
 # below so /vars and /brpc_metrics can tell WHICH methods ride the plane
@@ -86,6 +91,7 @@ dispatch_sessions = Adder(name="mc_dispatch_sessions")
 dispatch_steps = Adder(name="mc_dispatch_steps")
 dispatch_errors = Adder(name="mc_dispatch_errors")
 dispatch_rejects = Adder(name="mc_dispatch_rejects")
+dispatch_aborts = Adder(name="mc_dispatch_aborts")
 dispatch_session_us = LatencyRecorder(name="mc_dispatch_session_us")
 
 _method_counters: Dict[Tuple[str, str], Adder] = {}
@@ -106,6 +112,145 @@ def _method_counter(service: str, method: str) -> Adder:
             ctr = Adder(name=f"mc_dispatch_{safe}_sessions")
             _method_counters[key] = ctr
         return ctr
+
+
+# -- session fault plane -------------------------------------------------------
+#
+# A session is no longer fire-and-forget: every party (proposer included)
+# registers it here with a deadline and an abort event.  Death of a party
+# — detected from the proposer's failed run RPC, a dying control socket,
+# or a device/mc link's fail() hook — aborts the session FABRIC-WIDE: an
+# abort broadcast (phase:"abort") plus each party's own deadline watch
+# makes every survivor exit the lockstep chain with a clean ESESSION
+# instead of hanging in a barrier the dead party can never join.
+
+
+class SessionAborted(RuntimeError):
+    """A collective session aborted (party death, deadline, or reject).
+
+    ``dead_indexes``/``survivor_indexes`` are party positions in the
+    proposal's mesh order — the re-propose path runs the next session
+    over exactly ``survivor_indexes``."""
+
+    def __init__(
+        self,
+        reason: str,
+        dead_indexes=(),
+        survivor_indexes=(),
+        rejects=(),
+    ):
+        super().__init__(reason)
+        from incubator_brpc_tpu.utils.status import ErrorCode
+
+        self.error_code = int(ErrorCode.ESESSION)
+        self.reason = reason
+        self.dead_indexes = tuple(dead_indexes)
+        self.survivor_indexes = tuple(survivor_indexes)
+        self.rejects = tuple(rejects)  # (index, error_text) non-death fails
+
+
+class _SessionState:
+    __slots__ = (
+        "session_id", "party_ids", "owner", "deadline", "abort_event",
+        "abort_reason", "aborted",
+    )
+
+    def __init__(self, session_id, party_ids, deadline, owner):
+        self.session_id = session_id
+        self.party_ids = tuple(party_ids)
+        self.owner = owner  # the serving Server (None on the proposer)
+        self.deadline = deadline  # absolute monotonic seconds (0 = none)
+        self.abort_event = threading.Event()
+        self.abort_reason = ""
+        self.aborted = False
+
+
+# session id -> every local registrant (proposer AND parties: in a
+# single-controller run — and the in-process tests — several parties of
+# ONE session live in one process; an abort must unwedge all of them)
+_sessions: Dict[str, List[_SessionState]] = {}
+_sessions_lock = threading.Lock()
+
+
+def _register_session(session_id, party_ids, deadline, owner=None):
+    st = _SessionState(session_id, party_ids, deadline, owner)
+    with _sessions_lock:
+        _sessions.setdefault(session_id, []).append(st)
+    return st
+
+
+def _unregister_session(st: _SessionState) -> None:
+    with _sessions_lock:
+        states = _sessions.get(st.session_id)
+        if states is not None:
+            try:
+                states.remove(st)
+            except ValueError:
+                pass
+            if not states:
+                del _sessions[st.session_id]
+
+
+def active_sessions(owner=None) -> int:
+    """Live (registered, not yet closed) session registrations — all of
+    them, or only those served by ``owner`` (Server.enter_lame_duck
+    drains its own)."""
+    with _sessions_lock:
+        return sum(
+            1
+            for states in _sessions.values()
+            for st in states
+            if owner is None or st.owner is owner
+        )
+
+
+def abort_session(session_id: str, reason: str) -> bool:
+    """Flip every local registrant of one session to aborted (idempotent;
+    counted once per session per process). Returns False when the id is
+    unknown — already closed or never registered here, both fine for a
+    best-effort broadcast."""
+    with _sessions_lock:
+        states = list(_sessions.get(session_id, ()))
+        if not states:
+            return False
+        first = any(not st.aborted for st in states)
+        for st in states:
+            st.aborted = True
+            if not st.abort_reason:
+                st.abort_reason = reason
+    if first:
+        dispatch_aborts << 1
+        logger.warning("mc_dispatch session %s aborted: %s", session_id, reason)
+    for st in states:
+        st.abort_event.set()
+    return True
+
+
+def abort_sessions_for_devices(device_ids, reason: str) -> int:
+    """Link-death feedback (transport/device_link fail() calls here): any
+    active session with a party on one of these GLOBAL device ids aborts —
+    the link that carried the lockstep traffic is gone, so the chain can
+    never converge. Returns the number of sessions aborted."""
+    dead = set(int(d) for d in device_ids)
+    with _sessions_lock:
+        hit = [
+            sid for sid, states in _sessions.items()
+            if any(dead & set(st.party_ids) for st in states)
+        ]
+    for sid in hit:
+        abort_session(sid, reason)
+    return len(hit)
+
+
+# Between-step seam: chaos drills park parties here (deterministically
+# mid-session) and production leaves it None.  Called as fn(step_index)
+# before each lockstep step on every party running a registered session.
+_step_hook: Optional[Callable] = None
+
+
+def set_step_hook(fn: Optional[Callable]) -> None:
+    global _step_hook
+    _step_hook = fn
 
 
 # -- kernel resolution ---------------------------------------------------------
@@ -205,6 +350,7 @@ def run_dispatch_session(
     steps: int,
     service: str = "?",
     method: str = "?",
+    should_abort: Optional[Callable[[], Optional[str]]] = None,
 ) -> Tuple[np.ndarray, int, float]:
     """Run this party's side of a K-step session of ``dm``'s kernel;
     returns (own final row, own final n, elapsed seconds). Every party
@@ -251,8 +397,28 @@ def run_dispatch_session(
     )
     ns = jax.make_array_from_single_device_arrays((n,), sharding, n_shards)
     t0 = time.perf_counter()
-    for _ in range(steps):
+    for step_i in range(steps):
+        # fault plane: an aborted session exits the chain HERE, between
+        # dispatches, with a clean ESESSION — dispatches are async (XLA
+        # pipelines them), so the check costs nothing and the party never
+        # enters a barrier its dead peer cannot join.  A party already
+        # blocked INSIDE one collective finishes that step first (or hits
+        # the runtime's own collective timeout) — the between-step check
+        # plus every party's deadline watch is what bounds the hang.
+        if should_abort is not None:
+            why = should_abort()
+            if why:
+                raise SessionAborted(why)
+        hook = _step_hook
+        if hook is not None:
+            hook(step_i)  # chaos-drill seam (None in production)
         x, ns = step_fn(x, ns)  # chained: operands never leave the devices
+    if should_abort is not None:
+        # last look before the blocking fetch: the final collect is the
+        # one host-blocking point of the chain
+        why = should_abort()
+        if why:
+            raise SessionAborted(why)
     own_row = own_n = None
     for s in x.addressable_shards:
         # a process can address several mesh devices (single-controller
@@ -381,6 +547,15 @@ def make_dispatch_handler(server):
 
             cntl.set_failed(ErrorCode.EREQUEST, f"undecodable proposal: {e}")
             return b""
+        if req.get("phase") == "abort":
+            # the abort broadcast: validated as little as possible — a
+            # survivor must unwedge even when the rest of the proposal
+            # state is unreachable or corrupt
+            sid = str(req.get("session_id", ""))
+            found = bool(sid) and abort_session(
+                sid, str(req.get("reason", "")) or "aborted by proposer"
+            )
+            return json.dumps({"aborted": found}).encode()
         party_ids, own_index, steps, dm, err = _validate_proposal(req)
         if err is not None:
             cntl.set_failed(*err)
@@ -429,6 +604,45 @@ def make_dispatch_handler(server):
 
             cntl.set_failed(ErrorCode.EREQUEST, f"bad operands: {e}")
             return b""
+        # fault plane: a session_id-carrying run registers here so the
+        # abort broadcast, the party's own deadline watch, link-death
+        # feedback, and the proposer's control socket dying can all
+        # unwedge this party mid-chain with a clean ESESSION
+        session_id = str(req.get("session_id", "")) or None
+        st = None
+        sock_hook = None
+        if session_id is not None:
+            deadline_ms = float(req.get("deadline_ms", 0) or 0)
+            if deadline_ms <= 0:
+                deadline_ms = float(get_flag("mc_dispatch_session_deadline_ms"))
+            deadline = (
+                time.monotonic() + deadline_ms / 1000.0 if deadline_ms > 0
+                else 0.0
+            )
+            st = _register_session(
+                session_id, party_ids, deadline, owner=server
+            )
+            sock = getattr(cntl, "_sock", None)
+            hooks = getattr(sock, "on_failed", None)
+            if hooks is not None:
+                # the proposer died with us mid-chain: its control
+                # connection failing IS the death signal (socket feedback)
+                def _proposer_died(_s, _sid=session_id):
+                    abort_session(_sid, "proposer connection died mid-session")
+
+                hooks.append(_proposer_died)
+                sock_hook = (hooks, _proposer_died)
+
+        def _should_abort():
+            if st is None:
+                return None
+            if st.abort_event.is_set():
+                return st.abort_reason or "session aborted"
+            if st.deadline and time.monotonic() > st.deadline:
+                abort_session(st.session_id, "session deadline exceeded")
+                return "session deadline exceeded"
+            return None
+
         span = _start_session_span(
             service, method, dm.fingerprint(), party_ids, own_index, steps,
             trace_id=cntl.trace_id, parent_span_id=cntl.span_id,
@@ -436,8 +650,14 @@ def make_dispatch_handler(server):
         try:
             own_row, own_n, elapsed = run_dispatch_session(
                 party_ids, own_index, dm, operands, steps,
-                service=service, method=method,
+                service=service, method=method, should_abort=_should_abort,
             )
+        except SessionAborted as e:
+            from incubator_brpc_tpu.utils.status import ErrorCode
+
+            _end_session_span(span, error_code=ErrorCode.ESESSION)
+            cntl.set_failed(ErrorCode.ESESSION, f"session aborted: {e.reason}")
+            return b""
         except Exception as e:
             dispatch_errors << 1
             from incubator_brpc_tpu.utils.status import ErrorCode
@@ -446,6 +666,14 @@ def make_dispatch_handler(server):
             logger.exception("dispatch session failed")
             cntl.set_failed(ErrorCode.EINTERNAL, f"dispatch session: {e!r}")
             return b""
+        finally:
+            if sock_hook is not None:
+                try:
+                    sock_hook[0].remove(sock_hook[1])
+                except ValueError:
+                    pass
+            if st is not None:
+                _unregister_session(st)
         _end_session_span(span)
         return json.dumps(
             {
@@ -473,6 +701,7 @@ def propose_dispatch(
     steps: int = 1,
     proposer_index: Optional[int] = None,
     timeout_ms: float = 120000,
+    session_deadline_ms: Optional[float] = None,
 ) -> dict:
     """Schedule an N-party session of a registered device method.
 
@@ -489,10 +718,22 @@ def propose_dispatch(
        method) pair locally and fingerprint-checks it; any reject
        surfaces HERE, before lockstep. ``final = max(all targets)``.
     2. run fan-out (async — every party must be dispatching before any
-       can finish) with a short rejection watch, then the proposer's own
-       chain if it participates.
+       can finish) under a fault watcher, then the proposer's own chain
+       if it participates.
     3. completion barrier — every response must echo ``final`` (the
        convergent close: all parties dispatched exactly the same count).
+
+    Fault semantics: the run phase registers a SESSION (random id +
+    ``session_deadline_ms`` budget, default the RPC timeout) on every
+    party.  The watcher classifies a failed run RPC: connectivity
+    failures (dead party) and rejects both trigger an ABORT — an abort
+    broadcast to every surviving party plus the local abort event — so
+    every survivor exits its lockstep chain with ESESSION instead of
+    hanging in a barrier; :class:`SessionAborted` then carries the dead
+    and surviving index sets for the re-propose path
+    (:func:`propose_with_recovery`).  Breaker feedback is charged to the
+    dead party only: the survivors' ESESSION answers are excluded from
+    error cost by the LB (lb/__init__._feed_breaker).
     """
     import threading as _threading
 
@@ -518,6 +759,20 @@ def propose_dispatch(
                 f"operand of {len(op)}B exceeds method width {dm.width}"
             )
 
+    # session identity + deadline: what the fault plane keys on.  Every
+    # party gets the SAME budget, measured from its own clock at proposal
+    # arrival — a partitioned party that never hears the abort broadcast
+    # still unwedges at its own deadline.
+    import uuid
+
+    session_id = uuid.uuid4().hex
+    sess_ms = (
+        float(session_deadline_ms)
+        if session_deadline_ms and session_deadline_ms > 0
+        else float(get_flag("mc_dispatch_session_deadline_ms"))
+        or float(timeout_ms)
+    )
+
     def proposal(idx: int, nsteps: int, phase: str = "") -> bytes:
         d = {
             "parties": party_ids,
@@ -537,6 +792,8 @@ def propose_dispatch(
             d["operands"] = [
                 base64.b64encode(op).decode() for op in operands
             ]
+            d["session_id"] = session_id
+            d["deadline_ms"] = sess_ms
         return json.dumps(d).encode()
 
     def _call(ch, payload):
@@ -575,61 +832,242 @@ def propose_dispatch(
         _call(ch, proposal(idx, final))
         for ch, idx in zip(channels, remote_indexes)
     ]
-    if proposer_index is not None:
-        # Rejection watch before committing OUR device to a collective
-        # that could never rendezvous. A scheduler-only proposer skips
-        # it: it runs no collective, and phase 3 surfaces the same
-        # rejects — burning a fixed 50 ms there would tax every
-        # mc-lowered ParallelChannel call (and the LB latency feedback).
-        watch_deadline = time.monotonic() + _REJECT_WATCH_S
-        while time.monotonic() < watch_deadline:
-            for cntl, ev in pending:
-                if ev.is_set() and cntl.failed():
-                    raise RuntimeError(
-                        f"dispatch proposal rejected: {cntl.error_text}"
+    from incubator_brpc_tpu.utils.status import ErrorCode
+
+    # connectivity-class failures of a RUN rpc = the party is DEAD for
+    # this session (its chain will never converge); anything else is a
+    # reject.  Both abort the session — only death feeds the re-propose
+    # path's survivor set.
+    _DEATH_CODES = frozenset(
+        {
+            ErrorCode.EFAILEDSOCKET, ErrorCode.EEOF, ErrorCode.ECLOSE,
+            ErrorCode.EHOSTDOWN, ErrorCode.ERPCTIMEDOUT, ErrorCode.ELOGOFF,
+            ErrorCode.ETIMEDOUT,
+        }
+    )
+    session_deadline = time.monotonic() + sess_ms / 1000.0
+    st = _register_session(session_id, party_ids, session_deadline)
+    outcome = {"dead": [], "rejects": [], "reason": ""}
+    watch_stop = _threading.Event()
+
+    def _broadcast_abort(reason: str, skip) -> None:
+        """phase:"abort" to every party not already known dead (async,
+        best-effort — each party's own deadline is the backstop)."""
+        msg = json.dumps(
+            {"phase": "abort", "session_id": session_id, "reason": reason}
+        ).encode()
+        for ch, idx in zip(channels, remote_indexes):
+            if idx in skip:
+                continue
+            try:
+                _call(ch, msg)
+            except Exception:
+                logger.exception("abort broadcast to party %d failed", idx)
+
+    broadcast_done = [False]
+
+    def _trigger_abort(reason: str) -> None:
+        outcome["reason"] = outcome["reason"] or reason
+        if not broadcast_done[0]:
+            # one broadcast per session: later classifications (a second
+            # death found while the first abort settles) add to the
+            # outcome but the survivors were already told
+            broadcast_done[0] = True
+            _broadcast_abort(reason, set(outcome["dead"]))
+        abort_session(session_id, reason)
+
+    def _watch() -> None:
+        # the generalized rejection watch (supersedes the old fixed-50 ms
+        # participating-proposer scan): classify every settled run RPC as
+        # it lands; on the FIRST death/reject — or the session deadline —
+        # abort fabric-wide so survivors (the proposer's own chain
+        # included) exit their lockstep loops instead of waiting in a
+        # barrier the dead party can never join.  After an abort the
+        # watcher KEEPS scanning until every run RPC settles (or the
+        # deadline): an ESESSION answer is a SURVIVOR reporting the abort
+        # (its link saw the death first, or our broadcast arrived) — not
+        # a reject, and never the dead party, which must still be
+        # identified for the re-propose path.
+        seen = set()
+        while not watch_stop.wait(0.01):
+            done = True
+            now = time.monotonic()
+            for (cntl, ev), idx in zip(pending, remote_indexes):
+                if not ev.is_set():
+                    done = False
+                    continue
+                if idx in seen or not cntl.failed():
+                    continue
+                seen.add(idx)
+                code = cntl.error_code
+                if code == ErrorCode.ESESSION:
+                    # cooperative abort report from a LIVING party:
+                    # propagate (covers the link-death-detected-remotely
+                    # ordering) but blame nobody
+                    _trigger_abort(
+                        f"party {idx} reported abort: {cntl.error_text}"
                     )
-            if all(ev.is_set() for _c, ev in pending):
-                break  # every run already answered; nothing to watch
-            time.sleep(0.005)
+                elif code in _DEATH_CODES:
+                    outcome["dead"].append(idx)
+                    _trigger_abort(
+                        f"party {idx} died mid-session: {cntl.error_text}"
+                    )
+                else:
+                    outcome["rejects"].append((idx, cntl.error_text))
+                    _trigger_abort(
+                        f"party {idx} rejected the run: {cntl.error_text}"
+                    )
+            if done:
+                return
+            if st.abort_event.is_set() and not broadcast_done[0]:
+                # aborted from OUTSIDE the rpc plane (the proposer's own
+                # link-death hook fired): the survivors still need the
+                # broadcast — their links may be fine
+                _trigger_abort(st.abort_reason or "session aborted")
+            if now > session_deadline:
+                _trigger_abort("session deadline exceeded")
+                return
+
+    watcher = _threading.Thread(
+        target=_watch, name="mc-session-watch", daemon=True
+    )
+    watcher.start()
+
     own_elapsed = None
     results: List[Optional[bytes]] = [None] * n
-    if proposer_index is not None:
-        span = _start_session_span(
-            service, method, fingerprint, party_ids, proposer_index, final
-        )
-        try:
-            own_row, own_n, own_elapsed = run_dispatch_session(
-                party_ids, proposer_index, dm, operands,
-                final, service=service, method=method,
-            )
-        except Exception:
-            dispatch_errors << 1
-            from incubator_brpc_tpu.utils.status import ErrorCode
+    abort_exc: Optional[SessionAborted] = None
+    try:
+        if proposer_index is not None:
 
-            _end_session_span(span, error_code=ErrorCode.EINTERNAL)
-            raise
-        _end_session_span(span)
-        results[proposer_index] = dm.unpack(own_row, own_n)
+            def _own_should_abort():
+                if st.abort_event.is_set():
+                    return st.abort_reason or "session aborted"
+                if time.monotonic() > session_deadline:
+                    abort_session(session_id, "session deadline exceeded")
+                    return "session deadline exceeded"
+                return None
 
-    # Phase 3 — completion barrier; every response must echo ``final``
-    deadline = time.monotonic() + timeout_ms / 1000.0
-    for (cntl, ev), idx in zip(pending, remote_indexes):
-        if not ev.wait(max(0.0, deadline - time.monotonic())):
-            raise TimeoutError("dispatch peer never completed")
-        if cntl.failed():
-            raise RuntimeError(f"dispatch peer failed: {cntl.error_text}")
-        resp = json.loads(cntl.response_payload.decode())
-        # each party echoes the count it validated AND ran (a proposal
-        # below the party's accepted floor is rejected, never silently
-        # re-counted) — a mismatch here means a corrupted or stale
-        # proposal reached that party
-        if int(resp.get("steps", -1)) != final:
-            raise RuntimeError(
-                f"party {idx} dispatched {resp.get('steps')} steps, "
-                f"agreed final was {final} — close did not converge"
+            span = _start_session_span(
+                service, method, fingerprint, party_ids, proposer_index,
+                final,
             )
-        results[idx] = base64.b64decode(resp["result"])
+            try:
+                own_row, own_n, own_elapsed = run_dispatch_session(
+                    party_ids, proposer_index, dm, operands,
+                    final, service=service, method=method,
+                    should_abort=_own_should_abort,
+                )
+            except SessionAborted as e:
+                _end_session_span(span, error_code=ErrorCode.ESESSION)
+                abort_exc = e
+            except Exception:
+                dispatch_errors << 1
+                _end_session_span(span, error_code=ErrorCode.EINTERNAL)
+                # our own chain failed: the peers' chains can never
+                # converge either — take the whole session down cleanly
+                _trigger_abort("proposer chain failed")
+                raise
+            else:
+                _end_session_span(span)
+                results[proposer_index] = dm.unpack(own_row, own_n)
+
+        # Phase 3 — completion barrier; the watcher exits once every run
+        # RPC settled, or as soon as it aborted the session
+        watcher.join()
+        if st.abort_event.is_set() or abort_exc is not None:
+            dead = sorted(set(outcome["dead"]))
+            survivors = [i for i in range(n) if i not in set(dead)]
+            reason = (
+                outcome["reason"]
+                or (abort_exc.reason if abort_exc is not None else "")
+                or st.abort_reason
+                or "session aborted"
+            )
+            raise SessionAborted(
+                reason,
+                dead_indexes=dead,
+                survivor_indexes=survivors,
+                rejects=outcome["rejects"],
+            )
+        for (cntl, ev), idx in zip(pending, remote_indexes):
+            if cntl.failed():  # defensive: the watcher classifies these
+                raise RuntimeError(
+                    f"dispatch peer failed: {cntl.error_text}"
+                )
+            resp = json.loads(cntl.response_payload.decode())
+            # each party echoes the count it validated AND ran (a proposal
+            # below the party's accepted floor is rejected, never silently
+            # re-counted) — a mismatch here means a corrupted or stale
+            # proposal reached that party
+            if int(resp.get("steps", -1)) != final:
+                raise RuntimeError(
+                    f"party {idx} dispatched {resp.get('steps')} steps, "
+                    f"agreed final was {final} — close did not converge"
+                )
+            results[idx] = base64.b64decode(resp["result"])
+    finally:
+        watch_stop.set()
+        _unregister_session(st)
     return {"results": results, "final_steps": final, "elapsed_s": own_elapsed}
+
+
+def propose_with_recovery(
+    channels,
+    party_ids: List[int],
+    service: str,
+    method: str,
+    operands: List[bytes],
+    steps: int = 1,
+    proposer_index: Optional[int] = None,
+    timeout_ms: float = 120000,
+    session_deadline_ms: Optional[float] = None,
+    max_reproposals: int = 1,
+) -> dict:
+    """:func:`propose_dispatch` with the re-propose path: a session that
+    aborts on PARTY DEATH is re-proposed over the surviving party set (up
+    to ``max_reproposals`` times).  Rejects and proposer death are not
+    recoverable this way and re-raise.  The result dict gains
+    ``dead_party_ids`` (global device ids dropped along the way, [] on a
+    clean first run)."""
+    chs = list(channels)
+    pids = list(party_ids)
+    ops = list(operands)
+    pidx = proposer_index
+    dropped: List[int] = []
+    for attempt in range(max_reproposals + 1):
+        remote = [i for i in range(len(pids)) if i != pidx]
+        try:
+            out = propose_dispatch(
+                chs, pids, service, method, ops, steps=steps,
+                proposer_index=pidx, timeout_ms=timeout_ms,
+                session_deadline_ms=session_deadline_ms,
+            )
+            out["dead_party_ids"] = dropped
+            return out
+        except SessionAborted as e:
+            dead = set(e.dead_indexes)
+            if (
+                attempt == max_reproposals
+                or not dead
+                or e.rejects
+                or (pidx is not None and pidx in dead)
+                or len(pids) - len(dead) < 2
+            ):
+                raise
+            dropped.extend(pids[i] for i in sorted(dead))
+            logger.warning(
+                "re-proposing %s.%s over %d survivor(s) after: %s",
+                service, method, len(pids) - len(dead), e.reason,
+            )
+            keep = [i for i in range(len(pids)) if i not in dead]
+            chs = [
+                ch for ch, idx in zip(chs, remote) if idx not in dead
+            ]
+            ops = [ops[i] for i in keep]
+            pids = [pids[i] for i in keep]
+            if pidx is not None:
+                pidx = keep.index(pidx)
+    raise AssertionError("unreachable")
 
 
 # -- the ParallelChannel lowering ----------------------------------------------

@@ -16,6 +16,7 @@ interop-tested against reference binaries over TCP):
                     7 authentication_data(bytes)  8 stream_settings(msg)
     RpcRequestMeta: 1 service_name(str)  2 method_name(str)  3 log_id(i64)
                     4 trace_id(i64)  5 span_id(i64)  6 parent_span_id(i64)
+                    8 timeout_ms(i32)  — the propagated deadline budget
     RpcResponseMeta: 1 error_code(i32)  2 error_text(str)
 
 CompressType values follow options.proto (NONE=0 SNAPPY=1 GZIP=2 ZLIB=3);
@@ -133,12 +134,14 @@ def encode_request_submeta(
     trace_id: int = 0,
     span_id: int = 0,
     parent_span_id: int = 0,
+    timeout_ms: int = 0,
 ) -> bytes:
     """The RpcRequestMeta SUBMESSAGE bytes (RpcMeta field 1) — the single
     source of the request field table, shared by RpcMeta.encode and the
     native client plane (src/tbnet wraps these bytes into a full RpcMeta,
     splicing in its own correlation_id/attachment_size, so native frames
-    stay byte-identical to this codec's pack_request)."""
+    stay byte-identical to this codec's pack_request). ``timeout_ms`` is
+    the propagated deadline budget (RpcRequestMeta field 8)."""
     return (
         _f_bytes(1, service.encode())
         + _f_bytes(2, method.encode())
@@ -146,6 +149,7 @@ def encode_request_submeta(
         + _f_varint(4, trace_id)
         + _f_varint(5, span_id)
         + _f_varint(6, parent_span_id)
+        + _f_varint(8, timeout_ms)
     )
 
 
@@ -162,6 +166,7 @@ class RpcMeta:
     trace_id: int = 0
     span_id: int = 0
     parent_span_id: int = 0
+    timeout_ms: int = 0
     is_response: bool = False
     error_code: int = 0
     error_text: str = ""
@@ -186,6 +191,7 @@ class RpcMeta:
                 self.trace_id,
                 self.span_id,
                 self.parent_span_id,
+                self.timeout_ms,
             )
             out += _tag(1, 2) + _varint(len(sub)) + sub
         out += _f_varint(3, self.compress_type)
@@ -212,6 +218,8 @@ class RpcMeta:
                         m.span_id = v2
                     elif f2 == 6:
                         m.parent_span_id = v2
+                    elif f2 == 8 and w2 == 0:
+                        m.timeout_ms = v2
             elif field_no == 2 and wt == 2:
                 m.is_response = True
                 for f2, w2, v2 in _walk_fields(v):
@@ -270,6 +278,7 @@ def rpc_meta_to_meta(rm: RpcMeta) -> Meta:
         method=rm.method_name,
         compress=_WIRE_TO_COMPRESS.get(rm.compress_type, ""),
         attachment_size=rm.attachment_size,
+        timeout_ms=rm.timeout_ms,
         log_id=rm.log_id,
         trace_id=rm.trace_id,
         span_id=rm.span_id,
@@ -331,6 +340,7 @@ def pack_request(
         log_id=meta.log_id if meta else 0,
         trace_id=meta.trace_id if meta else 0,
         span_id=meta.span_id if meta else 0,
+        timeout_ms=meta.timeout_ms if meta else 0,
         compress_type=_COMPRESS_TO_WIRE.get(meta.compress if meta else "", 0),
         correlation_id=correlation_id,
         authentication_data=(

@@ -77,6 +77,10 @@ class Meta:
     method: str = ""
     compress: str = ""  # "", "gzip", "snappy" (zlib stands in for snappy)
     attachment_size: int = 0
+    # remaining deadline budget in ms, stamped by the client at send time
+    # (the reference's RpcRequestMeta.timeout_ms): 0 = no deadline rides
+    # this request; servers shed expired-at-arrival work with EDEADLINE
+    timeout_ms: int = 0
     log_id: int = 0
     trace_id: int = 0
     span_id: int = 0
@@ -102,6 +106,8 @@ class Meta:
         att = self.attachment_size if attachment_size is None else attachment_size
         if att:
             d["attachment_size"] = att
+        if self.timeout_ms:
+            d["timeout_ms"] = self.timeout_ms
         if self.log_id:
             d["log_id"] = self.log_id
         if self.trace_id:
@@ -132,6 +138,7 @@ class Meta:
             m.method = g("method", "")
             m.compress = g("compress", "")
             m.attachment_size = g("attachment_size", 0)
+            m.timeout_ms = g("timeout_ms", 0)
             m.log_id = g("log_id", 0)
             m.trace_id = g("trace_id", 0)
             m.span_id = g("span_id", 0)

@@ -331,6 +331,31 @@ class Channel:
             cntl._request_stream = request_stream
         cntl._mark_start()
 
+        # deadline propagation (reference RpcRequestMeta.timeout_ms): a
+        # call issued inside a server handler inherits what is LEFT of the
+        # caller's propagated budget when that is tighter than this call's
+        # own timeout — budgets only shrink across hops. An already-spent
+        # budget fails fast with EDEADLINE: no wire traffic for work the
+        # edge caller has given up on.
+        from incubator_brpc_tpu.rpc.deadline import current_deadline
+
+        _ambient = current_deadline()
+        if _ambient is not None:
+            if not cntl._deadline or _ambient < cntl._deadline:
+                cntl._deadline = _ambient
+                cntl.timeout_ms = max(
+                    0.0, (_ambient - cntl._start_ts) * 1000.0
+                )
+            if cntl._deadline <= cntl._start_ts:
+                cntl.set_failed(
+                    ErrorCode.EDEADLINE,
+                    "propagated deadline already expired",
+                )
+                cntl._mark_end()
+                if done is not None:
+                    done(cntl)
+                return cntl
+
         # native fast path: a sync, stream-less, unauthenticated,
         # uncompressed call to a single TCP server rides src/tbnet end to
         # end (C++ pack/write/pump; correlation handled by the native
@@ -820,10 +845,22 @@ class Channel:
             # sync caller will drive this socket's reads (see _sync_wait);
             # claiming before the write keeps the post-send GIL window tiny
             cntl._poll_owned = sock
+        # the wire deadline is the budget REMAINING now (retries re-stamp,
+        # so every hop sees what is actually left, not the original spec);
+        # a sub-ms residue still rides as 1 so "deadline present" survives
+        # integer ms truncation
+        import time as _time0
+
+        wire_timeout = 0
+        if cntl._deadline:
+            wire_timeout = max(
+                1, int((cntl._deadline - _time0.monotonic()) * 1000)
+            )
         meta = Meta(
             service=cntl._service,
             method=cntl._method,
             compress=cntl.compress_type,
+            timeout_ms=wire_timeout,
             log_id=cntl.log_id,
             trace_id=cntl.trace_id,
             span_id=cntl.span_id,

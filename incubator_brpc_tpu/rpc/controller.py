@@ -149,6 +149,19 @@ class Controller:
 
     # -- internals -----------------------------------------------------------
 
+    def deadline_left_ms(self) -> Optional[float]:
+        """Milliseconds of deadline budget left for this RPC (may be
+        negative once expired), or None when no deadline applies.
+
+        Client side: remaining of the call's own timeout.  Server side:
+        remaining of the PROPAGATED budget the request arrived with
+        (RpcMeta ``timeout_ms``) — what a handler should give any
+        downstream work it fans out to other threads (same-thread
+        downstream Channels inherit it automatically, rpc/deadline.py)."""
+        if self._deadline:
+            return (self._deadline - time.monotonic()) * 1000.0
+        return None
+
     def _reset_for_retry(self) -> None:
         self.error_code = 0
         self.error_text = ""
@@ -171,8 +184,16 @@ class Controller:
 
 
 # retriable errors (reference default RetryPolicy, retry_policy.cpp: retries
-# connectivity failures — including EHOSTDOWN — never server-side
-# application errors or timeouts)
+# connectivity failures — including EHOSTDOWN — and ELOGOFF (a stopping or
+# lame-duck server refusing new work is transient by design: the retry
+# lands on another replica), never server-side application errors or
+# timeouts)
 RETRIABLE = frozenset(
-    {ErrorCode.EFAILEDSOCKET, ErrorCode.EEOF, ErrorCode.ECLOSE, ErrorCode.EHOSTDOWN}
+    {
+        ErrorCode.EFAILEDSOCKET,
+        ErrorCode.EEOF,
+        ErrorCode.ECLOSE,
+        ErrorCode.EHOSTDOWN,
+        ErrorCode.ELOGOFF,
+    }
 )

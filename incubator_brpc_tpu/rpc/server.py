@@ -44,9 +44,90 @@ from incubator_brpc_tpu.rpc.dump import maybe_dump_request
 from incubator_brpc_tpu.transport.acceptor import Acceptor
 from incubator_brpc_tpu.transport.messenger import InputMessenger
 from incubator_brpc_tpu.utils.endpoint import EndPoint, str2endpoint
+from incubator_brpc_tpu.utils.flags import define_flag, get_flag
 from incubator_brpc_tpu.utils.status import ErrorCode, berror
 
 logger = logging.getLogger(__name__)
+
+define_flag(
+    "lame_duck_grace_s",
+    10.0,
+    "default grace window for Server.enter_lame_duck / the /quitquitquit "
+    "builtin / SIGTERM graceful quit: in-flight RPCs and open collective "
+    "sessions get this long to drain before the hard stop",
+    lambda v: v > 0,
+)
+define_flag(
+    "graceful_quit_on_sigterm",
+    False,
+    "SIGTERM triggers a lame-duck drain (stop accepting, fail /health, "
+    "drain in-flight work for lame_duck_grace_s, then stop) instead of "
+    "the default abrupt death — the reference's graceful_quit_on_sigterm "
+    "gflag (server.cpp)",
+    lambda v: True,
+)
+
+# Requests shed because their PROPAGATED deadline (RpcMeta timeout_ms)
+# expired before the method could be dispatched — expired-at-arrival and
+# expired-mid-queue both count here. Python-route sheds add directly;
+# native-plane sheds flow in through the telemetry drain
+# (transport/native_plane._consume_records), so one counter covers both
+# planes.
+deadline_shed_count = Adder(name="deadline_shed_count")
+
+# every started Server, for the SIGTERM graceful-quit fan-out (weak: a
+# leaked reference here must never pin a stopped server)
+import weakref as _weakref
+
+_started_servers: "_weakref.WeakSet" = _weakref.WeakSet()
+_sigterm_state = {"installed": False, "prev": None}
+
+
+def _on_sigterm(signum, frame) -> None:
+    """SIGTERM with graceful_quit_on_sigterm: lame-duck every running
+    server, then (once all drains finish) hand the signal to whatever was
+    installed before us so the process still dies."""
+    servers = [s for s in list(_started_servers) if s.running]
+    logger.info("SIGTERM: lame-duck draining %d server(s)", len(servers))
+
+    def _drain_all() -> None:
+        threads = [s.enter_lame_duck() for s in servers]
+        for t in threads:
+            if t is not None:
+                t.join()
+        import os
+        import signal as _signal
+
+        prev = _sigterm_state.get("prev")
+        try:
+            _signal.signal(
+                _signal.SIGTERM,
+                prev if callable(prev) else _signal.SIG_DFL,
+            )
+        except (ValueError, TypeError):
+            pass
+        os.kill(os.getpid(), _signal.SIGTERM)  # now dies the default death
+
+    threading.Thread(
+        target=_drain_all, name="sigterm-lame-duck", daemon=True
+    ).start()
+
+
+def _maybe_install_sigterm() -> None:
+    if _sigterm_state["installed"] or not get_flag("graceful_quit_on_sigterm"):
+        return
+    import signal as _signal
+
+    try:
+        _sigterm_state["prev"] = _signal.signal(_signal.SIGTERM, _on_sigterm)
+        _sigterm_state["installed"] = True
+    except ValueError:
+        # signal() only works on the main thread; a server started from a
+        # worker keeps the flag's promise best-effort
+        logger.warning(
+            "graceful_quit_on_sigterm: cannot install the SIGTERM handler "
+            "off the main thread"
+        )
 
 
 _warned_distributed_probe = False
@@ -363,6 +444,8 @@ class Server:
         self._acceptor: Optional[Acceptor] = None
         self._messenger = InputMessenger()
         self._stopping = False
+        self._lame_duck = False  # draining: no new work, conns stay up
+        self._lame_duck_thread: Optional[threading.Thread] = None
         self._started = False
         self._lock = threading.Lock()
         self._nprocessing = 0  # server-level concurrency
@@ -747,17 +830,18 @@ class Server:
         self._idle_reap_timer_id = None
         self._started = True
         if self.options.idle_timeout_s > 0:
-            if self._acceptor is not None:
-                self._schedule_idle_reap()
-            else:
-                logger.warning(
-                    "idle_timeout_s is not enforced on native-plane ports"
-                )
+            # enforced on BOTH planes: the Python acceptor scan below, and
+            # tb_server_close_idle for native ports (per-connection
+            # last-activity kept by the C++ loops; the reap shutdown()s,
+            # the owning loop reaps — no more "not enforced" warning)
+            self._schedule_idle_reap()
         if self.options.has_builtin_services:
             from incubator_brpc_tpu.builtin import portal
 
             portal.register_server(self)
         self._expose_limiter_gauges()
+        _started_servers.add(self)
+        _maybe_install_sigterm()
         logger.info("server started on %s", self.listen_endpoint)
         return True
 
@@ -822,16 +906,117 @@ class Server:
         # (default on, flags health_check_interval) will be redialed and
         # reaped again — the same cycle stock brpc has with its default-on
         # client health checker; both knobs are the operator's tradeoff.
-        if self._stopping or self._acceptor is None:
+        if self._stopping:
             return
-        cutoff = _time.monotonic() - self.options.idle_timeout_s
-        for sock in self._acceptor.connections():
-            if sock.last_active < cutoff:
-                sock.set_failed(
-                    ErrorCode.ECLOSE,
-                    f"idle for > {self.options.idle_timeout_s}s",
+        if self._acceptor is not None:
+            cutoff = _time.monotonic() - self.options.idle_timeout_s
+            for sock in self._acceptor.connections():
+                if sock.last_active < cutoff:
+                    sock.set_failed(
+                        ErrorCode.ECLOSE,
+                        f"idle for > {self.options.idle_timeout_s}s",
+                    )
+        if self._native_plane is not None:
+            culled = self._native_plane.close_idle(self.options.idle_timeout_s)
+            if culled:
+                logger.info(
+                    "reaped %d idle native connection(s) (> %gs)",
+                    culled, self.options.idle_timeout_s,
                 )
         self._schedule_idle_reap()
+
+    def enter_lame_duck(
+        self, grace_s: Optional[float] = None
+    ) -> Optional[threading.Thread]:
+        """Lame-duck drain (the reference's graceful quit /quitquitquit →
+        Server::Stop(grace) path): stop accepting NEW connections (the
+        listener closes, so redials are refused and the LB's
+        feedback/naming path routes elsewhere), flip ``/health`` to 503,
+        answer NEW requests on existing connections with ELOGOFF (now
+        retriable — a balanced client transparently lands on another
+        replica), let in-flight RPCs and open collective sessions finish
+        within ``grace_s`` (default: the ``lame_duck_grace_s`` flag), then
+        hard-stop.  Returns the drain thread (join it to observe the full
+        lifecycle), or None if the server wasn't running or is already
+        draining."""
+        if not self._started or self._stopping:
+            return None
+        with self._lock:
+            if self._lame_duck:
+                return self._lame_duck_thread
+            self._lame_duck = True
+        grace = (
+            float(get_flag("lame_duck_grace_s"))
+            if grace_s is None
+            else float(grace_s)
+        )
+        from incubator_brpc_tpu.bvar import PassiveStatus
+
+        # scrapeable drain marker; dies with the other gauges at stop
+        self._limit_gauges.append(
+            PassiveStatus(
+                lambda: 1 if self._lame_duck and not self._stopping else 0,
+                name=f"server_{self.port}_lame_duck",
+            )
+        )
+        if self._acceptor is not None:
+            self._acceptor.pause()
+        if self._native_plane is not None:
+            self._native_plane.pause_accept()
+        logger.info(
+            "server %s entering lame duck (grace %.1fs)",
+            self.listen_endpoint, grace,
+        )
+        t = threading.Thread(
+            target=self._drain_then_stop,
+            args=(grace,),
+            name=f"lame-duck-{self.port}",
+            daemon=True,
+        )
+        self._lame_duck_thread = t
+        t.start()
+        return t
+
+    def _drain_then_stop(self, grace_s: float) -> None:
+        import time as _time
+
+        deadline = _time.monotonic() + grace_s
+        with self._quiescent:
+            self._quiescent.wait_for(
+                lambda: self._nprocessing == 0,
+                timeout=max(0.0, deadline - _time.monotonic()),
+            )
+        # open collective sessions pin devices across the fabric: give
+        # them the rest of the grace window before the hard stop tears
+        # their control streams down
+        from incubator_brpc_tpu.parallel.mc_dispatch import active_sessions
+
+        while (
+            active_sessions(owner=self) > 0
+            and _time.monotonic() < deadline
+        ):
+            _time.sleep(0.02)
+        drained = self._nprocessing == 0 and active_sessions(owner=self) == 0
+        if not drained:
+            logger.warning(
+                "lame-duck grace %.1fs expired with work still in flight "
+                "(%d rpcs, %d sessions); hard-stopping",
+                grace_s, self._nprocessing, active_sessions(owner=self),
+            )
+        else:
+            # linger briefly before the hard stop: responses written in
+            # the last instants (the flood's final ELOGOFFs included) are
+            # still in socket buffers — closing under them would turn a
+            # clean drain into client-side resets
+            _time.sleep(min(0.25, max(0.0, deadline - _time.monotonic())))
+        self.stop()
+        self.join(timeout=max(0.5, deadline - _time.monotonic()))
+
+    @property
+    def lame_duck(self) -> bool:
+        """True while this server drains toward stop (health is failed,
+        new work is refused with ELOGOFF, existing work finishes)."""
+        return self._lame_duck
 
     def stop(self) -> None:
         """Stop accepting + fail connections; in-flight handlers finish
@@ -839,6 +1024,7 @@ class Server:
         if not self._started:
             return
         self._stopping = True
+        _started_servers.discard(self)
         tid = getattr(self, "_idle_reap_timer_id", None)
         if tid is not None:
             self._idle_reap_timer_id = None
@@ -990,7 +1176,10 @@ class Server:
         """The tbus_std process_request body (baidu_rpc_protocol.cpp:307)."""
         self.nrequest << 1
         meta = frame.meta
-        cntl = Controller()
+        # timeout_ms=0: a server-side controller has no deadline unless the
+        # request PROPAGATED one (set below) — deadline_left_ms() must not
+        # report the client-knob default on the serving side
+        cntl = Controller(timeout_ms=0)
         cntl.request_meta = meta
         cntl.remote_side = sock.remote
         cntl.log_id = meta.log_id
@@ -1007,6 +1196,34 @@ class Server:
         # SendRpcResponse off the request's protocol the same way)
         cntl._wire_protocol = getattr(frame, "wire_protocol", "tbus_std")
         cntl._mark_start()
+
+        # deadline propagation (reference RpcRequestMeta.timeout_ms +
+        # server-side ProcessRpcRequest shed): the request carries its
+        # remaining budget; measured against when the frame ARRIVED (the
+        # messenger stamps arrival_ts at cut), work that expired on the
+        # wire or in this server's dispatch queue is answered EDEADLINE
+        # without invoking the method — the C++ cutter does the identical
+        # check natively (src/tbnet run_native), byte-identical response.
+        budget_ms = getattr(meta, "timeout_ms", 0)
+        if budget_ms and budget_ms > 0:
+            import time as _time
+
+            arrival = getattr(frame, "arrival_ts", None)
+            now = _time.monotonic()
+            if arrival is None:
+                arrival = now
+            if (now - arrival) * 1000.0 >= budget_ms:
+                deadline_shed_count << 1
+                cntl.set_failed(
+                    ErrorCode.EDEADLINE, berror(ErrorCode.EDEADLINE)
+                )
+                self.nerror << 1
+                self._send_response(sock, cntl, b"")
+                return
+            # the server-side controller's deadline IS the propagated one:
+            # deadline_left_ms() hands the residue to downstream work
+            cntl.timeout_ms = budget_ms
+            cntl._deadline = arrival + budget_ms / 1000.0
 
         inj = self.options.fault_injector
         if inj is not None:
@@ -1031,7 +1248,10 @@ class Server:
                     self._send_response(sock, cntl, b"")
                     return
 
-        if self._stopping:
+        if self._stopping or self._lame_duck:
+            # lame duck refuses NEW work with the same retriable ELOGOFF a
+            # stopping server sends — a balanced client lands elsewhere;
+            # in-flight handlers (admitted before the flip) finish
             cntl.set_failed(ErrorCode.ELOGOFF, berror(ErrorCode.ELOGOFF))
             self._send_response(sock, cntl, b"")
             return
@@ -1115,6 +1335,12 @@ class Server:
         cntl._session_entered = True  # paired in _finish
         _prev_server = getattr(_usercode_tls, "server", None)
         _usercode_tls.server = self
+        # downstream Channels on this thread inherit the request's
+        # remaining budget (rpc/deadline.py) — the decrement-across-hops
+        # half of deadline propagation
+        from incubator_brpc_tpu.rpc.deadline import pop_deadline, push_deadline
+
+        _prev_deadline = push_deadline(cntl._deadline or None)
         try:
             response = prop.handler(cntl, payload)
         except Exception as e:
@@ -1122,6 +1348,7 @@ class Server:
             cntl.set_failed(ErrorCode.EINTERNAL, f"handler raised: {e!r}")
             response = b""
         finally:
+            pop_deadline(_prev_deadline)
             _usercode_tls.server = _prev_server
             # the parent-span window is handler execution on THIS thread;
             # an async completion elsewhere must not leave stale TLS here
@@ -1344,7 +1571,7 @@ class Server:
         prop = self._methods.get(f"{service}.{method}")
         if prop is None:
             return 404, "text/plain", f"no method {service}.{method}\n".encode()
-        if self._stopping:
+        if self._stopping or self._lame_duck:
             return 503, "text/plain", b"server stopping\n"
         # json2pb transcoding: when the handler carries a schema and the
         # body is JSON, transcode request in / response out — one handler

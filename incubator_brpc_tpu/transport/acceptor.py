@@ -48,6 +48,7 @@ class Acceptor:
         self._conn_lock = threading.Lock()
         self._accepting = False
         self._stopped = False
+        self._paused = False  # lame-duck: listener closed, conns live
 
         self._unix_path: Optional[str] = None
         if endpoint.ip.startswith("unix://"):
@@ -152,7 +153,7 @@ class Acceptor:
         finally:
             with self._conn_lock:
                 self._accepting = False
-            if not self._stopped:
+            if not self._stopped and not self._paused:
                 self._dispatcher.rearm(self._lsock.fileno(), EVENT_IN)
 
     def _forget(self, sock: Socket) -> None:
@@ -161,8 +162,18 @@ class Acceptor:
 
     # -- teardown -----------------------------------------------------------
 
-    def stop(self, close_connections: bool = True) -> None:
-        self._stopped = True
+    def pause(self) -> None:
+        """Lame-duck: close the listener (new connects are refused by the
+        kernel, so an LB redials elsewhere) while every accepted
+        connection keeps being served. Irreversible; ``stop`` still
+        performs the full teardown."""
+        with self._conn_lock:
+            if self._stopped or self._paused:
+                return
+            self._paused = True
+        self._close_listener()
+
+    def _close_listener(self) -> None:
         self._dispatcher.remove_consumer(self._lsock.fileno())
         if self._unix_path is not None:
             import os as _os
@@ -178,6 +189,12 @@ class Acceptor:
             self._lsock.close()
         except OSError:
             pass
+
+    def stop(self, close_connections: bool = True) -> None:
+        was_paused = self._paused
+        self._stopped = True
+        if not was_paused:  # pause already tore the listener down
+            self._close_listener()
         if close_connections:
             for sock in self.connections():
                 sock.set_failed(ErrorCode.ECLOSE, "acceptor stopped")

@@ -624,6 +624,21 @@ class DeviceLink:
             self._step_ts.clear()
         link_errors << 1
         self._retire_metrics()
+        # party-death feedback for the collective fault plane: a session
+        # whose lockstep traffic rode THIS link can never converge once
+        # the link is dead — abort it so every party exits with ESESSION
+        # (same moment the hooks above retire telemetry)
+        try:
+            from incubator_brpc_tpu.parallel.mc_dispatch import (
+                abort_sessions_for_devices,
+            )
+
+            abort_sessions_for_devices(
+                [d.id for d in self.devices if d is not None],
+                f"device link failed: {reason}",
+            )
+        except Exception:
+            logger.exception("link-death session abort failed")
         self._wbutex.add(1)
         self._wbutex.wake_all()
         for sock in self.socks:

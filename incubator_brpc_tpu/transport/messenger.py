@@ -210,6 +210,19 @@ class InputMessenger:
     def _dispatch(self, sock, cut, defer_tail: bool = False):
         if not cut:
             return None
+        # arrival stamp for deadline propagation: a request's remaining
+        # budget (meta timeout_ms) is measured from when its frame was cut
+        # off the wire, so time spent queued behind the worker pool or
+        # earlier frames of this burst counts against it (the server sheds
+        # expired-mid-queue work with EDEADLINE). One clock read per burst.
+        import time as _time
+
+        now = _time.monotonic()
+        for _proto, frame in cut:
+            try:
+                frame.arrival_ts = now
+            except AttributeError:
+                pass  # __slots__ frame (HTTP): no binary deadline to carry
         # Two classes of frame must be handled inline, in wire order, on
         # this (single-per-socket) reader fiber:
         # - stream frames: their per-stream ExecutionQueue push must happen

@@ -433,7 +433,7 @@ class NativeServerPlane:
                 name=f"native_plane_{self.port}_{k}",
             )
             for k in ("accepted", "native_reqs", "cb_frames", "handoffs",
-                      "live_conns")
+                      "live_conns", "deadline_sheds")
         ]
         if self._telemetry:
             self._m_stats.append(
@@ -572,6 +572,15 @@ class NativeServerPlane:
         errors = arr["error_code"]
         lat_us = arr["latency_ns"] * 1e-3
         ok = errors == 0
+        # natively-shed requests (propagated deadline expired before
+        # dispatch, recorded EDEADLINE in C++) feed the SAME global
+        # counter the Python route's sheds increment — one
+        # deadline_shed_count covers both planes (vectorized: one sum)
+        nshed = int((errors == _EC.EDEADLINE).sum())
+        if nshed:
+            from incubator_brpc_tpu.rpc.server import deadline_shed_count
+
+            deadline_shed_count << nshed
         server_lim = server._server_limiter
         server_auto = isinstance(server_lim, AutoConcurrencyLimiter)
         interval = int(get_flag("auto_cl_sampling_interval_us"))
@@ -621,14 +630,16 @@ class NativeServerPlane:
             # decimated to its sampling interval so a 100 k-record drain
             # feeds the handful of samples the limiter would keep anyway.
             # ELIMIT refusals are excluded like the Python route (a
-            # refused request never reaches on_responded).
+            # refused request never reaches on_responded); deadline sheds
+            # likewise — shed work never ran the method, so its "latency"
+            # says nothing the limiter should adapt to.
             prop = methods.get(full)
             method_auto = prop is not None and isinstance(
                 prop.status.limiter, AutoConcurrencyLimiter
             )
             if not (server_auto or method_auto):
                 continue
-            fb = mask & (errors != _EC.ELIMIT)
+            fb = mask & (errors != _EC.ELIMIT) & (errors != _EC.EDEADLINE)
             if not fb.any():
                 continue
             done_us = (arr["start_ns"][fb] + arr["latency_ns"][fb]) // 1000
@@ -765,6 +776,9 @@ class NativeServerPlane:
                 flags=flags & ~_FLAG_WIRE_PRPC,
                 error_code=error_code,
             )
+            # deadline-shed baseline for the worker-pool queue ahead
+            # (Server.process_request measures mid-queue expiry from it)
+            frame.arrival_ts = time.monotonic()
             if is_prpc:
                 frame.wire_protocol = "baidu_std"
             sock = self._sock_for(token)
@@ -913,16 +927,40 @@ class NativeServerPlane:
                     "accepted", "native_reqs", "cb_frames", "handoffs",
                     "live_conns",
                 )
-                return dict(zip(keys, (v.value for v in vals)))
+                out = dict(zip(keys, (v.value for v in vals)))
+                out["deadline_sheds"] = int(
+                    LIB.tb_server_deadline_sheds(self._srv)
+                )
+                return out
         return getattr(
             self,
             "_final_stats",
             dict.fromkeys(
                 ("accepted", "native_reqs", "cb_frames", "handoffs",
-                 "live_conns"),
+                 "live_conns", "deadline_sheds"),
                 0,
             ),
         )
+
+    def close_idle(self, idle_s: float) -> int:
+        """Cull native connections with no read activity for ``idle_s``
+        (Server's idle_timeout_s enforcement for native ports; the C++
+        side shutdown()s, the owning loop reaps)."""
+        with self._stats_lock:
+            if self._srv is None:
+                return 0
+            return int(
+                LIB.tb_server_close_idle(
+                    self._srv, int(max(0.0, idle_s) * 1000)
+                )
+            )
+
+    def pause_accept(self) -> None:
+        """Lame-duck: close the listener while live connections keep
+        being served (drained by the owner's grace window)."""
+        with self._stats_lock:
+            if self._srv is not None:
+                LIB.tb_server_pause_accept(self._srv)
 
     def connection_count(self) -> int:
         with self._socks_lock:
@@ -934,6 +972,37 @@ class NativeServerPlane:
             self.stop()
         except Exception:
             pass
+
+
+# process-global fault schedule for native CLIENT channels: armed on
+# every subsequently-created NativeClientChannel while the
+# ``fault_injection`` master flag is on (so rpc_press --fault-rate runs
+# stay on the C++ plane instead of forcing the Python socket seam).
+# Redials inherit it — an injected close heals into a re-armed channel,
+# matching the Python seam's process-wide injector.
+_native_client_fault = None
+
+
+def install_native_client_fault(
+    fail_every: int = 0,
+    close_every: int = 0,
+    delay_every: int = 0,
+    delay_ms: int = 0,
+    error_code: int = 0,
+) -> None:
+    """Install (or clear, with all zeros) the process-global native-client
+    fault schedule (see tb_channel_set_fault). Deterministic counter
+    scheduling like rpc/fault_injector.py; acts only behind the
+    ``fault_injection`` master flag."""
+    global _native_client_fault
+    spec = (
+        max(0, int(fail_every)),
+        max(0, int(close_every)),
+        max(0, int(delay_every)),
+        max(0, int(delay_ms)),
+        max(0, int(error_code)),
+    )
+    _native_client_fault = spec if any(spec[:3]) else None
 
 
 class NativeClientChannel:
@@ -982,9 +1051,38 @@ class NativeClientChannel:
         # create_string_buffer per call costs more than the whole native
         # round trip
         self._tls = threading.local()
+        spec = _native_client_fault
+        if spec is not None:
+            from incubator_brpc_tpu.utils.flags import get_flag
+
+            if get_flag("fault_injection"):
+                self.set_fault(*spec)
 
     def healthy(self) -> bool:
         return not self._closed and LIB.tb_channel_error(self._ch) == 0
+
+    def set_fault(
+        self,
+        fail_every: int = 0,
+        close_every: int = 0,
+        delay_every: int = 0,
+        delay_ms: int = 0,
+        error_code: int = 0,
+    ) -> None:
+        """Arm the C++ channel's counter-scheduled fault seam
+        (tb_channel_set_fault) — the native analog of the Python
+        Socket.write injector: every Nth call fails/closes/delays,
+        deterministically. 0 disables a schedule."""
+        rc = LIB.tb_channel_set_fault(
+            self._ch,
+            max(0, int(fail_every)),
+            max(0, int(close_every)),
+            max(0, int(delay_every)),
+            max(0, int(delay_ms)),
+            max(0, int(error_code)),
+        )
+        if rc != 0:  # current C++ always accepts; guard future revs
+            raise RuntimeError("tb_channel_set_fault rejected the schedule")
 
     def _meta_bytes(
         self,
@@ -994,8 +1092,13 @@ class NativeClientChannel:
         log_id: int = 0,
         trace_id: int = 0,
         span_id: int = 0,
+        timeout_ms: int = 0,
     ) -> bytes:
         traced = bool(log_id or trace_id or span_id)
+        # the propagated deadline (RpcRequestMeta field 8 / JSON
+        # timeout_ms) joins the cache KEY, not the uncached path: clients
+        # overwhelmingly reuse one configured timeout per channel, so the
+        # steady state stays one dict hit per call
         if self.protocol == "baidu_std":
             # the RpcRequestMeta submessage only — correlation_id and
             # attachment_size live OUTSIDE it, spliced in by the C++
@@ -1009,14 +1112,22 @@ class NativeClientChannel:
 
             if traced:
                 return encode_request_submeta(
-                    service, method, log_id, trace_id, span_id
+                    service, method, log_id, trace_id, span_id,
+                    timeout_ms=timeout_ms,
                 )
-            key = (service, method)
+            key = (service, method, timeout_ms)
             m = self._meta_cache.get(key)
             if m is None:
-                m = encode_request_submeta(service, method)
-                if len(self._meta_cache) < self._META_CACHE_MAX:
-                    self._meta_cache[key] = m
+                m = encode_request_submeta(
+                    service, method, timeout_ms=timeout_ms
+                )
+                if len(self._meta_cache) >= self._META_CACHE_MAX:
+                    # overflow = one-shot keys flooded it (decrementing
+                    # propagated deadlines mint a fresh timeout per call):
+                    # clear rather than freeze, so hot configured-timeout
+                    # keys re-cache immediately instead of never again
+                    self._meta_cache.clear()
+                self._meta_cache[key] = m
             return m
         from incubator_brpc_tpu.protocol.tbus_std import Meta
 
@@ -1024,16 +1135,20 @@ class NativeClientChannel:
             return Meta(
                 service=service,
                 method=method,
+                timeout_ms=timeout_ms,
                 log_id=log_id,
                 trace_id=trace_id,
                 span_id=span_id,
             ).to_bytes(attachment_size=att_len)
-        key = (service, method)
+        key = (service, method, timeout_ms)
         m = self._meta_cache.get(key)
         if m is None:
-            m = Meta(service=service, method=method).to_bytes()
-            if len(self._meta_cache) < self._META_CACHE_MAX:
-                self._meta_cache[key] = m
+            m = Meta(
+                service=service, method=method, timeout_ms=timeout_ms
+            ).to_bytes()
+            if len(self._meta_cache) >= self._META_CACHE_MAX:
+                self._meta_cache.clear()  # see the baidu_std branch
+            self._meta_cache[key] = m
         return m
 
     def decode_resp_meta(self, resp_meta: bytes):
@@ -1081,7 +1196,11 @@ class NativeClientChannel:
             self._inflight += 1
         try:
             meta = self._meta_bytes(
-                service, method, len(attachment), log_id, trace_id, span_id
+                service, method, len(attachment), log_id, trace_id, span_id,
+                timeout_ms=(
+                    max(1, int(timeout_ms))
+                    if timeout_ms and timeout_ms > 0 else 0
+                ),
             )
             flags = FLAG_BODY_CRC if get_flag("tbus_body_crc") else 0
             body = IOBuf()

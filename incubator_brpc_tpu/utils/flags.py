@@ -117,6 +117,14 @@ define_flag(
     lambda v: True,
 )
 define_flag(
+    "enable_quitquitquit",
+    False,
+    "serve the /quitquitquit graceful-quit trigger (an unauthenticated "
+    "remote DRAIN-AND-STOP on the portal: keep off unless the port is "
+    "trusted — the reference gates its quit endpoints the same way)",
+    lambda v: True,
+)
+define_flag(
     "http_gateway_async_timeout_s",
     30,
     "how long the http->rpc gateway waits for an async handler",

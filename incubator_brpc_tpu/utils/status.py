@@ -59,6 +59,15 @@ class ErrorCode(enum.IntEnum):
     ETERMINATED = 4001
     EDESTROYED = 4002
     EINVALIDDATA = 4003
+    # the request's PROPAGATED deadline (RpcMeta timeout_ms riding the
+    # wire) expired before the method was dispatched — distinct from
+    # ERPCTIMEDOUT (the client's own timer) so callers can tell "the
+    # fabric shed my already-dead work" from "the server was slow"
+    EDEADLINE = 4004
+    # a collective session was aborted fabric-wide (party death, session
+    # deadline, or a peer's reject) — survivors exit their lockstep
+    # chains with this instead of hanging in a barrier
+    ESESSION = 4005
 
     # Common host errnos reused by the framework
     EAGAIN = 11
@@ -86,6 +95,8 @@ _DESCRIPTIONS = {
     ErrorCode.ETERMINATED: "Terminated",
     ErrorCode.EDESTROYED: "Destroyed",
     ErrorCode.EINVALIDDATA: "Invalid data",
+    ErrorCode.EDEADLINE: "Deadline expired before dispatch",
+    ErrorCode.ESESSION: "Collective session aborted",
     ErrorCode.EINTERNAL: "Server internal error",
     ErrorCode.ERESPONSE: "Bad response",
     ErrorCode.ELOGOFF: "Server is stopping",

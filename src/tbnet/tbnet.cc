@@ -91,6 +91,7 @@ struct MetaLite {
   std::string service;
   std::string method;
   long attachment = 0;
+  long timeout_ms = 0;  // propagated deadline budget (0 = none)
 };
 
 struct Scan {
@@ -192,6 +193,15 @@ MetaLite scan_meta(const char* s, size_t n) {
       m.attachment = strtol(sc.p, &endp, 10);
       if (endp == sc.p || m.attachment < 0) return m;
       sc.p = endp;
+    } else if (key == "timeout_ms") {
+      // the propagated deadline is native-fast-path territory: the
+      // cutter sheds expired work itself (run_native), so a deadline-
+      // carrying frame must NOT fall off the interpreter-free plane
+      sc.ws();
+      char* endp = nullptr;
+      m.timeout_ms = strtol(sc.p, &endp, 10);
+      if (endp == sc.p || m.timeout_ms < 0) return m;
+      sc.p = endp;
     } else {
       // compress, stream ids, trace ids, error_text, extra...: semantics
       // the native fast path doesn't implement — Python handles them
@@ -286,6 +296,7 @@ struct PrpcMeta {
   size_t req_sub_len = 0;
   uint64_t cid = 0;
   long attachment = 0;
+  long timeout_ms = 0;  // RpcRequestMeta.timeout_ms (field 8); 0 = none
   uint32_t error_code = 0;
 };
 
@@ -346,8 +357,14 @@ PrpcMeta scan_prpc_meta(const char* s, size_t n) {
           } else if (w2 == 0) {
             uint64_t v2 = 0;
             if (!read_varint(q, sub_len, &qoff, &v2)) return m;
-            // log_id/trace_id/span ids: rpcz semantics live in Python
-            if (v2 != 0) m.to_python = true;
+            if (f2 == 8) {
+              // timeout_ms: the deadline shed runs natively (run_native)
+              if (v2 > (1ull << 31)) return m;
+              m.timeout_ms = static_cast<long>(v2);
+            } else if (v2 != 0) {
+              // log_id/trace_id/span ids: rpcz semantics live in Python
+              m.to_python = true;
+            }
           } else if (w2 == 1 || w2 == 5) {
             size_t skip = w2 == 1 ? 8 : 4;
             if (qoff + skip > sub_len) return m;
@@ -599,6 +616,10 @@ struct NetConn : PollObj {
   std::string memo_meta;
   uint64_t memo_idx = 0;
   long memo_attachment = -1;  // -1 = no memo
+  long memo_timeout = 0;      // timeout_ms of the memoized meta bytes
+  // stamped once per readable burst (deadline shed baseline + idle reap);
+  // written by the loop thread, read by tb_server_close_idle callers
+  std::atomic<uint64_t> last_active_ms{0};
   std::atomic<bool> dead{false};
   std::atomic<int> refs{0};
 };
@@ -682,7 +703,14 @@ struct ErrorCodes {
   uint32_t enomethod = 1002;
   uint32_t elimit = 2004;
   uint32_t erequest = 1003;
+  uint32_t edeadline = 4004;
 };
+
+// the EDEADLINE response text — MUST match utils/status.py berror(
+// EDEADLINE) byte-for-byte: the acceptance contract is that a shed
+// answered natively is indistinguishable from one answered by the
+// Python route
+constexpr const char kDeadlineShedText[] = "Deadline expired before dispatch";
 
 // ---------------------------------------------------------------------------
 // telemetry ring: bounded lock-free queue of completion records (Vyukov's
@@ -797,6 +825,13 @@ struct tb_server {
   std::atomic<uint64_t> cb_frames{0};
   std::atomic<uint64_t> handoffs{0};
   std::atomic<uint64_t> live_conns{0};
+  // requests answered EDEADLINE because their propagated budget expired
+  // before dispatch (the deadline_shed_count feed for native ports)
+  std::atomic<uint64_t> deadline_sheds{0};
+  // lame-duck: stop accepting while existing connections drain; the
+  // listener teardown runs on loop 0 (which owns the listen fd's epoll
+  // registration) at its next wakeup
+  std::atomic<bool> accept_paused{false};
   std::atomic<bool> stopped{false};
   // completion-record ring (tb_server_set_telemetry); null = disabled.
   // Set once before listen, so loop threads load it without a fence race.
@@ -893,6 +928,7 @@ struct ReqCtx {
   uint32_t cid_hi;
   uint32_t resp_flags; // tbus: response flags to echo (body-crc bit)
   long attachment;     // request attachment size (PRPC echo re-stamps it)
+  long timeout_ms;     // propagated deadline budget (0 = none rides this)
 };
 
 // append an error response frame into `out` (flushed with the batch)
@@ -948,6 +984,22 @@ void run_native(NetConn* c, NativeMethod* nm, const ReqCtx& rc,
     rec.reserved = 0;
     telemetry_push(tr, rec);
   };
+  // deadline shed (reference server-side timeout_ms handling): budget
+  // expired between the frame's ARRIVAL (burst read stamp) and this
+  // dispatch — behind queued frames of the burst or a slow native
+  // method — is answered EDEADLINE without running the method.  The
+  // response text matches utils/status.py berror(EDEADLINE) so native
+  // and Python sheds are byte-identical.
+  if (rc.timeout_ms > 0) {
+    uint64_t arrived = c->last_active_ms.load(std::memory_order_relaxed);
+    if (now_ms() - arrived >= static_cast<uint64_t>(rc.timeout_ms)) {
+      c->srv->deadline_sheds.fetch_add(1, std::memory_order_relaxed);
+      nm->nerr.fetch_add(1, std::memory_order_relaxed);
+      append_error(out, rc, c->srv->errs.edeadline, kDeadlineShedText);
+      telemetry_done(c->srv->errs.edeadline, 0);
+      return;  // caller owns body
+    }
+  }
   // snapshot ONCE: a runtime retune between the admission fetch_add and
   // the completion fetch_sub must see a consistent gate, or the counter
   // leaks (limit dropped to 0 mid-request) / underflows (raised from 0)
@@ -1128,7 +1180,8 @@ FrameStatus process_frames_tbus(NetConn* c) {
           memcmp(cb_meta, c->memo_meta.data(), hdr.meta_len) == 0 &&
           c->memo_attachment <= static_cast<long>(tb_iobuf_size(scratch))) {
         ReqCtx rc2{kProtoTbus, hdr.cid_lo, hdr.cid_hi,
-                   hdr.flags & kFlagBodyCrc, c->memo_attachment};
+                   hdr.flags & kFlagBodyCrc, c->memo_attachment,
+                   c->memo_timeout};
         run_native(c, s->native_methods[c->memo_idx], rc2, scratch, batch);
         tb_iobuf_clear(scratch);
         continue;
@@ -1151,8 +1204,10 @@ FrameStatus process_frames_tbus(NetConn* c) {
             c->memo_meta.assign(cb_meta, hdr.meta_len);
             c->memo_idx = idx;
             c->memo_attachment = ml.attachment;
+            c->memo_timeout = ml.timeout_ms;
             ReqCtx rc2{kProtoTbus, hdr.cid_lo, hdr.cid_hi,
-                       hdr.flags & kFlagBodyCrc, ml.attachment};
+                       hdr.flags & kFlagBodyCrc, ml.attachment,
+                       ml.timeout_ms};
             run_native(c, s->native_methods[idx], rc2, scratch, batch);
             tb_iobuf_clear(scratch);
             continue;
@@ -1165,7 +1220,7 @@ FrameStatus process_frames_tbus(NetConn* c) {
     s->cb_frames.fetch_add(1, std::memory_order_relaxed);
     if (s->frame_cb == nullptr) {
       if ((hdr.flags & kFlagResponse) == 0) {
-        ReqCtx rc2{kProtoTbus, hdr.cid_lo, hdr.cid_hi, 0, 0};
+        ReqCtx rc2{kProtoTbus, hdr.cid_lo, hdr.cid_hi, 0, 0, 0};
         append_error(batch, rc2, s->errs.enomethod, "no such method");
       }
       tb_iobuf_clear(scratch);
@@ -1228,7 +1283,8 @@ FrameStatus process_frames_prpc(NetConn* c) {
     const long blen = static_cast<long>(tb_iobuf_size(scratch));
     if (!pm.is_response && !pm.to_python && pm.attachment <= blen) {
       ReqCtx rc{kProtoPrpc, static_cast<uint32_t>(pm.cid),
-                static_cast<uint32_t>(pm.cid >> 32), 0, pm.attachment};
+                static_cast<uint32_t>(pm.cid >> 32), 0, pm.attachment,
+                pm.timeout_ms};
       // memo keyed on the request submessage (cid lives outside it)
       if (c->memo_attachment >= 0 &&
           pm.req_sub_len == c->memo_meta.size() && pm.req_sub_len > 0 &&
@@ -1266,7 +1322,7 @@ FrameStatus process_frames_prpc(NetConn* c) {
     if (s->frame_cb == nullptr) {
       if (!pm.is_response) {
         ReqCtx rc{kProtoPrpc, static_cast<uint32_t>(pm.cid),
-                  static_cast<uint32_t>(pm.cid >> 32), 0, 0};
+                  static_cast<uint32_t>(pm.cid >> 32), 0, 0, 0};
         append_error(batch, rc, s->errs.enomethod, "no such method");
       }
       tb_iobuf_clear(scratch);
@@ -1282,6 +1338,9 @@ FrameStatus process_frames_prpc(NetConn* c) {
 }
 
 void conn_readable(NetConn* c) {
+  // one clock read per readable burst: the arrival baseline for the
+  // deadline shed in run_native AND the idle-reap activity stamp
+  c->last_active_ms.store(now_ms(), std::memory_order_relaxed);
   size_t burst = tb_iobuf_read_burst();
   bool eof = false;
   for (;;) {
@@ -1304,6 +1363,7 @@ void conn_readable(NetConn* c) {
 
 void accept_ready(tb_server* s) {
   for (;;) {
+    if (s->accept_paused.load(std::memory_order_acquire)) return;
     int fd = accept4(s->listener.fd, nullptr, nullptr,
                      SOCK_NONBLOCK | SOCK_CLOEXEC);
     if (fd < 0) return;  // EAGAIN / EMFILE / EINTR: next event retries
@@ -1311,6 +1371,7 @@ void accept_ready(tb_server* s) {
     s->accepted.fetch_add(1, std::memory_order_relaxed);
     s->live_conns.fetch_add(1, std::memory_order_relaxed);
     NetConn* c = new NetConn();
+    c->last_active_ms.store(now_ms(), std::memory_order_relaxed);
     c->fd = fd;
     c->srv = s;
     c->loop = s->loops[s->next_loop.fetch_add(1) % s->loops.size()];
@@ -1333,6 +1394,15 @@ void loop_run(tb_server* s, NetLoop* l) {
   epoll_event evs[128];
   while (!l->stopping.load(std::memory_order_acquire)) {
     int n = epoll_wait(l->epfd, evs, 128, 500);
+    // lame-duck: loop 0 owns the listener's epoll registration, so the
+    // actual teardown runs HERE (no cross-thread epoll_ctl/close race
+    // with a concurrent accept_ready)
+    if (l == s->loops[0] && s->accept_paused.load(std::memory_order_acquire) &&
+        s->listener.fd >= 0) {
+      epoll_ctl(l->epfd, EPOLL_CTL_DEL, s->listener.fd, nullptr);
+      close(s->listener.fd);
+      s->listener.fd = -1;
+    }
     for (int i = 0; i < n; ++i) {
       PollObj* o = static_cast<PollObj*>(evs[i].data.ptr);
       if (o == nullptr) continue;
@@ -1629,6 +1699,42 @@ void tb_server_stats(const tb_server* s, uint64_t* accepted,
   if (live_conns) *live_conns = s->live_conns.load();
 }
 
+uint64_t tb_server_deadline_sheds(const tb_server* s) {
+  return s->deadline_sheds.load(std::memory_order_relaxed);
+}
+
+void tb_server_pause_accept(tb_server* s) {
+  if (s->accept_paused.exchange(true)) return;
+  // wake loop 0 so the listener teardown (which it owns) runs promptly
+  if (!s->loops.empty()) {
+    uint64_t one = 1;
+    ssize_t r = write(s->loops[0]->wake.fd, &one, sizeof one);
+    (void)r;
+  }
+}
+
+long tb_server_close_idle(tb_server* s, uint64_t idle_ms) {
+  // idle reap for native ports (reference Acceptor::CloseIdleConnections,
+  // acceptor.cpp:111): shutdown() is the thread-safe kill — the owning
+  // loop thread reaps the connection via EPOLLHUP, exactly the
+  // tb_conn_close discipline.  Returns the number of connections culled.
+  if (s->stopped.load(std::memory_order_acquire)) return 0;
+  uint64_t cutoff = now_ms();
+  long culled = 0;
+  for (NetLoop* l : s->loops) {
+    std::lock_guard<std::mutex> g(l->conns_mu);
+    for (NetConn* c : l->conns) {
+      if (c->dead.load(std::memory_order_acquire)) continue;
+      uint64_t last = c->last_active_ms.load(std::memory_order_relaxed);
+      if (last != 0 && cutoff > last && cutoff - last >= idle_ms) {
+        shutdown(c->fd, SHUT_RDWR);
+        ++culled;
+      }
+    }
+  }
+  return culled;
+}
+
 // ---------------------------------------------------------------------------
 // per-connection API (token-addressed; any thread)
 // ---------------------------------------------------------------------------
@@ -1709,6 +1815,18 @@ struct tb_channel {
   tb_iobuf* rbuf = nullptr;
   tb_iobuf* pump_body = nullptr;  // reused per-response cut target (pump)
   std::atomic<int> err{0};  // sticky -errno
+  // counter-scheduled fault injection (tb_channel_set_fault): the native
+  // analog of the Python Socket.write seam — every fail_every'th call
+  // answers fault_err_code without touching the wire, every
+  // close_every'th kills the connection mid-run, every delay_every'th
+  // sleeps delay_ms first.  All zero = disabled (the steady-state cost
+  // is one load).
+  std::atomic<uint64_t> fault_counter{0};
+  uint32_t fault_fail_every = 0;
+  uint32_t fault_close_every = 0;
+  uint32_t fault_delay_every = 0;
+  uint32_t fault_delay_ms = 0;
+  uint32_t fault_err_code = 0;
 };
 
 namespace {
@@ -1960,6 +2078,19 @@ int tb_channel_set_protocol(tb_channel* ch, int proto) {
   return 0;
 }
 
+int tb_channel_set_fault(tb_channel* ch, uint32_t fail_every,
+                         uint32_t close_every, uint32_t delay_every,
+                         uint32_t delay_ms, uint32_t err_code) {
+  // set BEFORE concurrent calls (rpc_press arms at channel creation);
+  // the schedule fields are plain stores read by callers afterwards
+  ch->fault_fail_every = fail_every;
+  ch->fault_close_every = close_every;
+  ch->fault_delay_every = delay_every;
+  ch->fault_delay_ms = delay_ms;
+  ch->fault_err_code = err_code != 0 ? err_code : 2001;  // EINTERNAL
+  return 0;
+}
+
 long tb_channel_call(tb_channel* ch, const void* meta, size_t meta_len,
                      const void* payload, size_t payload_len, const void* att,
                      size_t att_len, uint32_t flags_extra, tb_iobuf* body_out,
@@ -1967,6 +2098,27 @@ long tb_channel_call(tb_channel* ch, const void* meta, size_t meta_len,
                      uint32_t* err_code_out, int timeout_ms) {
   int sticky = ch->err.load(std::memory_order_acquire);
   if (sticky != 0) return sticky;
+  if (ch->fault_fail_every || ch->fault_close_every || ch->fault_delay_every) {
+    // deterministic injection (counter schedule, not RNG — the same call
+    // sequence injects the same faults, the FaultInjector discipline)
+    uint64_t n = ch->fault_counter.fetch_add(1, std::memory_order_relaxed) + 1;
+    if (ch->fault_close_every && n % ch->fault_close_every == 0) {
+      // kill the connection mid-run: the write below fails and the
+      // caller's redial machinery owns recovery (the socket-seam
+      // ACTION_CLOSE analog)
+      shutdown(ch->fd, SHUT_RDWR);
+    } else if (ch->fault_fail_every && n % ch->fault_fail_every == 0) {
+      // a completed-but-failed RPC, channel intact: the server "browned
+      // out" this one call
+      if (err_code_out) *err_code_out = ch->fault_err_code;
+      if (meta_len_out) *meta_len_out = 0;
+      return 0;
+    }
+    if (ch->fault_delay_every && n % ch->fault_delay_every == 0 &&
+        ch->fault_delay_ms > 0) {
+      usleep(static_cast<useconds_t>(ch->fault_delay_ms) * 1000);
+    }
+  }
   uint64_t deadline = now_ms() + (timeout_ms > 0 ? timeout_ms : 60000);
   uint64_t cid = ch->next_cid.fetch_add(1, std::memory_order_relaxed);
   Pending p;

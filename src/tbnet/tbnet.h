@@ -141,6 +141,19 @@ void tb_server_destroy(tb_server* s);
 void tb_server_stats(const tb_server* s, uint64_t* accepted,
                      uint64_t* native_reqs, uint64_t* cb_frames,
                      uint64_t* handoffs, uint64_t* live_conns);
+// Requests answered EDEADLINE because their propagated deadline (RpcMeta
+// timeout_ms / JSON meta timeout_ms) expired before dispatch — the
+// native plane's feed for the deadline_shed_count bvar.
+uint64_t tb_server_deadline_sheds(const tb_server* s);
+// Lame-duck: stop accepting NEW connections while existing ones keep
+// being served.  Asynchronous — the listener teardown runs on the loop
+// thread that owns it at its next wakeup (sub-ms).  Irreversible for
+// this server; tb_server_stop still performs the full teardown.
+void tb_server_pause_accept(tb_server* s);
+// Close every connection idle (no readable burst) for >= idle_ms.
+// Thread-safe (shutdown(); the owning loop reaps via EPOLLHUP — the
+// tb_conn_close discipline).  Returns the number of connections culled.
+long tb_server_close_idle(tb_server* s, uint64_t idle_ms);
 
 // ---- per-connection surface (used by the Python frame route) ----
 // Queue a tbus_std response frame on the connection (tbus_std conns only;
@@ -172,6 +185,15 @@ tb_channel* tb_channel_connect(const char* ip, int port, int timeout_ms,
 // proto bytes (decode on the Python side); err_code_out carries the
 // RpcResponseMeta error_code.  Returns 0, or -1 for an unknown protocol.
 int tb_channel_set_protocol(tb_channel* ch, int proto);
+// Counter-scheduled client-side fault injection (the native analog of
+// the Python Socket.write seam, rpc/fault_injector.py): every
+// fail_every'th tb_channel_call answers err_code (0 -> EINTERNAL)
+// without touching the wire, every close_every'th kills the connection
+// mid-run, every delay_every'th sleeps delay_ms first.  0 disables a
+// schedule; set before issuing concurrent calls.  Returns 0.
+int tb_channel_set_fault(tb_channel* ch, uint32_t fail_every,
+                         uint32_t close_every, uint32_t delay_every,
+                         uint32_t delay_ms, uint32_t err_code);
 // Synchronous call over the shared connection.  Thread-safe: concurrent
 // callers elect one reader which pumps completions for everyone (the
 // single-connection multi-caller shape of the reference's client,

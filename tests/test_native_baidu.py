@@ -257,10 +257,15 @@ class TestClientWireExactness:
         assert body.to_bytes() == b"ok"
         return got["req"]
 
+    # every call stamps its remaining deadline budget on the wire
+    # (RpcRequestMeta.timeout_ms, field 8) — the expected frames carry
+    # the capture helper's timeout_ms=5000
+
     def test_request_frame_byte_exact(self):
         req = self._capture_one_call(b"the-payload", b"")
         assert req == baidu_std.pack_request(
-            Meta(service="svc", method="mth"), b"the-payload",
+            Meta(service="svc", method="mth", timeout_ms=5000),
+            b"the-payload",
             correlation_id=1,
         )
 
@@ -268,8 +273,8 @@ class TestClientWireExactness:
         att = b"ATTACH" * 20
         req = self._capture_one_call(b"pp", att)
         assert req == baidu_std.pack_request(
-            Meta(service="svc", method="mth"), b"pp", correlation_id=1,
-            attachment=att,
+            Meta(service="svc", method="mth", timeout_ms=5000), b"pp",
+            correlation_id=1, attachment=att,
         )
 
     def test_traced_request_carries_dapper_ids_byte_exact(self):
@@ -279,8 +284,8 @@ class TestClientWireExactness:
         ids = dict(log_id=42, trace_id=0xDEADBEEF01, span_id=7)
         req = self._capture_one_call(b"pp", b"", **ids)
         assert req == baidu_std.pack_request(
-            Meta(service="svc", method="mth", **ids), b"pp",
-            correlation_id=1,
+            Meta(service="svc", method="mth", timeout_ms=5000, **ids),
+            b"pp", correlation_id=1,
         )
 
 

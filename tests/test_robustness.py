@@ -1085,3 +1085,776 @@ class TestDeviceLinkBackoff:
         with pytest.raises((OSError, ConnectionError)):
             dlm.get_or_create(ep, timeout_ms=500)
         assert dlm._backoff[key][0] == 2
+
+
+# ---------------------------------------------------------------------------
+# Fabric-wide failure semantics (PR 8): deadline propagation, collective
+# session abort/recovery, lame-duck drain
+# ---------------------------------------------------------------------------
+
+
+class _CaptureSock:
+    """Duck-typed connection for driving Server.process_request directly:
+    captures response bytes (materialized) in wire order."""
+
+    def __init__(self):
+        self.remote = None
+        self.context = {}
+        self.written = []
+
+    def write(self, data, **kw):
+        self.written.append(
+            data.to_bytes() if hasattr(data, "to_bytes") else bytes(data)
+        )
+        return 0
+
+
+class TestDeadlinePropagation:
+    """The propagated deadline (tbus_std JSON meta / PRPC RpcRequestMeta
+    field 8 ``timeout_ms``): servers shed expired work with EDEADLINE
+    before dispatch; the budget decrements across hops."""
+
+    def _shed_server(self):
+        srv = Server()
+        hits = []
+        srv.add_service("S", {"m": lambda c, r: (hits.append(1), b"ok")[1]})
+        assert srv.start(0)
+        return srv, hits
+
+    def test_expired_at_arrival_is_shed_without_dispatch(self):
+        from incubator_brpc_tpu.protocol.tbus_std import (
+            Meta,
+            ParsedFrame,
+            try_parse_frame,
+        )
+        from incubator_brpc_tpu.rpc.server import deadline_shed_count
+
+        srv, hits = self._shed_server()
+        try:
+            sock = _CaptureSock()
+            frame = ParsedFrame(
+                meta=Meta(service="S", method="m", timeout_ms=50),
+                payload=b"x",
+                correlation_id=7,
+            )
+            frame.arrival_ts = time.monotonic() - 0.2  # 200 ms in queue
+            before = deadline_shed_count.get_value()
+            srv.process_request(sock, frame)
+            assert not hits, "shed request must never invoke the method"
+            resp, _ = try_parse_frame(sock.written[0])
+            assert resp.error_code == ErrorCode.EDEADLINE
+            assert resp.meta.error_text == "Deadline expired before dispatch"
+            assert deadline_shed_count.get_value() == before + 1
+        finally:
+            srv.stop()
+            srv.join(timeout=5)
+
+    def test_unexpired_budget_dispatches_and_sets_deadline_left(self):
+        from incubator_brpc_tpu.protocol.tbus_std import Meta, ParsedFrame
+
+        srv = Server()
+        seen = {}
+
+        def handler(cntl, req):
+            seen["left"] = cntl.deadline_left_ms()
+            seen["timeout"] = cntl.timeout_ms
+            return b"ok"
+
+        srv.add_service("S", {"m": handler})
+        assert srv.start(0)
+        try:
+            frame = ParsedFrame(
+                meta=Meta(service="S", method="m", timeout_ms=5000),
+                payload=b"x",
+                correlation_id=8,
+            )
+            frame.arrival_ts = time.monotonic()
+            srv.process_request(_CaptureSock(), frame)
+            assert seen["timeout"] == 5000
+            assert 0 < seen["left"] <= 5000
+        finally:
+            srv.stop()
+            srv.join(timeout=5)
+
+    def test_budget_decrements_across_hops(self):
+        """edge -> A -> B: B sees strictly less budget than A stamped,
+        shrunk by at least A's handler time — the Controller decrement."""
+        seen = {}
+
+        srv_b = Server()
+        srv_b.add_service(
+            "B",
+            {
+                "m": lambda c, r: (
+                    seen.__setitem__(
+                        "b_budget", c.request_meta.timeout_ms
+                    ),
+                    b"ok",
+                )[1]
+            },
+        )
+        assert srv_b.start(0)
+
+        def a_handler(cntl, req):
+            seen["a_budget"] = cntl.request_meta.timeout_ms
+            time.sleep(0.12)  # burn budget before the downstream hop
+            ch = Channel()
+            assert ch.init(f"127.0.0.1:{srv_b.port}")
+            # NOTE: no explicit timeout — the downstream call inherits
+            # what is LEFT of the caller's propagated budget
+            c2 = ch.call_method("B", "m", b"y")
+            assert c2.ok(), c2.error_text
+            return b"ok"
+
+        srv_a = Server()
+        srv_a.add_service("A", {"m": a_handler})
+        assert srv_a.start(0)
+        try:
+            ch = Channel()
+            assert ch.init(
+                f"127.0.0.1:{srv_a.port}",
+                options=ChannelOptions(timeout_ms=2000),
+            )
+            c = ch.call_method("A", "m", b"x")
+            assert c.ok(), c.error_text
+            assert 0 < seen["a_budget"] <= 2000
+            assert seen["b_budget"] < seen["a_budget"] - 100, seen
+        finally:
+            srv_a.stop()
+            srv_b.stop()
+            srv_a.join(timeout=5)
+            srv_b.join(timeout=5)
+
+    def test_spent_budget_fails_fast_without_wire_traffic(self):
+        from incubator_brpc_tpu.rpc import deadline as dl
+
+        srv, hits = self._shed_server()
+        try:
+            ch = Channel()
+            assert ch.init(f"127.0.0.1:{srv.port}")
+            prev = dl.push_deadline(time.monotonic() - 0.01)
+            try:
+                c = ch.call_method("S", "m", b"x")
+            finally:
+                dl.pop_deadline(prev)
+            assert c.error_code == ErrorCode.EDEADLINE
+            assert not hits, "an expired budget must not reach the wire"
+        finally:
+            srv.stop()
+            srv.join(timeout=5)
+
+
+def _build_slow_native_lib(tmp_path):
+    """Compile a tb_native_fn that sleeps 80 ms — the burst-delay that
+    makes the SECOND frame of a batch expire mid-queue on the C++ plane.
+    Skips when no C toolchain is available."""
+    import subprocess
+
+    src = tmp_path / "slow.c"
+    src.write_text(
+        "#include <stddef.h>\n"
+        "#include <stdlib.h>\n"
+        "#include <unistd.h>\n"
+        "int tb_slow80(void* ud, const char* req, size_t n, char** resp,\n"
+        "              size_t* resp_len) {\n"
+        "    usleep(80000);\n"
+        "    *resp = (char*)malloc(1);\n"
+        "    (*resp)[0] = 's';\n"
+        "    *resp_len = 1;\n"
+        "    return 0;\n"
+        "}\n"
+    )
+    so = tmp_path / "slow.so"
+    try:
+        subprocess.run(
+            ["cc", "-shared", "-fPIC", "-O1", "-o", str(so), str(src)],
+            check=True,
+            capture_output=True,
+            timeout=60,
+        )
+    except (OSError, subprocess.SubprocessError):
+        pytest.skip("no C toolchain for the slow native method")
+    return so
+
+
+def _read_prpc_frames(sock, n):
+    import struct as _struct
+
+    out = []
+    buf = b""
+    for _ in range(n):
+        while len(buf) < 12:
+            buf += sock.recv(4096)
+        body, _meta = _struct.unpack_from(">II", buf, 4)
+        total = 12 + body
+        while len(buf) < total:
+            buf += sock.recv(4096)
+        out.append(buf[:total])
+        buf = buf[total:]
+    return out
+
+
+class TestNativeDeadlineShed:
+    """The C++ cutter sheds expired-mid-queue work natively — EDEADLINE
+    byte-identical to the Python route, counted and telemetry-recorded."""
+
+    @pytest.fixture
+    def native_shed(self, tmp_path):
+        from incubator_brpc_tpu.transport import native_plane as np_mod
+
+        if not np_mod.NET_AVAILABLE:
+            pytest.skip("native plane unavailable")
+        so = _build_slow_native_lib(tmp_path)
+        srv = Server(ServerOptions(native_plane=True))
+        slow = np_mod.native_method_lib(
+            str(so), "tb_slow80", lambda c, r: b"s"
+        )
+        srv.add_service(
+            "svc", {"slow": slow, "echo": np_mod.native_echo}
+        )
+        assert srv.start(0)
+        if "svc.slow" not in srv._native_plane.native_method_names():
+            srv.stop()
+            pytest.skip("slow method did not register natively")
+        yield srv
+        srv.stop()
+        srv.join(timeout=5)
+
+    def test_native_shed_byte_identical_to_python_plane(self, native_shed):
+        import socket as pysocket
+
+        from incubator_brpc_tpu.protocol import baidu_std
+        from incubator_brpc_tpu.protocol.tbus_std import Meta, ParsedFrame
+        from incubator_brpc_tpu.rpc.server import deadline_shed_count
+
+        srv = native_shed
+        before = deadline_shed_count.get_value()
+        # one burst: [slow (80 ms, no deadline), echo (30 ms budget)] —
+        # the second frame expires while the first monopolizes the loop
+        f1 = baidu_std.pack_request(
+            Meta(service="svc", method="slow"), b"a", correlation_id=1
+        )
+        f2 = baidu_std.pack_request(
+            Meta(service="svc", method="echo", timeout_ms=30),
+            b"b",
+            correlation_id=2,
+        )
+        with pysocket.create_connection(
+            ("127.0.0.1", srv.port), timeout=10
+        ) as s:
+            s.sendall(f1 + f2)
+            r1, r2 = _read_prpc_frames(s, 2)
+        ok1, _ = baidu_std.try_parse_frame(r1)
+        shed, _ = baidu_std.try_parse_frame(r2)
+        assert ok1.error_code == 0
+        assert shed.error_code == ErrorCode.EDEADLINE
+        assert shed.meta.error_text == "Deadline expired before dispatch"
+
+        # the Python plane's shed for the SAME request: byte-identical
+        py_srv = Server()
+        py_srv.add_service("svc", {"echo": lambda c, r: r})
+        assert py_srv.start(0)
+        try:
+            cap = _CaptureSock()
+            frame = ParsedFrame(
+                meta=Meta(service="svc", method="echo", timeout_ms=30),
+                payload=b"b",
+                correlation_id=2,
+            )
+            frame.wire_protocol = "baidu_std"
+            frame.arrival_ts = time.monotonic() - 0.08
+            py_srv.process_request(cap, frame)
+            assert cap.written[0] == r2, "native and Python sheds differ"
+        finally:
+            py_srv.stop()
+            py_srv.join(timeout=5)
+
+        # counted: the per-port C++ counter immediately; the global
+        # deadline_shed_count once the telemetry drain folds it in
+        assert srv._native_plane.stats()["deadline_sheds"] == 1
+        srv._native_plane.drain_telemetry()
+        assert deadline_shed_count.get_value() >= before + 1
+
+    def test_fresh_deadline_rides_the_fast_path(self, native_shed):
+        """A deadline-carrying frame with budget left stays on the
+        interpreter-free plane (the scanner parses timeout_ms instead of
+        routing to Python)."""
+        srv = native_shed
+        ch = Channel()
+        assert ch.init(
+            f"127.0.0.1:{srv.port}",
+            options=ChannelOptions(
+                native_plane=True, protocol="baidu_std", timeout_ms=2000
+            ),
+        )
+        base = srv._native_plane.stats()
+        c = ch.call_method("svc", "echo", b"hello")
+        assert c.ok() and c.response_payload == b"hello"
+        after = srv._native_plane.stats()
+        assert after["native_reqs"] == base["native_reqs"] + 1
+        assert after["cb_frames"] == base["cb_frames"]
+
+
+class TestSessionAbortChaosDrill:
+    """The acceptance chaos drill: kill one party mid multi-step session;
+    survivors unblock with ESESSION within 2x the session deadline, the
+    dead node's breaker trips, and a re-proposed session over the
+    survivors succeeds."""
+
+    DEADLINE_MS = 4000
+
+    @pytest.fixture
+    def mesh(self, tuned_flags):
+        import jax
+
+        from incubator_brpc_tpu.parallel.compat import resolve_shard_map
+
+        try:
+            resolve_shard_map()
+        except ImportError:
+            pytest.skip("no shard_map in this jax build")
+        if len(jax.devices()) < 4:
+            pytest.skip("needs a 4+ device mesh")
+        # breaker windows sized so the dead party's refused dials trip it
+        # within a screenful of calls (the TestBrownoutRecovery tuning)
+        tuned_flags("circuit_breaker_short_window_size", 30)
+        tuned_flags("circuit_breaker_long_window_size", 300)
+        tuned_flags("circuit_breaker_min_isolation_duration_ms", 60000)
+        tuned_flags("enable_circuit_breaker", True)
+        from incubator_brpc_tpu.rpc import device_method
+        from incubator_brpc_tpu.rpc.device_method import (
+            DeviceMethod,
+            lookup_device_method,
+            register_device_method,
+        )
+        from incubator_brpc_tpu.transport.mc_worker import (
+            SESSION_WIDTH,
+            _scale_psum_kernel,
+        )
+
+        prev = lookup_device_method("dsvc", "scale")
+        register_device_method(
+            "dsvc", "scale", DeviceMethod(_scale_psum_kernel, width=SESSION_WIDTH)
+        )
+        servers, channels = [], []
+        for i in range(3):
+            s = Server(
+                ServerOptions(
+                    device_index=i + 1,
+                    enable_collective_service=True,
+                    collective_max_concurrency=0,
+                )
+            )
+            s.add_service(
+                "dsvc",
+                {"scale": device_method(_scale_psum_kernel, width=SESSION_WIDTH)},
+            )
+            assert s.start(0)
+            servers.append(s)
+            ch = Channel()
+            # every party behind its own breaker-owning LB (list:// =
+            # LoadBalancerWithNaming), so the drill can prove WHO gets
+            # charged for the death
+            assert ch.init(
+                f"list://127.0.0.1:{s.port}",
+                lb_name="rr",
+                options=ChannelOptions(max_retry=1, timeout_ms=8000),
+            )
+            channels.append(ch)
+        party_ids = [d.id for d in jax.devices()[1:4]]
+        yield servers, channels, party_ids
+        from incubator_brpc_tpu.parallel import mc_dispatch
+
+        mc_dispatch.set_step_hook(None)
+        for ch in channels:
+            if ch._lb is not None:
+                ch._lb.stop()
+        for s in servers:
+            s.stop()
+            s.join(timeout=5)
+
+    def test_party_death_aborts_survivors_and_recovery_succeeds(self, mesh):
+        from incubator_brpc_tpu.parallel import mc_dispatch
+
+        servers, channels, party_ids = mesh
+        operands = [bytes([i + 1]) * 8 for i in range(3)]
+        before_aborts = mc_dispatch.dispatch_aborts.get_value()
+
+        # park every party between steps so the kill lands MID-session
+        mc_dispatch.set_step_hook(lambda step: time.sleep(0.03))
+        killer = threading.Timer(
+            0.4, lambda: (servers[0].stop(), servers[0].join(timeout=3))
+        )
+        killer.start()
+        t0 = time.monotonic()
+        try:
+            with pytest.raises(mc_dispatch.SessionAborted) as exc:
+                mc_dispatch.propose_dispatch(
+                    channels,
+                    party_ids,
+                    "dsvc",
+                    "scale",
+                    operands,
+                    steps=120,
+                    proposer_index=None,
+                    timeout_ms=30000,
+                    session_deadline_ms=self.DEADLINE_MS,
+                )
+        finally:
+            killer.cancel()
+        elapsed = time.monotonic() - t0
+        assert elapsed < 2 * self.DEADLINE_MS / 1000.0
+        assert exc.value.dead_indexes == (0,)
+        assert exc.value.survivor_indexes == (1, 2)
+        assert exc.value.error_code == ErrorCode.ESESSION
+
+        # every survivor's handler unblocked (returned ESESSION) within
+        # 2x the session deadline — not wedged in the lockstep barrier
+        deadline = t0 + 2 * self.DEADLINE_MS / 1000.0
+        assert wait_until(
+            lambda: servers[1]._nprocessing == 0
+            and servers[2]._nprocessing == 0
+            and mc_dispatch.active_sessions() == 0,
+            timeout=max(0.1, deadline - time.monotonic()),
+        )
+        assert mc_dispatch.dispatch_aborts.get_value() > before_aborts
+        mc_dispatch.set_step_hook(None)
+
+        # the dead node's breaker trips (connect-refused selects feed it);
+        # the survivors' breakers stay closed — their ESESSION answers are
+        # excluded from error cost
+        for _ in range(30):
+            if channels[0]._lb.isolated_servers():
+                break
+            channels[0].call_method("dsvc", "scale", b"x")
+        assert channels[0]._lb.isolated_servers(), (
+            "dead party's breaker never tripped"
+        )
+        for i in (1, 2):
+            assert not channels[i]._lb.isolated_servers(), (
+                f"survivor {i}'s breaker tripped off cooperative aborts"
+            )
+
+        # recovery: the next session over the surviving set completes
+        out = mc_dispatch.propose_dispatch(
+            channels[1:],
+            party_ids[1:],
+            "dsvc",
+            "scale",
+            operands[1:],
+            steps=2,
+            proposer_index=None,
+            timeout_ms=30000,
+        )
+        assert out["final_steps"] == 2
+        assert all(r is not None for r in out["results"])
+
+    def test_propose_with_recovery_drops_dead_party(self, mesh):
+        from incubator_brpc_tpu.parallel import mc_dispatch
+
+        servers, channels, party_ids = mesh
+        operands = [bytes([i + 1]) * 8 for i in range(3)]
+        mc_dispatch.set_step_hook(lambda step: time.sleep(0.03))
+        killer = threading.Timer(
+            0.3, lambda: (servers[0].stop(), servers[0].join(timeout=3))
+        )
+        killer.start()
+        try:
+            out = mc_dispatch.propose_with_recovery(
+                channels,
+                party_ids,
+                "dsvc",
+                "scale",
+                operands,
+                steps=40,
+                proposer_index=None,
+                timeout_ms=30000,
+                session_deadline_ms=self.DEADLINE_MS,
+            )
+        finally:
+            killer.cancel()
+            mc_dispatch.set_step_hook(None)
+        # the re-proposed session ran over the survivors only
+        assert out["dead_party_ids"] == [party_ids[0]]
+        assert out["final_steps"] == 40
+        assert out["results"][0] is not None and out["results"][1] is not None
+
+    def test_esession_excluded_from_breaker_cost(self, tuned_flags):
+        """Unit: N ESESSION completions never charge a node's breaker;
+        the same N EFAILEDSOCKET completions trip it."""
+        tuned_flags("circuit_breaker_short_window_size", 10)
+        tuned_flags("enable_circuit_breaker", True)
+        srv = Server()
+        srv.add_service("e", {"m": lambda c, r: b"ok"})
+        assert srv.start(0)
+        ch = Channel()
+        assert ch.init(
+            f"list://127.0.0.1:{srv.port}",
+            lb_name="rr",
+            options=ChannelOptions(max_retry=0, timeout_ms=2000),
+        )
+        try:
+            lb = ch._lb
+            assert ch.call_method("e", "m", b"x").ok()
+            sock = lb.select_server()
+            for _ in range(50):
+                lb.feedback(sock, 1000.0, ErrorCode.ESESSION)
+                lb.feedback(sock, 1000.0, ErrorCode.EDEADLINE)
+            assert not lb.isolated_servers(), (
+                "cooperative failure codes charged the breaker"
+            )
+            for _ in range(50):
+                lb.feedback(sock, 1000.0, ErrorCode.EFAILEDSOCKET)
+                if lb.isolated_servers():
+                    break
+            assert lb.isolated_servers(), "real errors must still trip it"
+        finally:
+            if ch._lb is not None:
+                ch._lb.stop()
+            srv.stop()
+            srv.join(timeout=5)
+
+
+class TestLameDuck:
+    """enter_lame_duck / /quitquitquit: accepting stops, /health flips,
+    in-flight work drains with zero connection resets, then hard stop."""
+
+    def test_drains_inflight_flood_cleanly(self):
+        srv = Server()
+        srv.add_service(
+            "S", {"slow": lambda c, r: (time.sleep(0.25), b"done")[1]}
+        )
+        assert srv.start(0)
+        ch = Channel()
+        assert ch.init(
+            f"127.0.0.1:{srv.port}", options=ChannelOptions(timeout_ms=8000)
+        )
+        results = []
+        lock = threading.Lock()
+
+        def call():
+            c = ch.call("S", "slow", b"x")
+            with lock:
+                results.append(c.error_code)
+
+        ts = [threading.Thread(target=call) for _ in range(6)]
+        for t in ts:
+            t.start()
+        assert wait_until(lambda: srv._nprocessing > 0, timeout=5.0)
+
+        drain = srv.enter_lame_duck(grace_s=10)
+        assert drain is not None
+        assert srv.lame_duck
+
+        # /health flips immediately
+        from incubator_brpc_tpu.builtin.pages import _health
+
+        class F:
+            query = {}
+            path = "/health"
+
+        assert _health(srv, F)[0] == 503
+
+        # NEW work is refused with (retriable) ELOGOFF, never a reset
+        c2 = ch.call("S", "slow", b"y")
+        assert c2.error_code == ErrorCode.ELOGOFF
+
+        for t in ts:
+            t.join()
+        drain.join(timeout=15)
+        assert not drain.is_alive()
+        # zero connection-reset errors: every in-flight call completed OK
+        assert results and all(code == 0 for code in results), results
+        assert srv._stopping
+
+    def test_quitquitquit_page_triggers_drain(self, flags):
+        from incubator_brpc_tpu.builtin.pages import _quitquitquit
+
+        flags("enable_quitquitquit", True)
+        srv = Server()
+        srv.add_service("S", {"m": lambda c, r: b"ok"})
+        assert srv.start(0)
+
+        class F:
+            query = {"grace_s": "5"}
+            path = "/quitquitquit"
+
+        status, _ct, body = _quitquitquit(srv, F)
+        assert status == 200 and b"lame-duck" in body
+        assert srv.lame_duck
+        srv._lame_duck_thread.join(timeout=10)
+        assert srv._stopping
+
+        class Bad:
+            query = {"grace_s": "-1"}
+            path = "/quitquitquit"
+
+        assert _quitquitquit(srv, Bad)[0] == 400
+
+    def test_quitquitquit_gated_off_by_default(self):
+        """An unauthenticated remote stop must be opt-in (the /dir
+        discipline): with the flag at its default the page refuses."""
+        from incubator_brpc_tpu.builtin.pages import _quitquitquit
+
+        srv = Server()
+        srv.add_service("S", {"m": lambda c, r: b"ok"})
+        assert srv.start(0)
+        try:
+            class F:
+                query = {}
+                path = "/quitquitquit"
+
+            status, _ct, body = _quitquitquit(srv, F)
+            assert status == 403 and b"enable_quitquitquit" in body
+            assert not srv.lame_duck
+        finally:
+            srv.stop()
+            srv.join(timeout=5)
+
+    def test_lame_duck_drill_tool(self, flags):
+        """The one-command drain-under-load run: rpc_press
+        --lame-duck-drill against a live server reports a clean drain."""
+        import sys
+
+        flags("enable_quitquitquit", True)
+        sys.path.insert(0, ".")
+        from tools.rpc_press import run_lame_duck_drill
+
+        srv = Server()
+        srv.add_service("S", {"echo": lambda c, r: r})
+        assert srv.start(0)
+        counts = run_lame_duck_drill(
+            f"127.0.0.1:{srv.port}",
+            "S",
+            "echo",
+            b"x" * 32,
+            threads=3,
+            duration=2.0,
+            timeout_ms=3000,
+        )
+        assert counts["drained_clean"], counts
+        assert counts["ok"] > 0
+        assert counts["reset"] == 0
+        assert srv._stopping  # the drill terminated the target
+
+    def test_sigterm_flag_installs_handler(self, tuned_flags):
+        import signal
+
+        from incubator_brpc_tpu.rpc import server as server_mod
+
+        tuned_flags("graceful_quit_on_sigterm", True)
+        prev_state = dict(server_mod._sigterm_state)
+        prev_handler = signal.getsignal(signal.SIGTERM)
+        server_mod._sigterm_state["installed"] = False
+        try:
+            srv = Server()
+            srv.add_service("S", {"m": lambda c, r: b"ok"})
+            assert srv.start(0)
+            assert signal.getsignal(signal.SIGTERM) is server_mod._on_sigterm
+            srv.stop()
+            srv.join(timeout=5)
+        finally:
+            signal.signal(signal.SIGTERM, prev_handler)
+            server_mod._sigterm_state.update(prev_state)
+
+
+class TestNativeIdleReap:
+    def test_idle_native_connection_reaped(self):
+        """idle_timeout_s is enforced on native-plane ports now: an idle
+        connection is culled from the C++ loops (satellite — the old
+        behavior was a warning and an immortal connection)."""
+        import socket as pysocket
+
+        from incubator_brpc_tpu.transport import native_plane as np_mod
+
+        if not np_mod.NET_AVAILABLE:
+            pytest.skip("native plane unavailable")
+        srv = Server(
+            ServerOptions(native_plane=True, idle_timeout_s=0.4)
+        )
+        srv.add_service("svc", {"echo": np_mod.native_echo})
+        assert srv.start(0)
+        try:
+            from incubator_brpc_tpu.protocol import baidu_std
+            from incubator_brpc_tpu.protocol.tbus_std import Meta
+
+            s = pysocket.create_connection(("127.0.0.1", srv.port), timeout=10)
+            s.sendall(
+                baidu_std.pack_request(
+                    Meta(service="svc", method="echo"), b"hi", correlation_id=1
+                )
+            )
+            (r1,) = _read_prpc_frames(s, 1)
+            frame, _ = baidu_std.try_parse_frame(r1)
+            assert frame.error_code == 0
+            # now idle: the reap (scan at idle/2) must close it within a
+            # few scan periods — recv returns b"" on the culled fd
+            s.settimeout(5.0)
+            got = s.recv(1)
+            assert got == b"", "idle native connection was not reaped"
+            s.close()
+        finally:
+            srv.stop()
+            srv.join(timeout=5)
+
+
+class TestNativeFaultSeam:
+    """tb_channel_set_fault: the counter-scheduled client fault seam on
+    the C++ plane (rpc_press --native-plane --fault-rate no longer forces
+    the Python route)."""
+
+    def test_deterministic_fail_schedule(self, flags):
+        from incubator_brpc_tpu.transport import native_plane as np_mod
+
+        if not np_mod.NET_AVAILABLE:
+            pytest.skip("native plane unavailable")
+        flags("fault_injection", True)
+        np_mod.install_native_client_fault(fail_every=4)
+        srv = Server(ServerOptions(native_plane=True))
+        srv.add_service("svc", {"echo": np_mod.native_echo})
+        assert srv.start(0)
+        nch = None
+        try:
+            nch = np_mod.NativeClientChannel("127.0.0.1", srv.port)
+            codes = []
+            for _ in range(12):
+                _rc, ec, _m, _b = nch.call(
+                    "svc", "echo", b"x", timeout_ms=2000
+                )
+                codes.append(ec)
+            # exact-rate counter schedule: every 4th call, same every run
+            assert [i for i, ec in enumerate(codes) if ec] == [3, 7, 11]
+            assert all(
+                ec == ErrorCode.EINTERNAL for ec in codes if ec
+            )
+        finally:
+            np_mod.install_native_client_fault()  # clear
+            if nch is not None:
+                nch.close()
+            srv.stop()
+            srv.join(timeout=5)
+
+    def test_master_flag_gates_arming(self, flags):
+        from incubator_brpc_tpu.transport import native_plane as np_mod
+
+        if not np_mod.NET_AVAILABLE:
+            pytest.skip("native plane unavailable")
+        flags("fault_injection", False)  # master flag OFF
+        np_mod.install_native_client_fault(fail_every=2)
+        srv = Server(ServerOptions(native_plane=True))
+        srv.add_service("svc", {"echo": np_mod.native_echo})
+        assert srv.start(0)
+        nch = None
+        try:
+            nch = np_mod.NativeClientChannel("127.0.0.1", srv.port)
+            for _ in range(6):
+                _rc, ec, _m, _b = nch.call(
+                    "svc", "echo", b"x", timeout_ms=2000
+                )
+                assert ec == 0  # nothing injected without the master flag
+        finally:
+            np_mod.install_native_client_fault()
+            if nch is not None:
+                nch.close()
+            srv.stop()
+            srv.join(timeout=5)

@@ -873,6 +873,38 @@ class TestModelChecker:
             for v in res.violations
         ), _fmt(res.violations)
 
+    # -- the fault plane (party death + abort convergence) ----------------
+
+    def test_party_death_scope_holds(self):
+        """The shipped fault scope: one party may die at any instant —
+        every reachable terminal state leaves no LIVING party stuck in
+        the lockstep barrier (the abort broadcast + detection converge)."""
+        res = modelcheck.explore(
+            SessionModel(
+                n_parties=3, steps=2, floors=(0, 1, 3), max_deaths=1
+            )
+        )
+        assert not res.violations, _fmt(res.violations)
+        assert res.states > 10_000  # a real fault space, not a toy walk
+
+    def test_dropped_abort_broadcast_flips_red(self):
+        """The acceptance meta-test: a proposer that aborts without
+        broadcasting leaves survivors wedged in the barrier — the
+        abort-convergence check names the stuck party."""
+        res = modelcheck.explore(
+            SessionModel(max_deaths=1, drop_abort=True)
+        )
+        assert any(
+            v.rule == "model-unsafe"
+            and "stuck in the lockstep barrier" in v.message
+            for v in res.violations
+        ), _fmt(res.violations)
+
+    def test_default_models_cover_party_death(self):
+        """make verify-models runs the extended scope by default."""
+        names = [m.name for m in modelcheck.default_models()]
+        assert "mc_dispatch_session_party_death" in names
+
     def test_unrevivable_breaker_flips_red(self):
         res = modelcheck.explore(BreakerModel(reset_keeps_broken=True))
         assert any(

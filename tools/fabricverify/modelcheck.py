@@ -164,10 +164,13 @@ def explore(model, max_states: int = _MAX_STATES) -> Result:
 
 def default_models() -> List[object]:
     """The shipped scope: the acceptance-criterion 3-party/2-step session
-    space (with a floor spread that exercises the max-join) plus the full
-    breaker machine."""
+    space (with a floor spread that exercises the max-join), the same
+    space under the fault plane (one party may die at any instant — the
+    abort-convergence property: no survivor is ever left stuck in the
+    lockstep barrier), plus the full breaker machine."""
     return [
         SessionModel(n_parties=3, steps=2, floors=(0, 1, 3)),
+        SessionModel(n_parties=3, steps=2, floors=(0, 1, 3), max_deaths=1),
         BreakerModel(),
     ]
 
@@ -192,13 +195,14 @@ def main(argv=None) -> int:
         help="session model proposed step count (default 2)",
     )
     args = ap.parse_args(argv)
+    floors = tuple(min(i * 2, args.steps + 1) for i in range(args.parties))
     models = [
         SessionModel(
-            n_parties=args.parties,
-            steps=args.steps,
-            floors=tuple(
-                min(i * 2, args.steps + 1) for i in range(args.parties)
-            ),
+            n_parties=args.parties, steps=args.steps, floors=floors
+        ),
+        SessionModel(
+            n_parties=args.parties, steps=args.steps, floors=floors,
+            max_deaths=1,
         ),
         BreakerModel(),
     ]

@@ -8,9 +8,10 @@ implemented in the package.  Extraction rules (see docs/ANALYSIS.md,
   branch in the real code, nothing that doesn't (payload bytes,
   latencies and ids are abstracted away; *counts and phases* stay).
 - Every nondeterministic choice the real system faces (message
-  delivery order, drops, duplicates, timer firings) is an explicit
-  ``actions()`` branch, so the explorer visits ALL interleavings that
-  the bounded scope admits — the substitute for production soak.
+  delivery order, drops, duplicates, timer firings, party deaths) is an
+  explicit ``actions()`` branch, so the explorer visits ALL
+  interleavings that the bounded scope admits — the substitute for
+  production soak.
 - Known-bad variants are constructor flags (``drop_close_echo=True``),
   NOT separate models: the meta-tests instantiate the mutation and
   assert the checker flips red, proving the property actually binds.
@@ -19,12 +20,19 @@ Two models ship:
 
 - :class:`SessionModel` — the mc_dispatch N-party session protocol
   (parallel/mc_dispatch.py): accept fan-out + barrier, the monotone
-  ``final = max(proposed, all targets)`` join, run fan-out, and the
-  convergent close barrier where every party echoes ``final``.  The
-  environment may reorder (inherent — delivery picks any in-flight
-  message), drop (≤ ``max_drops``) and duplicate (≤ ``max_dups``)
-  messages.  The proposer may time out ONLY when something was actually
-  dropped — so a deadlock on a drop-free path is a protocol bug, not an
+  ``final = max(proposed, all targets)`` join, run fan-out into the
+  LOCKSTEP BARRIER (a party that entered its chain is blocked until
+  every party joins — the device collective), the convergent close
+  barrier where every party echoes ``final``, and the fault plane: up
+  to ``max_deaths`` parties may die at any instant; the proposer
+  detects an outstanding dead party (the failed-RPC / socket feedback
+  of the real code) and broadcasts ABORT so every survivor leaves the
+  barrier — the abort-convergence property asserts no living party is
+  ever left stuck in the barrier at the end.  The environment may
+  reorder (inherent — delivery picks any in-flight message), drop
+  (≤ ``max_drops``) and duplicate (≤ ``max_dups``) messages.  The
+  proposer may time out ONLY when something was actually dropped — so
+  a deadlock on a drop-free, death-free path is a protocol bug, not an
   abstracted timeout.
 - :class:`BreakerModel` — the circuit-breaker state machine
   (rpc/circuit_breaker.py + the LB isolation dance in lb/__init__.py):
@@ -42,7 +50,10 @@ from typing import List, Tuple
 # ---------------------------------------------------------------------------
 
 # party phases
-P_IDLE, P_ACCEPTED, P_RAN = 0, 1, 2
+#   RUNNING = inside the lockstep barrier (entered the jitted chain; in a
+#   real multi-controller run the party is BLOCKED here until every other
+#   party joins — or the abort plane unwedges it)
+P_IDLE, P_ACCEPTED, P_RUNNING, P_RAN, P_ABORTED = 0, 1, 2, 3, 4
 # proposer phases
 PR_ACCEPT_WAIT, PR_RUN_WAIT, PR_DONE, PR_ABORTED = 0, 1, 2, 3
 
@@ -51,19 +62,24 @@ REJECT = -1  # run_resp payload for a below-floor run proposal
 
 class SessionModel:
     """State = (proposer_phase, final, acks, echoes, parties, msgs,
-    drops_used, dups_used) — all tuples/ints, hashable.
+    drops_used, dups_used, dead, deaths_used) — all tuples/ints,
+    hashable.
 
     - ``acks``/``echoes``: tuples of per-party values (None until heard).
     - ``parties``: tuple of (phase, target_or_ran_steps).
     - ``msgs``: sorted tuple of in-flight (kind, party, value) triples —
       a multiset; delivery picks ANY element, which IS reorder.
+      Delivery to a dead party consumes the message silently.
+    - ``dead``: tuple of per-party death flags (the environment may kill
+      up to ``max_deaths`` parties at any instant).
 
     Mutations (each one seeded bug the meta-tests prove the checker
     catches):
 
-    - ``drop_close_echo``: a party that ran its chain never sends the
-      close-barrier echo — the real-code analog of a lost/forgotten
-      ``run_resp``; the proposer waits forever on a drop-free path.
+    - ``drop_close_echo``: parties that completed the collective never
+      send the close-barrier echo — the real-code analog of a
+      lost/forgotten ``run_resp``; the proposer waits forever on a
+      drop-free path.
     - ``min_join``: the proposer folds accept targets with ``min``
       instead of ``max`` — a party with a higher floor gets a run
       proposal below what it accepted and rejects (the run-phase floor
@@ -71,12 +87,16 @@ class SessionModel:
     - ``no_floor_reject``: with ``min_join``, parties also skip the
       floor check and silently run fewer steps than they accepted —
       the close barrier then sees non-convergent echoes.
+    - ``drop_abort``: the proposer aborts (death detected, reject,
+      timeout) but the ABORT BROADCAST is never sent — survivors stay
+      wedged in the lockstep barrier forever; the abort-convergence
+      check in ``terminal_ok`` flips red with the stuck party named.
     """
 
     name = "mc_dispatch_session"
     source = "incubator_brpc_tpu/parallel/mc_dispatch.py"
 
-    M_ACCEPT_REQ, M_ACCEPT_ACK, M_RUN_REQ, M_RUN_RESP = 0, 1, 2, 3
+    M_ACCEPT_REQ, M_ACCEPT_ACK, M_RUN_REQ, M_RUN_RESP, M_ABORT = 0, 1, 2, 3, 4
 
     def __init__(
         self,
@@ -85,9 +105,11 @@ class SessionModel:
         floors: Tuple[int, ...] = (0, 1, 3),
         max_drops: int = 1,
         max_dups: int = 1,
+        max_deaths: int = 0,
         drop_close_echo: bool = False,
         min_join: bool = False,
         no_floor_reject: bool = False,
+        drop_abort: bool = False,
     ):
         assert len(floors) == n_parties
         self.n = n_parties
@@ -95,9 +117,13 @@ class SessionModel:
         self.floors = floors
         self.max_drops = max_drops
         self.max_dups = max_dups
+        self.max_deaths = max_deaths
         self.drop_close_echo = drop_close_echo
         self.min_join = min_join
         self.no_floor_reject = no_floor_reject
+        self.drop_abort = drop_abort
+        if max_deaths > 0:
+            self.name = "mc_dispatch_session_party_death"
 
     def initial_state(self):
         msgs = tuple(
@@ -112,6 +138,8 @@ class SessionModel:
             msgs,
             0,                                  # drops used
             0,                                  # dups used
+            (False,) * self.n,                  # dead flags
+            0,                                  # deaths used
         )
 
     # -- helpers -------------------------------------------------------------
@@ -126,43 +154,129 @@ class SessionModel:
     def _with(msgs, *new):
         return tuple(sorted(msgs + tuple(new)))
 
+    def _abort_msgs(self, dead):
+        """The abort broadcast: one M_ABORT per living party (the real
+        proposer skips parties it already observed dead).  The
+        ``drop_abort`` mutation loses the whole broadcast."""
+        if self.drop_abort:
+            return ()
+        return tuple(
+            (self.M_ABORT, j, 0) for j in range(self.n) if not dead[j]
+        )
+
     def is_terminal(self, s) -> bool:
-        phase, _f, _a, _e, _p, msgs, _d, _du = s
+        phase, _f, _a, _e, _p, msgs, _d, _du, _dead, _dt = s
         return phase in (PR_DONE, PR_ABORTED) and not msgs
 
     def actions(self, s) -> List[Tuple[str, tuple]]:
-        phase, final, acks, echoes, parties, msgs, drops, dups = s
+        (phase, final, acks, echoes, parties, msgs, drops, dups, dead,
+         deaths) = s
         out: List[Tuple[str, tuple]] = []
         for m in sorted(set(msgs)):
             out.append((f"deliver{m}", self._deliver(s, m)))
+            if m[0] == self.M_ABORT:
+                # abort delivery is modeled RELIABLE: in the real code a
+                # lost abort rpc is backstopped by each party's own
+                # session deadline (every party unwedges itself); were
+                # drops allowed here, that backstop would have to be
+                # modeled too and the broadcast property would go
+                # vacuous.  What this model verifies instead is the
+                # sharper claim: every abort path SENDS an abort to
+                # every survivor (the drop_abort mutation breaks it).
+                continue
             if drops < self.max_drops:
                 out.append(
                     (f"drop{m}",
                      (phase, final, acks, echoes, parties,
-                      self._without(msgs, m), drops + 1, dups))
+                      self._without(msgs, m), drops + 1, dups, dead, deaths))
                 )
             if dups < self.max_dups:
                 out.append(
                     (f"dup{m}",
                      (phase, final, acks, echoes, parties,
-                      self._with(msgs, m), drops, dups + 1))
+                      self._with(msgs, m), drops, dups + 1, dead, deaths))
+                )
+        # the environment kills a party at any instant
+        if deaths < self.max_deaths:
+            for j in range(self.n):
+                if not dead[j]:
+                    out.append(
+                        (f"die{j}",
+                         (phase, final, acks, echoes, parties, msgs, drops,
+                          dups,
+                          dead[:j] + (True,) + dead[j + 1:], deaths + 1))
+                    )
+        # the lockstep collective completes only when EVERY party joined
+        # the barrier alive — then all emit their close echoes at once
+        if all(p[0] == P_RUNNING for p in parties) and not any(dead):
+            newp = tuple((P_RAN, p[1]) for p in parties)
+            newm = msgs
+            if not self.drop_close_echo:
+                newm = self._with(
+                    msgs,
+                    *[(self.M_RUN_RESP, j, parties[j][1])
+                      for j in range(self.n)],
+                )
+            out.append(
+                ("collective_complete",
+                 (phase, final, acks, echoes, newp, newm, drops, dups, dead,
+                  deaths))
+            )
+        # death detection (the real code's failed-RPC / dying-socket
+        # feedback): a dead party the proposer still waits on triggers
+        # the fabric-wide abort — broadcast + local abort state
+        if phase in (PR_ACCEPT_WAIT, PR_RUN_WAIT):
+            waiting_on_dead = any(
+                dead[j]
+                and (acks[j] is None if phase == PR_ACCEPT_WAIT
+                     else echoes[j] is None)
+                for j in range(self.n)
+            )
+            if waiting_on_dead:
+                out.append(
+                    ("detect_death",
+                     (PR_ABORTED, final, acks, echoes, parties,
+                      self._with(msgs, *self._abort_msgs(dead)), drops, dups,
+                      dead, deaths))
                 )
         # the proposer's deadline: enabled only when the environment
         # actually lost something — a drop-free path must make progress
-        # through protocol actions alone
+        # through protocol actions alone.  A timeout abort broadcasts
+        # too (the real session deadline does).
         if phase in (PR_ACCEPT_WAIT, PR_RUN_WAIT) and drops > 0:
             out.append(
                 ("timeout",
-                 (PR_ABORTED, final, acks, echoes, parties, msgs, drops, dups))
+                 (PR_ABORTED, final, acks, echoes, parties,
+                  self._with(msgs, *self._abort_msgs(dead)), drops, dups,
+                  dead, deaths))
             )
         return out
 
     def _deliver(self, s, m) -> tuple:
-        phase, final, acks, echoes, parties, msgs, drops, dups = s
+        (phase, final, acks, echoes, parties, msgs, drops, dups, dead,
+         deaths) = s
         msgs = self._without(msgs, m)
         kind, i, val = m
+        same = (phase, final, acks, echoes, parties, msgs, drops, dups, dead,
+                deaths)
+
+        if kind == self.M_ABORT:
+            # a survivor leaves whatever pre-completion phase it is in —
+            # including the lockstep barrier, the whole point of the
+            # broadcast; a party that already RAN keeps its result
+            if dead[i]:
+                return same
+            pphase, val0 = parties[i]
+            if pphase in (P_IDLE, P_ACCEPTED, P_RUNNING):
+                parties = (
+                    parties[:i] + ((P_ABORTED, val0),) + parties[i + 1:]
+                )
+            return (phase, final, acks, echoes, parties, msgs, drops, dups,
+                    dead, deaths)
 
         if kind == self.M_ACCEPT_REQ:
+            if dead[i]:
+                return same  # delivered to a corpse: consumed, no ack
             # party admission: its ack may RAISE the target to its floor
             # (mc_dispatch_min_steps); duplicates re-ack idempotently
             target = max(val, self.floors[i])
@@ -172,12 +286,15 @@ class SessionModel:
                 newp = (
                     parties[:i] + ((P_ACCEPTED, target),) + parties[i + 1:]
                 )
+            elif pphase == P_ABORTED:
+                return same  # aborted party re-joins nothing
             msgs = self._with(msgs, (self.M_ACCEPT_ACK, i, target))
-            return (phase, final, acks, echoes, newp, msgs, drops, dups)
+            return (phase, final, acks, echoes, newp, msgs, drops, dups,
+                    dead, deaths)
 
         if kind == self.M_ACCEPT_ACK:
             if phase != PR_ACCEPT_WAIT or acks[i] is not None:
-                return (phase, final, acks, echoes, parties, msgs, drops, dups)
+                return same
             acks = acks[:i] + (val,) + acks[i + 1:]
             if all(a is not None for a in acks):
                 # the N-party join: monotone max (the seeded min_join
@@ -191,11 +308,14 @@ class SessionModel:
                 )
                 return (
                     PR_RUN_WAIT, final, acks, echoes, parties, msgs, drops,
-                    dups,
+                    dups, dead, deaths,
                 )
-            return (phase, final, acks, echoes, parties, msgs, drops, dups)
+            return (phase, final, acks, echoes, parties, msgs, drops, dups,
+                    dead, deaths)
 
         if kind == self.M_RUN_REQ:
+            if dead[i]:
+                return same
             pphase, target = parties[i]
             if pphase == P_ACCEPTED:
                 if val < self.floors[i] and not self.no_floor_reject:
@@ -204,43 +324,55 @@ class SessionModel:
                     msgs = self._with(msgs, (self.M_RUN_RESP, i, REJECT))
                     return (
                         phase, final, acks, echoes, parties, msgs, drops,
-                        dups,
+                        dups, dead, deaths,
                     )
-                ran = val
-                parties = parties[:i] + ((P_RAN, ran),) + parties[i + 1:]
-                if not self.drop_close_echo:
-                    msgs = self._with(msgs, (self.M_RUN_RESP, i, ran))
-                return (phase, final, acks, echoes, parties, msgs, drops, dups)
+                # the party enters its lockstep chain and BLOCKS in the
+                # collective barrier until everyone joins (or abort)
+                parties = (
+                    parties[:i] + ((P_RUNNING, val),) + parties[i + 1:]
+                )
+                return (phase, final, acks, echoes, parties, msgs, drops,
+                        dups, dead, deaths)
             if pphase == P_RAN:
                 # duplicate run proposal: idempotent re-echo of what ran
                 if not self.drop_close_echo:
                     msgs = self._with(
                         msgs, (self.M_RUN_RESP, i, parties[i][1])
                     )
-                return (phase, final, acks, echoes, parties, msgs, drops, dups)
-            # run before accept cannot happen (the ack caused the run
-            # fan-out); delivered to an idle party it is ignored
-            return (phase, final, acks, echoes, parties, msgs, drops, dups)
+                return (phase, final, acks, echoes, parties, msgs, drops,
+                        dups, dead, deaths)
+            # idle (run before accept cannot happen — the ack caused the
+            # fan-out), running (duplicate), or aborted: ignored
+            return same
 
         # M_RUN_RESP
         if phase != PR_RUN_WAIT or echoes[i] is not None:
-            return (phase, final, acks, echoes, parties, msgs, drops, dups)
+            return same
         if val == REJECT:
-            return (PR_ABORTED, final, acks, echoes, parties, msgs, drops, dups)
+            # a reject aborts the whole session — and the survivors
+            # already in the barrier must be told (abort broadcast),
+            # exactly like a death
+            return (PR_ABORTED, final, acks, echoes, parties,
+                    self._with(msgs, *self._abort_msgs(dead)), drops, dups,
+                    dead, deaths)
         echoes = echoes[:i] + (val,) + echoes[i + 1:]
         if all(e is not None for e in echoes):
             ok = all(e == final for e in echoes)
-            return (
-                PR_DONE if ok else PR_ABORTED,
-                final, acks, echoes, parties, msgs, drops, dups,
-            )
-        return (phase, final, acks, echoes, parties, msgs, drops, dups)
+            if ok:
+                return (PR_DONE, final, acks, echoes, parties, msgs, drops,
+                        dups, dead, deaths)
+            # non-convergent close: abort, and unwedge everyone
+            return (PR_ABORTED, final, acks, echoes, parties,
+                    self._with(msgs, *self._abort_msgs(dead)), drops, dups,
+                    dead, deaths)
+        return (phase, final, acks, echoes, parties, msgs, drops, dups,
+                dead, deaths)
 
     # -- properties ----------------------------------------------------------
 
     def invariant(self, s) -> str:
         """Safety on every reachable state; '' when fine."""
-        _ph, final, _a, _e, parties, _m, _d, _du = s
+        _ph, final, _a, _e, parties, _m, _d, _du, _dead, _dt = s
         for i, (pphase, val) in enumerate(parties):
             if pphase == P_RAN and val < self.floors[i]:
                 return (
@@ -251,7 +383,17 @@ class SessionModel:
 
     def terminal_ok(self, s) -> str:
         """Checked on terminal states; '' when fine."""
-        phase, final, _a, echoes, parties, _m, drops, _du = s
+        (phase, final, _a, echoes, parties, _m, drops, _du, dead,
+         deaths) = s
+        # abort convergence: however the session ended, no LIVING party
+        # may be left inside the lockstep barrier — that is a real
+        # process wedged on a device collective forever
+        for i, (pphase, _v) in enumerate(parties):
+            if pphase == P_RUNNING and not dead[i]:
+                return (
+                    f"party {i} is alive and still stuck in the lockstep "
+                    "barrier at session end — the abort never reached it"
+                )
         if phase == PR_DONE:
             expect = max(self.steps, *self.floors)
             if final != expect:
@@ -266,11 +408,11 @@ class SessionModel:
                         f"close converged but party {i} state is "
                         f"{(pphase, ran)}, expected ran {final}"
                     )
-        if drops == 0 and phase != PR_DONE:
+        if drops == 0 and deaths == 0 and phase != PR_DONE:
             return (
-                "drop-free path ended without a converged close "
-                f"(proposer phase {phase}) — the protocol aborted or "
-                "diverged with no environment fault to blame"
+                "drop-free, death-free path ended without a converged "
+                f"close (proposer phase {phase}) — the protocol aborted "
+                "or diverged with no environment fault to blame"
             )
         return ""
 

@@ -33,32 +33,48 @@ def run_press(
     from incubator_brpc_tpu.rpc import Channel, ChannelOptions
 
     if fault_rate > 0 or fault_delay_ms > 0:
-        # one-command brownout run: arm the deterministic FaultInjector at
-        # this process's socket-write seam (rpc/fault_injector.py) so a
-        # scripted fraction of the press traffic fails/stalls — what the
-        # limiter/breaker/retry machinery is tuned against
-        from incubator_brpc_tpu.rpc import FaultInjector, install_socket_injector
+        # one-command brownout run: arm the deterministic fault seam of
+        # WHICHEVER plane carries the traffic. Python plane: the
+        # FaultInjector at the Socket.write seam (rpc/fault_injector.py).
+        # Native plane: the tb_channel_set_fault counter schedule — every
+        # round(1/rate)'th call fails/delays in C++, so --native-plane
+        # brownout runs no longer force the interpreter onto the path
+        # (PR 3's documented workaround, retired). Both live behind the
+        # same fault_injection master flag.
         from incubator_brpc_tpu.utils.flags import set_flag_unchecked
 
+        set_flag_unchecked("fault_injection", True)
         if native_plane:
-            # the injector lives at the Python Socket.write seam; the C++
-            # client channel never crosses it — a "brownout" that injects
-            # nothing would be silently misleading
+            from incubator_brpc_tpu.transport.native_plane import (
+                install_native_client_fault,
+            )
+
+            install_native_client_fault(
+                fail_every=(
+                    max(1, round(1.0 / fault_rate)) if fault_rate > 0 else 0
+                ),
+                delay_every=1 if fault_delay_ms > 0 else 0,
+                delay_ms=int(fault_delay_ms),
+            )
             print(
-                "fault injection forces the Python plane "
-                "(--native-plane ignored for this run)",
+                "native-plane fault seam armed (counter schedule: "
+                f"fail every {max(1, round(1.0 / fault_rate)) if fault_rate > 0 else 0}"
+                f", delay {fault_delay_ms:g} ms/call)",
                 file=sys.stderr,
             )
-            native_plane = False
-
-        set_flag_unchecked("fault_injection", True)
-        install_socket_injector(
-            FaultInjector(
-                error_rate=fault_rate,
-                delay_rate=1.0 if fault_delay_ms > 0 else 0.0,
-                delay_ms=fault_delay_ms,
+        else:
+            from incubator_brpc_tpu.rpc import (
+                FaultInjector,
+                install_socket_injector,
             )
-        )
+
+            install_socket_injector(
+                FaultInjector(
+                    error_rate=fault_rate,
+                    delay_rate=1.0 if fault_delay_ms > 0 else 0.0,
+                    delay_ms=fault_delay_ms,
+                )
+            )
 
     ch = Channel()
     if not ch.init(
@@ -106,6 +122,137 @@ def run_press(
     }
 
 
+def _http_get(server: str, path: str, timeout: float = 5.0) -> str:
+    """One ad-hoc HTTP GET against the target's builtin portal (every
+    server serves it on its RPC port)."""
+    import socket as _socket
+
+    ip, _, port = server.rpartition(":")
+    with _socket.create_connection((ip, int(port)), timeout=timeout) as s:
+        s.sendall(
+            f"GET {path} HTTP/1.0\r\nHost: {server}\r\n\r\n".encode()
+        )
+        out = b""
+        while True:
+            chunk = s.recv(4096)
+            if not chunk:
+                break
+            out += chunk
+    return out.decode(errors="replace")
+
+
+def run_lame_duck_drill(
+    server: str,
+    service: str,
+    method: str,
+    payload: bytes,
+    threads: int = 4,
+    duration: float = 5.0,
+    timeout_ms: float = 1000,
+    grace_s: float = 0.0,
+) -> dict:
+    """Drain-under-load in one command: flood the target, trigger its
+    ``/quitquitquit`` builtin a third of the way in, keep pressing until
+    the server is gone, and classify what the clients saw.  A clean
+    lame-duck drain shows ZERO connection-reset-class failures: in-flight
+    RPCs finish, refreshed work gets retriable ELOGOFF, and only after
+    the drain completes do connects start being refused (not counted —
+    the workers stop at the first connect-refused-class error after the
+    trigger)."""
+    import threading as _threading
+
+    from incubator_brpc_tpu.rpc import Channel, ChannelOptions
+    from incubator_brpc_tpu.utils.status import ErrorCode
+
+    grace = grace_s if grace_s > 0 else max(1.0, duration * 0.5)
+    ch = Channel()
+    if not ch.init(
+        server, options=ChannelOptions(timeout_ms=timeout_ms, max_retry=0)
+    ):
+        raise SystemExit(f"cannot init channel to {server}")
+    RESET_CODES = frozenset(
+        {ErrorCode.EFAILEDSOCKET, ErrorCode.EEOF, ErrorCode.ECLOSE}
+    )
+    events = []  # (issue time, completion time, kind) across every worker
+    lock = _threading.Lock()
+    triggered = _threading.Event()
+    stop_at = time.monotonic() + duration
+
+    def worker():
+        local = []
+        while time.monotonic() < stop_at:
+            issued = time.monotonic()
+            cntl = ch.call_method(service, method, payload)
+            code = cntl.error_code
+            now = time.monotonic()
+            if code == 0:
+                local.append((issued, now, "ok"))
+            elif code == ErrorCode.ELOGOFF:
+                local.append((issued, now, "logoff"))
+            elif code in RESET_CODES or code == ErrorCode.EHOSTDOWN:
+                local.append((issued, now, "conn"))
+                if triggered.is_set():
+                    break  # the server is gone: the drill is over
+            else:
+                local.append((issued, now, "other"))
+        with lock:
+            events.extend(local)
+
+    ts = [_threading.Thread(target=worker) for _ in range(threads)]
+    for t in ts:
+        t.start()
+    time.sleep(duration * 0.3)
+    print(
+        f"triggering /quitquitquit?grace_s={grace:g} on {server}",
+        file=sys.stderr,
+    )
+    status = _http_get(server, f"/quitquitquit?grace_s={grace:g}")
+    triggered.set()
+    status_line = status.splitlines()[0] if status else "<empty response>"
+    if " 200 " not in status_line:
+        print(f"quitquitquit answered: {status_line}", file=sys.stderr)
+        if " 403 " in status_line:
+            print(
+                "hint: the target must run with the enable_quitquitquit "
+                "flag on (default off)",
+                file=sys.stderr,
+            )
+        # no drain was triggered: stop the flood and report the refusal
+        # instead of classifying a drill that never ran
+        for t in ts:
+            t.join()
+        return {
+            "ok": 0, "logoff": 0, "reset": 0, "other": 0,
+            "drained_clean": False, "trigger_failed": status_line,
+        }
+    for t in ts:
+        t.join()
+    # Classification: a RESET is a connection-class failure of a call
+    # that was ISSUED while the server was still serving (issue time
+    # comfortably before the last served ok/ELOGOFF) — that is admitted
+    # or admissible work killed mid-drain, including a grace-expiry hard
+    # stop cutting off slow in-flight handlers.  Connection failures of
+    # calls issued AT the very end of the serving window (within the
+    # guard band) are the shutdown boundary: the final close racing the
+    # last writes, or connects refused on the now-stopped server — the
+    # drill ending, not dirty draining.
+    GUARD_S = 0.05
+    served = [done for _i, done, k in events if k in ("ok", "logoff")]
+    last_served = max(served) if served else 0.0
+    counts = {
+        "ok": sum(1 for _i, _d, k in events if k == "ok"),
+        "logoff": sum(1 for _i, _d, k in events if k == "logoff"),
+        "reset": sum(
+            1
+            for issued, _d, k in events
+            if k == "conn" and issued < last_served - GUARD_S
+        ),
+        "other": sum(1 for _i, _d, k in events if k == "other"),
+    }
+    counts["drained_clean"] = counts["reset"] == 0 and counts["other"] == 0
+    return counts
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--server", required=True, help="ip:port or naming url")
@@ -135,6 +282,18 @@ def main(argv=None) -> int:
         help="inject this write-path delay (every operation) — latency "
         "brownout for limiter/timeout tuning",
     )
+    p.add_argument(
+        "--lame-duck-drill", action="store_true",
+        help="drain-under-load in one command: flood the target, trigger "
+        "its /quitquitquit a third of the way in, and report what the "
+        "clients saw (a clean drain = zero connection-reset errors). "
+        "TERMINATES the target server.",
+    )
+    p.add_argument(
+        "--lame-duck-grace-s", type=float, default=0.0,
+        help="grace window passed to /quitquitquit (0 = half the press "
+        "duration)",
+    )
     args = p.parse_args(argv)
 
     service, _, method = args.method.rpartition(".")
@@ -145,6 +304,24 @@ def main(argv=None) -> int:
             payload = f.read()
     else:
         payload = b"x" * args.payload_bytes
+
+    if args.lame_duck_drill:
+        counts = run_lame_duck_drill(
+            args.server,
+            service,
+            method,
+            payload,
+            threads=args.threads,
+            duration=args.duration,
+            timeout_ms=args.timeout_ms,
+            grace_s=args.lame_duck_grace_s,
+        )
+        print(
+            f"ok={counts['ok']} logoff={counts['logoff']} "
+            f"reset={counts['reset']} other={counts['other']} "
+            f"drained_clean={counts['drained_clean']}"
+        )
+        return 0 if counts["drained_clean"] else 1
 
     stats = run_press(
         args.server,
