@@ -415,48 +415,81 @@ def bench_native_plane(results: dict) -> None:
             nc.close()
         srv.stop()
 
-    # scaling curve across event loops (the reference's per-thread scaling
-    # table, docs/cn/benchmark.md:112-122): L loops, L connections, each
-    # pumped from its own thread — tb_channel_pump runs in C++ with the
-    # GIL released, so the threads genuinely overlap
-    per_conn = 100000
-    for loops in (1, 2, 4):
+    bench_native_scaling(results)
+
+
+def bench_native_scaling(results: dict) -> None:
+    """Reactors × connections scaling matrix (the reference's per-thread
+    scaling table, docs/cn/benchmark.md:112-122): R per-core reactors
+    serving C connections pumped concurrently, each from its own thread —
+    tb_channel_pump runs in C++ with the GIL released, so the client
+    threads genuinely overlap, and the server spreads its cut/dispatch/
+    pack work across the reactors. The headline ratio is
+    scaling_efficiency = best 4-reactor qps / best 1-reactor qps: the
+    one-core ceiling (BENCH_r05's 544 ns / ~1.9 M qps, one shared core)
+    is broken exactly when this exceeds 1."""
+    from incubator_brpc_tpu.rpc import Server, ServerOptions, native_echo
+    from incubator_brpc_tpu.transport import native_plane as np_mod
+
+    if not np_mod.NET_AVAILABLE:
+        return
+    payload = b"x" * 64
+    per_conn = 60000
+    for reactors in (1, 2, 4):
         srv = Server(
             ServerOptions(native_plane=True, usercode_inline=True,
-                          native_loops=loops)
+                          num_reactors=reactors)
         )
         srv.add_service("bench", {"echo": native_echo})
         assert srv.start(0)
-        chans = [
-            np_mod.NativeClientChannel("127.0.0.1", srv.port)
-            for _ in range(loops)
-        ]
         try:
-            for nc in chans:  # warm every connection/loop pairing
-                nc.pump("bench", "echo", payload, 2000, inflight=64)
-            errs = []
-
-            def puller(nc):
+            for conns in (1, 2, 4):
+                chans = [
+                    np_mod.NativeClientChannel("127.0.0.1", srv.port)
+                    for _ in range(conns)
+                ]
                 try:
-                    nc.pump("bench", "echo", payload, per_conn, inflight=128)
-                except Exception as e:  # noqa: BLE001
-                    errs.append(e)
+                    for nc in chans:  # warm every connection/reactor pairing
+                        nc.pump("bench", "echo", payload, 2000, inflight=64)
+                    best = 0.0
+                    for _rep in range(3):  # best-of-3: co-tenant noise on
+                        errs = []          # shared cores swamps one rep
 
-            threads = [
-                threading.Thread(target=puller, args=(nc,)) for nc in chans
-            ]
-            t0 = time.perf_counter()
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-            dt = time.perf_counter() - t0
-            assert not errs, errs[:1]
-            results[f"native_pump_qps_{loops}loop"] = loops * per_conn / dt
+                        def puller(nc):
+                            try:
+                                nc.pump(
+                                    "bench", "echo", payload, per_conn,
+                                    inflight=128,
+                                )
+                            except Exception as e:  # noqa: BLE001
+                                errs.append(e)
+
+                        threads = [
+                            threading.Thread(target=puller, args=(nc,))
+                            for nc in chans
+                        ]
+                        t0 = time.perf_counter()
+                        for t in threads:
+                            t.start()
+                        for t in threads:
+                            t.join()
+                        dt = time.perf_counter() - t0
+                        assert not errs, errs[:1]
+                        best = max(best, conns * per_conn / dt)
+                    results[f"native_pump_qps_r{reactors}c{conns}"] = best
+                finally:
+                    for nc in chans:
+                        nc.close()
         finally:
-            for nc in chans:
-                nc.close()
             srv.stop()
+    best1 = max(
+        results.get(f"native_pump_qps_r1c{c}", 0) for c in (1, 2, 4)
+    )
+    best4 = max(
+        results.get(f"native_pump_qps_r4c{c}", 0) for c in (1, 2, 4)
+    )
+    if best1 > 0:
+        results["native_pump_scaling_efficiency"] = best4 / best1
 
 
 def bench_device_rpc(results: dict) -> None:
@@ -737,6 +770,7 @@ BASELINES = {
     "device_rpc": "bounded by window/RTT on this tunneled chip (~0.5-1s submission+readback per round under load, high variance); concurrent calls micro-batch into vmapped dispatches, which cuts dispatch COUNT — the win shows where dispatch cost dominates (local PCIe), not through a tunnel",
     "fabricnet_mfu": "vs v5e peak bf16 197 TFLOP/s",
     "native_pump_notes": "template-pack + pooled body reuse + meta memo; 1 shared core, both sides",
+    "native_pump_scaling": "r05 one-core baseline: 544 ns/echo, ~1.9 M qps with client AND server sharing ONE core, and BENCH_r04's flat 1/2/4-conn curve (~1 M qps each — one loop thread was the ceiling). The matrix is R reactors x C connections (aggregate qps); scaling_efficiency = best 4-reactor / best 1-reactor. The reference scales 3-5 M qps/thread across 24 cores (docs/cn/benchmark.md:112-122); on this host the reachable ratio is capped by host_cpus, since the C client pumps burn the same cores the reactors serve from",
     "prpc_pump_telemetry": "prpc_pump_ns runs with the native telemetry ring ON (the default: per-method latency + sampled rpcz + limiter feedback recorded in-path); prpc_pump_notelem_ns is the same pump ring-less — the delta is the instrumentation tax (acceptance < 5%)",
 }
 
@@ -796,15 +830,34 @@ def main() -> None:
                         if "pooled_32k_gbps" in results
                         else None
                     ),
+                    # reactors × connections matrix: key "<R>r" maps conn
+                    # count -> aggregate qps on an R-reactor server
                     "native_pump_scaling_qps": {
-                        str(k): round(results[f"native_pump_qps_{k}loop"])
-                        for k in (1, 2, 4)
-                        if f"native_pump_qps_{k}loop" in results
+                        f"{r}r": {
+                            str(c): round(
+                                results[f"native_pump_qps_r{r}c{c}"]
+                            )
+                            for c in (1, 2, 4)
+                            if f"native_pump_qps_r{r}c{c}" in results
+                        }
+                        for r in (1, 2, 4)
+                        if any(
+                            f"native_pump_qps_r{r}c{c}" in results
+                            for c in (1, 2, 4)
+                        )
                     },
-                    # context for the scaling row: with host_cpus=1 the
-                    # curve CANNOT rise (client pump + server loop already
-                    # share one core); the per-loop design is validated by
-                    # the flat-not-collapsing aggregate
+                    # best 4-reactor qps / best 1-reactor qps — > 1 means
+                    # the one-core ceiling is broken; ~min(4, host_cpus/2)
+                    # is the loopback bound (client pumps burn cores too)
+                    "scaling_efficiency": (
+                        round(results["native_pump_scaling_efficiency"], 2)
+                        if "native_pump_scaling_efficiency" in results
+                        else None
+                    ),
+                    # context for the scaling row: the client pump threads
+                    # and the server reactors share these cores, so the
+                    # reachable efficiency is bounded by host_cpus, not by
+                    # the reactor count
                     "host_cpus": os.cpu_count(),
                     # pure-Python plane (the portable fallback)
                     "rpc_echo_py_us": round(results["rpc_echo_py_us"], 1),

@@ -61,7 +61,7 @@ class TelemetryRecord(ctypes.Structure):
         ("request_size", ctypes.c_uint32),
         ("response_size", ctypes.c_uint32),
         ("sampled", ctypes.c_uint32),
-        ("reserved", ctypes.c_uint32),
+        ("reactor_id", ctypes.c_uint32),
     ]
 
 
@@ -240,6 +240,14 @@ SIGNATURES = {
     "tb_mru_size": (ctypes.c_size_t, [b]),
     # ---- tbnet (src/tbnet): native network plane ----
     "tb_server_create": (b, [ctypes.c_int]),
+    "tb_server_num_reactors": (ctypes.c_int, [b]),
+    # work-stealing dispatch pool (per-reactor Chase–Lev deques; worker
+    # threads steal) + the per-method long-running deferral flag
+    "tb_server_set_dispatch_pool": (ctypes.c_int, [b, ctypes.c_int]),
+    "tb_server_set_native_long_running": (
+        ctypes.c_int,
+        [b, ctypes.c_char_p, ctypes.c_int],
+    ),
     "tb_server_set_frame_cb": (None, [b, FRAME_FN, ctypes.c_void_p]),
     "tb_server_set_handoff_cb": (None, [b, HANDOFF_FN, ctypes.c_void_p]),
     "tb_server_set_closed_cb": (None, [b, CLOSED_FN, ctypes.c_void_p]),
@@ -276,7 +284,17 @@ SIGNATURES = {
         ctypes.c_long,
         [b, ctypes.POINTER(TelemetryRecord), ctypes.c_size_t],
     ),
+    # one reactor's ring only (the per-ring batched drain's shape)
+    "tb_server_drain_telemetry_ring": (
+        ctypes.c_long,
+        [b, ctypes.c_int, ctypes.POINTER(TelemetryRecord), ctypes.c_size_t],
+    ),
     "tb_server_telemetry_dropped": (ctypes.c_uint64, [b]),
+    # per-reactor live_conns / native_reqs / ring drops
+    "tb_server_reactor_stats": (
+        ctypes.c_int,
+        [b, ctypes.c_int] + [ctypes.POINTER(ctypes.c_uint64)] * 3,
+    ),
     "tb_server_listen": (ctypes.c_int, [b, ctypes.c_char_p, ctypes.c_int]),
     "tb_server_port": (ctypes.c_int, [b]),
     "tb_server_stop": (None, [b]),
@@ -373,6 +391,9 @@ SIGNATURES = {
         ],
     ),
     "tb_channel_error": (ctypes.c_int, [b]),
+    # client reactor shard pinned at connect + wrong-shard cid counter
+    "tb_channel_reactor": (ctypes.c_int, [b]),
+    "tb_channel_cid_misroutes": (ctypes.c_uint64, [b]),
     "tb_channel_destroy": (None, [b]),
     "tb_channel_pump": (
         ctypes.c_long,
@@ -387,6 +408,13 @@ SIGNATURES = {
             ctypes.c_int,
         ],
     ),
+    # ---- work-stealing deque (Chase–Lev; the dispatch pool's queue) ----
+    "tb_wsq_create": (b, [ctypes.c_size_t]),
+    "tb_wsq_destroy": (None, [b]),
+    "tb_wsq_push": (ctypes.c_int, [b, ctypes.c_uint64]),
+    "tb_wsq_pop": (ctypes.c_int, [b, ctypes.POINTER(ctypes.c_uint64)]),
+    "tb_wsq_steal": (ctypes.c_int, [b, ctypes.POINTER(ctypes.c_uint64)]),
+    "tb_wsq_size": (ctypes.c_long, [b]),
 }
 del b
 

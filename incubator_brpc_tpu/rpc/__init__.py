@@ -50,7 +50,11 @@ from incubator_brpc_tpu.rpc.stream import (
     stream_accept,
     stream_create,
 )
-from incubator_brpc_tpu.transport.native_plane import native_echo, native_nop
+from incubator_brpc_tpu.transport.native_plane import (
+    native_echo,
+    native_long_running,
+    native_nop,
+)
 
 __all__ = [
     "Authenticator",
@@ -84,6 +88,7 @@ __all__ = [
     "DeviceMethod",
     "device_method",
     "native_echo",
+    "native_long_running",
     "native_nop",
     "stream_accept",
     "stream_create",

@@ -610,6 +610,21 @@ class Channel:
                 if cntl._span is not None:
                     end_client_span(cntl)
                 return True
+            if rc == -_errno.EBADMSG:
+                # the response's correlation id carried another reactor
+                # shard's tag (tb_channel cid partitioning): a protocol-
+                # level bad answer, not a dead connection — surface it as
+                # EREQUEST and keep the channel (the C++ side already
+                # counted it in tb_channel_cid_misroutes)
+                cntl.set_failed(
+                    ErrorCode.EREQUEST,
+                    "response correlation id from the wrong reactor shard",
+                )
+                cntl.remote_side = self._single_server
+                cntl._mark_end()
+                if cntl._span is not None:
+                    end_client_span(cntl)
+                return True
             # connection-level failure: recycle and let the regular path
             # (fresh dial + retry arbitration) handle this call
             with self._native_lock:

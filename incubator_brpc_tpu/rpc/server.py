@@ -335,7 +335,9 @@ class ServerOptions:
         rtmp_service=None,
         ssl_context=None,
         native_plane: bool = False,
-        native_loops: int = 2,
+        native_loops: Optional[int] = None,
+        num_reactors: Optional[int] = None,
+        native_dispatch_workers: int = 0,
         session_local_data_factory=None,
         reserved_session_local_data: int = 0,
         thread_local_data_factory=None,
@@ -364,7 +366,19 @@ class ServerOptions:
         # falls back to the Python acceptor when the toolchain is missing
         # or the listen endpoint is a unix socket.
         self.native_plane = native_plane
-        self.native_loops = native_loops
+        # Reactor count for the native plane: one per-core event loop,
+        # each owning its own epoll fd, SO_REUSEPORT listener, telemetry
+        # ring, and cut/pack buffers; connections shard round-robin at
+        # accept and never migrate.  None = auto from the affinity mask.
+        # ``native_loops`` is the legacy spelling of the same knob.
+        self.num_reactors = (
+            num_reactors if num_reactors is not None else native_loops
+        )
+        # Work-stealing dispatch pool threads for native user methods
+        # flagged long-running (native_long_running) or arriving behind a
+        # queue-pressured burst; 0 = every native method runs inline on
+        # its reactor loop thread.
+        self.native_dispatch_workers = native_dispatch_workers
         # device this server binds for transport='tpu' links (None = pick a
         # neighbor of the client's device; the reference's use_rdma slot)
         self.device_index = device_index
@@ -419,6 +433,17 @@ class ServerOptions:
         # block: a blocking handler stalls every connection hashed to the
         # same dispatcher. First N-1 of a batch still fan out to fibers.
         self.usercode_inline = usercode_inline
+
+    @property
+    def native_loops(self) -> Optional[int]:
+        """Legacy spelling of ``num_reactors`` — a live alias, so code
+        that still assigns ``opts.native_loops = N`` after construction
+        keeps steering the reactor count."""
+        return self.num_reactors
+
+    @native_loops.setter
+    def native_loops(self, value: Optional[int]) -> None:
+        self.num_reactors = value
 
 
 class Server:
@@ -787,7 +812,11 @@ class Server:
             # the C++ listener is AF_INET-only: fall back to the Python
             # acceptor for anything its inet_pton cannot parse (IPv6,
             # hostnames) instead of surfacing an OSError from Server.start
-            plane = np_mod.NativeServerPlane(self, self.options.native_loops)
+            plane = np_mod.NativeServerPlane(
+                self,
+                self.options.num_reactors,
+                dispatch_workers=self.options.native_dispatch_workers,
+            )
             try:
                 plane.register_methods()
                 port = plane.listen(ep.ip, ep.port)

@@ -135,6 +135,41 @@ def native_nop(cntl, request: bytes) -> bytes:
 native_nop._native_kind = KIND_NOP
 
 
+def native_long_running(handler):
+    """Mark a native .so method (``native_method_lib``) long-running: with
+    a dispatch pool enabled (``ServerOptions.native_dispatch_workers``)
+    its requests always defer to the work-stealing pool instead of
+    running inline on the reactor loop thread — one slow handler can't
+    stall its reactor's frame cut/pack work.  No-op without a pool, and
+    for plain Python handlers (the Python route has its own worker
+    pool)."""
+    try:
+        handler._native_long_running = True
+        return handler
+    except AttributeError:  # bound methods can't carry attributes: wrap
+
+        def wrapped(cntl, request, _fb=handler):
+            return _fb(cntl, request)
+
+        wrapped._native_long_running = True
+        return wrapped
+
+
+def _resolve_num_reactors(nloops) -> int:
+    """None = auto from the process affinity mask (the per-core
+    EventDispatcher default), capped so a 96-core host doesn't mint 96
+    loop threads for one port."""
+    if nloops:
+        return max(1, int(nloops))
+    import os
+
+    try:
+        ncpu = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        ncpu = os.cpu_count() or 1
+    return max(1, min(16, ncpu))
+
+
 class NativeConnSock:
     """Socket facade over a tbnet connection token — just enough surface
     for the Python request path (process_request, streams, auth): write,
@@ -237,7 +272,8 @@ def _drain_pump(plane_ref, stop_event) -> None:
 
 
 class NativeServerPlane:
-    def __init__(self, server, nloops: int = 2):
+    def __init__(self, server, nloops: Optional[int] = None,
+                 dispatch_workers: int = 0):
         if not NET_AVAILABLE:
             raise RuntimeError("native plane unavailable")
         self._server = server
@@ -245,12 +281,24 @@ class NativeServerPlane:
         # /brpc_metrics scrape snapshots the expose registry before stop()
         # hides the per-port gauges, so stats() can race tb_server_destroy
         self._stats_lock = threading.Lock()
-        self._srv = LIB.tb_server_create(nloops)
+        # one reactor per core by default: each owns its own epoll loop,
+        # listener (SO_REUSEPORT), telemetry ring, and cut/pack buffers;
+        # connections shard round-robin at accept and never migrate
+        self.num_reactors = _resolve_num_reactors(nloops)
+        self._srv = LIB.tb_server_create(self.num_reactors)
         from incubator_brpc_tpu.utils.flags import get_flag
 
         LIB.tb_server_set_max_body(
             self._srv, int(get_flag("max_body_size")) + 64 * 1024
         )
+        # work-stealing dispatch pool for long-running / queue-pressured
+        # native methods (0 = every native method runs inline)
+        self._dispatch_workers = max(0, int(dispatch_workers))
+        if self._dispatch_workers:
+            if LIB.tb_server_set_dispatch_pool(
+                self._srv, self._dispatch_workers
+            ) != 0:
+                logger.warning("dispatch pool rejected (listen already?)")
         # telemetry ring (tb_server_set_telemetry must precede listen):
         # every natively-dispatched completion is recorded in C++ and
         # drained here into per-method latency summaries, sampled rpcz
@@ -265,7 +313,10 @@ class NativeServerPlane:
             )
         self._tel_lock = threading.Lock()  # serializes drains (one consumer)
         self._tel_recorders: Dict[int, LatencyRecorder] = {}  # method idx ->
-        self._tel_drained = 0  # records pulled off the ring so far
+        self._tel_drained = 0  # records pulled off the rings so far
+        # per-reactor drained roll-up (the rings themselves are per
+        # reactor in C++; drops come from tb_server_reactor_stats)
+        self._tel_drained_per = [0] * self.num_reactors
         # 4096-record drain batches: numpy's fixed per-batch costs
         # amortize to ~tens of ns per record (the drain shares cores
         # with the hot path it observes)
@@ -363,6 +414,13 @@ class NativeServerPlane:
                     self._native_names.append(full)
                     if prop.status.limiter is None:
                         self._auto_targets.append(full)
+                    if getattr(prop.handler, "_native_long_running", False):
+                        if LIB.tb_server_set_native_long_running(
+                            self._srv, full.encode(), 1
+                        ) != 0:
+                            logger.warning(
+                                "long-running flag rejected for %s", full
+                            )
                 else:
                     logger.warning(
                         "native registration of %s rejected (duplicate or "
@@ -435,6 +493,28 @@ class NativeServerPlane:
             for k in ("accepted", "native_reqs", "cb_frames", "handoffs",
                       "live_conns", "deadline_sheds")
         ]
+        # per-reactor families (native_reactor_<port>_<i>_*): connection
+        # shard occupancy, dispatched requests, and ring drops per
+        # reactor — the roll-up above stays the per-port truth, these
+        # make skewed sharding and a hot reactor visible.  The memoized
+        # snapshot (the _stats_snapshot pattern) keeps one scrape to one
+        # native read per reactor, with the three values per row taken
+        # at the same instant.
+        for i in range(self.num_reactors):
+            self._m_stats.extend(
+                PassiveStatus(
+                    (lambda _i=i, _k=k: self._reactor_snapshot(_i)[_k]),
+                    name=f"native_reactor_{self.port}_{i}_{k}",
+                )
+                for k in ("conns", "reqs", "dropped")
+            )
+            if self._telemetry:
+                self._m_stats.append(
+                    PassiveStatus(
+                        (lambda _i=i: self._tel_drained_per[_i]),
+                        name=f"native_reactor_{self.port}_{i}_drained",
+                    )
+                )
         if self._telemetry:
             self._m_stats.append(
                 PassiveStatus(
@@ -480,17 +560,41 @@ class NativeServerPlane:
     # -- telemetry drain ---------------------------------------------------
 
     def telemetry_dropped(self) -> int:
-        """Ring-overflow drop count (records lost to a full ring)."""
+        """Ring-overflow drop count, summed across every reactor's ring."""
         with self._stats_lock:
             if self._srv is None:
                 return getattr(self, "_final_tel_dropped", 0)
             return int(LIB.tb_server_telemetry_dropped(self._srv))
 
+    def reactor_stats(self, reactor: int) -> Dict[str, int]:
+        """One reactor's live connections, natively-dispatched request
+        count, and telemetry-ring drops (zeros after stop or for an
+        out-of-range index)."""
+        with self._stats_lock:
+            if self._srv is None:
+                final = getattr(self, "_final_reactor_stats", None)
+                if final is not None and 0 <= reactor < len(final):
+                    return final[reactor]
+                return {"conns": 0, "reqs": 0, "dropped": 0}
+            vals = [ctypes.c_uint64() for _ in range(3)]
+            rc = LIB.tb_server_reactor_stats(
+                self._srv, int(reactor), *[ctypes.byref(v) for v in vals]
+            )
+            if rc != 0:
+                return {"conns": 0, "reqs": 0, "dropped": 0}
+            return {
+                "conns": vals[0].value,
+                "reqs": vals[1].value,
+                "dropped": vals[2].value,
+            }
+
     # fabriclint: hotpath
     def drain_telemetry(self) -> int:
-        """Pull every completed record off the C++ ring and fan it out:
-        per-method latency summaries, sampled rpcz server spans, and
-        limiter feedback (Server._on_native_completion). Returns the
+        """Pull every completed record off each reactor's C++ ring and
+        fan it out: per-method latency summaries, sampled rpcz server
+        spans, and limiter feedback (Server._on_native_completion).
+        Batched PER RING (one reactor's records per numpy pass — still
+        vectorized) with a per-reactor drained roll-up. Returns the
         record count. Serialized: the background pump, scrape hooks, and
         the stop-time flush never interleave batches."""
         if not self._telemetry:
@@ -500,29 +604,39 @@ class NativeServerPlane:
         with self._tel_lock:
             # batch cap: a drain races live producers, and a scrape-path
             # caller must not spin forever against a sustained flood —
-            # 256 batches (~1M records) per call, the rest next cycle
-            # fabriclint: allow(hotpath-loop) bounded at 256 batches per call; per-RECORD work stays vectorized in _consume_records
-            for _ in range(256):
-                # fabriclint: allow(hotpath-lock) guards the native handle against tb_server_destroy; once per 4096-record batch, not per record
-                with self._stats_lock:
-                    if self._srv is None:
-                        break
-                    n = int(
-                        LIB.tb_server_drain_telemetry(
-                            self._srv, self._tel_batch, len(self._tel_batch)
+            # 256 batches (~1M records) per call ACROSS the rings, the
+            # rest next cycle
+            budget = 256
+            # fabriclint: allow(hotpath-loop) iterates reactors (<=16), never records; per-ring batches bounded by the shared budget below
+            for reactor in range(self.num_reactors):
+                # fabriclint: allow(hotpath-loop) bounded by the shared 256-batch budget; per-RECORD work stays vectorized in _consume_records
+                while budget > 0:
+                    budget -= 1
+                    # fabriclint: allow(hotpath-lock) guards the native handle against tb_server_destroy; once per 4096-record batch, not per record
+                    with self._stats_lock:
+                        if self._srv is None:
+                            budget = 0
+                            break
+                        n = int(
+                            LIB.tb_server_drain_telemetry_ring(
+                                self._srv, reactor, self._tel_batch,
+                                len(self._tel_batch),
+                            )
                         )
-                    )
-                if n <= 0:
+                    if n <= 0:
+                        break
+                    # fan-out OUTSIDE _stats_lock: limiter feedback can
+                    # push a new adaptive limit back down through
+                    # set_native_max_concurrency, which takes _stats_lock
+                    self._consume_records(self._tel_batch, n)
+                    total += n
+                    self._tel_drained_per[reactor] += n
+                    # loop until an EMPTY return, not a short batch: the
+                    # C++ drain can return fewer than it popped
+                    # (clock-invalid records are discarded there), so a
+                    # short batch does not mean the ring is dry
+                if budget <= 0:
                     break
-                # fan-out OUTSIDE _stats_lock: limiter feedback can push a
-                # new adaptive limit back down through
-                # set_native_max_concurrency, which takes _stats_lock
-                self._consume_records(self._tel_batch, n)
-                total += n
-                # loop until an EMPTY return, not a short batch: the C++
-                # drain can return fewer than it popped (clock-invalid
-                # records are discarded there), so a short batch does
-                # not mean the ring is dry
             self._tel_drained += total
         return total
 
@@ -549,7 +663,7 @@ class NativeServerPlane:
                     ("request_size", "<u4"),
                     ("response_size", "<u4"),
                     ("sampled", "<u4"),
-                    ("reserved", "<u4"),
+                    ("reactor_id", "<u4"),
                 ]
             )
         return cls._REC_DTYPE
@@ -710,6 +824,21 @@ class NativeServerPlane:
                             response_size=int(rec["response_size"]),
                         )
                     )
+
+    def _reactor_snapshot(self, reactor: int) -> Dict[str, int]:
+        """reactor_stats memoized for ~50 ms (the _stats_snapshot
+        discipline): one scrape renders 3 gauges per reactor off ONE
+        native read, and a row's values come from the same instant.
+        Benign race on the cache slot — worst case one extra read."""
+        now = time.monotonic()
+        cache = getattr(self, "_reactor_snaps", None)
+        if cache is None:
+            cache = self._reactor_snaps = {}
+        snap = cache.get(reactor)
+        if snap is None or now - snap[0] > 0.05:
+            snap = (now, self.reactor_stats(reactor))
+            cache[reactor] = snap
+        return snap[1]
 
     def _stats_snapshot(self) -> Dict[str, int]:
         """stats() memoized for ~50 ms: one /brpc_metrics scrape touches
@@ -886,6 +1015,9 @@ class NativeServerPlane:
         # destroy frees the epoll/event fds and the method table
         LIB.tb_server_stop(self._srv)
         self._final_stats = self.stats()
+        self._final_reactor_stats = [
+            self.reactor_stats(i) for i in range(self.num_reactors)
+        ]
         # loops quiescent: flush the telemetry tail so the last
         # completions still reach the summaries/limiters, THEN freeze the
         # drop counter (the flush itself can add clock-invalid discards)
@@ -1060,6 +1192,24 @@ class NativeClientChannel:
 
     def healthy(self) -> bool:
         return not self._closed and LIB.tb_channel_error(self._ch) == 0
+
+    @property
+    def reactor(self) -> int:
+        """Client reactor shard this channel pinned at connect — the top
+        8 bits of every correlation id it mints (-1 once closed)."""
+        with self._lock:
+            if self._ch is None:
+                return -1
+            return int(LIB.tb_channel_reactor(self._ch))
+
+    def cid_misroutes(self) -> int:
+        """Responses seen with a WRONG shard tag in their correlation id
+        (each answered EREQUEST to the re-tagged pending instead of
+        crashing or stranding its caller)."""
+        with self._lock:
+            if self._ch is None:
+                return 0
+            return int(LIB.tb_channel_cid_misroutes(self._ch))
 
     def set_fault(
         self,

@@ -670,13 +670,43 @@ struct Wake : PollObj {
   int fd = -1;
 };
 
+struct Listener : PollObj {
+  Listener() : PollObj(1) {}
+  int fd = -1;
+};
+
+struct TelemetryRing;
+struct WorkDeque;
+
 struct NetLoop {
+  int id = 0;  // reactor index (telemetry records carry it)
   int epfd = -1;
   Wake wake;
+  // per-reactor listener: every reactor binds the same port with
+  // SO_REUSEPORT (multi-reactor servers) so accepts run in parallel and
+  // lame-duck teardown happens on each owning loop thread; fd -1 when
+  // the reactor has no listener (single-reactor, or REUSEPORT fallback)
+  Listener listener;
   std::thread th;
   std::atomic<bool> stopping{false};
   std::vector<NetConn*> conns;
   std::mutex conns_mu;  // guards conns (loop thread + stop-time sweep)
+  // per-reactor data pools: the burst response batch and per-frame body
+  // scratch are owned by the reactor and reused across bursts — nothing
+  // on the cut/pack path allocates per burst or crosses a lock
+  tb_iobuf* batch = nullptr;
+  tb_iobuf* scratch = nullptr;
+  // per-reactor counters (tb_server_reactor_stats / stats roll-up)
+  std::atomic<uint64_t> live_conns{0};
+  std::atomic<uint64_t> native_reqs{0};
+  // per-reactor completion ring: loop-thread (and pool-worker) producers
+  // never contend with another reactor's — set once before listen
+  std::atomic<TelemetryRing*> telemetry{nullptr};
+  // per-reactor work-stealing deque (dispatch pool enabled only)
+  WorkDeque* deque = nullptr;
+  // loop-thread-only: inline user-callback dispatches in the current
+  // readable burst (the queue-depth pressure signal for pool deferral)
+  int inline_burst = 0;
 };
 
 struct NativeMethod {
@@ -688,14 +718,12 @@ struct NativeMethod {
   std::atomic<uint32_t> nprocessing{0};
   std::atomic<uint64_t> nreq{0};
   std::atomic<uint64_t> nerr{0};
+  // long-running: with a dispatch pool enabled, requests to this method
+  // always defer to the pool (tb_server_set_native_long_running)
+  std::atomic<uint32_t> long_running{0};
   std::string full_name;
   tb_native_fn fn = nullptr;  // kKindCallback
   void* ud = nullptr;
-};
-
-struct Listener : PollObj {
-  Listener() : PollObj(1) {}
-  int fd = -1;
 };
 
 struct ErrorCodes {
@@ -803,11 +831,124 @@ long telemetry_pop(TelemetryRing* r, tb_telemetry_record* out, size_t max) {
   return static_cast<long>(n);
 }
 
+// per-request routing context shared by the tbus and PRPC dispatch loops
+struct ReqCtx {
+  int wire;            // kProtoTbus / kProtoPrpc
+  uint32_t cid_lo;
+  uint32_t cid_hi;
+  uint32_t resp_flags; // tbus: response flags to echo (body-crc bit)
+  long attachment;     // request attachment size (PRPC echo re-stamps it)
+  long timeout_ms;     // propagated deadline budget (0 = none rides this)
+};
+
+// ---------------------------------------------------------------------------
+// work-stealing deque (Chase–Lev) + dispatch pool: the reactor loop thread
+// is the single owner (push at the bottom; pop only during stop-time
+// drain), pool workers steal the top.  A full deque rejects the push and
+// the caller runs the work inline — backpressure, never blocking the
+// reactor.  This is the bthread M:N shape specialized to "slow native
+// user methods must not stall their reactor's cut/pack work" (reference
+// task_group.cc steal loops, SURVEY L3).
+// ---------------------------------------------------------------------------
+
+struct WorkDeque {
+  explicit WorkDeque(size_t cap) {
+    size_t c = 64;
+    while (c < cap && c < (1u << 20)) c <<= 1;
+    cells = new std::atomic<uint64_t>[c];
+    mask = c - 1;
+  }
+  ~WorkDeque() { delete[] cells; }
+  alignas(64) std::atomic<int64_t> top{0};     // thieves CAS this
+  alignas(64) std::atomic<int64_t> bottom{0};  // owner only
+  std::atomic<uint64_t>* cells = nullptr;
+  size_t mask = 0;
+
+  bool push(uint64_t v) {  // owner only
+    int64_t b = bottom.load(std::memory_order_relaxed);
+    int64_t t = top.load(std::memory_order_acquire);
+    if (b - t > static_cast<int64_t>(mask)) return false;  // full
+    cells[b & static_cast<int64_t>(mask)].store(v, std::memory_order_relaxed);
+    // release: a thief acquiring `bottom` sees the cell store
+    bottom.store(b + 1, std::memory_order_release);
+    return true;
+  }
+
+  bool pop(uint64_t* out) {  // owner only (stop-time drain)
+    int64_t b = bottom.load(std::memory_order_relaxed) - 1;
+    bottom.store(b, std::memory_order_relaxed);
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    int64_t t = top.load(std::memory_order_relaxed);
+    if (t > b) {  // empty
+      bottom.store(b + 1, std::memory_order_relaxed);
+      return false;
+    }
+    uint64_t v = cells[b & static_cast<int64_t>(mask)].load(
+        std::memory_order_relaxed);
+    if (t == b) {
+      // last element: race the thieves for it via top
+      if (!top.compare_exchange_strong(t, t + 1, std::memory_order_seq_cst,
+                                       std::memory_order_relaxed)) {
+        bottom.store(b + 1, std::memory_order_relaxed);
+        return false;  // a thief won
+      }
+      bottom.store(b + 1, std::memory_order_relaxed);
+    }
+    *out = v;
+    return true;
+  }
+
+  bool steal(uint64_t* out) {  // any thief
+    int64_t t = top.load(std::memory_order_acquire);
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    int64_t b = bottom.load(std::memory_order_acquire);
+    if (t >= b) return false;  // empty
+    // safe stale read: push() refuses to reuse a cell until top has
+    // advanced past it, so a concurrent overwrite implies our CAS fails
+    uint64_t v = cells[t & static_cast<int64_t>(mask)].load(
+        std::memory_order_relaxed);
+    if (!top.compare_exchange_strong(t, t + 1, std::memory_order_seq_cst,
+                                     std::memory_order_relaxed))
+      return false;  // lost the race (owner pop or another thief)
+    *out = v;
+    return true;
+  }
+
+  long size() const {
+    int64_t b = bottom.load(std::memory_order_relaxed);
+    int64_t t = top.load(std::memory_order_relaxed);
+    return b > t ? static_cast<long>(b - t) : 0;
+  }
+};
+
+// one deferred native dispatch: everything the worker needs to run the
+// method, pack the response in the right wire protocol, and append the
+// completion record into the OWNING reactor's telemetry ring
+struct WorkTask {
+  NativeMethod* nm = nullptr;
+  tb_server* srv = nullptr;
+  NetLoop* loop = nullptr;  // owning reactor (ring + reactor_id)
+  uint64_t conn_token = 0;
+  ReqCtx rc{};
+  uint32_t limited = 0;    // nprocessing held across queue + run
+  uint64_t t_start = 0;    // telemetry ticks at dispatch entry (0 = off)
+  uint64_t arrival_ms = 0; // frame's burst-arrival stamp (deadline base)
+  size_t req_len = 0;
+  char* req = nullptr;     // contiguous request copy (worker frees)
+};
+
+struct DispatchPool {
+  std::vector<std::thread> workers;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::atomic<uint64_t> pending{0};
+  std::atomic<bool> stopping{false};
+};
+
 }  // namespace
 
 struct tb_server {
   std::vector<NetLoop*> loops;
-  Listener listener;
   int port = 0;
   std::atomic<size_t> next_loop{0};
   tb_frame_fn frame_cb = nullptr;
@@ -821,21 +962,22 @@ struct tb_server {
   tb_flatmap* methods = nullptr;  // key -> index into native_methods
   std::vector<NativeMethod*> native_methods;
   std::atomic<uint64_t> accepted{0};
-  std::atomic<uint64_t> native_reqs{0};
   std::atomic<uint64_t> cb_frames{0};
   std::atomic<uint64_t> handoffs{0};
-  std::atomic<uint64_t> live_conns{0};
   // requests answered EDEADLINE because their propagated budget expired
   // before dispatch (the deadline_shed_count feed for native ports)
   std::atomic<uint64_t> deadline_sheds{0};
-  // lame-duck: stop accepting while existing connections drain; the
-  // listener teardown runs on loop 0 (which owns the listen fd's epoll
-  // registration) at its next wakeup
+  // lame-duck: stop accepting while existing connections drain; EVERY
+  // reactor tears down its own listener on its own loop thread at its
+  // next wakeup (per-reactor listeners via SO_REUSEPORT)
   std::atomic<bool> accept_paused{false};
   std::atomic<bool> stopped{false};
-  // completion-record ring (tb_server_set_telemetry); null = disabled.
-  // Set once before listen, so loop threads load it without a fence race.
-  std::atomic<TelemetryRing*> telemetry{nullptr};
+  bool listening = false;       // pre-listen-only knobs gate on this
+  bool telemetry_enabled = false;  // per-reactor rings live in the loops
+  // work-stealing dispatch pool (tb_server_set_dispatch_pool): null =
+  // every native method runs inline on its reactor
+  DispatchPool* pool = nullptr;
+  int pool_workers = 0;
 };
 
 namespace {
@@ -900,7 +1042,7 @@ void conn_destroy(NetConn* c, bool close_fd) {
   uint64_t token = c->token;
   conn_retire(c);
   if (close_fd && c->fd >= 0) close(c->fd);
-  if (c->srv) c->srv->live_conns.fetch_sub(1);
+  if (c->loop) c->loop->live_conns.fetch_sub(1);
   // close_fd==false means handoff: the connection lives on in Python
   if (close_fd && c->srv && c->srv->closed_cb != nullptr)
     c->srv->closed_cb(c->srv->closed_ctx, token);
@@ -921,16 +1063,6 @@ void conn_destroy(NetConn* c, bool close_fd) {
 
 // ---- server-side frame dispatch ----
 
-// per-request routing context shared by the tbus and PRPC dispatch loops
-struct ReqCtx {
-  int wire;            // kProtoTbus / kProtoPrpc
-  uint32_t cid_lo;
-  uint32_t cid_hi;
-  uint32_t resp_flags; // tbus: response flags to echo (body-crc bit)
-  long attachment;     // request attachment size (PRPC echo re-stamps it)
-  long timeout_ms;     // propagated deadline budget (0 = none rides this)
-};
-
 // append an error response frame into `out` (flushed with the batch)
 void append_error(tb_iobuf* out, const ReqCtx& rc, uint32_t code,
                   const char* text) {
@@ -948,41 +1080,152 @@ void append_error(tb_iobuf* out, const ReqCtx& rc, uint32_t code,
             rc.cid_lo, rc.cid_hi, kFlagResponse, code);
 }
 
+// ONE completion-record fill for every dispatch path (inline, pool run,
+// pool shed): the 48-byte ABI has a single writer, so a layout change
+// cannot silently diverge between the inline and deferred planes.
+void push_completion_record(TelemetryRing* tr, NativeMethod* nm,
+                            uint32_t err, uint64_t t_start, uint64_t cid64,
+                            size_t req_len, size_t resp_len,
+                            int reactor_id) {
+  if (tr == nullptr) return;
+  tb_telemetry_record rec;
+  rec.method_idx = nm->index;
+  rec.error_code = err;
+  rec.start_ns = t_start;  // raw ticks; the drain converts to ns
+  rec.latency_ns = telemetry_ticks() - t_start;
+  rec.correlation_id = cid64;
+  rec.request_size = static_cast<uint32_t>(
+      req_len > 0xFFFFFFFFu ? 0xFFFFFFFFu : req_len);
+  rec.response_size = static_cast<uint32_t>(
+      resp_len > 0xFFFFFFFFu ? 0xFFFFFFFFu : resp_len);
+  rec.sampled = 0;  // telemetry_push elects from the claimed position
+  rec.reactor_id = static_cast<uint32_t>(reactor_id);
+  telemetry_push(tr, rec);
+}
+
+// Pack a user-callback result (or its error) into `out` in the
+// request's wire protocol — shared by the inline dispatch and the pool
+// worker, so the two planes answer byte-identically by construction.
+void pack_callback_result(tb_iobuf* out, NativeMethod* nm, const ReqCtx& rc,
+                          uint64_t cid64, int rc2, const char* resp,
+                          size_t resp_len, uint32_t* t_err, size_t* t_resp) {
+  if (rc2 != 0) {
+    nm->nerr.fetch_add(1, std::memory_order_relaxed);
+    append_error(out, rc, static_cast<uint32_t>(rc2),
+                 "native method failed");
+    *t_err = static_cast<uint32_t>(rc2);
+  } else if (rc.wire == kProtoPrpc) {
+    append_prpc_resp_header(out, cid64, 0, nullptr, 0, resp_len, 0);
+    if (resp_len) tb_iobuf_append(out, resp, resp_len);
+    *t_resp = resp_len;
+  } else {
+    uint32_t flags = kFlagResponse | rc.resp_flags;
+    uint32_t crc = tb_crc32c(0, nullptr, 0);
+    if (flags & kFlagBodyCrc) crc = tb_crc32c(crc, resp, resp_len);
+    append_header(out, nullptr, 0, resp_len, crc, rc.cid_lo, rc.cid_hi,
+                  flags, 0);
+    if (resp_len) tb_iobuf_append(out, resp, resp_len);
+    *t_resp = resp_len;
+  }
+}
+
+// run one deferred task on a pool worker: user method, response pack in
+// the request's wire protocol, completion record into the OWNING
+// reactor's ring.  The connection is token-addressed — it may have died
+// while the task sat in the deque (the response is then dropped, exactly
+// like a death between dispatch and flush).
+void run_pool_task(WorkTask* t) {
+  NativeMethod* nm = t->nm;
+  const uint64_t cid64 = static_cast<uint64_t>(t->rc.cid_lo) |
+                         (static_cast<uint64_t>(t->rc.cid_hi) << 32);
+  tb_iobuf* out = tb_iobuf_create();
+  uint32_t t_err = 0;
+  size_t t_resp = 0;
+  // the propagated deadline keeps ticking while the task waits in the
+  // deque: a budget that expired in the queue is shed EDEADLINE here —
+  // running the (slow, that's why it deferred) method for a caller that
+  // already gave up would burn worker capacity exactly when overloaded
+  if (t->rc.timeout_ms > 0 &&
+      now_ms() - t->arrival_ms >= static_cast<uint64_t>(t->rc.timeout_ms)) {
+    t->srv->deadline_sheds.fetch_add(1, std::memory_order_relaxed);
+    nm->nerr.fetch_add(1, std::memory_order_relaxed);
+    append_error(out, t->rc, t->srv->errs.edeadline, kDeadlineShedText);
+    t_err = t->srv->errs.edeadline;
+  } else {
+    char* resp = nullptr;
+    size_t resp_len = 0;
+    int rc2 = nm->fn(nm->ud, t->req, t->req_len, &resp, &resp_len);
+    pack_callback_result(out, nm, t->rc, cid64, rc2, resp, resp_len,
+                         &t_err, &t_resp);
+    free(resp);
+  }
+  NetConn* c = conn_resolve(t->conn_token);
+  if (c != nullptr) {
+    conn_queue_iobuf(c, out);
+    conn_unref(c);
+  }
+  tb_iobuf_destroy(out);
+  if (t->limited) nm->nprocessing.fetch_sub(1);
+  if (t->t_start != 0)  // dispatch entry: queue wait is in the latency
+    push_completion_record(
+        t->loop->telemetry.load(std::memory_order_acquire), nm, t_err,
+        t->t_start, cid64, t->req_len, t_resp, t->loop->id);
+  free(t->req);
+  delete t;
+}
+
+void pool_worker(tb_server* s, size_t widx) {
+  DispatchPool* p = s->pool;
+  const size_t nloops = s->loops.size();
+  for (;;) {
+    uint64_t v = 0;
+    bool got = false;
+    // steal from the preferred deque first, then sweep the others — the
+    // "steal on empty" half of the Chase–Lev discipline
+    for (size_t k = 0; k < nloops && !got; ++k)
+      got = s->loops[(widx + k) % nloops]->deque->steal(&v);
+    if (got) {
+      p->pending.fetch_sub(1, std::memory_order_relaxed);
+      run_pool_task(reinterpret_cast<WorkTask*>(v));
+      continue;
+    }
+    std::unique_lock<std::mutex> lk(p->mu);
+    if (p->stopping.load(std::memory_order_acquire)) return;
+    if (p->pending.load(std::memory_order_acquire) > 0) continue;  // rescan
+    p->cv.wait_for(lk, std::chrono::milliseconds(50));
+    if (p->stopping.load(std::memory_order_acquire)) return;
+  }
+}
+
+// budget of inline user-callback dispatches per readable burst: past it,
+// further callback-kind frames of the burst defer to the pool even when
+// not flagged long-running (queue-depth pressure — a flood of one method
+// must not monopolize the reactor's cut/pack slot)
+constexpr int kInlineBurstBudget = 32;
+
 // Native method kinds: the response is built and appended into the burst's
 // batch without ever leaving C++ — the whole ProcessRpcRequest/user code/
 // SendRpcResponse round (baidu_rpc_protocol.cpp:307-503,136) for these
 // methods is native.  `out` collects every response of one readable burst;
 // the caller queues it once (one writev per burst, not per request).
-// `body` stays owned by the caller (a per-burst reusable scratch —
+// `body` stays owned by the caller (the reactor's reusable scratch —
 // creating/destroying an iobuf handle per request was measurable on the
 // pump's ns/req floor); echo ref-shares its blocks into `out` before the
 // caller clears it.
 void run_native(NetConn* c, NativeMethod* nm, const ReqCtx& rc,
                 tb_iobuf* body, tb_iobuf* out) {
   nm->nreq.fetch_add(1, std::memory_order_relaxed);
-  c->srv->native_reqs.fetch_add(1, std::memory_order_relaxed);
+  c->loop->native_reqs.fetch_add(1, std::memory_order_relaxed);
   const uint64_t cid64 = static_cast<uint64_t>(rc.cid_lo) |
                          (static_cast<uint64_t>(rc.cid_hi) << 32);
-  // telemetry: one record per dispatched request into the MPSC ring —
-  // the only hot-path cost is the clock reads + one CAS when enabled
-  TelemetryRing* tr = c->srv->telemetry.load(std::memory_order_acquire);
+  // telemetry: one record per dispatched request into the reactor's own
+  // MPSC ring — the only hot-path cost is clock reads + one CAS
+  TelemetryRing* tr = c->loop->telemetry.load(std::memory_order_acquire);
   const uint64_t t_start = tr != nullptr ? telemetry_ticks() : 0;
   const size_t req_len = tr != nullptr ? tb_iobuf_size(body) : 0;
   auto telemetry_done = [&](uint32_t err, size_t resp_len) {
-    if (tr == nullptr) return;
-    tb_telemetry_record rec;
-    rec.method_idx = nm->index;
-    rec.error_code = err;
-    rec.start_ns = t_start;  // raw ticks; the drain converts to ns
-    rec.latency_ns = telemetry_ticks() - t_start;
-    rec.correlation_id = cid64;
-    rec.request_size = static_cast<uint32_t>(
-        req_len > 0xFFFFFFFFu ? 0xFFFFFFFFu : req_len);
-    rec.response_size = static_cast<uint32_t>(
-        resp_len > 0xFFFFFFFFu ? 0xFFFFFFFFu : resp_len);
-    rec.sampled = 0;  // telemetry_push elects from the claimed position
-    rec.reserved = 0;
-    telemetry_push(tr, rec);
+    push_completion_record(tr, nm, err, t_start, cid64, req_len, resp_len,
+                           c->loop->id);
   };
   // deadline shed (reference server-side timeout_ms handling): budget
   // expired between the frame's ARRIVAL (burst read stamp) and this
@@ -1011,6 +1254,47 @@ void run_native(NetConn* c, NativeMethod* nm, const ReqCtx& rc,
     telemetry_done(c->srv->errs.elimit, 0);
     return;  // caller owns body
   }
+  // work-stealing deferral: user methods flagged long-running — or
+  // arriving behind a queue-depth-pressured burst — hand off to the
+  // dispatch pool so one slow handler can't stall this reactor's
+  // cut/pack work.  Admission (nprocessing above) spans queue + run; the
+  // worker appends the telemetry record at completion.  A full deque
+  // falls through and runs inline: backpressure, never blocking.
+  DispatchPool* pool = c->srv->pool;
+  if (pool != nullptr && nm->kind == kKindCallback &&
+      (nm->long_running.load(std::memory_order_relaxed) != 0 ||
+       c->loop->inline_burst >= kInlineBurstBudget)) {
+    size_t blen = tb_iobuf_size(body);
+    char* req = static_cast<char*>(malloc(blen ? blen : 1));
+    if (req != nullptr) {
+      if (blen) tb_iobuf_copy_to(body, req, blen, 0);
+      WorkTask* t = new WorkTask();
+      t->nm = nm;
+      t->srv = c->srv;
+      t->loop = c->loop;
+      t->conn_token = c->token;
+      t->rc = rc;
+      t->limited = limit ? 1u : 0u;
+      t->t_start = tr != nullptr ? t_start : 0;
+      t->arrival_ms = c->last_active_ms.load(std::memory_order_relaxed);
+      t->req_len = blen;
+      t->req = req;
+      if (c->loop->deque->push(reinterpret_cast<uint64_t>(t))) {
+        pool->pending.fetch_add(1, std::memory_order_release);
+        {
+          // empty critical section pairs with the worker's wait: a
+          // sleeper that checked pending before our fetch_add cannot
+          // miss the notify (this path is already off the 544 ns lane)
+          std::lock_guard<std::mutex> g(pool->mu);
+        }
+        pool->cv.notify_one();
+        return;  // caller owns body; worker answers
+      }
+      delete t;
+      free(req);
+    }
+  }
+  if (nm->kind == kKindCallback) ++c->loop->inline_burst;
   uint32_t flags = kFlagResponse | rc.resp_flags;
   char meta[64];
   size_t meta_len = 0;
@@ -1055,23 +1339,9 @@ void run_native(NetConn* c, NativeMethod* nm, const ReqCtx& rc,
     size_t resp_len = 0;
     int rc2 = nm->fn(nm->ud, req, blen, &resp, &resp_len);
     if (req != stackbuf) free(req);
-    if (rc2 != 0) {
-      nm->nerr.fetch_add(1, std::memory_order_relaxed);
-      append_error(out, rc, static_cast<uint32_t>(rc2),
-                   "native method failed");
-      t_err = static_cast<uint32_t>(rc2);
-    } else if (rc.wire == kProtoPrpc) {
-      append_prpc_resp_header(out, cid64, 0, nullptr, 0, resp_len, 0);
-      if (resp_len) tb_iobuf_append(out, resp, resp_len);
-    } else {
-      uint32_t crc = tb_crc32c(0, nullptr, 0);
-      if (flags & kFlagBodyCrc) crc = tb_crc32c(crc, resp, resp_len);
-      append_header(out, nullptr, 0, resp_len, crc, rc.cid_lo, rc.cid_hi,
-                    flags, 0);
-      if (resp_len) tb_iobuf_append(out, resp, resp_len);
-    }
+    pack_callback_result(out, nm, rc, cid64, rc2, resp, resp_len, &t_err,
+                         &t_resp);
     free(resp);
-    if (rc2 == 0) t_resp = resp_len;
   } else {  // nop
     if (rc.wire == kProtoPrpc) {
       append_prpc_resp_header(out, cid64, 0, nullptr, 0, 0, 0);
@@ -1135,14 +1405,17 @@ FrameStatus process_frames_tbus(NetConn* c) {
   // One response batch per readable burst: native responses append here
   // and flush with ONE conn_queue_iobuf (one writev) at every exit —
   // the per-request syscall was the dominant cost of the old shape.
-  tb_iobuf* batch = tb_iobuf_create();
-  tb_iobuf* scratch = tb_iobuf_create();  // per-frame body, cleared and reused
+  // Both buffers are the REACTOR's data pool (created once per loop,
+  // cleared per burst): the hot path allocates nothing and never shares
+  // them with another reactor.
+  tb_iobuf* batch = c->loop->batch;
+  tb_iobuf* scratch = c->loop->scratch;  // per-frame body, cleared and reused
   auto flush = [&](FrameStatus st) {
     // every exit flushes: even a killed connection sends the responses of
     // the frames that parsed cleanly before the bad one
     if (tb_iobuf_size(batch) > 0) conn_queue_iobuf(c, batch);
-    tb_iobuf_destroy(batch);
-    tb_iobuf_destroy(scratch);
+    tb_iobuf_clear(batch);
+    tb_iobuf_clear(scratch);
     return st;
   };
   for (;;) {
@@ -1243,12 +1516,12 @@ FrameStatus process_frames_tbus(NetConn* c) {
 // interpreter, everything else one frame callback into Python.
 FrameStatus process_frames_prpc(NetConn* c) {
   tb_server* s = c->srv;
-  tb_iobuf* batch = tb_iobuf_create();
-  tb_iobuf* scratch = tb_iobuf_create();
+  tb_iobuf* batch = c->loop->batch;      // reactor data pool (see tbus loop)
+  tb_iobuf* scratch = c->loop->scratch;
   auto flush = [&](FrameStatus st) {
     if (tb_iobuf_size(batch) > 0) conn_queue_iobuf(c, batch);
-    tb_iobuf_destroy(batch);
-    tb_iobuf_destroy(scratch);
+    tb_iobuf_clear(batch);
+    tb_iobuf_clear(scratch);
     return st;
   };
   for (;;) {
@@ -1341,6 +1614,7 @@ void conn_readable(NetConn* c) {
   // one clock read per readable burst: the arrival baseline for the
   // deadline shed in run_native AND the idle-reap activity stamp
   c->last_active_ms.store(now_ms(), std::memory_order_relaxed);
+  c->loop->inline_burst = 0;  // fresh pressure budget per readable burst
   size_t burst = tb_iobuf_read_burst();
   bool eof = false;
   for (;;) {
@@ -1361,20 +1635,23 @@ void conn_readable(NetConn* c) {
   if (eof) conn_destroy(c, true);
 }
 
-void accept_ready(tb_server* s) {
+void accept_ready(tb_server* s, Listener* lst) {
   for (;;) {
     if (s->accept_paused.load(std::memory_order_acquire)) return;
-    int fd = accept4(s->listener.fd, nullptr, nullptr,
+    int fd = accept4(lst->fd, nullptr, nullptr,
                      SOCK_NONBLOCK | SOCK_CLOEXEC);
     if (fd < 0) return;  // EAGAIN / EMFILE / EINTR: next event retries
     set_nodelay(fd);
     s->accepted.fetch_add(1, std::memory_order_relaxed);
-    s->live_conns.fetch_add(1, std::memory_order_relaxed);
     NetConn* c = new NetConn();
     c->last_active_ms.store(now_ms(), std::memory_order_relaxed);
     c->fd = fd;
     c->srv = s;
+    // sharded at accept time, never migrates: round-robin assignment
+    // keeps the distribution even regardless of which reactor's
+    // SO_REUSEPORT listener the kernel handed the connection to
     c->loop = s->loops[s->next_loop.fetch_add(1) % s->loops.size()];
+    c->loop->live_conns.fetch_add(1, std::memory_order_relaxed);
     c->rbuf = tb_iobuf_create();
     c->wbuf = tb_iobuf_create();
     conn_register(c);
@@ -1394,14 +1671,15 @@ void loop_run(tb_server* s, NetLoop* l) {
   epoll_event evs[128];
   while (!l->stopping.load(std::memory_order_acquire)) {
     int n = epoll_wait(l->epfd, evs, 128, 500);
-    // lame-duck: loop 0 owns the listener's epoll registration, so the
-    // actual teardown runs HERE (no cross-thread epoll_ctl/close race
-    // with a concurrent accept_ready)
-    if (l == s->loops[0] && s->accept_paused.load(std::memory_order_acquire) &&
-        s->listener.fd >= 0) {
-      epoll_ctl(l->epfd, EPOLL_CTL_DEL, s->listener.fd, nullptr);
-      close(s->listener.fd);
-      s->listener.fd = -1;
+    // lame-duck: every reactor owns its own listener's epoll
+    // registration, so the actual teardown runs HERE on the owning loop
+    // thread (no cross-thread epoll_ctl/close race with a concurrent
+    // accept_ready)
+    if (s->accept_paused.load(std::memory_order_acquire) &&
+        l->listener.fd >= 0) {
+      epoll_ctl(l->epfd, EPOLL_CTL_DEL, l->listener.fd, nullptr);
+      close(l->listener.fd);
+      l->listener.fd = -1;
     }
     for (int i = 0; i < n; ++i) {
       PollObj* o = static_cast<PollObj*>(evs[i].data.ptr);
@@ -1412,8 +1690,8 @@ void loop_run(tb_server* s, NetLoop* l) {
         (void)r;
         continue;
       }
-      if (o->kind == 1) {  // listener
-        accept_ready(s);
+      if (o->kind == 1) {  // listener (this reactor's own)
+        accept_ready(s, static_cast<Listener*>(o));
         continue;
       }
       NetConn* c = static_cast<NetConn*>(o);
@@ -1443,8 +1721,12 @@ tb_server* tb_server_create(int nloops) {
   s->methods = tb_flatmap_create(64);
   for (int i = 0; i < nloops; ++i) {
     NetLoop* l = new NetLoop();
+    l->id = i;
     l->epfd = epoll_create1(EPOLL_CLOEXEC);
     l->wake.fd = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
+    // reactor-owned data pools, reused across every burst the loop cuts
+    l->batch = tb_iobuf_create();
+    l->scratch = tb_iobuf_create();
     epoll_event ev{};
     ev.events = EPOLLIN;
     ev.data.ptr = static_cast<PollObj*>(&l->wake);
@@ -1452,6 +1734,28 @@ tb_server* tb_server_create(int nloops) {
     s->loops.push_back(l);
   }
   return s;
+}
+
+int tb_server_num_reactors(const tb_server* s) {
+  return static_cast<int>(s->loops.size());
+}
+
+int tb_server_set_dispatch_pool(tb_server* s, int nworkers) {
+  // pre-listen only: loop threads read s->pool / deques without fences
+  if (s->listening) return -1;
+  s->pool_workers = nworkers > 0 ? nworkers : 0;
+  return 0;
+}
+
+int tb_server_set_native_long_running(tb_server* s, const char* full_name,
+                                      int on) {
+  for (NativeMethod* nm : s->native_methods) {
+    if (nm->full_name == full_name) {
+      nm->long_running.store(on ? 1u : 0u, std::memory_order_relaxed);
+      return 0;
+    }
+  }
+  return -1;
 }
 
 void tb_server_set_frame_cb(tb_server* s, tb_frame_fn cb, void* ctx) {
@@ -1471,12 +1775,9 @@ void tb_server_set_closed_cb(tb_server* s, tb_closed_fn cb, void* ctx) {
 
 void tb_server_set_max_body(tb_server* s, size_t bytes) { s->max_body = bytes; }
 
-void tb_server_set_telemetry(tb_server* s, uint32_t capacity,
-                             uint32_t sample_every) {
-  // pre-listen only: the pointer is published once, so the loop threads
-  // never see the ring torn down under them
-  if (capacity == 0 || s->telemetry.load(std::memory_order_relaxed) != nullptr)
-    return;
+namespace {
+
+TelemetryRing* make_telemetry_ring(uint32_t capacity, uint32_t sample_every) {
   size_t cap = 64;
   while (cap < capacity && cap < (1u << 24)) cap <<= 1;
   TelemetryRing* r = new TelemetryRing();
@@ -1500,13 +1801,11 @@ void tb_server_set_telemetry(tb_server* s, uint32_t capacity,
 #else
   r->cal_ticks0 = r->cal_mono0 = tb_monotonic_ns();  // ticks ARE ns
 #endif
-  s->telemetry.store(r, std::memory_order_release);
+  return r;
 }
 
-long tb_server_drain_telemetry(tb_server* s, tb_telemetry_record* out,
-                               size_t max_records) {
-  TelemetryRing* r = s->telemetry.load(std::memory_order_acquire);
-  if (r == nullptr || out == nullptr || max_records == 0) return 0;
+long ring_drain(TelemetryRing* r, tb_telemetry_record* out,
+                size_t max_records) {
 #if defined(__x86_64__)
   // refine the tick->ns ratio over the ever-growing anchor baseline,
   // then convert the popped records in place: start_ns becomes
@@ -1551,9 +1850,67 @@ long tb_server_drain_telemetry(tb_server* s, tb_telemetry_record* out,
 #endif
 }
 
+}  // namespace
+
+void tb_server_set_telemetry(tb_server* s, uint32_t capacity,
+                             uint32_t sample_every) {
+  // pre-listen only: the per-reactor ring pointers are published once,
+  // so the loop threads never see a ring torn down under them
+  if (capacity == 0 || s->telemetry_enabled) return;
+  s->telemetry_enabled = true;
+  for (NetLoop* l : s->loops)
+    l->telemetry.store(make_telemetry_ring(capacity, sample_every),
+                       std::memory_order_release);
+}
+
+long tb_server_drain_telemetry(tb_server* s, tb_telemetry_record* out,
+                               size_t max_records) {
+  if (out == nullptr || max_records == 0) return 0;
+  long total = 0;
+  for (NetLoop* l : s->loops) {
+    TelemetryRing* r = l->telemetry.load(std::memory_order_acquire);
+    if (r == nullptr) continue;
+    total += ring_drain(r, out + total, max_records - total);
+    if (static_cast<size_t>(total) >= max_records) break;
+  }
+  return total;
+}
+
+long tb_server_drain_telemetry_ring(tb_server* s, int reactor,
+                                    tb_telemetry_record* out,
+                                    size_t max_records) {
+  if (reactor < 0 || static_cast<size_t>(reactor) >= s->loops.size())
+    return -1;
+  if (out == nullptr || max_records == 0) return 0;
+  TelemetryRing* r =
+      s->loops[reactor]->telemetry.load(std::memory_order_acquire);
+  return r == nullptr ? 0 : ring_drain(r, out, max_records);
+}
+
 uint64_t tb_server_telemetry_dropped(const tb_server* s) {
-  TelemetryRing* r = s->telemetry.load(std::memory_order_acquire);
-  return r == nullptr ? 0 : r->dropped.load(std::memory_order_relaxed);
+  uint64_t total = 0;
+  for (NetLoop* l : s->loops) {
+    TelemetryRing* r = l->telemetry.load(std::memory_order_acquire);
+    if (r != nullptr) total += r->dropped.load(std::memory_order_relaxed);
+  }
+  return total;
+}
+
+int tb_server_reactor_stats(const tb_server* s, int reactor,
+                            uint64_t* live_conns, uint64_t* native_reqs,
+                            uint64_t* telemetry_dropped) {
+  if (reactor < 0 || static_cast<size_t>(reactor) >= s->loops.size())
+    return -1;
+  NetLoop* l = s->loops[reactor];
+  if (live_conns) *live_conns = l->live_conns.load(std::memory_order_relaxed);
+  if (native_reqs)
+    *native_reqs = l->native_reqs.load(std::memory_order_relaxed);
+  if (telemetry_dropped) {
+    TelemetryRing* r = l->telemetry.load(std::memory_order_acquire);
+    *telemetry_dropped =
+        r == nullptr ? 0 : r->dropped.load(std::memory_order_relaxed);
+  }
+  return 0;
 }
 
 namespace {
@@ -1620,31 +1977,72 @@ int tb_server_register_native_fn(tb_server* s, const char* full_name,
 }
 
 int tb_server_listen(tb_server* s, const char* ip, int port) {
-  int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
-  if (fd < 0) return -errno;
-  int one = 1;
-  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_port = htons(static_cast<uint16_t>(port));
-  if (inet_pton(AF_INET, ip, &addr.sin_addr) != 1) {
-    close(fd);
-    return -EINVAL;
+  if (inet_pton(AF_INET, ip, &addr.sin_addr) != 1) return -EINVAL;
+  const bool reuseport = s->loops.size() > 1;
+  // SO_REUSEPORT would also let an UNRELATED server (same uid) bind the
+  // same explicit port — the kernel would then split connections between
+  // the two with no error anywhere.  Keep the EADDRINUSE contract: probe
+  // the requested port with a plain exclusive bind first (the tiny
+  // close-to-rebind window can only turn into a clean bind failure
+  // below, never into silent sharing with a server that was already
+  // there).  Ephemeral binds (port 0) pick a free port by construction.
+  if (reuseport && port != 0) {
+    int probe = socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+    if (probe < 0) return -errno;
+    int one = 1;
+    setsockopt(probe, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (bind(probe, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0) {
+      int e = errno;
+      close(probe);
+      return -e;
+    }
+    close(probe);
   }
-  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0 ||
-      listen(fd, 1024) != 0) {
-    int e = errno;
-    close(fd);
-    return -e;
+  int bound_port = port;
+  for (size_t i = 0; i < s->loops.size(); ++i) {
+    int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+    if (fd < 0) {
+      if (i == 0) return -errno;
+      break;  // reactors without a listener still get conns round-robin
+    }
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    // per-reactor listeners on ONE port: the SO_REUSEPORT analog of the
+    // reference's per-core EventDispatcher accept sharding.  Single-
+    // reactor servers keep the plain bind (and its EADDRINUSE contract).
+    if (reuseport) setsockopt(fd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof one);
+    addr.sin_port = htons(static_cast<uint16_t>(bound_port));
+    if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0 ||
+        listen(fd, 1024) != 0) {
+      int e = errno;
+      close(fd);
+      if (i == 0) return -e;
+      break;  // REUSEPORT unsupported: earlier listeners carry the load
+    }
+    if (i == 0) {
+      socklen_t alen = sizeof addr;
+      getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+      bound_port = ntohs(addr.sin_port);
+    }
+    s->loops[i]->listener.fd = fd;
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.ptr = static_cast<PollObj*>(&s->loops[i]->listener);
+    epoll_ctl(s->loops[i]->epfd, EPOLL_CTL_ADD, fd, &ev);
   }
-  socklen_t alen = sizeof addr;
-  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
-  s->port = ntohs(addr.sin_port);
-  s->listener.fd = fd;
-  epoll_event ev{};
-  ev.events = EPOLLIN;
-  ev.data.ptr = static_cast<PollObj*>(&s->listener);
-  epoll_ctl(s->loops[0]->epfd, EPOLL_CTL_ADD, fd, &ev);
+  s->port = bound_port;
+  s->listening = true;
+  // dispatch pool: per-reactor deques + worker threads, started before
+  // the loops so no push can beat the workers into existence
+  if (s->pool_workers > 0) {
+    for (NetLoop* l : s->loops) l->deque = new WorkDeque(8192);
+    s->pool = new DispatchPool();
+    for (int w = 0; w < s->pool_workers; ++w)
+      s->pool->workers.emplace_back(pool_worker, s, static_cast<size_t>(w));
+  }
   for (NetLoop* l : s->loops) l->th = std::thread(loop_run, s, l);
   return s->port;
 }
@@ -1661,9 +2059,28 @@ void tb_server_stop(tb_server* s) {
   }
   for (NetLoop* l : s->loops)
     if (l->th.joinable()) l->th.join();
-  if (s->listener.fd >= 0) {
-    close(s->listener.fd);
-    s->listener.fd = -1;
+  for (NetLoop* l : s->loops) {
+    if (l->listener.fd >= 0) {
+      close(l->listener.fd);
+      l->listener.fd = -1;
+    }
+  }
+  // dispatch pool: stop workers, then run the stranded tasks on THIS
+  // thread (loops are joined, so nobody else pushes; connections are
+  // still alive, so the answers flush before the sweep below)
+  if (s->pool != nullptr) {
+    {
+      std::lock_guard<std::mutex> g(s->pool->mu);
+      s->pool->stopping.store(true, std::memory_order_release);
+    }
+    s->pool->cv.notify_all();
+    for (std::thread& t : s->pool->workers)
+      if (t.joinable()) t.join();
+    for (NetLoop* l : s->loops) {
+      uint64_t v = 0;
+      while (l->deque->pop(&v))
+        run_pool_task(reinterpret_cast<WorkTask*>(v));
+    }
   }
   // loops are quiescent: sweep remaining conns single-threaded
   for (NetLoop* l : s->loops) {
@@ -1681,11 +2098,15 @@ void tb_server_destroy(tb_server* s) {
   for (NetLoop* l : s->loops) {
     close(l->wake.fd);
     close(l->epfd);
+    tb_iobuf_destroy(l->batch);
+    tb_iobuf_destroy(l->scratch);
+    delete l->telemetry.load(std::memory_order_relaxed);
+    delete l->deque;
     delete l;
   }
   for (NativeMethod* nm : s->native_methods) delete nm;
   tb_flatmap_destroy(s->methods);
-  delete s->telemetry.load(std::memory_order_relaxed);
+  delete s->pool;
   delete s;
 }
 
@@ -1693,10 +2114,20 @@ void tb_server_stats(const tb_server* s, uint64_t* accepted,
                      uint64_t* native_reqs, uint64_t* cb_frames,
                      uint64_t* handoffs, uint64_t* live_conns) {
   if (accepted) *accepted = s->accepted.load();
-  if (native_reqs) *native_reqs = s->native_reqs.load();
+  if (native_reqs) {
+    uint64_t total = 0;
+    for (NetLoop* l : s->loops)
+      total += l->native_reqs.load(std::memory_order_relaxed);
+    *native_reqs = total;
+  }
   if (cb_frames) *cb_frames = s->cb_frames.load();
   if (handoffs) *handoffs = s->handoffs.load();
-  if (live_conns) *live_conns = s->live_conns.load();
+  if (live_conns) {
+    uint64_t total = 0;
+    for (NetLoop* l : s->loops)
+      total += l->live_conns.load(std::memory_order_relaxed);
+    *live_conns = total;
+  }
 }
 
 uint64_t tb_server_deadline_sheds(const tb_server* s) {
@@ -1705,10 +2136,11 @@ uint64_t tb_server_deadline_sheds(const tb_server* s) {
 
 void tb_server_pause_accept(tb_server* s) {
   if (s->accept_paused.exchange(true)) return;
-  // wake loop 0 so the listener teardown (which it owns) runs promptly
-  if (!s->loops.empty()) {
+  // wake EVERY loop: each reactor tears down its own listener on its own
+  // thread at the next wakeup (the PR 8 single-loop assumption, retired)
+  for (NetLoop* l : s->loops) {
     uint64_t one = 1;
-    ssize_t r = write(s->loops[0]->wake.fd, &one, sizeof one);
+    ssize_t r = write(l->wake.fd, &one, sizeof one);
     (void)r;
   }
 }
@@ -1805,6 +2237,12 @@ struct Pending {
 struct tb_channel {
   int fd = -1;
   int proto = 0;  // 0 = tbus_std, 1 = baidu_std (PRPC)
+  // client reactor shard, pinned at connect: the top 8 bits of every cid
+  // this channel mints carry it, so completions route to the owning
+  // channel's pending table without any cross-channel map and a frame
+  // carrying another shard's tag is detectably misrouted
+  uint32_t shard = 0;
+  std::atomic<uint64_t> cid_misroutes{0};
   std::mutex wmu;  // writers (pack + writev serialize)
   std::mutex rmu;  // reader election
   std::mutex pmu;  // pending table + done queue + cv
@@ -1830,6 +2268,32 @@ struct tb_channel {
 };
 
 namespace {
+
+// cid space partition: top 8 bits = client reactor shard, low 56 bits =
+// the channel's sequence.  56 bits of sequence cannot wrap in practice.
+constexpr int kCidShardShift = 56;
+constexpr uint64_t kCidSeqMask = (1ull << kCidShardShift) - 1;
+std::atomic<uint32_t> g_next_client_shard{0};
+
+uint64_t channel_next_cid(tb_channel* ch) {
+  return (static_cast<uint64_t>(ch->shard) << kCidShardShift) |
+         (ch->next_cid.fetch_add(1, std::memory_order_relaxed) & kCidSeqMask);
+}
+
+// Validate an inbound cid's shard tag.  Returns the cid to complete
+// (re-tagged to the local shard on mismatch) and sets *misroute — the
+// caller fails the re-tagged pending with -EBADMSG instead of letting a
+// corrupted tag strand its caller until timeout.
+uint64_t channel_check_cid(tb_channel* ch, uint64_t cid, bool* misroute) {
+  if ((cid >> kCidShardShift) == ch->shard) {
+    *misroute = false;
+    return cid;
+  }
+  *misroute = true;
+  ch->cid_misroutes.fetch_add(1, std::memory_order_relaxed);
+  return (static_cast<uint64_t>(ch->shard) << kCidShardShift) |
+         (cid & kCidSeqMask);
+}
 
 void channel_fail(tb_channel* ch, int err) {
   ch->err.store(err, std::memory_order_release);
@@ -1859,23 +2323,34 @@ int prpc_complete_one(tb_channel* ch) {
   PrpcMeta pm = scan_prpc_meta(meta.data(), meta_len);
   if (!pm.ok) return -EPROTO;
   size_t rest = body_len - meta_len;
+  bool mis = false;
+  uint64_t cid = channel_check_cid(ch, pm.cid, &mis);
   {
     // completion runs under pmu so a timed-out caller can't free its
     // Pending (or its body iobuf) while the cut writes into it
     std::unique_lock<std::mutex> pl(ch->pmu);
-    auto it = ch->pending.find(pm.cid);
+    auto it = ch->pending.find(cid);
     Pending* p = it == ch->pending.end() ? nullptr : it->second;
-    tb_iobuf* dst = (p != nullptr && p->targeted) ? p->body : tb_iobuf_create();
+    // a wrong-shard frame's payload never reaches the caller's buffer:
+    // the pending (located by re-tagged sequence) fails with -EBADMSG
+    tb_iobuf* dst =
+        (p != nullptr && p->targeted && !mis) ? p->body : tb_iobuf_create();
     tb_iobuf_popn(ch->rbuf, kPrpcHeader + meta_len);
     if (rest) tb_iobuf_cutn(ch->rbuf, dst, rest);
     if (p == nullptr) {
       tb_iobuf_destroy(dst);  // timed-out caller already left: drop
+    } else if (mis) {
+      tb_iobuf_destroy(dst);
+      p->fail = -EBADMSG;  // surfaced as EREQUEST by the Python plane
+      if (!p->targeted) ch->doneq.emplace_back(cid, p);
+      p->done = true;
+      ch->pcv.notify_all();
     } else {
       p->meta = std::move(meta);
       p->err_code = pm.error_code;
       if (!p->targeted) {
         p->body = dst;
-        ch->doneq.emplace_back(pm.cid, p);
+        ch->doneq.emplace_back(cid, p);
       }
       p->done = true;
       ch->pcv.notify_all();
@@ -1928,8 +2403,10 @@ bool pump_once(tb_channel* ch, int slice_ms) {
       return false;
     }
     if (tb_iobuf_size(ch->rbuf) < kHeader + hdr.body_len) break;
-    uint64_t cid = static_cast<uint64_t>(hdr.cid_lo) |
-                   (static_cast<uint64_t>(hdr.cid_hi) << 32);
+    uint64_t wire_cid = static_cast<uint64_t>(hdr.cid_lo) |
+                        (static_cast<uint64_t>(hdr.cid_hi) << 32);
+    bool mis = false;
+    uint64_t cid = channel_check_cid(ch, wire_cid, &mis);
     std::string meta(hdr.meta_len, '\0');
     bool proto_err = false;
     {
@@ -1939,14 +2416,22 @@ bool pump_once(tb_channel* ch, int slice_ms) {
       auto it = ch->pending.find(cid);
       Pending* p = it == ch->pending.end() ? nullptr : it->second;
       tb_iobuf* dst =
-          (p != nullptr && p->targeted) ? p->body : tb_iobuf_create();
+          (p != nullptr && p->targeted && !mis) ? p->body : tb_iobuf_create();
       int crc =
           tb_tbus_cut(ch->rbuf, &hdr, meta.empty() ? nullptr : &meta[0], dst);
       if (crc != 0) {
-        if (p == nullptr || !p->targeted) tb_iobuf_destroy(dst);
+        if (p == nullptr || !p->targeted || mis) tb_iobuf_destroy(dst);
         proto_err = true;
       } else if (p == nullptr) {
         tb_iobuf_destroy(dst);  // timed-out caller already left: drop
+      } else if (mis) {
+        // wrong-shard tag: the re-tagged pending fails -EBADMSG (the
+        // Python plane answers EREQUEST); the channel itself survives
+        tb_iobuf_destroy(dst);
+        p->fail = -EBADMSG;
+        if (!p->targeted) ch->doneq.emplace_back(cid, p);
+        p->done = true;
+        ch->pcv.notify_all();
       } else {
         p->meta = std::move(meta);
         p->err_code = hdr.error_code;
@@ -2068,8 +2553,21 @@ tb_channel* tb_channel_connect(const char* ip, int port, int timeout_ms,
   set_nonblock(fd);
   tb_channel* ch = new tb_channel();
   ch->fd = fd;
+  // pin to a client reactor shard (round-robin over the process): the
+  // shard tag partitions the cid space so completions route without any
+  // cross-channel shared map
+  ch->shard = g_next_client_shard.fetch_add(1, std::memory_order_relaxed) &
+              0xFFu;
   ch->rbuf = tb_iobuf_create();
   return ch;
+}
+
+int tb_channel_reactor(const tb_channel* ch) {
+  return static_cast<int>(ch->shard);
+}
+
+uint64_t tb_channel_cid_misroutes(const tb_channel* ch) {
+  return ch->cid_misroutes.load(std::memory_order_relaxed);
 }
 
 int tb_channel_set_protocol(tb_channel* ch, int proto) {
@@ -2120,7 +2618,7 @@ long tb_channel_call(tb_channel* ch, const void* meta, size_t meta_len,
     }
   }
   uint64_t deadline = now_ms() + (timeout_ms > 0 ? timeout_ms : 60000);
-  uint64_t cid = ch->next_cid.fetch_add(1, std::memory_order_relaxed);
+  uint64_t cid = channel_next_cid(ch);
   Pending p;
   p.targeted = true;
   p.body = body_out;
@@ -2165,7 +2663,7 @@ uint64_t tb_channel_send(tb_channel* ch, const void* meta, size_t meta_len,
     if (err_out) *err_out = -sticky;
     return 0;
   }
-  uint64_t cid = ch->next_cid.fetch_add(1, std::memory_order_relaxed);
+  uint64_t cid = channel_next_cid(ch);
   Pending* p = new Pending();
   p->targeted = false;
   p->body = nullptr;
@@ -2295,7 +2793,7 @@ long tb_channel_pump(tb_channel* ch, const void* meta, size_t meta_len,
     // whole batch with as few writev calls as the kernel accepts (one
     // syscall per window refill, not per request)
     while (outstanding < inflight && sent < n) {
-      uint64_t cid = ch->next_cid.fetch_add(1, std::memory_order_relaxed);
+      uint64_t cid = channel_next_cid(ch);
       if (ch->proto == 1) {
         put_varint_fixed10(
             reinterpret_cast<uint8_t*>(tmpl.data()) + cid_off, cid);
@@ -2372,6 +2870,8 @@ long tb_channel_pump(tb_channel* ch, const void* meta, size_t meta_len,
           if (!pm.ok) {
             result = -EPROTO;
           } else {
+            bool mis = false;  // count wrong-shard tags; the pump's
+            channel_check_cid(ch, pm.cid, &mis);  // completion count stands
             if (pm.error_code != 0) result = -EREMOTEIO;
             ++done;
             --outstanding;
@@ -2399,6 +2899,12 @@ long tb_channel_pump(tb_channel* ch, const void* meta, size_t meta_len,
           result = -EPROTO;
         tb_iobuf_clear(ch->pump_body);
         if (result == 0) {
+          bool mis = false;
+          channel_check_cid(
+              ch,
+              static_cast<uint64_t>(hdr.cid_lo) |
+                  (static_cast<uint64_t>(hdr.cid_hi) << 32),
+              &mis);
           if (hdr.error_code != 0) result = -EREMOTEIO;
           ++done;
           --outstanding;
@@ -2432,3 +2938,30 @@ void tb_channel_destroy(tb_channel* ch) {
   if (ch->pump_body != nullptr) tb_iobuf_destroy(ch->pump_body);
   delete ch;
 }
+
+// ---------------------------------------------------------------------------
+// work-stealing deque C surface (tb_wsq_*): the dispatch pool's Chase–Lev
+// deque exported standalone — the TSAN steal-storm stress drives it from
+// Python, and future native schedulers can reuse it.
+// ---------------------------------------------------------------------------
+
+struct tb_wsq {
+  explicit tb_wsq(size_t cap) : d(cap) {}
+  WorkDeque d;
+};
+
+tb_wsq* tb_wsq_create(size_t capacity) { return new tb_wsq(capacity); }
+
+void tb_wsq_destroy(tb_wsq* q) { delete q; }
+
+int tb_wsq_push(tb_wsq* q, uint64_t value) {
+  return q->d.push(value) ? 0 : -1;
+}
+
+int tb_wsq_pop(tb_wsq* q, uint64_t* out) { return q->d.pop(out) ? 1 : 0; }
+
+int tb_wsq_steal(tb_wsq* q, uint64_t* out) {
+  return q->d.steal(out) ? 1 : 0;
+}
+
+long tb_wsq_size(const tb_wsq* q) { return q->d.size(); }

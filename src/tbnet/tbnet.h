@@ -78,11 +78,18 @@ typedef struct {
   uint32_t request_size;    // payload + attachment bytes
   uint32_t response_size;   // payload + attachment bytes (0 on error)
   uint32_t sampled;         // counter-based 1/N sample flag (rpcz)
-  uint32_t reserved;
+  uint32_t reactor_id;      // reactor that cut/dispatched the request
 } tb_telemetry_record;
 
 // ---- server ----
+// `nloops` is the reactor count: each reactor owns its own epoll fd, loop
+// thread, listener (SO_REUSEPORT when nloops > 1), telemetry ring, and
+// reusable cut/pack buffers.  Accepted connections are sharded round-robin
+// across reactors at accept time and never migrate — the frame-cutter →
+// decode → dispatch → pack hot path crosses zero cross-reactor locks.
 tb_server* tb_server_create(int nloops);
+// Reactor count this server was created with (>= 1).
+int tb_server_num_reactors(const tb_server* s);
 // Enable the per-port completion-record ring: every natively dispatched
 // request appends ONE tb_telemetry_record into a lock-free MPSC ring of
 // `capacity` slots (rounded up to a power of two); when the ring is full
@@ -97,12 +104,26 @@ void tb_server_set_telemetry(tb_server* s, uint32_t capacity,
 // records are discarded and counted as dropped) — callers must drain
 // until 0, not until a short batch.  Safe against concurrent loop-thread
 // producers; drains race each other safely but the Python side still
-// serializes them (single consumer).
+// serializes them (single consumer).  Walks every reactor's ring; use
+// tb_server_drain_telemetry_ring to drain one reactor's ring in
+// per-reactor batches (the vectorized drain's shape).
 long tb_server_drain_telemetry(tb_server* s, tb_telemetry_record* out,
                                size_t max_records);
+// Drain ONE reactor's completion ring (reactor in [0, num_reactors)).
+// Same return/drain-until-0 contract as tb_server_drain_telemetry;
+// -1 for an out-of-range reactor.
+long tb_server_drain_telemetry_ring(tb_server* s, int reactor,
+                                    tb_telemetry_record* out,
+                                    size_t max_records);
 // Records lost: ring overflow + clock-invalid discards at drain
-// (0 when telemetry is disabled).
+// (0 when telemetry is disabled).  Summed across every reactor's ring.
 uint64_t tb_server_telemetry_dropped(const tb_server* s);
+// Per-reactor counters (reactor in [0, num_reactors)): live connections
+// owned by the reactor, requests it dispatched natively, and its
+// telemetry ring's drop count.  0 ok, -1 out of range.  Any thread.
+int tb_server_reactor_stats(const tb_server* s, int reactor,
+                            uint64_t* live_conns, uint64_t* native_reqs,
+                            uint64_t* telemetry_dropped);
 void tb_server_set_frame_cb(tb_server* s, tb_frame_fn cb, void* ctx);
 void tb_server_set_handoff_cb(tb_server* s, tb_handoff_fn cb, void* ctx);
 void tb_server_set_closed_cb(tb_server* s, tb_closed_fn cb, void* ctx);
@@ -132,6 +153,19 @@ typedef int (*tb_native_fn)(void* ud, const char* req, size_t req_len,
 int tb_server_register_native_fn(tb_server* s, const char* full_name,
                                  tb_native_fn fn, void* ud,
                                  uint32_t max_concurrency);
+// Work-stealing dispatch pool: `nworkers` threads, each reactor owning a
+// Chase–Lev deque the workers steal from when their preferred deque runs
+// empty.  User methods (tb_server_register_native_fn kinds) flagged
+// long-running — or arriving behind a queue-depth-pressured burst —
+// defer to the pool so one slow handler can't stall its reactor's
+// cut/pack work; fast methods stay inline on the loop thread.  Call
+// BEFORE tb_server_listen (0 disables; returns -1 after listen).
+int tb_server_set_dispatch_pool(tb_server* s, int nworkers);
+// Mark a registered user method long-running: with a dispatch pool
+// enabled its requests always defer to the pool.  0 ok, -1 unknown
+// method.  Runtime-safe (loop threads read the flag per request).
+int tb_server_set_native_long_running(tb_server* s, const char* full_name,
+                                      int on);
 // listen on ip:port (port 0 = ephemeral); returns the bound port or -errno.
 int tb_server_listen(tb_server* s, const char* ip, int port);
 int tb_server_port(const tb_server* s);
@@ -146,13 +180,15 @@ void tb_server_stats(const tb_server* s, uint64_t* accepted,
 // native plane's feed for the deadline_shed_count bvar.
 uint64_t tb_server_deadline_sheds(const tb_server* s);
 // Lame-duck: stop accepting NEW connections while existing ones keep
-// being served.  Asynchronous — the listener teardown runs on the loop
-// thread that owns it at its next wakeup (sub-ms).  Irreversible for
-// this server; tb_server_stop still performs the full teardown.
+// being served.  Asynchronous and reactor-aware — EVERY reactor tears
+// down its own listener on its own loop thread at its next wakeup
+// (sub-ms).  Irreversible for this server; tb_server_stop still performs
+// the full teardown.
 void tb_server_pause_accept(tb_server* s);
-// Close every connection idle (no readable burst) for >= idle_ms.
-// Thread-safe (shutdown(); the owning loop reaps via EPOLLHUP — the
-// tb_conn_close discipline).  Returns the number of connections culled.
+// Close every connection idle (no readable burst) for >= idle_ms,
+// across every reactor's connection list.  Thread-safe (shutdown(); the
+// owning reactor reaps via EPOLLHUP — the tb_conn_close discipline).
+// Returns the number of connections culled.
 long tb_server_close_idle(tb_server* s, uint64_t idle_ms);
 
 // ---- per-connection surface (used by the Python frame route) ----
@@ -173,8 +209,23 @@ int tb_conn_close(uint64_t token);
 
 // ---- client channel ----
 // Blocking connect with timeout; NULL on failure (*err_out = errno).
+// Every channel pins to a client reactor shard at connect (round-robin
+// over a process-global counter): the correlation-id space is
+// partitioned per shard — the top 8 bits of every cid the channel mints
+// carry its shard id, so completions route back to the owning channel's
+// pending table with NO shared cross-channel map, and a response whose
+// cid names a different shard is detectably misrouted (see
+// tb_channel_cid_misroutes) instead of silently corrupting a wait.
 tb_channel* tb_channel_connect(const char* ip, int port, int timeout_ms,
                                int* err_out);
+// The client reactor shard this channel pinned at connect (>= 0).
+int tb_channel_reactor(const tb_channel* ch);
+// Responses observed with a WRONG shard tag in their correlation id.
+// Each one is counted, re-tagged to the local shard, and — when a
+// pending call with the same sequence exists — completes that call with
+// -EBADMSG (the Python plane surfaces it as EREQUEST); the channel
+// itself survives.
+uint64_t tb_channel_cid_misroutes(const tb_channel* ch);
 // Select the channel's wire protocol BEFORE the first send: 0 = tbus_std
 // (default), 1 = baidu_std (PRPC).  In baidu_std mode the `meta` argument
 // of call/send/pump is the pre-encoded RpcRequestMeta SUBMESSAGE
@@ -229,6 +280,25 @@ void tb_channel_destroy(tb_channel* ch);
 long tb_channel_pump(tb_channel* ch, const void* meta, size_t meta_len,
                      const void* payload, size_t payload_len, int n,
                      int inflight, int timeout_ms);
+
+// ---- work-stealing deque (Chase–Lev) ----
+// The dispatch pool's per-reactor queue, exported standalone so the
+// TSAN stress (and any future native scheduler) can drive it directly:
+// ONE owner thread pushes/pops the bottom, any number of thieves steal
+// the top.  Values are opaque u64 (the server stores task pointers).
+typedef struct tb_wsq tb_wsq;
+// capacity is rounded up to a power of two (min 64).
+tb_wsq* tb_wsq_create(size_t capacity);
+void tb_wsq_destroy(tb_wsq* q);
+// Owner-only: 0 ok, -1 full (caller runs the work inline — backpressure,
+// never blocking).
+int tb_wsq_push(tb_wsq* q, uint64_t value);
+// Owner-only: 1 = popped into *out, 0 = empty.
+int tb_wsq_pop(tb_wsq* q, uint64_t* out);
+// Any thread: 1 = stolen into *out, 0 = empty or lost the race (retry).
+int tb_wsq_steal(tb_wsq* q, uint64_t* out);
+// Approximate outstanding count (owner's view; racy by design).
+long tb_wsq_size(const tb_wsq* q);
 
 #ifdef __cplusplus
 }

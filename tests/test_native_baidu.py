@@ -246,6 +246,10 @@ class TestClientWireExactness:
             "127.0.0.1", port, protocol="baidu_std"
         )
         try:
+            # the first cid a channel mints is 1 in its own shard's cid
+            # partition (top 8 bits carry the client reactor shard pinned
+            # at connect) — the comparison frame must use the same cid
+            first_cid = (nch.reactor << 56) | 1
             rc, ec, meta, body = nch.call(
                 "svc", "mth", payload, attachment, timeout_ms=5000, **ids
             )
@@ -255,26 +259,26 @@ class TestClientWireExactness:
             lst.close()
         assert rc >= 0 and ec == 0, (rc, ec)
         assert body.to_bytes() == b"ok"
-        return got["req"]
+        return got["req"], first_cid
 
     # every call stamps its remaining deadline budget on the wire
     # (RpcRequestMeta.timeout_ms, field 8) — the expected frames carry
     # the capture helper's timeout_ms=5000
 
     def test_request_frame_byte_exact(self):
-        req = self._capture_one_call(b"the-payload", b"")
+        req, cid = self._capture_one_call(b"the-payload", b"")
         assert req == baidu_std.pack_request(
             Meta(service="svc", method="mth", timeout_ms=5000),
             b"the-payload",
-            correlation_id=1,
+            correlation_id=cid,
         )
 
     def test_request_frame_with_attachment_byte_exact(self):
         att = b"ATTACH" * 20
-        req = self._capture_one_call(b"pp", att)
+        req, cid = self._capture_one_call(b"pp", att)
         assert req == baidu_std.pack_request(
             Meta(service="svc", method="mth", timeout_ms=5000), b"pp",
-            correlation_id=1, attachment=att,
+            correlation_id=cid, attachment=att,
         )
 
     def test_traced_request_carries_dapper_ids_byte_exact(self):
@@ -282,10 +286,10 @@ class TestClientWireExactness:
         # Python packer sends them — the server parents its rpcz span
         # into the client's trace off these fields
         ids = dict(log_id=42, trace_id=0xDEADBEEF01, span_id=7)
-        req = self._capture_one_call(b"pp", b"", **ids)
+        req, cid = self._capture_one_call(b"pp", b"", **ids)
         assert req == baidu_std.pack_request(
             Meta(service="svc", method="mth", timeout_ms=5000, **ids),
-            b"pp", correlation_id=1,
+            b"pp", correlation_id=cid,
         )
 
 

@@ -583,7 +583,13 @@ class TestTelemetryRingStress:
     TBNET_STRESS_N (default 2000) echoes each.
     """
 
-    def test_multi_producer_append_vs_concurrent_drain(self, tuned_flags):
+    @pytest.mark.parametrize("num_reactors", [None, 4])
+    def test_multi_producer_append_vs_concurrent_drain(
+        self, tuned_flags, num_reactors
+    ):
+        # num_reactors=4 is the multi-reactor variant: producers spread
+        # over four per-reactor rings, the drain walks them all, and the
+        # produced == drained + dropped invariant must hold ACROSS rings
         import os
 
         import numpy as np
@@ -600,7 +606,12 @@ class TestTelemetryRingStress:
         tuned_flags("native_telemetry_sample_every", 64)
         # background cadence tight so the drain genuinely races producers
         tuned_flags("native_telemetry_drain_ms", 1)
-        srv = Server(ServerOptions(native_plane=True, usercode_inline=True))
+        srv = Server(
+            ServerOptions(
+                native_plane=True, usercode_inline=True,
+                num_reactors=num_reactors,
+            )
+        )
         srv.add_service("svc", {"echo": native_echo})
         assert srv.start(0)
         plane = srv._native_plane
@@ -692,5 +703,647 @@ class TestTelemetryRingStress:
         assert streams == nthreads
         # every sampled flag is the exact 1/N election — counter-based
         # over claimed ring positions, and claims never exceed produced
-        # requests, so the count is bounded by ceil(produced/N)
-        assert int(all_recs["sampled"].sum()) <= produced // 64 + 1
+        # requests, so the count is bounded by ceil(ring_produced/N)
+        # summed across the per-reactor rings (each elects independently)
+        nrings = plane.num_reactors
+        assert int(all_recs["sampled"].sum()) <= produced // 64 + nrings
+
+
+class TestMultiReactor:
+    """Per-core reactor sharding (ISSUE 9): connection→reactor affinity,
+    cross-reactor cid routing, and the reactor-aware lame-duck / idle
+    reap that PR 8 assumed a single loop thread for."""
+
+    def _capture_drained(self, plane):
+        """Wrap the plane's record fan-out to keep a copy of every
+        drained batch (the ring-stress capture pattern)."""
+        import numpy as np
+
+        captured = []
+        lock = threading.Lock()
+        orig = plane._consume_records
+        dtype = native_plane.NativeServerPlane._rec_dtype()
+
+        def capture(batch, n):
+            arr = np.frombuffer(batch, dtype=dtype, count=n).copy()
+            with lock:
+                captured.append(arr)
+            orig(batch, n)
+
+        plane._consume_records = capture
+        return captured
+
+    def test_connection_shard_affinity(self, native_server, tuned_flags):
+        """Every frame of a connection is cut/packed on its owning
+        reactor: each client channel's records (keyed by the cid's
+        client-shard tag) carry exactly ONE reactor_id, and round-robin
+        sharding uses every reactor."""
+        import numpy as np
+
+        tuned_flags("native_telemetry", True)
+        srv = native_server(
+            ServerOptions(
+                native_plane=True, usercode_inline=True, num_reactors=4
+            ),
+            services={"svc": {"echo": native_echo}},
+        )
+        port = _start(srv)
+        plane = srv._native_plane
+        assert plane.num_reactors == 4
+        captured = self._capture_drained(plane)
+        chans = [
+            native_plane.NativeClientChannel("127.0.0.1", port)
+            for _ in range(4)
+        ]
+        try:
+            for _round in range(20):
+                for ch in chans:
+                    rc, err, _m, _b = ch.call(
+                        "svc", "echo", b"affinity", timeout_ms=5000
+                    )
+                    assert rc >= 0 and err == 0, (rc, err)
+        finally:
+            shards = [ch.reactor for ch in chans]
+            for ch in chans:
+                ch.close()
+        plane.drain_telemetry()
+        recs = np.concatenate(captured)
+        assert len(recs) == 80
+        seen_reactors = set()
+        for shard in shards:
+            grp = recs[(recs["correlation_id"] >> 56) == shard]
+            assert len(grp) == 20
+            reactors = set(int(r) for r in np.unique(grp["reactor_id"]))
+            # the affinity contract: one connection, one reactor, forever
+            assert len(reactors) == 1, (shard, reactors)
+            seen_reactors |= reactors
+        # round-robin accept sharding: 4 connections cover all 4 reactors
+        assert seen_reactors == {0, 1, 2, 3}
+        # the per-reactor gauges tell the same story
+        for i in range(4):
+            st = plane.reactor_stats(i)
+            assert st["conns"] == 1 or st["conns"] == 0  # closed by now
+            assert st["reqs"] >= 20
+
+    def test_interleaved_cross_reactor_calls_no_misroutes(
+        self, native_server
+    ):
+        """Interleaved responses across reactors route back by cid with
+        zero misroutes and zero cross-talk."""
+        srv = native_server(
+            ServerOptions(
+                native_plane=True, usercode_inline=True, num_reactors=4
+            ),
+            services={"svc": {"echo": native_echo}},
+        )
+        port = _start(srv)
+        chans = [
+            native_plane.NativeClientChannel("127.0.0.1", port)
+            for _ in range(4)
+        ]
+        errs = []
+
+        def hammer(idx, ch):
+            payload = bytes([65 + idx]) * (16 + idx)
+            for _ in range(200):
+                rc, err, _m, body = ch.call(
+                    "svc", "echo", payload, timeout_ms=5000
+                )
+                if rc < 0 or err != 0 or body.to_bytes(len(body)) != payload:
+                    errs.append((idx, rc, err))
+                    return
+
+        try:
+            ts = [
+                threading.Thread(target=hammer, args=(i, ch))
+                for i, ch in enumerate(chans)
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert not errs, errs[:3]
+            assert sum(ch.cid_misroutes() for ch in chans) == 0
+        finally:
+            for ch in chans:
+                ch.close()
+
+    @staticmethod
+    def _wrong_shard_server():
+        """A raw tbus_std 'server' that echoes request frames with the
+        cid's shard byte flipped — the cross-reactor misroute fuzz."""
+        import socket as pysocket
+        import struct
+
+        lst = pysocket.socket()
+        lst.bind(("127.0.0.1", 0))
+        lst.listen(4)
+
+        def serve():
+            while True:
+                try:
+                    conn, _ = lst.accept()
+                except OSError:
+                    return
+                buf = b""
+                while True:
+                    try:
+                        d = conn.recv(65536)
+                    except OSError:
+                        break
+                    if not d:
+                        break
+                    buf += d
+                    while len(buf) >= 32:
+                        h = struct.unpack("<8I", buf[:32])
+                        if len(buf) < 32 + h[1]:
+                            break
+                        frame, buf = buf[: 32 + h[1]], buf[32 + h[1]:]
+                        hdr = list(struct.unpack("<8I", frame[:32]))
+                        hdr[2] |= 1  # response flag
+                        hdr[4] ^= 0xFF000000  # corrupt the shard tag
+                        try:
+                            conn.sendall(
+                                struct.pack("<8I", *hdr) + frame[32:]
+                            )
+                        except OSError:
+                            break
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        return lst, lst.getsockname()[1]
+
+    def test_wrong_shard_cid_answered_erequest_not_crash(self):
+        """A response whose cid carries another shard's tag completes
+        the caller with EREQUEST (via -EBADMSG) instead of crashing or
+        stranding it; the channel survives and counts the misroute."""
+        import errno
+
+        lst, port = self._wrong_shard_server()
+        try:
+            ch = native_plane.NativeClientChannel("127.0.0.1", port)
+            try:
+                rc, err, _m, _b = ch.call(
+                    "svc", "echo", b"payload", timeout_ms=3000
+                )
+                assert rc == -errno.EBADMSG
+                assert ch.cid_misroutes() == 1
+                assert ch.healthy()  # survived: no sticky failure
+            finally:
+                ch.close()
+        finally:
+            lst.close()
+
+    def test_wrong_shard_cid_surfaces_erequest_at_l5(self):
+        """The Python Channel maps the misroute to EREQUEST (the
+        'answered EREQUEST' half of the fuzz contract)."""
+        lst, port = self._wrong_shard_server()
+        try:
+            ch = Channel()
+            assert ch.init(
+                f"127.0.0.1:{port}",
+                options=ChannelOptions(native_plane=True, timeout_ms=3000),
+            )
+            cntl = ch.call_method("svc", "echo", b"q")
+            assert cntl.failed()
+            assert cntl.error_code == ErrorCode.EREQUEST
+        finally:
+            lst.close()
+
+    def test_lame_duck_multi_reactor(self, native_server):
+        """pause_accept tears down EVERY reactor's listener (on its own
+        loop thread) while existing connections keep being served — the
+        PR 8 single-loop assumption, retired."""
+        import socket as pysocket
+
+        srv = native_server(
+            ServerOptions(
+                native_plane=True, usercode_inline=True, num_reactors=4
+            ),
+            services={"svc": {"echo": native_echo}},
+        )
+        port = _start(srv)
+        chans = [
+            native_plane.NativeClientChannel("127.0.0.1", port)
+            for _ in range(4)
+        ]
+        try:
+            for ch in chans:
+                rc, err, _m, _b = ch.call("svc", "echo", b"x", timeout_ms=5000)
+                assert rc >= 0 and err == 0
+            srv._native_plane.pause_accept()
+            # every reactor's listener closes asynchronously (sub-ms
+            # wakeup, 500 ms epoll backstop): new connects must fail
+            deadline = time.monotonic() + 3.0
+            refused = False
+            while time.monotonic() < deadline:
+                try:
+                    probe = pysocket.create_connection(
+                        ("127.0.0.1", port), timeout=0.2
+                    )
+                    # accepted by a not-yet-torn-down listener: the conn
+                    # may still die immediately; retry until refused
+                    probe.close()
+                    time.sleep(0.05)
+                except OSError:
+                    refused = True
+                    break
+            assert refused, "listeners still accepting after pause_accept"
+            # existing connections keep working on every reactor
+            for ch in chans:
+                rc, err, _m, _b = ch.call("svc", "echo", b"y", timeout_ms=5000)
+                assert rc >= 0 and err == 0
+        finally:
+            for ch in chans:
+                ch.close()
+
+    def test_close_idle_multi_reactor(self, native_server):
+        """Idle reap walks every reactor's connection list; the owning
+        loop reaps via EPOLLHUP."""
+        srv = native_server(
+            ServerOptions(
+                native_plane=True, usercode_inline=True, num_reactors=4
+            ),
+            services={"svc": {"echo": native_echo}},
+        )
+        port = _start(srv)
+        chans = [
+            native_plane.NativeClientChannel("127.0.0.1", port)
+            for _ in range(4)
+        ]
+        try:
+            for ch in chans:
+                rc, err, _m, _b = ch.call("svc", "echo", b"x", timeout_ms=5000)
+                assert rc >= 0 and err == 0
+            time.sleep(0.15)
+            culled = srv._native_plane.close_idle(0.05)
+            assert culled == 4  # one idle conn per reactor, all reaped
+        finally:
+            for ch in chans:
+                ch.close()
+
+
+class TestDispatchPool:
+    """Work-stealing dispatch pool: long-running native methods defer to
+    pool workers so one slow handler can't stall its reactor's cut/pack
+    work."""
+
+    SRC = r"""
+    #include <stdlib.h>
+    #include <string.h>
+    #include <unistd.h>
+    extern "C" int slow_reverse_method(void* ud, const char* req, size_t n,
+                                       char** resp, size_t* resp_len) {
+      (void)ud;
+      usleep(400000);  /* 400 ms: long enough to prove the loop is free */
+      char* out = (char*)malloc(n ? n : 1);
+      for (size_t i = 0; i < n; ++i) out[i] = req[n - 1 - i];
+      *resp = out;
+      *resp_len = n;
+      return 0;
+    }
+    """
+
+    @pytest.fixture(scope="class")
+    def slow_lib(self, tmp_path_factory):
+        import subprocess
+
+        d = tmp_path_factory.mktemp("slow_methods")
+        src = d / "slow.cc"
+        so = d / "libslow.so"
+        src.write_text(self.SRC)
+        subprocess.run(
+            ["g++", "-O2", "-fPIC", "-shared", "-o", str(so), str(src)],
+            check=True,
+            capture_output=True,
+        )
+        return str(so)
+
+    def _py_reverse(self, cntl, req):
+        return req[::-1]
+
+    def test_long_running_method_does_not_stall_reactor(
+        self, native_server, slow_lib
+    ):
+        """ONE reactor, a 400 ms flagged-long-running native method in
+        flight — echoes on the same reactor still answer fast because
+        the slow handler runs on a pool worker, not the loop thread."""
+        from incubator_brpc_tpu.rpc import native_long_running
+        from incubator_brpc_tpu.transport.native_plane import (
+            native_method_lib,
+        )
+
+        slow = native_long_running(
+            native_method_lib(slow_lib, "slow_reverse_method",
+                              self._py_reverse)
+        )
+        srv = native_server(
+            ServerOptions(
+                native_plane=True, usercode_inline=True, num_reactors=1,
+                native_dispatch_workers=2,
+            ),
+            services={"user": {"slow": slow, "echo": native_echo}},
+        )
+        port = _start(srv)
+        slow_ch = native_plane.NativeClientChannel("127.0.0.1", port)
+        echo_ch = native_plane.NativeClientChannel("127.0.0.1", port)
+        result = {}
+
+        def run_slow():
+            t0 = time.perf_counter()
+            rc, err, _m, body = slow_ch.call(
+                "user", "slow", b"abcdef", timeout_ms=10000
+            )
+            result["rc"] = rc
+            result["err"] = err
+            result["body"] = body.to_bytes(len(body))
+            result["dt"] = time.perf_counter() - t0
+
+        try:
+            t = threading.Thread(target=run_slow)
+            t.start()
+            time.sleep(0.08)  # the slow call is now inside usleep
+            t0 = time.perf_counter()
+            rc, err, _m, body = echo_ch.call(
+                "user", "echo", b"fast", timeout_ms=5000
+            )
+            echo_dt = time.perf_counter() - t0
+            assert rc >= 0 and err == 0
+            assert body.to_bytes(len(body)) == b"fast"
+            t.join(timeout=15)
+            assert result["rc"] >= 0 and result["err"] == 0, result
+            assert result["body"] == b"fedcba"
+            assert result["dt"] >= 0.4  # the method really slept
+            # the echo answered while the slow call was still in flight:
+            # the reactor loop was NOT blocked behind the 400 ms method
+            assert echo_dt < 0.2, f"echo stalled {echo_dt * 1e3:.0f} ms"
+            # both dispatched natively, zero Python frames
+            stats = srv._native_plane.stats()
+            assert stats["cb_frames"] == 0
+            assert stats["native_reqs"] >= 2
+        finally:
+            slow_ch.close()
+            echo_ch.close()
+
+    def test_pool_telemetry_records_carry_reactor(
+        self, native_server, slow_lib, tuned_flags
+    ):
+        """Deferred dispatches still record completions into the OWNING
+        reactor's ring (latency spans queue + run)."""
+        import numpy as np
+
+        from incubator_brpc_tpu.rpc import native_long_running
+        from incubator_brpc_tpu.transport.native_plane import (
+            native_method_lib,
+        )
+
+        tuned_flags("native_telemetry", True)
+        slow = native_long_running(
+            native_method_lib(slow_lib, "slow_reverse_method",
+                              self._py_reverse)
+        )
+        srv = native_server(
+            ServerOptions(
+                native_plane=True, usercode_inline=True, num_reactors=2,
+                native_dispatch_workers=1,
+            ),
+            services={"user": {"slow": slow}},
+        )
+        port = _start(srv)
+        plane = srv._native_plane
+        captured = TestMultiReactor._capture_drained(
+            TestMultiReactor(), plane
+        )
+        ch = native_plane.NativeClientChannel("127.0.0.1", port)
+        try:
+            rc, err, _m, body = ch.call(
+                "user", "slow", b"xy", timeout_ms=10000
+            )
+            assert rc >= 0 and err == 0
+            assert body.to_bytes(len(body)) == b"yx"
+        finally:
+            shard = ch.reactor
+            ch.close()
+        plane.drain_telemetry()
+        recs = np.concatenate(captured)
+        mine = recs[(recs["correlation_id"] >> 56) == shard]
+        assert len(mine) == 1
+        rec = mine[0]
+        assert int(rec["error_code"]) == 0
+        assert int(rec["latency_ns"]) >= 400_000_000  # the sleep is in it
+        assert int(rec["reactor_id"]) in (0, 1)
+
+
+class TestWorkStealingDeque:
+    """tb_wsq_*: the dispatch pool's Chase–Lev deque driven directly."""
+
+    def test_push_pop_fifo_lifo_contract(self):
+        from incubator_brpc_tpu.native import LIB
+        import ctypes
+
+        q = LIB.tb_wsq_create(64)
+        try:
+            for v in (10, 20, 30):
+                assert LIB.tb_wsq_push(q, v) == 0
+            assert LIB.tb_wsq_size(q) == 3
+            out = ctypes.c_uint64()
+            # owner pops the BOTTOM (LIFO)
+            assert LIB.tb_wsq_pop(q, ctypes.byref(out)) == 1
+            assert out.value == 30
+            # thief steals the TOP (FIFO)
+            assert LIB.tb_wsq_steal(q, ctypes.byref(out)) == 1
+            assert out.value == 10
+            assert LIB.tb_wsq_pop(q, ctypes.byref(out)) == 1
+            assert out.value == 20
+            assert LIB.tb_wsq_pop(q, ctypes.byref(out)) == 0  # empty
+            assert LIB.tb_wsq_steal(q, ctypes.byref(out)) == 0
+        finally:
+            LIB.tb_wsq_destroy(q)
+
+    def test_full_deque_rejects_push(self):
+        from incubator_brpc_tpu.native import LIB
+        import ctypes
+
+        q = LIB.tb_wsq_create(1)  # rounds up to the 64 minimum
+        try:
+            pushed = 0
+            while LIB.tb_wsq_push(q, pushed) == 0:
+                pushed += 1
+                assert pushed < 10000  # must hit the cap
+            assert pushed >= 64
+            out = ctypes.c_uint64()
+            assert LIB.tb_wsq_pop(q, ctypes.byref(out)) == 1
+            assert LIB.tb_wsq_push(q, 999) == 0  # space freed
+        finally:
+            LIB.tb_wsq_destroy(q)
+
+
+@pytest.mark.slow
+class TestWorkStealingDequeStress:
+    """Steal storm racing owner push/pop + stop — the `make san` TSAN
+    workload for the Chase–Lev deque (WSQ_STRESS_* sized, like the ring
+    stress).  Conservation: every pushed value is consumed exactly once
+    (owner pop or a thief's steal), nothing lost, nothing duplicated."""
+
+    def test_steal_storm_conservation(self):
+        import ctypes
+        import os
+
+        from incubator_brpc_tpu.native import LIB
+
+        nthieves = int(os.environ.get("WSQ_STRESS_THREADS", "4"))
+        n_items = int(os.environ.get("WSQ_STRESS_N", "20000"))
+        q = LIB.tb_wsq_create(1024)
+        stop = threading.Event()
+        stolen: list = [[] for _ in range(nthieves)]
+        popped: list = []
+
+        def thief(idx):
+            out = ctypes.c_uint64()
+            got = stolen[idx]
+            while not stop.is_set() or LIB.tb_wsq_size(q) > 0:
+                if LIB.tb_wsq_steal(q, ctypes.byref(out)) == 1:
+                    got.append(out.value)
+
+        ts = [
+            threading.Thread(target=thief, args=(i,), name=f"thief-{i}")
+            for i in range(nthieves)
+        ]
+        for t in ts:
+            t.start()
+        # owner: push everything, interleaving pops (the stop-time drain
+        # shape) so pop-vs-steal races on the last element get exercised
+        out = ctypes.c_uint64()
+        pushed = 0
+        while pushed < n_items:
+            if LIB.tb_wsq_push(q, pushed) == 0:
+                pushed += 1
+            else:  # full: drain a few from our own bottom like the pool
+                if LIB.tb_wsq_pop(q, ctypes.byref(out)) == 1:
+                    popped.append(out.value)
+            if pushed % 97 == 0 and LIB.tb_wsq_pop(
+                q, ctypes.byref(out)
+            ) == 1:
+                popped.append(out.value)
+        stop.set()
+        for t in ts:
+            t.join()
+        # owner drains the leftovers (reactor stop discipline)
+        while LIB.tb_wsq_pop(q, ctypes.byref(out)) == 1:
+            popped.append(out.value)
+        LIB.tb_wsq_destroy(q)
+        consumed = popped + [v for lst in stolen for v in lst]
+        assert len(consumed) == n_items, (
+            f"consumed {len(consumed)} != pushed {n_items}"
+        )
+        assert len(set(consumed)) == n_items  # exactly-once, no dups
+
+
+class TestMultiReactorReviewFixes:
+    """Regressions for the review findings on the multi-reactor plane."""
+
+    def test_explicit_port_double_bind_still_eaddrinuse(self, native_server):
+        """SO_REUSEPORT on the per-reactor listeners must NOT let a
+        second multi-reactor server bind the same explicit port — the
+        kernel would silently split connections between unrelated
+        servers.  An exclusive probe bind preserves the EADDRINUSE
+        contract."""
+        srv1 = native_server(
+            ServerOptions(
+                native_plane=True, usercode_inline=True, num_reactors=4
+            ),
+            services={"svc": {"echo": native_echo}},
+        )
+        port = _start(srv1)
+        srv2 = Server(
+            ServerOptions(
+                native_plane=True, usercode_inline=True, num_reactors=4,
+                has_builtin_services=False,
+            )
+        )
+        srv2.add_service("svc2", {"echo": native_echo})
+        try:
+            # the native listen refuses (EADDRINUSE probe), and the
+            # Python-acceptor fallback then fails the same way — the
+            # double start is LOUD, not silent connection splitting
+            with pytest.raises(OSError) as exc:
+                assert not srv2.start(port)
+            import errno as _errno
+
+            assert exc.value.errno == _errno.EADDRINUSE
+        finally:
+            srv2.stop()
+        # the first server still owns the port
+        ch = native_plane.NativeClientChannel("127.0.0.1", port)
+        try:
+            rc, err, _m, _b = ch.call("svc", "echo", b"x", timeout_ms=5000)
+            assert rc >= 0 and err == 0
+        finally:
+            ch.close()
+
+    def test_queue_expired_deadline_shed_in_pool(
+        self, native_server, tmp_path_factory
+    ):
+        """A deferred task whose propagated deadline expires while it
+        waits in the work-stealing deque is shed EDEADLINE by the pool
+        worker instead of running the slow method for a caller that
+        already gave up."""
+        import subprocess
+
+        from incubator_brpc_tpu.rpc import native_long_running
+        from incubator_brpc_tpu.transport.native_plane import (
+            native_method_lib,
+        )
+
+        d = tmp_path_factory.mktemp("shed_methods")
+        src = d / "slow.cc"
+        so = d / "libslow.so"
+        src.write_text(TestDispatchPool.SRC)
+        subprocess.run(
+            ["g++", "-O2", "-fPIC", "-shared", "-o", str(so), str(src)],
+            check=True, capture_output=True,
+        )
+        slow = native_long_running(
+            native_method_lib(
+                str(so), "slow_reverse_method",
+                TestDispatchPool._py_reverse,
+            )
+        )
+        srv = native_server(
+            ServerOptions(
+                native_plane=True, usercode_inline=True, num_reactors=1,
+                native_dispatch_workers=1,  # ONE worker: second call queues
+            ),
+            services={"user": {"slow": slow}},
+        )
+        port = _start(srv)
+        ch1 = native_plane.NativeClientChannel("127.0.0.1", port)
+        ch2 = native_plane.NativeClientChannel("127.0.0.1", port)
+        results = {}
+
+        def first():
+            results["first"] = ch1.call(
+                "user", "slow", b"ab", timeout_ms=10000
+            )[:2]
+
+        try:
+            t = threading.Thread(target=first)
+            t.start()
+            time.sleep(0.1)  # the worker is now inside the 400 ms sleep
+            # 150 ms budget: expires ~250 ms before the worker frees up
+            rc, err, _m, _b = ch2.call(
+                "user", "slow", b"cd", timeout_ms=150
+            )
+            t.join(timeout=15)
+            assert results["first"] == (2, 0), results  # ran fine
+            # the queued call was shed with the deadline error, not run
+            assert err == ErrorCode.EDEADLINE or rc < 0, (rc, err)
+            deadline = time.monotonic() + 2
+            while time.monotonic() < deadline:
+                if srv._native_plane.stats()["deadline_sheds"] >= 1:
+                    break
+                time.sleep(0.02)
+            assert srv._native_plane.stats()["deadline_sheds"] >= 1
+        finally:
+            ch1.close()
+            ch2.close()

@@ -152,6 +152,21 @@ class TestFfiCheckerCatchesDrift:
             for v in vs
         ), _fmt(vs)
 
+    def test_width_change_in_new_reactor_export(self, tbnet_text):
+        # ISSUE 9 acceptance: a seeded width flip in one of the NEW
+        # multi-reactor exports still flips the checker red — the FFI
+        # gate covers the grown surface, not just the seed's
+        mut = self._mutate(
+            tbnet_text,
+            "int tb_server_reactor_stats(const tb_server* s, int reactor,",
+            "int tb_server_reactor_stats(const tb_server* s, long reactor,",
+        )
+        vs = ffi_check.check(tbnet_text=mut)
+        assert any(
+            v.rule == "ffi-type" and "tb_server_reactor_stats" in v.message
+            for v in vs
+        ), _fmt(vs)
+
     def test_signedness_change(self, tbnet_text):
         mut = self._mutate(
             tbnet_text,

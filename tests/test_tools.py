@@ -124,6 +124,44 @@ class TestPress:
         assert stats["ok"] > 10
         assert stats["latency_us_p99"] >= stats["latency_us_p50"] > 0
 
+    def test_press_reactor_mode_reports_distribution(self):
+        # --reactors N --conns-per-reactor M: the sharded-accept load
+        # run against a multi-reactor native server, per-reactor conn
+        # distribution scraped from the target's /vars
+        from incubator_brpc_tpu.rpc import (
+            Server,
+            ServerOptions,
+            native_echo,
+        )
+        from incubator_brpc_tpu.transport import native_plane as np_mod
+        from tools.rpc_press import run_reactor_press
+
+        if not np_mod.NET_AVAILABLE:
+            import pytest as _pytest
+
+            _pytest.skip("native runtime unavailable")
+        srv = Server(
+            ServerOptions(
+                native_plane=True, usercode_inline=True, num_reactors=4
+            )
+        )
+        srv.add_service("demo", {"echo": native_echo})
+        assert srv.start(0)
+        try:
+            stats = run_reactor_press(
+                f"127.0.0.1:{srv.port}", "demo", "echo", b"press",
+                reactors=4, conns_per_reactor=1, duration=0.5,
+                timeout_ms=15000,
+            )
+            assert stats["fail"] == 0
+            assert stats["ok"] > 10
+            assert stats["cid_misroutes"] == 0
+            # round-robin accept sharding: 4 conns spread one per reactor
+            assert stats["reactor_conns"] == {0: 1, 1: 1, 2: 1, 3: 1}
+            assert len(stats["client_shards"]) == 4
+        finally:
+            srv.stop()
+
     def test_press_over_device_links(self, echo_server):
         # --transport tpu: the rdma_performance client's use_rdma flag —
         # the same load loop over the device plane

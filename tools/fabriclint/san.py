@@ -56,8 +56,12 @@ TSAN_SUPP = os.path.join(REPO_ROOT, "tools", "fabriclint", "tsan.supp")
 
 ASAN_TESTS = ["tests/test_native_plane.py", "tests/test_native_baidu.py"]
 TSAN_TESTS = [
-    # the lock-free telemetry ring under multi-producer fire (PR 6)
+    # the lock-free telemetry ring under multi-producer fire (PR 6),
+    # including the multi-reactor (4-ring) parametrization
     "tests/test_native_plane.py::TestTelemetryRingStress",
+    # the Chase–Lev work-stealing deque: steal storm racing owner
+    # push/pop + stop (the dispatch pool's queue, ISSUE 9)
+    "tests/test_native_plane.py::TestWorkStealingDequeStress",
     # the scheduler plane: worker_pool + timer_thread schedule/unschedule
     # storm racing stop (the dynamic complement of fabricverify's static
     # lock-order pass)
@@ -247,6 +251,8 @@ def run_tsan() -> int:
         "TBNET_STRESS_N": "400",
         "SCHED_STRESS_THREADS": "4",
         "SCHED_STRESS_N": "200",
+        "WSQ_STRESS_THREADS": "4",
+        "WSQ_STRESS_N": "4000",
     }
     err = _preflight_native(env)
     if err:
@@ -256,9 +262,9 @@ def run_tsan() -> int:
     bad = rc != 0 or "WARNING: ThreadSanitizer" in out
     tail = "\n".join(out.splitlines()[-15:])
     if bad:
-        print(f"[FAIL] tsan ring + scheduler stress:\n{tail}")
+        print(f"[FAIL] tsan ring + deque + scheduler stress:\n{tail}")
         return 1
-    print(f"[ok] tsan ring + scheduler stress: {_last_line(out)}")
+    print(f"[ok] tsan ring + deque + scheduler stress: {_last_line(out)}")
     return 0
 
 

@@ -122,9 +122,128 @@ def run_press(
     }
 
 
+def run_reactor_press(
+    server: str,
+    service: str,
+    method: str,
+    payload: bytes,
+    reactors: int,
+    conns_per_reactor: int = 2,
+    duration: float = 5.0,
+    timeout_ms: float = 1000,
+    fault_rate: float = 0.0,
+    fault_delay_ms: float = 0.0,
+) -> dict:
+    """Sharded-accept load run: ``reactors * conns_per_reactor`` native
+    client channels (each pinned to its own client reactor shard at
+    connect) flood the target concurrently, then the target's
+    ``/vars`` is scraped for the ``native_reactor_<port>_<i>_conns``
+    family so the per-reactor connection distribution — and any skew in
+    the accept sharding — is printed next to the qps numbers.  The
+    ``--fault-rate``/``--fault-delay-ms`` brownout flags arm the native
+    client fault seam (tb_channel_set_fault) on every channel, exactly
+    like ``--native-plane`` runs."""
+    import re
+
+    from incubator_brpc_tpu.bvar import LatencyRecorder
+    from incubator_brpc_tpu.transport.native_plane import (
+        NET_AVAILABLE,
+        NativeClientChannel,
+        install_native_client_fault,
+    )
+
+    if not NET_AVAILABLE:
+        raise SystemExit("--reactors needs the native plane (libtbutil.so)")
+    if fault_rate > 0 or fault_delay_ms > 0:
+        from incubator_brpc_tpu.utils.flags import set_flag_unchecked
+
+        set_flag_unchecked("fault_injection", True)
+        install_native_client_fault(
+            fail_every=(
+                max(1, round(1.0 / fault_rate)) if fault_rate > 0 else 0
+            ),
+            delay_every=1 if fault_delay_ms > 0 else 0,
+            delay_ms=int(fault_delay_ms),
+        )
+        print(
+            "native-plane fault seam armed on every reactor channel "
+            f"(fail every "
+            f"{max(1, round(1.0 / fault_rate)) if fault_rate > 0 else 0}, "
+            f"delay {fault_delay_ms:g} ms/call)",
+            file=sys.stderr,
+        )
+    ip, _, port = server.rpartition(":")
+    nconns = max(1, reactors) * max(1, conns_per_reactor)
+    chans = [NativeClientChannel(ip, int(port)) for _ in range(nconns)]
+    latency = LatencyRecorder(name=None)
+    stop_at = time.monotonic() + duration
+    counts = {"ok": 0, "fail": 0}
+    lock = threading.Lock()
+
+    def worker(ch):
+        ok = fail = 0
+        while time.monotonic() < stop_at:
+            t0 = time.perf_counter()
+            rc, err, _meta, _body = ch.call(
+                service, method, payload, timeout_ms=int(timeout_ms)
+            )
+            if rc >= 0 and err == 0:
+                ok += 1
+                latency << (time.perf_counter() - t0) * 1e6
+            else:
+                fail += 1
+        with lock:
+            counts["ok"] += ok
+            counts["fail"] += fail
+
+    ts = [threading.Thread(target=worker, args=(ch,)) for ch in chans]
+    t0 = time.monotonic()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = time.monotonic() - t0
+    # scrape the distribution while our connections are still open — a
+    # closed channel leaves the reactor's conn gauge before we read it
+    distribution = {}
+    try:
+        text = _http_get(server, "/vars", timeout=2.0)
+        # anchored to THIS port: a process serving several native ports
+        # exposes a native_reactor_* family per port, and merging them
+        # would misreport the very skew this print exists to surface
+        for m in re.finditer(
+            rf"native_reactor_{int(port)}_(\d+)_conns\s*:\s*(\d+)", text
+        ):
+            distribution[int(m.group(1))] = int(m.group(2))
+    except OSError:
+        pass  # no portal reachable: fall back to client-side pins below
+    misroutes = sum(ch.cid_misroutes() for ch in chans)
+    client_shards = [ch.reactor for ch in chans]
+    for ch in chans:
+        ch.close()
+    return {
+        "qps": counts["ok"] / wall if wall else 0.0,
+        "ok": counts["ok"],
+        "fail": counts["fail"],
+        "latency_us_avg": latency.latency(),
+        "latency_us_p50": latency.latency_percentile(0.5),
+        "latency_us_p99": latency.latency_percentile(0.99),
+        "latency_us_max": latency.max_latency(),
+        "reactor_conns": distribution,
+        "client_shards": client_shards,
+        "cid_misroutes": misroutes,
+    }
+
+
 def _http_get(server: str, path: str, timeout: float = 5.0) -> str:
     """One ad-hoc HTTP GET against the target's builtin portal (every
-    server serves it on its RPC port)."""
+    server serves it on its RPC port).  Servers may hold the connection
+    open after the response (keep-alive on handed-off native
+    connections), so the read stops once Content-Length is satisfied; a
+    timeout is tolerated ONLY for a complete (or length-less) body — a
+    server stalling mid-body still raises instead of returning silently
+    truncated output."""
+    import re as _re
     import socket as _socket
 
     ip, _, port = server.rpartition(":")
@@ -133,8 +252,23 @@ def _http_get(server: str, path: str, timeout: float = 5.0) -> str:
             f"GET {path} HTTP/1.0\r\nHost: {server}\r\n\r\n".encode()
         )
         out = b""
-        while True:
-            chunk = s.recv(4096)
+        expect = None  # total bytes once headers + Content-Length known
+        while expect is None or len(out) < expect:
+            if expect is None and b"\r\n\r\n" in out:
+                head, _, _rest = out.partition(b"\r\n\r\n")
+                m = _re.search(
+                    rb"^content-length:\s*(\d+)\s*$", head,
+                    _re.IGNORECASE | _re.MULTILINE,
+                )
+                if m:
+                    expect = len(head) + 4 + int(m.group(1))
+                    continue
+            try:
+                chunk = s.recv(4096)
+            except _socket.timeout:
+                if expect is None and out:
+                    break  # no Content-Length: best effort, data in hand
+                raise  # nothing yet, or a server stalled mid-body
             if not chunk:
                 break
             out += chunk
@@ -272,6 +406,17 @@ def main(argv=None) -> int:
         help="route eligible calls through the C++ client channel",
     )
     p.add_argument(
+        "--reactors", type=int, default=0,
+        help="sharded-accept load: open REACTORS * CONNS_PER_REACTOR "
+        "native channels (each pinned to its own client reactor shard) "
+        "and print the server's per-reactor connection distribution so "
+        "skewed sharding is visible",
+    )
+    p.add_argument(
+        "--conns-per-reactor", type=int, default=2,
+        help="connections per reactor group for --reactors runs",
+    )
+    p.add_argument(
         "--fault-rate", type=float, default=0.0,
         help="inject transport-write failures on this fraction of "
         "operations (deterministic counter schedule; drives the "
@@ -322,6 +467,47 @@ def main(argv=None) -> int:
             f"drained_clean={counts['drained_clean']}"
         )
         return 0 if counts["drained_clean"] else 1
+
+    if args.reactors > 0:
+        if args.transport == "tpu":
+            p.error("--reactors drives TCP native channels; it cannot "
+                    "combine with --transport tpu")
+        stats = run_reactor_press(
+            args.server,
+            service,
+            method,
+            payload,
+            reactors=args.reactors,
+            conns_per_reactor=args.conns_per_reactor,
+            duration=args.duration,
+            timeout_ms=args.timeout_ms,
+            fault_rate=args.fault_rate,
+            fault_delay_ms=args.fault_delay_ms,
+        )
+        if stats["reactor_conns"]:
+            dist = " ".join(
+                f"r{i}={n}" for i, n in sorted(stats["reactor_conns"].items())
+            )
+        else:  # no portal on the target: show the client-side pins
+            dist = "client-shards=" + ",".join(
+                str(s) for s in stats["client_shards"]
+            )
+        print(f"per-reactor conns: {dist}", file=sys.stderr)
+        if stats["cid_misroutes"]:
+            print(
+                f"cid misroutes observed: {stats['cid_misroutes']}",
+                file=sys.stderr,
+            )
+        print(
+            f"qps={stats['qps']:.0f} ok={stats['ok']} fail={stats['fail']} "
+            f"avg={stats['latency_us_avg']:.0f}us "
+            f"p50={stats['latency_us_p50']:.0f}us "
+            f"p99={stats['latency_us_p99']:.0f}us "
+            f"max={stats['latency_us_max']:.0f}us"
+        )
+        if args.fault_rate > 0 or args.fault_delay_ms > 0:
+            return 0  # failures are the point of a brownout run
+        return 0 if stats["fail"] == 0 else 1
 
     stats = run_press(
         args.server,
