@@ -52,7 +52,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from incubator_brpc_tpu.bvar import Adder, LatencyRecorder
+from incubator_brpc_tpu.bvar import Adder, LatencyRecorder, PassiveStatus
 from incubator_brpc_tpu.utils.flags import define_flag, get_flag
 
 logger = logging.getLogger(__name__)
@@ -76,6 +76,38 @@ define_flag(
     lambda v: v >= 0,
 )
 
+define_flag(
+    "mc_dispatch_checkpoint_every",
+    0,
+    "checkpoint cadence (in lockstep steps) for collective-method "
+    "sessions: every C completed steps each party retains its "
+    "device-resident operand shards in a ring, so an aborted session can "
+    "resume from the last COMMON checkpoint instead of step 0; the "
+    "proposer stamps the cadence into the run proposal so every party "
+    "checkpoints the same steps; 0 = checkpointing off (abort = restart)",
+    lambda v: v >= 0,
+)
+
+define_flag(
+    "mc_dispatch_checkpoint_depth",
+    4,
+    "ring depth of the per-party checkpoint store: how many checkpointed "
+    "steps stay device-resident per session (older entries are evicted "
+    "oldest-first; memory cost per entry is parties x width bytes)",
+    lambda v: v >= 1,
+)
+
+define_flag(
+    "mc_dispatch_step_deadline_ms",
+    0,
+    "per-STEP watchdog for collective-method sessions: a single lockstep "
+    "step (dispatch-to-dispatch progress, or the final fetch) stalled "
+    "longer than this aborts the session fabric-wide — bounding a wedge "
+    "INSIDE one step instead of waiting out the whole session deadline; "
+    "0 = off (the session deadline is the only backstop)",
+    lambda v: v >= 0,
+)
+
 DISPATCH_METHOD = "collective_dispatch"
 
 # Bounds a proposal must sit inside before anything is resolved or run
@@ -92,6 +124,8 @@ dispatch_steps = Adder(name="mc_dispatch_steps")
 dispatch_errors = Adder(name="mc_dispatch_errors")
 dispatch_rejects = Adder(name="mc_dispatch_rejects")
 dispatch_aborts = Adder(name="mc_dispatch_aborts")
+dispatch_resumes = Adder(name="mc_dispatch_resumes")
+dispatch_replaced_parties = Adder(name="mc_dispatch_replaced_parties")
 dispatch_session_us = LatencyRecorder(name="mc_dispatch_session_us")
 
 _method_counters: Dict[Tuple[str, str], Adder] = {}
@@ -138,6 +172,8 @@ class SessionAborted(RuntimeError):
         dead_indexes=(),
         survivor_indexes=(),
         rejects=(),
+        session_id: str = "",
+        final_steps: int = 0,
     ):
         super().__init__(reason)
         from incubator_brpc_tpu.utils.status import ErrorCode
@@ -147,15 +183,20 @@ class SessionAborted(RuntimeError):
         self.dead_indexes = tuple(dead_indexes)
         self.survivor_indexes = tuple(survivor_indexes)
         self.rejects = tuple(rejects)  # (index, error_text) non-death fails
+        # what the resume path needs: the aborted session's identity (its
+        # checkpoint rings are keyed on it) and the agreed step count the
+        # resumed run must still converge to
+        self.session_id = session_id
+        self.final_steps = int(final_steps)
 
 
 class _SessionState:
     __slots__ = (
         "session_id", "party_ids", "owner", "deadline", "abort_event",
-        "abort_reason", "aborted",
+        "abort_reason", "aborted", "epoch",
     )
 
-    def __init__(self, session_id, party_ids, deadline, owner):
+    def __init__(self, session_id, party_ids, deadline, owner, epoch=0):
         self.session_id = session_id
         self.party_ids = tuple(party_ids)
         self.owner = owner  # the serving Server (None on the proposer)
@@ -163,6 +204,13 @@ class _SessionState:
         self.abort_event = threading.Event()
         self.abort_reason = ""
         self.aborted = False
+        # which RUN of this session this registrant belongs to: a RESUMED
+        # run re-registers the SAME session id at epoch+1, and an abort
+        # broadcast stamped with an older epoch (a straggler from the
+        # aborted first run — delayed delivery, or a retry that rode a
+        # fresh connection and lost FIFO with the resume proposal) must
+        # not kill the healed run
+        self.epoch = int(epoch)
 
 
 # session id -> every local registrant (proposer AND parties: in a
@@ -171,9 +219,26 @@ class _SessionState:
 _sessions: Dict[str, List[_SessionState]] = {}
 _sessions_lock = threading.Lock()
 
+# abort tombstones: session id -> highest epoch aborted SO FAR.  An abort
+# only flips registrants that exist when it lands — a run proposal of an
+# already-aborted epoch arriving AFTER the abort would otherwise register
+# fresh and start a zombie chain no peer will ever join (unwedged only by
+# its own deadline).  The tombstone closes that race: such proposals are
+# rejected ESESSION at admission.  A RESUMED run (epoch+1) stays
+# admissible — the tombstone only covers epochs the proposer already gave
+# up on.  Insertion-ordered, capped (dead sessions age out).
+_MAX_TOMBSTONES = 256
+_aborted_epochs: Dict[str, int] = {}
 
-def _register_session(session_id, party_ids, deadline, owner=None):
-    st = _SessionState(session_id, party_ids, deadline, owner)
+
+def aborted_epoch(session_id: str) -> int:
+    """Highest aborted epoch for a session (-1 = never aborted here)."""
+    with _sessions_lock:
+        return _aborted_epochs.get(session_id, -1)
+
+
+def _register_session(session_id, party_ids, deadline, owner=None, epoch=0):
+    st = _SessionState(session_id, party_ids, deadline, owner, epoch=epoch)
     with _sessions_lock:
         _sessions.setdefault(session_id, []).append(st)
     return st
@@ -204,13 +269,35 @@ def active_sessions(owner=None) -> int:
         )
 
 
-def abort_session(session_id: str, reason: str) -> bool:
-    """Flip every local registrant of one session to aborted (idempotent;
-    counted once per session per process). Returns False when the id is
-    unknown — already closed or never registered here, both fine for a
-    best-effort broadcast."""
+def abort_session(
+    session_id: str, reason: str, epoch: Optional[int] = None
+) -> bool:
+    """Flip local registrants of one session to aborted (idempotent;
+    counted once per session per process).  ``epoch`` scopes the abort to
+    registrants of that run or older — a stale broadcast from an aborted
+    first run cannot kill the session's RESUMED run (epoch+1); None
+    aborts every registrant (link death, local sweeps).  Returns False
+    when nothing matched — already closed, never registered here, or all
+    registrants newer than the stamped epoch; all fine for a best-effort
+    broadcast."""
     with _sessions_lock:
-        states = list(_sessions.get(session_id, ()))
+        states = [
+            st
+            for st in _sessions.get(session_id, ())
+            if epoch is None or st.epoch <= epoch
+        ]
+        # tombstone the aborted epoch(s): a run proposal for an epoch ≤
+        # this arriving LATER (reordered past the abort) must not start a
+        # zombie chain.  An epoch-stamped abort tombstones even with no
+        # registrant yet — the abort-beats-proposal ordering; an unstamped
+        # (local) abort tombstones whatever it actually hit.
+        stone = epoch if epoch is not None else max(
+            (st.epoch for st in states), default=None
+        )
+        if stone is not None and _aborted_epochs.get(session_id, -1) < stone:
+            while len(_aborted_epochs) >= _MAX_TOMBSTONES:
+                _aborted_epochs.pop(next(iter(_aborted_epochs)))
+            _aborted_epochs[session_id] = stone
         if not states:
             return False
         first = any(not st.aborted for st in states)
@@ -224,6 +311,21 @@ def abort_session(session_id: str, reason: str) -> bool:
     for st in states:
         st.abort_event.set()
     return True
+
+
+def abort_sessions_for_owner(owner, reason: str) -> int:
+    """Abort every session served by one Server — the chaos drill's
+    clean-death seam (a killed party's own handler must unwedge promptly
+    instead of burning its session deadline) and a stop-time sweep for
+    anything that outlived a drain. Returns the number of sessions hit."""
+    with _sessions_lock:
+        hit = [
+            sid for sid, states in _sessions.items()
+            if any(st.owner is owner for st in states)
+        ]
+    for sid in hit:
+        abort_session(sid, reason)
+    return len(hit)
 
 
 def abort_sessions_for_devices(device_ids, reason: str) -> int:
@@ -242,14 +344,244 @@ def abort_sessions_for_devices(device_ids, reason: str) -> int:
     return len(hit)
 
 
+# -- step-granular checkpoint rings --------------------------------------------
+#
+# The elastic half of the fault plane: with ``mc_dispatch_checkpoint_every``
+# set, each party retains a device-resident ring of its last
+# ``mc_dispatch_checkpoint_depth`` completed-step operand shards, keyed by
+# (session_id, own party index).  An aborted session's rings survive the
+# abort so the resume barrier can agree on the last COMMON checkpointed
+# step (the min-join over survivor watermarks) and replay only the steps
+# past it.  Rings are released by the proposer's phase:"release" broadcast
+# on clean completion (or after a finished resume) and capped by an
+# oldest-session eviction so a crashed proposer cannot pin device memory
+# forever.  Entries hold the session's GLOBAL jax arrays — retaining them
+# is free (no host sync; the buffers just stay alive on their devices).
+
+_MAX_CHECKPOINT_SESSIONS = 16
+
+
+class _CheckpointRing:
+    __slots__ = ("session_id", "own_index", "party_ids", "entries",
+                 "entry_bytes")
+
+    def __init__(self, session_id, own_index, party_ids, entry_bytes):
+        self.session_id = session_id
+        self.own_index = int(own_index)
+        self.party_ids = tuple(party_ids)
+        self.entries = []  # ascending [(completed_step, x, ns)]
+        self.entry_bytes = int(entry_bytes)  # retained bytes per entry
+
+    def put(self, step: int, x, ns, depth: int) -> None:
+        # a RESUMED run replays step numbers the aborted run already
+        # checkpointed: the fresh entry REPLACES the stale one (which may
+        # be wedged behind the dead party's collective and never become
+        # ready) — duplicates would make get() hand back the stale arrays
+        step = int(step)
+        self.entries = [e for e in self.entries if e[0] != step]
+        self.entries.append((step, x, ns))
+        self.entries.sort(key=lambda e: e[0])
+        while len(self.entries) > depth:
+            self.entries.pop(0)
+
+    @staticmethod
+    def _ready(x, ns) -> bool:
+        """Checkpoints are retained at DISPATCH time (the chain is
+        async); an entry only counts toward the resume census once its
+        buffers are actually computed — a step wedged behind a dead
+        party's collective must never be elected as the resume point
+        (materializing it would hang the resume barrier itself)."""
+        for arr in (x, ns):
+            fn = getattr(arr, "is_ready", None)
+            if callable(fn):
+                try:
+                    if not fn():
+                        return False
+                except Exception:  # noqa: BLE001 — runtime quirk: count it
+                    pass
+        return True
+
+    def watermark(self) -> int:
+        steps = self.steps()
+        return max(steps) if steps else 0
+
+    def steps(self):
+        return [s for s, x, n in self.entries if self._ready(x, n)]
+
+    def get(self, step: int):
+        for s, x, ns in self.entries:
+            if s == step:
+                return x, ns
+        return None
+
+
+# session id -> {own_index: ring}; insertion-ordered for eviction
+_checkpoints: Dict[str, Dict[int, _CheckpointRing]] = {}
+_checkpoints_lock = threading.Lock()
+
+
+def _checkpoint_ring(session_id, own_index, party_ids, entry_bytes):
+    """Get-or-create the ring for one party of one session (evicting the
+    oldest session past the cap — bounded device memory, not a leak).
+    Eviction prefers sessions with no LIVE registrant: a churning fleet
+    of short sessions must not silently strip a long-running session of
+    the very checkpoints its resume depends on.  (The live set is
+    snapshotted before taking the ring lock — no lock nesting.)"""
+    with _sessions_lock:
+        live = set(_sessions)
+    with _checkpoints_lock:
+        rings = _checkpoints.get(session_id)
+        if rings is None:
+            while len(_checkpoints) >= _MAX_CHECKPOINT_SESSIONS:
+                victim = next(
+                    (s for s in _checkpoints if s not in live),
+                    next(iter(_checkpoints)),  # all live: cap still wins
+                )
+                _checkpoints.pop(victim)
+            rings = _checkpoints.setdefault(session_id, {})
+        ring = rings.get(int(own_index))
+        if ring is None:
+            ring = _CheckpointRing(
+                session_id, own_index, party_ids, entry_bytes
+            )
+            rings[int(own_index)] = ring
+        return ring
+
+
+def _checkpoint_lookup(session_id, own_index):
+    with _checkpoints_lock:
+        return _checkpoints.get(session_id, {}).get(int(own_index))
+
+
+def checkpoint_watermarks(session_id: str) -> Dict[int, dict]:
+    """Every LOCAL party's checkpoint census for one session — what a
+    phase:"resume_query" answers: {party index: {"watermark": last
+    checkpointed step, "steps": retained steps}}."""
+    with _checkpoints_lock:
+        rings = list(_checkpoints.get(session_id, {}).values())
+    return {
+        r.own_index: {"watermark": r.watermark(), "steps": r.steps()}
+        for r in rings
+    }
+
+
+def release_checkpoints(session_id: str) -> bool:
+    """Drop every local ring of one session (the proposer broadcasts this
+    on clean completion; idempotent)."""
+    with _checkpoints_lock:
+        return _checkpoints.pop(session_id, None) is not None
+
+
+def checkpoint_bytes_retained() -> int:
+    """Device bytes pinned by checkpoint rings across every session —
+    the cost side of the checkpoint-depth tradeoff, scrapeable."""
+    with _checkpoints_lock:
+        return sum(
+            len(r.entries) * r.entry_bytes
+            for rings in _checkpoints.values()
+            for r in rings.values()
+        )
+
+
+checkpoint_bytes_gauge = PassiveStatus(
+    checkpoint_bytes_retained, name="mc_dispatch_checkpoint_bytes"
+)
+
+
+def _checkpoint_rows(
+    session_id: str, step: int, slots
+) -> Dict[int, Tuple[bytes, int]]:
+    """Materialize checkpointed rows for the requested party slots at one
+    step, from ANY local ring that addresses them — the reshard source a
+    replacement party is bootstrapped from.  Returns {slot: (full-width
+    row bytes, n)} for every slot this process can serve (possibly
+    empty).  This is the one host-blocking checkpoint operation, and it
+    only runs on the resume path."""
+    import jax  # noqa: F401 — device access below
+
+    want = [int(s) for s in slots]
+    with _checkpoints_lock:
+        rings = list(_checkpoints.get(session_id, {}).values())
+    out: Dict[int, Tuple[bytes, int]] = {}
+    for ring in rings:
+        entry = ring.get(int(step))
+        if entry is None:
+            continue
+        x, ns = entry
+        by_dev_row = {s.device: s for s in x.addressable_shards}
+        by_dev_n = {s.device: s for s in ns.addressable_shards}
+        for slot in want:
+            if slot in out or not (0 <= slot < len(ring.party_ids)):
+                continue
+            try:
+                dev = _devices_by_id([ring.party_ids[slot]])[0]
+            except ValueError:
+                continue
+            sh, sn = by_dev_row.get(dev), by_dev_n.get(dev)
+            if sh is None or sn is None:
+                continue
+            row = np.asarray(sh.data).reshape(-1).astype(np.uint8)
+            out[slot] = (
+                row.tobytes(),
+                int(np.asarray(sn.data).reshape(-1)[0]),
+            )
+    return out
+
+
+def checkpoint_fetch(session_id: str, step: int, slots) -> Dict[int, dict]:
+    """The wire form of :func:`_checkpoint_rows` (phase:"fetch_shard"):
+    {slot: {"row": b64 full-width row bytes, "n": int}}."""
+    return {
+        slot: {"row": base64.b64encode(row).decode(), "n": int(n)}
+        for slot, (row, n) in _checkpoint_rows(session_id, step, slots).items()
+    }
+
+
+def resume_point(watermarks: Dict[int, Optional[dict]]) -> int:
+    """The resume barrier's join: the last COMMON checkpointed step over
+    the survivors — ``min`` over their watermarks, the dual of the accept
+    phase's ``max`` join (a session can only resume from a step EVERY
+    survivor retained, just as it can only run a count every party
+    accepted).  ``watermarks[slot]`` is a resume_query answer ({"watermark",
+    "steps"}) or None for a survivor that answered nothing.  Any survivor
+    with no checkpoint drags the join to 0 — the full-restart fallback.
+    The min is validated against every retained set (rings are
+    cadence-uniform, but an evicted entry must not be resumed from): when
+    the min is not common, the join falls back to the deepest step ALL
+    survivors still retain, then to 0."""
+    if not watermarks:
+        return 0
+    infos = list(watermarks.values())
+    if any(not info or int(info.get("watermark", 0)) <= 0 for info in infos):
+        return 0
+    point = min(int(info["watermark"]) for info in infos)
+    sets = [frozenset(int(s) for s in info.get("steps", ())) for info in infos]
+    if all(point in s for s in sets):
+        return point
+    common = frozenset.intersection(*sets) if sets else frozenset()
+    return max((s for s in common if s <= point), default=0)
+
+
 # Between-step seam: chaos drills park parties here (deterministically
 # mid-session) and production leaves it None.  Called as fn(step_index)
-# before each lockstep step on every party running a registered session.
+# — or fn(step_index, own_index) when it accepts two arguments, so a
+# drill can target ONE party — before each lockstep step on every party
+# running a registered session.
 _step_hook: Optional[Callable] = None
 
 
 def set_step_hook(fn: Optional[Callable]) -> None:
     global _step_hook
+    if fn is not None:
+        import inspect
+
+        try:
+            nparams = len(inspect.signature(fn).parameters)
+        except (TypeError, ValueError):
+            nparams = 1
+        if nparams < 2:
+            inner = fn
+            fn = lambda step, idx, _f=inner: _f(step)  # noqa: E731
     _step_hook = fn
 
 
@@ -351,6 +683,12 @@ def run_dispatch_session(
     service: str = "?",
     method: str = "?",
     should_abort: Optional[Callable[[], Optional[str]]] = None,
+    session_id: Optional[str] = None,
+    resume_from: int = 0,
+    resume_state: Optional[Dict[int, Tuple[bytes, int]]] = None,
+    checkpoint_every: int = 0,
+    step_deadline_ms: float = 0.0,
+    session_epoch: int = 0,
 ) -> Tuple[np.ndarray, int, float]:
     """Run this party's side of a K-step session of ``dm``'s kernel;
     returns (own final row, own final n, elapsed seconds). Every party
@@ -364,7 +702,17 @@ def run_dispatch_session(
     device-resident across the chain: only the initial device_put and the
     final fetch cross the host boundary, and XLA pipelines the K
     dispatches (the ack/credit discipline is the response barrier the
-    proposer collects — no per-step coordination)."""
+    proposer collects — no per-step coordination).
+
+    Elastic extensions: with ``checkpoint_every`` > 0 (and a session id)
+    every C-th completed step's global arrays are retained in this
+    party's device-resident ring; ``resume_from`` = R restores step R's
+    state — from the local ring when retained, else from
+    ``resume_state`` ({slot: (full-width row bytes, n)}, the reshard a
+    replacement party is bootstrapped with) — and replays only steps
+    > R; ``step_deadline_ms`` arms a watchdog that aborts the session
+    fabric-wide when a SINGLE step (or the final fetch) stalls, instead
+    of waiting out the whole session deadline."""
     import jax
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -372,6 +720,8 @@ def run_dispatch_session(
     n = len(devices)
     if len(operands) != n:
         raise ValueError("one operand per party required")
+    if not (0 <= resume_from <= steps):
+        raise ValueError(f"resume_from {resume_from} outside 0..{steps}")
     mesh = Mesh(np.asarray(devices), ("par",))
     sharding = NamedSharding(mesh, P("par"))
     step_fn = _make_step(dm, mesh, sharding, party_ids)
@@ -383,58 +733,192 @@ def run_dispatch_session(
             f"party {own_index} device {own_dev} is not addressable from "
             f"this process"
         )
-    row_shards, n_shards = [], []
-    for i, dev in enumerate(devices):
-        if dev not in addressable:
-            continue
-        row, nn = dm.pack(operands[i])
-        row_shards.append(jax.device_put(row[None, :], dev))
-        n_shards.append(
-            jax.device_put(np.asarray([nn], dtype=np.int32), dev)
+    ring = None
+    if checkpoint_every and checkpoint_every > 0 and session_id:
+        n_addr = sum(1 for d in devices if d in addressable)
+        ring = _checkpoint_ring(
+            session_id, own_index, party_ids,
+            entry_bytes=n_addr * (dm.width + 4),
         )
+    restored = None
+    if resume_from > 0:
+        restored = _restore_state(
+            session_id, own_index, resume_from, devices, addressable,
+            dm, resume_state,
+        )
+        if restored is None:
+            raise LookupError(
+                f"no checkpoint for session {session_id} step "
+                f"{resume_from} reachable from party {own_index}"
+            )
+    row_shards, n_shards = [], []
+    if restored is None:
+        for i, dev in enumerate(devices):
+            if dev not in addressable:
+                continue
+            row, nn = dm.pack(operands[i])
+            row_shards.append(jax.device_put(row[None, :], dev))
+            n_shards.append(
+                jax.device_put(np.asarray([nn], dtype=np.int32), dev)
+            )
+    else:
+        row_shards, n_shards = restored
     x = jax.make_array_from_single_device_arrays(
         (n, dm.width), sharding, row_shards
     )
     ns = jax.make_array_from_single_device_arrays((n,), sharding, n_shards)
+
+    # the per-step watchdog: ``progress`` is (step index, last progress
+    # instant), advanced by the chain before every dispatch and before
+    # the final fetch; a stall past the step deadline aborts the session
+    # FABRIC-WIDE (abort_session → every local registrant's event + the
+    # proposer's watcher sees the ESESSION answers), so one wedged step
+    # costs the fabric a step deadline, not a session deadline.  The
+    # wedged party itself still finishes its blocking device call first
+    # — what the watchdog bounds is how long everyone ELSE waits.
+    # Dispatches are ASYNC (the host loop stamps per-step progress while
+    # XLA pipelines the compute), so the final fetch is where the whole
+    # replayed chain's device time is actually awaited: its allowance is
+    # one step deadline PER replayed step, not one — a healthy long
+    # session must not be aborted for merely computing.
+    wd_stop = None
+    progress = [resume_from, time.monotonic()]
+    if step_deadline_ms and step_deadline_ms > 0 and session_id:
+        wd_stop = threading.Event()
+        budget_s = step_deadline_ms / 1000.0
+        fetch_allow_s = budget_s * max(1, steps - resume_from)
+
+        def _watch_steps(sid=session_id, ep=session_epoch):
+            poll = min(0.01, budget_s / 4)
+            while not wd_stop.wait(poll):
+                allowed = budget_s if progress[0] < steps else fetch_allow_s
+                if time.monotonic() - progress[1] > allowed:
+                    what = (
+                        f"step {progress[0]}" if progress[0] < steps
+                        else "final fetch"
+                    )
+                    abort_session(
+                        sid,
+                        f"{what} exceeded the {step_deadline_ms:g}ms "
+                        "step deadline",
+                        epoch=ep,
+                    )
+                    return
+
+        threading.Thread(
+            target=_watch_steps, name="mc-step-watchdog", daemon=True
+        ).start()
     t0 = time.perf_counter()
-    for step_i in range(steps):
-        # fault plane: an aborted session exits the chain HERE, between
-        # dispatches, with a clean ESESSION — dispatches are async (XLA
-        # pipelines them), so the check costs nothing and the party never
-        # enters a barrier its dead peer cannot join.  A party already
-        # blocked INSIDE one collective finishes that step first (or hits
-        # the runtime's own collective timeout) — the between-step check
-        # plus every party's deadline watch is what bounds the hang.
+    try:
+        for step_i in range(resume_from, steps):
+            # fault plane: an aborted session exits the chain HERE,
+            # between dispatches, with a clean ESESSION — dispatches are
+            # async (XLA pipelines them), so the check costs nothing and
+            # the party never enters a barrier its dead peer cannot
+            # join.  A party already blocked INSIDE one collective
+            # finishes that step first (or hits the runtime's own
+            # collective timeout) — the between-step check, every
+            # party's deadline watch, and the per-step watchdog are what
+            # bound the hang.
+            if should_abort is not None:
+                why = should_abort()
+                if why:
+                    raise SessionAborted(why)
+            progress[0], progress[1] = step_i, time.monotonic()
+            hook = _step_hook
+            if hook is not None:
+                hook(step_i, own_index)  # chaos-drill seam
+            x, ns = step_fn(x, ns)  # chained: operands stay on-device
+            completed = step_i + 1
+            if ring is not None and completed % checkpoint_every == 0:
+                # retaining the global arrays IS the checkpoint: the
+                # buffers stay device-resident, no host sync happens
+                # here, and the ring caps how many stay alive
+                ring.put(
+                    completed, x, ns,
+                    int(get_flag("mc_dispatch_checkpoint_depth")),
+                )
         if should_abort is not None:
+            # last look before the blocking fetch: the final collect is
+            # the one host-blocking point of the chain
             why = should_abort()
             if why:
                 raise SessionAborted(why)
-        hook = _step_hook
-        if hook is not None:
-            hook(step_i)  # chaos-drill seam (None in production)
-        x, ns = step_fn(x, ns)  # chained: operands never leave the devices
-    if should_abort is not None:
-        # last look before the blocking fetch: the final collect is the
-        # one host-blocking point of the chain
-        why = should_abort()
-        if why:
-            raise SessionAborted(why)
-    own_row = own_n = None
-    for s in x.addressable_shards:
-        # a process can address several mesh devices (single-controller
-        # runs): OUR shard is the one on devices[own_index]
-        if s.device == own_dev:
-            own_row = np.asarray(s.data).reshape(-1)
-    for s in ns.addressable_shards:
-        if s.device == own_dev:
-            own_n = int(np.asarray(s.data).reshape(-1)[0])
+        progress[0], progress[1] = steps, time.monotonic()
+        own_row = own_n = None
+        for s in x.addressable_shards:
+            # a process can address several mesh devices (single-
+            # controller runs): OUR shard is the one on devices[own_index]
+            if s.device == own_dev:
+                own_row = np.asarray(s.data).reshape(-1)
+        for s in ns.addressable_shards:
+            if s.device == own_dev:
+                own_n = int(np.asarray(s.data).reshape(-1)[0])
+    finally:
+        if wd_stop is not None:
+            wd_stop.set()
     elapsed = time.perf_counter() - t0
     assert own_row is not None and own_n is not None
     dispatch_sessions << 1
-    dispatch_steps << steps
+    dispatch_steps << (steps - resume_from)
     dispatch_session_us << elapsed * 1e6
     _method_counter(service, method) << 1
     return own_row, own_n, elapsed
+
+
+def _restore_state(
+    session_id, own_index, step, devices, addressable, dm, resume_state
+):
+    """Rebuild this party's addressable shards of the session state at
+    one checkpointed step: the local ring's device-resident buffers when
+    retained (a survivor resuming in place — same devices, zero copies),
+    falling back per-slot to ``resume_state`` rows shipped over the rpc
+    plane (the replacement's bootstrap; also covers a survivor whose ring
+    lost the slot).  Returns (row_shards, n_shards) or None when any
+    addressable slot is unrecoverable."""
+    import jax
+
+    ring = _checkpoint_lookup(session_id, own_index) if session_id else None
+    entry = ring.get(int(step)) if ring is not None else None
+    by_dev_row, by_dev_n, old_pids = {}, {}, ()
+    if entry is not None:
+        old_x, old_ns = entry
+        by_dev_row = {s.device: s for s in old_x.addressable_shards}
+        by_dev_n = {s.device: s for s in old_ns.addressable_shards}
+        old_pids = ring.party_ids
+    state = resume_state or {}
+    row_shards, n_shards = [], []
+    for i, dev in enumerate(devices):
+        if dev not in addressable:
+            continue
+        src_dev = None
+        if i < len(old_pids):
+            src = [d for d in by_dev_row if d.id == old_pids[i]]
+            src_dev = src[0] if src else None
+        if src_dev is not None:
+            row_buf = by_dev_row[src_dev].data
+            n_buf = by_dev_n[src_dev].data
+            if src_dev != dev:
+                # a replaced slot restored from a survivor's ring: the
+                # retained buffer lives on the OLD device — move it
+                row_buf = jax.device_put(np.asarray(row_buf), dev)
+                n_buf = jax.device_put(np.asarray(n_buf), dev)
+            row_shards.append(row_buf)
+            n_shards.append(n_buf)
+            continue
+        if int(i) in state:
+            row_bytes, nn = state[int(i)]
+            try:
+                row, n32 = dm.pack_state(row_bytes, nn)
+            except ValueError:
+                return None  # wrong-geometry reshard: unrecoverable slot
+            row_shards.append(jax.device_put(row[None, :], dev))
+            n_shards.append(
+                jax.device_put(np.asarray([n32], dtype=np.int32), dev)
+            )
+            continue
+        return None
+    return row_shards, n_shards
 
 
 # -- rpcz spans (annotated with method identity) -------------------------------
@@ -449,6 +933,7 @@ def _start_session_span(
     steps: int,
     trace_id: int = 0,
     parent_span_id: int = 0,
+    resume_from: int = 0,
 ):
     from incubator_brpc_tpu.builtin.rpcz import (
         SPAN_TYPE_COLLECTIVE,
@@ -463,10 +948,15 @@ def _start_session_span(
         parent_span_id=parent_span_id,
     )
     if span is not None:
-        span.annotate(
+        note = (
             f"method={service}.{method} fingerprint={fingerprint} "
             f"steps={steps} index={own_index} parties={party_ids}"
         )
+        if resume_from > 0:
+            # a resumed chain: the span shows how much work the
+            # checkpoint saved (only steps > resume_from re-ran)
+            note += f" resume_from={resume_from}"
+        span.annotate(note)
     return span
 
 
@@ -552,10 +1042,44 @@ def make_dispatch_handler(server):
             # survivor must unwedge even when the rest of the proposal
             # state is unreachable or corrupt
             sid = str(req.get("session_id", ""))
+            try:
+                # epoch-scoped: a straggler abort from a superseded run
+                # must not kill the session's resumed run
+                abort_epoch = (
+                    int(req["epoch"]) if "epoch" in req else None
+                )
+            except (ValueError, TypeError):
+                abort_epoch = None
             found = bool(sid) and abort_session(
-                sid, str(req.get("reason", "")) or "aborted by proposer"
+                sid,
+                str(req.get("reason", "")) or "aborted by proposer",
+                epoch=abort_epoch,
             )
             return json.dumps({"aborted": found}).encode()
+        if req.get("phase") == "resume_query":
+            # the resume barrier's census: every LOCAL party's checkpoint
+            # watermark for this session — the proposer min-joins these
+            # over the survivors into the resume point
+            sid = str(req.get("session_id", ""))
+            wm = checkpoint_watermarks(sid) if sid else {}
+            return json.dumps(
+                {"watermarks": {str(k): v for k, v in wm.items()}}
+            ).encode()
+        if req.get("phase") == "fetch_shard":
+            # reshard: materialize checkpointed rows for the requested
+            # slots (the replacement party's bootstrap state)
+            sid = str(req.get("session_id", ""))
+            step = int(req.get("step", 0) or 0)
+            slots = [int(s) for s in req.get("slots", ())]
+            rows = checkpoint_fetch(sid, step, slots) if sid else {}
+            return json.dumps(
+                {"rows": {str(k): v for k, v in rows.items()}}
+            ).encode()
+        if req.get("phase") == "release":
+            sid = str(req.get("session_id", ""))
+            return json.dumps(
+                {"released": bool(sid) and release_checkpoints(sid)}
+            ).encode()
         party_ids, own_index, steps, dm, err = _validate_proposal(req)
         if err is not None:
             cntl.set_failed(*err)
@@ -609,8 +1133,51 @@ def make_dispatch_handler(server):
         # feedback, and the proposer's control socket dying can all
         # unwedge this party mid-chain with a clean ESESSION
         session_id = str(req.get("session_id", "")) or None
+        # elastic plane: the proposer stamps the checkpoint cadence and
+        # step deadline into the run proposal (cadence MUST be uniform
+        # across parties or the min-join loses its "last common step"
+        # meaning); absent fields fall back to this party's own flags
+        try:
+            run_epoch = int(req.get("epoch", 0) or 0)
+            resume_from = int(req.get("resume_from", 0) or 0)
+            if "checkpoint_every" in req:
+                checkpoint_every = int(req["checkpoint_every"] or 0)
+            else:
+                checkpoint_every = int(get_flag("mc_dispatch_checkpoint_every"))
+            if "step_deadline_ms" in req:
+                step_deadline_ms = float(req["step_deadline_ms"] or 0)
+            else:
+                step_deadline_ms = float(
+                    get_flag("mc_dispatch_step_deadline_ms")
+                )
+            resume_state = {
+                int(k): (base64.b64decode(v["row"]), int(v["n"]))
+                for k, v in (req.get("resume_state") or {}).items()
+            }
+            if not (0 <= resume_from <= steps):
+                raise ValueError(f"resume_from {resume_from} out of bounds")
+            if resume_from > 0 and session_id is None:
+                raise ValueError("resume_from requires a session_id")
+        except (ValueError, TypeError, KeyError) as e:
+            from incubator_brpc_tpu.utils.status import ErrorCode
+
+            dispatch_rejects << 1
+            cntl.set_failed(ErrorCode.EREQUEST, f"bad resume fields: {e}")
+            return b""
         st = None
         sock_hook = None
+        if session_id is not None and run_epoch <= aborted_epoch(session_id):
+            # the abort for this epoch already passed through here: a
+            # stale (reordered or retried) run proposal must not start a
+            # zombie chain no peer will ever join
+            from incubator_brpc_tpu.utils.status import ErrorCode
+
+            cntl.set_failed(
+                ErrorCode.ESESSION,
+                f"session aborted: run epoch {run_epoch} already "
+                "tombstoned on this party",
+            )
+            return b""
         if session_id is not None:
             deadline_ms = float(req.get("deadline_ms", 0) or 0)
             if deadline_ms <= 0:
@@ -620,15 +1187,19 @@ def make_dispatch_handler(server):
                 else 0.0
             )
             st = _register_session(
-                session_id, party_ids, deadline, owner=server
+                session_id, party_ids, deadline, owner=server,
+                epoch=run_epoch,
             )
             sock = getattr(cntl, "_sock", None)
             hooks = getattr(sock, "on_failed", None)
             if hooks is not None:
                 # the proposer died with us mid-chain: its control
                 # connection failing IS the death signal (socket feedback)
-                def _proposer_died(_s, _sid=session_id):
-                    abort_session(_sid, "proposer connection died mid-session")
+                def _proposer_died(_s, _sid=session_id, _ep=run_epoch):
+                    abort_session(
+                        _sid, "proposer connection died mid-session",
+                        epoch=_ep,
+                    )
 
                 hooks.append(_proposer_died)
                 sock_hook = (hooks, _proposer_died)
@@ -639,24 +1210,43 @@ def make_dispatch_handler(server):
             if st.abort_event.is_set():
                 return st.abort_reason or "session aborted"
             if st.deadline and time.monotonic() > st.deadline:
-                abort_session(st.session_id, "session deadline exceeded")
+                abort_session(
+                    st.session_id, "session deadline exceeded",
+                    epoch=st.epoch,
+                )
                 return "session deadline exceeded"
             return None
 
         span = _start_session_span(
             service, method, dm.fingerprint(), party_ids, own_index, steps,
             trace_id=cntl.trace_id, parent_span_id=cntl.span_id,
+            resume_from=resume_from,
         )
         try:
             own_row, own_n, elapsed = run_dispatch_session(
                 party_ids, own_index, dm, operands, steps,
                 service=service, method=method, should_abort=_should_abort,
+                session_id=session_id, resume_from=resume_from,
+                resume_state=resume_state,
+                checkpoint_every=checkpoint_every,
+                step_deadline_ms=step_deadline_ms,
+                session_epoch=run_epoch,
             )
         except SessionAborted as e:
             from incubator_brpc_tpu.utils.status import ErrorCode
 
             _end_session_span(span, error_code=ErrorCode.ESESSION)
             cntl.set_failed(ErrorCode.ESESSION, f"session aborted: {e.reason}")
+            return b""
+        except LookupError as e:
+            # a resume proposal for a step this party no longer retains
+            # (evicted ring, wrong process): a clean control-stream
+            # reject — the proposer falls back to a full restart
+            from incubator_brpc_tpu.utils.status import ErrorCode
+
+            dispatch_rejects << 1
+            _end_session_span(span, error_code=ErrorCode.EREQUEST)
+            cntl.set_failed(ErrorCode.EREQUEST, f"cannot resume: {e}")
             return b""
         except Exception as e:
             dispatch_errors << 1
@@ -681,6 +1271,7 @@ def make_dispatch_handler(server):
                     dm.unpack(own_row, own_n)
                 ).decode(),
                 "steps": steps,
+                "resumed_from": resume_from,
                 "elapsed_s": elapsed,
                 "index": own_index,
             }
@@ -702,6 +1293,13 @@ def propose_dispatch(
     proposer_index: Optional[int] = None,
     timeout_ms: float = 120000,
     session_deadline_ms: Optional[float] = None,
+    session_id: Optional[str] = None,
+    resume_from: int = 0,
+    resume_state: Optional[Dict[int, Tuple[bytes, int]]] = None,
+    resume_state_slots=None,
+    checkpoint_every: Optional[int] = None,
+    step_deadline_ms: Optional[float] = None,
+    epoch: int = 0,
 ) -> dict:
     """Schedule an N-party session of a registered device method.
 
@@ -737,9 +1335,6 @@ def propose_dispatch(
     """
     import threading as _threading
 
-    from incubator_brpc_tpu.rpc.controller import Controller
-    from incubator_brpc_tpu.transport.device_link import HANDSHAKE_SERVICE
-
     n = len(party_ids)
     remote_indexes = [i for i in range(n) if i != proposer_index]
     if len(remote_indexes) != len(channels):
@@ -762,16 +1357,32 @@ def propose_dispatch(
     # session identity + deadline: what the fault plane keys on.  Every
     # party gets the SAME budget, measured from its own clock at proposal
     # arrival — a partitioned party that never hears the abort broadcast
-    # still unwedges at its own deadline.
+    # still unwedges at its own deadline.  A caller-supplied session_id
+    # is a RESUME of that session: the parties' checkpoint rings are
+    # keyed on it.
     import uuid
 
-    session_id = uuid.uuid4().hex
+    if session_id is None:
+        session_id = uuid.uuid4().hex
     sess_ms = (
         float(session_deadline_ms)
         if session_deadline_ms and session_deadline_ms > 0
         else float(get_flag("mc_dispatch_session_deadline_ms"))
         or float(timeout_ms)
     )
+    ckpt_every = (
+        int(checkpoint_every)
+        if checkpoint_every is not None
+        else int(get_flag("mc_dispatch_checkpoint_every"))
+    )
+    step_ms = (
+        float(step_deadline_ms)
+        if step_deadline_ms is not None
+        else float(get_flag("mc_dispatch_step_deadline_ms"))
+    )
+    resume_from = int(resume_from or 0)
+    if not (0 <= resume_from <= steps):
+        raise ValueError(f"resume_from {resume_from} outside 0..{steps}")
 
     def proposal(idx: int, nsteps: int, phase: str = "") -> bytes:
         d = {
@@ -794,20 +1405,35 @@ def propose_dispatch(
             ]
             d["session_id"] = session_id
             d["deadline_ms"] = sess_ms
+            d["epoch"] = int(epoch)
+            # elastic plane: the proposer owns the cadence (uniform
+            # across parties — the min-join's "last common step" depends
+            # on it) and the step watchdog; a resumed run names the
+            # agreed restore point plus bootstrap rows for parties
+            # without a ring (the replacement)
+            d["checkpoint_every"] = ckpt_every
+            d["step_deadline_ms"] = step_ms
+            if resume_from > 0:
+                d["resume_from"] = resume_from
+                # bootstrap rows ride only to the parties that need them
+                # (resume_state_slots — the replacements; survivors
+                # restore from their own rings): shipping the full state
+                # to every party would be N^2 x width control bytes
+                if resume_state and (
+                    resume_state_slots is None or idx in resume_state_slots
+                ):
+                    d["resume_state"] = {
+                        str(i): {
+                            "row": base64.b64encode(bytes(row)).decode(),
+                            "n": int(nn),
+                        }
+                        for i, (row, nn) in resume_state.items()
+                    }
         return json.dumps(d).encode()
 
     def _call(ch, payload):
-        cntl = Controller(timeout_ms=timeout_ms)
-        cntl._force_host = True  # scheduling rides the host plane
-        ev = _threading.Event()
-        ch.call_method(
-            HANDSHAKE_SERVICE,
-            DISPATCH_METHOD,
-            payload,
-            cntl=cntl,
-            done=lambda c, _ev=ev: _ev.set(),
-        )
-        return cntl, ev
+        # scheduling rides the host plane — the shared control-call shape
+        return _control_call(ch, payload, timeout_ms)
 
     # Phase 1 — accept barrier + the monotone-max step-count join
     accepts = [
@@ -846,7 +1472,9 @@ def propose_dispatch(
         }
     )
     session_deadline = time.monotonic() + sess_ms / 1000.0
-    st = _register_session(session_id, party_ids, session_deadline)
+    st = _register_session(
+        session_id, party_ids, session_deadline, epoch=epoch
+    )
     outcome = {"dead": [], "rejects": [], "reason": ""}
     watch_stop = _threading.Event()
 
@@ -854,7 +1482,12 @@ def propose_dispatch(
         """phase:"abort" to every party not already known dead (async,
         best-effort — each party's own deadline is the backstop)."""
         msg = json.dumps(
-            {"phase": "abort", "session_id": session_id, "reason": reason}
+            {
+                "phase": "abort",
+                "session_id": session_id,
+                "reason": reason,
+                "epoch": int(epoch),
+            }
         ).encode()
         for ch, idx in zip(channels, remote_indexes):
             if idx in skip:
@@ -874,7 +1507,7 @@ def propose_dispatch(
             # outcome but the survivors were already told
             broadcast_done[0] = True
             _broadcast_abort(reason, set(outcome["dead"]))
-        abort_session(session_id, reason)
+        abort_session(session_id, reason, epoch=epoch)
 
     def _watch() -> None:
         # the generalized rejection watch (supersedes the old fixed-50 ms
@@ -943,19 +1576,25 @@ def propose_dispatch(
                 if st.abort_event.is_set():
                     return st.abort_reason or "session aborted"
                 if time.monotonic() > session_deadline:
-                    abort_session(session_id, "session deadline exceeded")
+                    abort_session(
+                        session_id, "session deadline exceeded", epoch=epoch
+                    )
                     return "session deadline exceeded"
                 return None
 
             span = _start_session_span(
                 service, method, fingerprint, party_ids, proposer_index,
-                final,
+                final, resume_from=resume_from,
             )
             try:
                 own_row, own_n, own_elapsed = run_dispatch_session(
                     party_ids, proposer_index, dm, operands,
                     final, service=service, method=method,
                     should_abort=_own_should_abort,
+                    session_id=session_id, resume_from=resume_from,
+                    resume_state=resume_state,
+                    checkpoint_every=ckpt_every, step_deadline_ms=step_ms,
+                    session_epoch=epoch,
                 )
             except SessionAborted as e:
                 _end_session_span(span, error_code=ErrorCode.ESESSION)
@@ -988,6 +1627,8 @@ def propose_dispatch(
                 dead_indexes=dead,
                 survivor_indexes=survivors,
                 rejects=outcome["rejects"],
+                session_id=session_id,
+                final_steps=final,
             )
         for (cntl, ev), idx in zip(pending, remote_indexes):
             if cntl.failed():  # defensive: the watcher classifies these
@@ -1005,10 +1646,146 @@ def propose_dispatch(
                     f"agreed final was {final} — close did not converge"
                 )
             results[idx] = base64.b64decode(resp["result"])
+        # clean completion: nothing left to resume — release every
+        # party's checkpoint ring (best-effort broadcast; the eviction
+        # cap is the backstop for a proposer that dies before this)
+        if ckpt_every > 0:
+            release_checkpoints(session_id)
+            msg = json.dumps(
+                {"phase": "release", "session_id": session_id}
+            ).encode()
+            for ch in channels:
+                try:
+                    _call(ch, msg)
+                except Exception:
+                    logger.exception("checkpoint release broadcast failed")
     finally:
         watch_stop.set()
         _unregister_session(st)
-    return {"results": results, "final_steps": final, "elapsed_s": own_elapsed}
+    return {
+        "results": results,
+        "final_steps": final,
+        "elapsed_s": own_elapsed,
+        "session_id": session_id,
+        "resumed_from": resume_from if resume_from > 0 else None,
+    }
+
+
+def _control_call(ch, payload: bytes, timeout_ms: float):
+    """One control-stream RPC (resume barrier traffic rides the same
+    host-plane method the proposals do)."""
+    import threading as _threading
+
+    from incubator_brpc_tpu.rpc.controller import Controller
+    from incubator_brpc_tpu.transport.device_link import HANDSHAKE_SERVICE
+
+    cntl = Controller(timeout_ms=timeout_ms)
+    cntl._force_host = True
+    ev = _threading.Event()
+    ch.call_method(
+        HANDSHAKE_SERVICE, DISPATCH_METHOD, payload, cntl=cntl,
+        done=lambda c, _ev=ev: _ev.set(),
+    )
+    return cntl, ev
+
+
+def _query_watermarks(
+    session_id: str, survivor_pairs, timeout_ms: float
+) -> Dict[int, dict]:
+    """The resume barrier's gather half: ask every surviving remote party
+    for its checkpoint census (phase:"resume_query"), merge with the
+    proposer-local census (a participating proposer — and, in-process,
+    co-hosted parties — answer from the same registry).  A survivor that
+    fails the query contributes nothing, which drags the min-join to 0 —
+    the safe side."""
+    msg = json.dumps(
+        {"phase": "resume_query", "session_id": session_id}
+    ).encode()
+    calls = []
+    for ch, idx in survivor_pairs:
+        try:
+            calls.append(_control_call(ch, msg, timeout_ms))
+        except Exception:
+            logger.exception("resume query to party %d failed", idx)
+    merged: Dict[int, dict] = dict(checkpoint_watermarks(session_id))
+    deadline = time.monotonic() + timeout_ms / 1000.0
+    for cntl, ev in calls:
+        if not ev.wait(max(0.0, deadline - time.monotonic())):
+            continue
+        if cntl.failed():
+            continue
+        try:
+            ans = json.loads(cntl.response_payload.decode())
+            for k, info in (ans.get("watermarks") or {}).items():
+                slot = int(k)
+                have = merged.get(slot)
+                if have is None or int(info.get("watermark", 0)) > int(
+                    have.get("watermark", 0)
+                ):
+                    merged[slot] = info
+        except (ValueError, TypeError, AttributeError):
+            continue
+    return merged
+
+
+def _fetch_state(
+    session_id: str,
+    step: int,
+    slots: List[int],
+    channels,
+    timeout_ms: float,
+    required=None,
+) -> Optional[Dict[int, Tuple[bytes, int]]]:
+    """Reshard: assemble session state at one checkpointed step from the
+    survivors' rings (local first, then phase:"fetch_shard" over the rpc
+    plane) — what bootstraps a replacement party.  Returns
+    {slot: (full-width row bytes, n)} covering whatever was reachable,
+    or None when a REQUIRED slot (default: all of ``slots``) is
+    unrecoverable — the caller then falls back to a full restart.  On a
+    true multi-controller fabric each survivor serves only its own slot,
+    so asking for every slot with ``required`` = the replaced ones gets
+    the replacement everything reachable without failing the resume on
+    rows nobody can provide."""
+    # local rings first, raw (no b64 round trip for rows already here)
+    state: Dict[int, Tuple[bytes, int]] = dict(
+        _checkpoint_rows(session_id, step, slots)
+    )
+
+    def _absorb(rows: Dict) -> None:
+        for k, v in rows.items():
+            slot = int(k)
+            if slot not in state:
+                state[slot] = (base64.b64decode(v["row"]), int(v["n"]))
+
+    for ch in channels:
+        missing = [s for s in slots if s not in state]
+        if not missing:
+            break
+        msg = json.dumps(
+            {
+                "phase": "fetch_shard",
+                "session_id": session_id,
+                "step": int(step),
+                "slots": missing,
+            }
+        ).encode()
+        try:
+            cntl, ev = _control_call(ch, msg, timeout_ms)
+        except Exception:
+            logger.exception("shard fetch failed")
+            continue
+        if not ev.wait(timeout_ms / 1000.0) or cntl.failed():
+            continue
+        try:
+            _absorb(
+                json.loads(cntl.response_payload.decode()).get("rows") or {}
+            )
+        except (ValueError, TypeError, KeyError, AttributeError):
+            continue
+    need = slots if required is None else required
+    if any(s not in state for s in need):
+        return None
+    return state
 
 
 def propose_with_recovery(
@@ -1022,27 +1799,69 @@ def propose_with_recovery(
     timeout_ms: float = 120000,
     session_deadline_ms: Optional[float] = None,
     max_reproposals: int = 1,
+    spares=None,
+    checkpoint_every: Optional[int] = None,
+    step_deadline_ms: Optional[float] = None,
 ) -> dict:
-    """:func:`propose_dispatch` with the re-propose path: a session that
-    aborts on PARTY DEATH is re-proposed over the surviving party set (up
-    to ``max_reproposals`` times).  Rejects and proposer death are not
-    recoverable this way and re-raise.  The result dict gains
-    ``dead_party_ids`` (global device ids dropped along the way, [] on a
-    clean first run)."""
+    """:func:`propose_dispatch` with the elastic recovery path: a session
+    that aborts on PARTY DEATH heals instead of restarting from nothing
+    (up to ``max_reproposals`` times).  Two recovery modes, tried in
+    order:
+
+    1. **Resume with replacement** — when ``spares`` (a list of
+       ``(channel, device_id)`` standby parties) can fill every dead
+       slot: the resume barrier min-joins the survivors' checkpoint
+       watermarks into the last COMMON checkpointed step, the dead
+       party's state is re-sharded out of the survivors' rings over the
+       rpc plane, and the SAME session (same id, same party-set width,
+       same agreed step count) re-runs only the steps past the resume
+       point — byte-identical to an undisturbed run.  Zero common
+       checkpoint falls back to a full restart, still over the healed
+       party set.
+    2. **Shrink restart** — no spare: the PR-8 path, a fresh session
+       from step 0 over the survivors only (an axis-reducing kernel
+       cannot RESUME with fewer parties — re-running checkpointed-past
+       steps with a divergent party set is exactly what the fabricverify
+       resume model forbids).
+
+    Rejects and proposer death are not recoverable and re-raise.  The
+    result dict gains ``dead_party_ids``, ``replaced_party_ids`` and
+    ``resumed_from`` (None unless the winning run was a resume)."""
     chs = list(channels)
-    pids = list(party_ids)
+    pids = [int(p) for p in party_ids]
     ops = list(operands)
     pidx = proposer_index
     dropped: List[int] = []
+    replaced: List[int] = []
+    spare_pool = list(spares or ())
+    import uuid
+
+    session_id = uuid.uuid4().hex
+    run_steps = steps
+    resume_from = 0
+    resume_state: Optional[Dict[int, Tuple[bytes, int]]] = None
+    resumed = False
     for attempt in range(max_reproposals + 1):
         remote = [i for i in range(len(pids)) if i != pidx]
         try:
             out = propose_dispatch(
-                chs, pids, service, method, ops, steps=steps,
+                chs, pids, service, method, ops, steps=run_steps,
                 proposer_index=pidx, timeout_ms=timeout_ms,
                 session_deadline_ms=session_deadline_ms,
+                session_id=session_id, resume_from=resume_from,
+                resume_state=resume_state,
+                resume_state_slots=frozenset(
+                    i for i in range(len(pids))
+                    if pids[i] in set(replaced)
+                ) or None,
+                checkpoint_every=checkpoint_every,
+                step_deadline_ms=step_deadline_ms,
+                epoch=attempt,
             )
             out["dead_party_ids"] = dropped
+            out["replaced_party_ids"] = replaced
+            if resumed:
+                dispatch_resumes << 1
             return out
         except SessionAborted as e:
             dead = set(e.dead_indexes)
@@ -1051,22 +1870,94 @@ def propose_with_recovery(
                 or not dead
                 or e.rejects
                 or (pidx is not None and pidx in dead)
-                or len(pids) - len(dead) < 2
             ):
                 raise
-            dropped.extend(pids[i] for i in sorted(dead))
-            logger.warning(
-                "re-proposing %s.%s over %d survivor(s) after: %s",
-                service, method, len(pids) - len(dead), e.reason,
-            )
-            keep = [i for i in range(len(pids)) if i not in dead]
-            chs = [
-                ch for ch, idx in zip(chs, remote) if idx not in dead
-            ]
-            ops = [ops[i] for i in keep]
-            pids = [pids[i] for i in keep]
-            if pidx is not None:
-                pidx = keep.index(pidx)
+            have_spares = len(spare_pool) >= len(dead)
+            if not have_spares and len(pids) - len(dead) < 2:
+                # a shrink below 2 parties is no session; replacement
+                # does not shrink, so the width guard only gates mode 2
+                raise
+            run_steps = max(run_steps, e.final_steps or 0)
+            if have_spares:
+                # elastic heal: replacement + resume (mode 1)
+                dropped.extend(pids[i] for i in sorted(dead))
+                survivor_slots = [
+                    i for i in range(len(pids)) if i not in dead
+                ]
+                surv_pairs = [
+                    (ch, idx)
+                    for ch, idx in zip(chs, remote)
+                    if idx not in dead
+                ]
+                wms = _query_watermarks(session_id, surv_pairs, timeout_ms)
+                point = resume_point(
+                    {i: wms.get(i) for i in survivor_slots}
+                )
+                for slot in sorted(dead):
+                    sch, sdev = spare_pool.pop(0)
+                    replaced.append(int(sdev))
+                    pids[slot] = int(sdev)
+                    chs[remote.index(slot)] = sch
+                state = None
+                if point > 0:
+                    # reshard for the REPLACEMENTS: gather every slot
+                    # reachable at the resume point (a single-controller
+                    # replacement addresses all slots; a true
+                    # multi-controller one only its own), but REQUIRE
+                    # only the replaced slots — survivors restore their
+                    # slots from their own rings, and the bootstrap rows
+                    # ride only to the replacement parties
+                    # (resume_state_slots below).  A dead slot no
+                    # reachable ring covers (a true mc fabric, where the
+                    # dead party's ring died with it) forces the
+                    # full-restart fallback — still over the healed set.
+                    state = _fetch_state(
+                        session_id, point, list(range(len(pids))),
+                        [ch for ch, _i in surv_pairs], timeout_ms,
+                        required=sorted(dead),
+                    )
+                    if state is None:
+                        point = 0  # reshard incomplete: full restart
+                resume_from = point
+                resume_state = state
+                resumed = True
+                dispatch_replaced_parties << len(dead)
+                logger.warning(
+                    "resuming %s.%s session %s from step %d with %d "
+                    "replacement(s) after: %s",
+                    service, method, session_id, point, len(dead),
+                    e.reason,
+                )
+            else:
+                # shrink restart (mode 2): new session over the
+                # survivors; the old session's rings are released
+                # best-effort (the eviction cap is the backstop)
+                dropped.extend(pids[i] for i in sorted(dead))
+                logger.warning(
+                    "re-proposing %s.%s over %d survivor(s) after: %s",
+                    service, method, len(pids) - len(dead), e.reason,
+                )
+                release_checkpoints(session_id)
+                rel = json.dumps(
+                    {"phase": "release", "session_id": session_id}
+                ).encode()
+                keep = [i for i in range(len(pids)) if i not in dead]
+                chs = [
+                    ch for ch, idx in zip(chs, remote) if idx not in dead
+                ]
+                for ch in chs:
+                    try:
+                        _control_call(ch, rel, timeout_ms)
+                    except Exception:
+                        logger.exception("checkpoint release failed")
+                ops = [ops[i] for i in keep]
+                pids = [pids[i] for i in keep]
+                if pidx is not None:
+                    pidx = keep.index(pidx)
+                session_id = uuid.uuid4().hex
+                resume_from = 0
+                resume_state = None
+                resumed = False
     raise AssertionError("unreachable")
 
 
@@ -1089,10 +1980,18 @@ def lower_parallel_call(
     byte-identical), each party's operand is its sub-request, the
     proposer is a pure scheduler (its process cannot address any party
     device), and one 1-step session replaces the host fan-out. Returns
-    per-sub response bytes in channel order."""
+    per-sub response bytes in channel order.
+
+    Resume is transparent here: the call routes through
+    :func:`propose_with_recovery`, so a multi-step lowering (or a future
+    combo batching several steps into one session) heals the same way a
+    direct session does.  A 1-step session has no checkpointed past and
+    no spare pool, so an abort still surfaces as :class:`SessionAborted`
+    and the combo layer falls back to the host fan-out — unchanged
+    semantics, one recovery plane."""
     if not timeout_ms or timeout_ms <= 0:
         timeout_ms = 120000.0
-    out = propose_dispatch(
+    out = propose_with_recovery(
         channels,
         [d.id for d in devices],
         service,
@@ -1101,6 +2000,7 @@ def lower_parallel_call(
         steps=1,
         proposer_index=None,
         timeout_ms=timeout_ms,
+        max_reproposals=0,
     )
     mc_lowered_dispatches << 1
     return out["results"]

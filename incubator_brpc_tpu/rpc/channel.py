@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import weakref
 from typing import Callable, Optional, Union
 
 from incubator_brpc_tpu import protocol as proto_pkg
@@ -34,7 +35,9 @@ from incubator_brpc_tpu.runtime.timer_thread import global_timer_thread
 from incubator_brpc_tpu.runtime.worker_pool import global_worker_pool
 from incubator_brpc_tpu.transport.messenger import InputMessenger
 from incubator_brpc_tpu.transport.socket_map import SocketMap
+from incubator_brpc_tpu.bvar import Adder, PassiveStatus
 from incubator_brpc_tpu.utils.endpoint import EndPoint, str2endpoint
+from incubator_brpc_tpu.utils.flags import define_flag, get_flag
 from incubator_brpc_tpu.utils.status import ErrorCode, berror
 
 logger = logging.getLogger(__name__)
@@ -163,6 +166,88 @@ def _process_response_blocking(sock, frame: ParsedFrame) -> None:
 proto_pkg.TBUS_STD.process_response = process_response
 
 
+# -- retry budget --------------------------------------------------------------
+#
+# The SRE retry-budget discipline: retries are only safe while they are a
+# small fraction of traffic — once a backend browns out, per-call retry
+# caps (max_retry) still multiply offered load by (1 + max_retry), and
+# the retry storm finishes the backend off.  Every Channel therefore owns
+# a token bucket: each issued call deposits ``retry_budget_ratio``
+# tokens, each retry withdraws one, and an empty bucket makes the call
+# FAIL FAST with the original error instead of retrying.  Steady-state
+# retry volume is thus capped at ~ratio of call volume, while the bucket
+# cap still absorbs short error bursts at full retry fidelity.
+
+define_flag(
+    "retry_budget_ratio",
+    0.1,
+    "per-channel retry budget (SRE-style): each issued call deposits "
+    "this many retry tokens and each retry attempt withdraws one, so "
+    "sustained retry volume is capped at this fraction of call volume; "
+    "an exhausted budget fails the call fast with the original error "
+    "instead of amplifying a brownout into a retry storm; 0 disables",
+    lambda v: 0 <= v <= 1,
+)
+
+# burst allowance: a full bucket funds this many back-to-back retries
+# before the ratio gates (and is also the bucket's starting balance, so
+# young channels are not penalized for their first errors)
+_RETRY_BUDGET_CAP = 50.0
+
+# codes that never draw from the budget: deliberate, non-amplifying
+# control signals — a propagated deadline died (EDEADLINE), a collective
+# session aborted cooperatively (ESESSION), admission control shed the
+# request (ELIMIT).  None of them is in the default RETRIABLE set, but a
+# custom retry_policy may retry them, and that decision must not burn
+# budget meant for connectivity failures.
+RETRY_BUDGET_EXEMPT = frozenset(
+    {ErrorCode.EDEADLINE, ErrorCode.ESESSION, ErrorCode.ELIMIT}
+)
+
+retry_budget_exhausted = Adder(name="retry_budget_exhausted")
+_live_budgets = weakref.WeakSet()
+# aggregate balance across live channels — budget state in /vars (the
+# per-channel value is intentionally not a bvar: channels are many and
+# short-lived; the aggregate plus the exhaustion counter is the signal)
+retry_budget_tokens = PassiveStatus(
+    lambda: round(sum(b.balance() for b in list(_live_budgets)), 2),
+    name="retry_budget_tokens",
+)
+
+
+class RetryBudget:
+    """Token-bucket retry budget for one channel (see module note)."""
+
+    def __init__(self, ratio: float):
+        self._ratio = float(ratio)
+        self._tokens = _RETRY_BUDGET_CAP
+        self._lock = threading.Lock()
+        if self._ratio > 0:
+            _live_budgets.add(self)
+
+    def on_call(self) -> None:
+        """One issued call funds ``ratio`` of a future retry."""
+        if self._ratio <= 0:
+            return
+        with self._lock:
+            self._tokens = min(_RETRY_BUDGET_CAP, self._tokens + self._ratio)
+
+    def acquire(self, code: int) -> bool:
+        """May one retry for this error run? Exempt codes never draw."""
+        if self._ratio <= 0 or code in RETRY_BUDGET_EXEMPT:
+            return True
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+        retry_budget_exhausted << 1
+        return False
+
+    def balance(self) -> float:
+        with self._lock:
+            return self._tokens
+
+
 class ChannelOptions:
     def __init__(
         self,
@@ -251,6 +336,7 @@ class Channel:
         self._lb = None  # LoadBalancerWithNaming (lb/__init__.py), task #5
         self._socket_map = _client_socket_map
         self._init_done = False
+        self._retry_budget: Optional[RetryBudget] = None
         self._device_sock = None  # transport="tpu": last-used link (the
         # links themselves live in the process-wide DeviceLinkMap)
         self._native_ch = None  # NativeClientChannel (lazy; native_plane)
@@ -284,6 +370,7 @@ class Channel:
                 return False
         else:
             self._single_server = str2endpoint(str(target))
+        self._retry_budget = RetryBudget(float(get_flag("retry_budget_ratio")))
         self._init_done = True
         return True
 
@@ -297,6 +384,7 @@ class Channel:
         if not lb.start():
             return False
         self._lb = lb
+        self._retry_budget = RetryBudget(float(get_flag("retry_budget_ratio")))
         self._init_done = True
         return True
 
@@ -315,6 +403,8 @@ class Channel:
         """The CallMethod entry (channel.cpp:285). Synchronous when ``done``
         is None (joins the call id); asynchronous otherwise."""
         assert self._init_done, "Channel.init() not called"
+        if self._retry_budget is not None:
+            self._retry_budget.on_call()
         if cntl is None:
             cntl = Controller(
                 timeout_ms=self._options.timeout_ms,
@@ -1048,14 +1138,24 @@ class Channel:
                 self._issue_rpc(cntl)
             return
         if self._should_retry(cntl, code) and cntl.retried_count < cntl.max_retry:
-            cntl.retried_count += 1
-            if cntl._sent_sockets:
-                cntl._excluded_sockets.add(cntl._sent_sockets[-1].id)
-            cntl._reset_for_retry()
-            self._issue_rpc(cntl)
-            return
+            if self._budget_allows(code):
+                cntl.retried_count += 1
+                if cntl._sent_sockets:
+                    cntl._excluded_sockets.add(cntl._sent_sockets[-1].id)
+                cntl._reset_for_retry()
+                self._issue_rpc(cntl)
+                return
+            # budget exhausted: fail fast with the ORIGINAL error — the
+            # whole point is NOT multiplying a brownout's offered load
+            text = f"{text} (retry budget exhausted)"
         cntl.set_failed(code, text)
         self._end_rpc(cntl)
+
+    def _budget_allows(self, code: int) -> bool:
+        """One retry's draw against this channel's retry budget (exempt
+        codes pass without drawing; no budget = unlimited)."""
+        b = self._retry_budget
+        return b is None or b.acquire(code)
 
     def _should_retry(self, cntl: Controller, code: int) -> bool:
         """RetryPolicy::DoRetry (retry_policy.h): the channel's custom
@@ -1079,11 +1179,23 @@ class Channel:
 
     def _on_rpc_returned(self, cntl: Controller, frame: ParsedFrame, sock) -> None:
         """Response arrived (id locked by process_response)."""
+        budget_note = ""
         if frame.error_code != 0 and self._should_retry(
             cntl, frame.error_code
         ) and (
             cntl.retried_count < cntl.max_retry
         ):
+            if not self._budget_allows(frame.error_code):
+                # same marker as the _arbitrate_error seam: a triager
+                # must be able to tell budget-capped failures apart on
+                # BOTH response paths
+                budget_note = " (retry budget exhausted)"
+                frame_error_retry = False
+            else:
+                frame_error_retry = True
+        else:
+            frame_error_retry = False
+        if frame_error_retry:
             cntl.retried_count += 1
             cntl._excluded_sockets.add(sock.id)
             from incubator_brpc_tpu.transport.event_dispatcher import (
@@ -1106,8 +1218,11 @@ class Channel:
         if frame.error_code != 0:
             cntl.set_failed(
                 frame.error_code,
-                (frame.meta.error_text if frame.meta else "")
-                or f"remote error {frame.error_code}",
+                (
+                    (frame.meta.error_text if frame.meta else "")
+                    or f"remote error {frame.error_code}"
+                )
+                + budget_note,
             )
         else:
             payload = frame.payload

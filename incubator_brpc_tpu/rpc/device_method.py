@@ -105,6 +105,21 @@ class DeviceMethod:
         n = int(n)
         return bytes(np.asarray(row[:n], dtype=np.uint8))
 
+    def pack_state(self, row_bytes: bytes, n: int) -> Tuple[np.ndarray, np.int32]:
+        """Re-materialize a checkpointed FULL-WIDTH state row — the
+        elastic-session reshard format (parallel/mc_dispatch): unlike an
+        operand (``pack``, ≤ width, zero-padded), a mid-chain state row
+        must be exactly ``width`` bytes — the values beyond the original
+        operand length are live kernel state, and silently padding a
+        short row would resume a corrupted chain."""
+        if len(row_bytes) != self.width:
+            raise ValueError(
+                f"state row of {len(row_bytes)}B != method width "
+                f"{self.width}"
+            )
+        row = np.frombuffer(bytes(row_bytes), dtype=np.uint8).copy()
+        return row, np.int32(int(n))
+
 
 # (service, method) -> DeviceMethod; filled by Server.add_service when a
 # handler carries ._device_method (process-global, like the reference's

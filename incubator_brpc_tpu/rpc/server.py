@@ -1015,17 +1015,37 @@ class Server:
                 lambda: self._nprocessing == 0,
                 timeout=max(0.0, deadline - _time.monotonic()),
             )
-        # open collective sessions pin devices across the fabric: give
-        # them the rest of the grace window before the hard stop tears
-        # their control streams down
+        # open collective sessions pin devices across the fabric, and
+        # open streaming RPCs are in-flight work with no _nprocessing
+        # footprint: both get the rest of the grace window before the
+        # hard stop tears their transport down
         from incubator_brpc_tpu.parallel.mc_dispatch import active_sessions
 
         while (
             active_sessions(owner=self) > 0
-            and _time.monotonic() < deadline
-        ):
+            or self._open_streams()
+        ) and _time.monotonic() < deadline:
             _time.sleep(0.02)
-        drained = self._nprocessing == 0 and active_sessions(owner=self) == 0
+        stragglers = self._open_streams()
+        if stragglers:
+            # grace expired under live streams: RST them NOW so each
+            # peer's writer stops on a clean frame — dying later under
+            # stop()'s socket sweep would look like a network failure
+            logger.warning(
+                "lame-duck grace expired with %d open stream(s); "
+                "sending RST",
+                len(stragglers),
+            )
+            for s in stragglers:
+                try:
+                    s.rst(ErrorCode.ELOGOFF, "server drained (lame duck)")
+                except Exception:
+                    logger.exception("lame-duck stream RST raised")
+        drained = (
+            self._nprocessing == 0
+            and active_sessions(owner=self) == 0
+            and not stragglers
+        )
         if not drained:
             logger.warning(
                 "lame-duck grace %.1fs expired with work still in flight "
@@ -1040,6 +1060,20 @@ class Server:
             _time.sleep(min(0.25, max(0.0, deadline - _time.monotonic())))
         self.stop()
         self.join(timeout=max(0.5, deadline - _time.monotonic()))
+
+    def _open_streams(self):
+        """Live streaming RPCs bound to this server's connections — the
+        third kind of in-flight work the lame-duck drain waits on (the
+        first two: ``_nprocessing`` handlers, collective sessions)."""
+        if self._acceptor is None:
+            return []
+        from incubator_brpc_tpu.rpc import stream as stream_mod
+
+        try:
+            conns = list(self._acceptor.connections())
+        except Exception:
+            return []
+        return stream_mod.open_streams(conns)
 
     @property
     def lame_duck(self) -> bool:

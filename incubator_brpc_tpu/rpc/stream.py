@@ -306,6 +306,23 @@ class Stream:
             except Exception:
                 logger.exception("stream %d on_closed raised", self.id)
 
+    def rst(self, code: int = ErrorCode.ECLOSE, reason: str = "stream reset") -> None:
+        """Force-terminate the stream NOW: tell the peer with an RST frame
+        (so its writer stops instead of filling a dead window) and fail
+        the local side.  The lame-duck drain uses this at grace expiry —
+        a stream that outlives the drain dies cleanly here rather than
+        dirtily under the final ``stop()``'s socket teardown."""
+        with self._lock:
+            sock, rid = self._sock, self.remote_id
+            alive = self.state == CONNECTED
+        if alive and sock is not None and rid:
+            meta = Meta(stream_id=rid, extra={"ft": FT_RST})
+            try:
+                sock.write(pack_frame(meta, b"", 0, flags=FLAG_STREAM))
+            except Exception:
+                logger.exception("stream %d RST write failed", self.id)
+        self._fail(code, reason)
+
     def _on_socket_failed(self, sock) -> None:
         self._fail(sock.error_code, sock.error_text or "transport failed")
 
@@ -369,6 +386,21 @@ def _registry_remove(sid: int) -> None:
 def get_stream(sid: int) -> Optional[Stream]:
     with _streams_lock:
         return _streams.get(sid)
+
+
+def open_streams(socks=None) -> List[Stream]:
+    """Live (CONNECTED) streams — all of them, or only those riding one
+    of the given sockets.  ``Server.enter_lame_duck`` drains the streams
+    bound to ITS connections alongside ``nprocessing`` and the active
+    collective sessions: a long-lived stream is in-flight work even when
+    no RPC handler is running."""
+    with _streams_lock:
+        items = list(_streams.values())
+    live = [s for s in items if s.state == CONNECTED]
+    if socks is None:
+        return live
+    sockset = set(socks)
+    return [s for s in live if s._sock in sockset]
 
 
 def stream_create(options: Optional[StreamOptions] = None) -> Stream:

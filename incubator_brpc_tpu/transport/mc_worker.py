@@ -159,6 +159,34 @@ def run_server(args) -> int:
     server.add_service(
         "dsvc", {"scale": _device_method(kernel, width=SESSION_WIDTH)}
     )
+    if args.chaos_kill_at_step >= 0:
+        # the deterministic chaos drill: this party "dies" at EXACTLY
+        # step K of its first session — the RPC server stops (conns
+        # fail, so the proposer classifies a connectivity death) while
+        # the PROCESS stays alive (the jax.distributed group and the
+        # device plane survive, so the healed session can still run and
+        # every worker reaches the exit barrier).  The local session is
+        # aborted too so this handler unwedges now, not at its deadline.
+        from incubator_brpc_tpu.parallel import mc_dispatch as _mcd
+
+        chaos_fired = threading.Event()
+
+        def _chaos_die() -> None:
+            print("SERVER_DYING", flush=True)
+            server.stop()
+            _mcd.abort_sessions_for_owner(
+                server, "chaos drill killed this party"
+            )
+
+        def _chaos_hook(step: int, own_index: int) -> None:
+            if step >= args.chaos_kill_at_step and not chaos_fired.is_set():
+                chaos_fired.set()
+                threading.Thread(target=_chaos_die, daemon=True).start()
+                # park until the stop lands so no further step of the
+                # doomed chain dispatches past the kill point
+                time.sleep(0.2)
+
+        _mcd.set_step_hook(_chaos_hook)
     server.add_service("Admin", {"Quit": _quit})
     assert server.start(args.rpc_port)
     print(f"SERVER_READY port={server.port}", flush=True)
@@ -460,19 +488,45 @@ def run_session_client(args) -> int:
         "dsvc", "scale", DeviceMethod(_scale_psum_kernel, width=SESSION_WIDTH)
     )
     ports = [int(p) for p in args.rpc_ports.split(",")]
-    party_ids = sorted(d.id for d in jax.devices())
+    spare_procs = set(
+        int(p) for p in args.spare_procs.split(",") if p != ""
+    )
+    # spare parties stand OUTSIDE the initial session: their devices are
+    # excluded from the party set and their channels form the standby
+    # pool the elastic recovery path heals dead slots from
+    spare_dev_ids = sorted(
+        d.id for d in jax.devices() if d.process_index in spare_procs
+    )
+    party_ids = sorted(
+        d.id for d in jax.devices() if d.id not in set(spare_dev_ids)
+    )
     client_index = party_ids.index(jax.local_devices()[0].id)
     n = len(party_ids)
-    assert len(ports) == n - 1
-    chans = _connect_all(ports)
-    if chans is None:
+    assert len(ports) == n - 1 + len(spare_procs)
+    all_chans = _connect_all(ports)
+    if all_chans is None:
         return 1
+    # server i serves proc i; spare procs' channels leave the party list
+    chans = [
+        ch for i, ch in enumerate(all_chans) if i not in spare_procs
+    ]
+    spares = list(
+        zip(
+            [ch for i, ch in enumerate(all_chans) if i in spare_procs],
+            spare_dev_ids,
+        )
+    )
     # per-party operands with DIFFERENT lengths: proves both the operand
     # routing and the n-passthrough across the chain
     operands = [
         bytes((7 * i + j) % 256 for j in range(64 + 8 * i)) for i in range(n)
     ]
     steps = args.collective_steps or 4
+    if args.expect_resume:
+        return _run_session_client_resume(
+            args, chans, spares, party_ids, client_index, operands, steps,
+            ports,
+        )
     if args.expect_reject:
         # one server registered a different body under the same name: the
         # accept phase must reject CLEANLY, before any lockstep entry
@@ -502,6 +556,58 @@ def run_session_client(args) -> int:
         "parties": n,
         "steps": out["final_steps"],
         "per_step_ms": out["elapsed_s"] / out["final_steps"] * 1e3,
+        "method": "dsvc.scale",
+    }
+    print("CLIENT_OK " + json.dumps(stats), flush=True)
+    _quit_servers(ports)
+    return 0
+
+
+def _run_session_client_resume(
+    args, chans, spares, party_ids, client_index, operands, steps, ports
+) -> int:
+    """Chaos-drill client half: one party dies at exactly step K
+    (``--chaos-kill-at-step`` on its server); the session must HEAL —
+    resume barrier over the survivors, a replacement party filling the
+    dead slot, replay from the agreed resume point — and the merged
+    result must be byte-identical to an undisturbed run of the same
+    operands.  On a TRUE multi-controller fabric the dead party's
+    checkpoint ring died with its RPC plane, so the reshard can be
+    unreachable and the heal legitimately lands as a full restart over
+    the replaced set (``resumed_from`` None): the drill asserts the
+    HEAL, and reports the resume point it achieved."""
+    from incubator_brpc_tpu.parallel.mc_dispatch import propose_with_recovery
+
+    ckpt = args.checkpoint_every or 2
+    out = propose_with_recovery(
+        chans, party_ids, "dsvc", "scale", operands,
+        steps=steps, proposer_index=client_index, timeout_ms=120000,
+        session_deadline_ms=60000, max_reproposals=1,
+        spares=spares, checkpoint_every=ckpt,
+    )
+    want = session_expected(operands, out["final_steps"])
+    identical = all(
+        got == exp for got, exp in zip(out["results"], want)
+    )
+    if not identical:
+        print("CLIENT_FAIL resumed merge diverged from the model", flush=True)
+        return 1
+    if not out["replaced_party_ids"]:
+        print(
+            f"CLIENT_FAIL no heal: replaced={out['replaced_party_ids']} "
+            f"resumed_from={out['resumed_from']}",
+            flush=True,
+        )
+        return 1
+    stats = {
+        "parties": len(party_ids),
+        "steps": out["final_steps"],
+        # None on a fabric where the dead ring was unreachable (full
+        # restart over the replaced set); an int = true checkpoint resume
+        "resumed_from": out["resumed_from"],
+        "dead_party_ids": out["dead_party_ids"],
+        "replaced_party_ids": out["replaced_party_ids"],
+        "byte_identical": True,
         "method": "dsvc.scale",
     }
     print("CLIENT_OK " + json.dumps(stats), flush=True)
@@ -801,6 +907,54 @@ def orchestrate_session(
     )
 
 
+def orchestrate_chaos_session(
+    n_parties: int = 3,
+    steps: int = 8,
+    kill_at: int = 3,
+    checkpoint_every: int = 2,
+    timeout: float = 300.0,
+):
+    """The scriptable chaos drill: ``n_parties - 1`` party servers + ONE
+    spare server + the session client, all one jax.distributed group.
+    Server 0 is armed with ``--chaos-kill-at-step kill_at`` so exactly
+    one party dies at step K of the session; the client runs
+    ``propose_with_recovery`` with the spare in its standby pool and
+    asserts the session HEALS: replacement joins, resume point agreed
+    over the survivors' checkpoints, and the merged result byte-identical
+    to an undisturbed run.  Returns the client's stats (resumed_from,
+    replaced_party_ids, byte_identical)."""
+    n_servers = n_parties  # n_parties - 1 party servers + 1 spare
+    ports = _free_ports(n_servers + 1)
+    coord, rpc_ports = ports[0], ports[1:]
+    nprocs = n_servers + 1
+    spare_proc = n_servers - 1  # the LAST server process is the spare
+    specs = []
+    for i in range(n_servers):
+        argv = [
+            "--coord-port", str(coord), "--nprocs", str(nprocs),
+            "--proc-id", str(i), "--rpc-port", str(rpc_ports[i]),
+        ]
+        if i == 0:
+            argv += ["--chaos-kill-at-step", str(kill_at)]
+        specs.append((f"server{i}", "server", tuple(argv)))
+    client = [
+        "--coord-port", str(coord), "--nprocs", str(nprocs),
+        "--proc-id", str(nprocs - 1),
+        "--rpc-ports", ",".join(map(str, rpc_ports)),
+        "--collective-steps", str(steps),
+        "--spare-procs", str(spare_proc),
+        "--expect-resume",
+        "--checkpoint-every", str(checkpoint_every),
+    ]
+    specs.append(("session-client", "session-client", tuple(client)))
+    return _orchestrate(
+        specs,
+        label=f"chaos session (kill party 0 at step {kill_at})",
+        timeout=timeout,
+        servers_may_die=True,
+    )
+
+
 def orchestrate_fabric(n_servers: int = 2, extra=(), timeout: float = 300.0):
     """Spawn ``n_servers`` server processes + one fabric client (all in one
     jax.distributed group) and return the client's per-link stats."""
@@ -862,6 +1016,11 @@ def main(argv=None) -> int:
     ap.add_argument("--wrong-kernel", action="store_true")  # server
     ap.add_argument("--expect-reject", action="store_true")  # session client
     ap.add_argument("--mc-lowering-check", action="store_true")  # fabric
+    # elastic sessions (checkpoint/resume + party replacement):
+    ap.add_argument("--chaos-kill-at-step", type=int, default=-1)  # server
+    ap.add_argument("--spare-procs", type=str, default="")  # session client
+    ap.add_argument("--expect-resume", action="store_true")  # session client
+    ap.add_argument("--checkpoint-every", type=int, default=0)  # client
     args = ap.parse_args(argv)
     if args.proc_id < 0:
         # pair convention: server is the coordinator, client is last

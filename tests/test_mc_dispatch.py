@@ -623,3 +623,23 @@ def test_eight_party_session(fabric_capable):
     assert stats["parties"] == 8, transcript
     assert stats["steps"] >= 8
     assert stats["per_step_ms"] < 500, stats
+
+
+@pytest.mark.slow
+def test_chaos_kill_at_step_resumes(fabric_capable):
+    """The scriptable chaos drill (the dryrun_multichip chaos_resume
+    gate): one REAL party process loses its RPC server at exactly step K;
+    the session heals with the spare party and the merged result stays
+    byte-identical to the undisturbed model."""
+    from incubator_brpc_tpu.transport.mc_worker import (
+        orchestrate_chaos_session,
+    )
+
+    stats, transcript = orchestrate_chaos_session(
+        n_parties=3, steps=8, kill_at=3, checkpoint_every=2, timeout=420
+    )
+    assert stats["byte_identical"], transcript
+    assert stats["replaced_party_ids"], transcript
+    # resumed_from is an int when the dead slot's checkpoint was
+    # resharable, None when no reachable ring covered it (a true
+    # multi-controller fabric) — the heal itself is the gate

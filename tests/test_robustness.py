@@ -1858,3 +1858,788 @@ class TestNativeFaultSeam:
                 nch.close()
             srv.stop()
             srv.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# elastic collective sessions: checkpoint/resume, replacement, watchdog
+# ---------------------------------------------------------------------------
+
+
+class TestResumePointJoin:
+    """The resume barrier's min-join (parallel/mc_dispatch.resume_point):
+    last COMMON checkpointed step over the survivors — pure units."""
+
+    def _info(self, wm, steps):
+        return {"watermark": wm, "steps": list(steps)}
+
+    def test_min_join_over_skewed_watermarks(self):
+        from incubator_brpc_tpu.parallel.mc_dispatch import resume_point
+
+        wms = {
+            0: self._info(4, [2, 4]),
+            1: self._info(6, [2, 4, 6]),
+            2: self._info(2, [2]),
+        }
+        assert resume_point(wms) == 2
+
+    def test_zero_checkpoint_falls_back_to_full_restart(self):
+        from incubator_brpc_tpu.parallel.mc_dispatch import resume_point
+
+        # one survivor never checkpointed: the whole join is 0 — restart
+        assert resume_point(
+            {0: self._info(6, [2, 4, 6]), 1: self._info(0, [])}
+        ) == 0
+        # a survivor that answered nothing at all drags to 0 too
+        assert resume_point({0: self._info(6, [2, 4, 6]), 1: None}) == 0
+        assert resume_point({}) == 0
+
+    def test_evicted_min_falls_back_to_deepest_common(self):
+        from incubator_brpc_tpu.parallel.mc_dispatch import resume_point
+
+        # min watermark 4 was EVICTED from survivor 1's ring: fall back
+        # to the deepest step everyone still retains
+        wms = {
+            0: self._info(4, [2, 4]),
+            1: self._info(6, [2, 6]),
+        }
+        assert resume_point(wms) == 2
+        # nothing common at all: full restart
+        assert resume_point(
+            {0: self._info(4, [4]), 1: self._info(6, [6])}
+        ) == 0
+
+
+class TestCheckpointRings:
+    """Ring census/release/eviction units (no device traffic — the ring
+    only retains references; materialization is a resume-path affair)."""
+
+    def test_census_release_and_gauge(self):
+        from incubator_brpc_tpu.parallel import mc_dispatch as mcd
+
+        sid = "t-ring-census"
+        ring = mcd._checkpoint_ring(sid, 1, (10, 11, 12), entry_bytes=100)
+        for step in (2, 4, 6):
+            ring.put(step, object(), object(), depth=2)
+        # depth=2: step 2 evicted, watermark = newest retained
+        assert ring.steps() == [4, 6]
+        wm = mcd.checkpoint_watermarks(sid)
+        assert wm[1]["watermark"] == 6 and wm[1]["steps"] == [4, 6]
+        assert mcd.checkpoint_bytes_retained() >= 200
+        assert mcd.release_checkpoints(sid)
+        assert not mcd.checkpoint_watermarks(sid)
+        assert not mcd.release_checkpoints(sid)  # idempotent
+
+    def test_unready_entries_excluded_from_census(self):
+        from incubator_brpc_tpu.parallel import mc_dispatch as mcd
+
+        class _Arr:
+            def __init__(self, ready):
+                self._r = ready
+
+            def is_ready(self):
+                return self._r
+
+        sid = "t-ring-ready"
+        ring = mcd._checkpoint_ring(sid, 0, (1, 2), entry_bytes=10)
+        ring.put(2, _Arr(True), _Arr(True), depth=4)
+        ring.put(4, _Arr(False), _Arr(True), depth=4)
+        # a dispatched-but-never-completed step (wedged behind a dead
+        # party's collective) must not be elected as the resume point
+        assert ring.steps() == [2]
+        assert mcd.checkpoint_watermarks(sid)[0]["watermark"] == 2
+        mcd.release_checkpoints(sid)
+
+    def test_resumed_replay_replaces_stale_same_step_entry(self):
+        """A resumed run re-checkpoints step numbers the aborted run
+        already put(): the fresh entry must REPLACE the stale one (which
+        may be wedged and never-ready), not shadow behind it."""
+        from incubator_brpc_tpu.parallel import mc_dispatch as mcd
+
+        class _Arr:
+            def __init__(self, ready):
+                self._r = ready
+
+            def is_ready(self):
+                return self._r
+
+        sid = "t-ring-replace"
+        ring = mcd._checkpoint_ring(sid, 0, (1, 2), entry_bytes=10)
+        stale = _Arr(False)
+        ring.put(2, _Arr(True), _Arr(True), depth=4)
+        ring.put(4, stale, _Arr(True), depth=4)  # wedged, never ready
+        assert ring.watermark() == 2
+        fresh = _Arr(True)
+        ring.put(4, fresh, _Arr(True), depth=4)  # the replayed step 4
+        assert ring.steps() == [2, 4]
+        assert ring.get(4)[0] is fresh  # not the stale shadow
+        assert ring.watermark() == 4
+        mcd.release_checkpoints(sid)
+
+    def test_cap_eviction_spares_active_sessions(self):
+        """Ring eviction prefers sessions with no live registrant: short
+        -session churn must not strip a long-running session of the
+        checkpoints its resume depends on."""
+        from incubator_brpc_tpu.parallel import mc_dispatch as mcd
+
+        sid = "t-ring-active"
+        st = mcd._register_session(sid, (1, 2), deadline=0.0)
+        try:
+            mcd._checkpoint_ring(sid, 0, (1, 2), entry_bytes=1)
+            for i in range(mcd._MAX_CHECKPOINT_SESSIONS + 4):
+                mcd._checkpoint_ring(f"t-ring-churn-{i}", 0, (1,), entry_bytes=1)
+            assert mcd._checkpoint_lookup(sid, 0) is not None, (
+                "churn evicted a LIVE session's ring"
+            )
+        finally:
+            mcd._unregister_session(st)
+            mcd.release_checkpoints(sid)
+            for i in range(mcd._MAX_CHECKPOINT_SESSIONS + 4):
+                mcd.release_checkpoints(f"t-ring-churn-{i}")
+
+    def test_session_cap_evicts_oldest(self):
+        from incubator_brpc_tpu.parallel import mc_dispatch as mcd
+
+        sids = [f"t-ring-cap-{i}" for i in range(mcd._MAX_CHECKPOINT_SESSIONS + 2)]
+        for sid in sids:
+            mcd._checkpoint_ring(sid, 0, (1,), entry_bytes=1)
+        assert not mcd.checkpoint_watermarks(sids[0])  # evicted
+        assert mcd._checkpoint_lookup(sids[-1], 0) is not None
+        for sid in sids:
+            mcd.release_checkpoints(sid)
+
+
+def _shard_map_or_skip(min_devices=4):
+    import jax
+
+    from incubator_brpc_tpu.parallel.compat import resolve_shard_map
+
+    try:
+        resolve_shard_map()
+    except ImportError:
+        pytest.skip("no shard_map in this jax build")
+    if len(jax.devices()) < min_devices:
+        pytest.skip(f"needs a {min_devices}+ device mesh")
+    return jax.devices()
+
+
+class TestElasticSessionUnits:
+    """run_dispatch_session's checkpoint/restore seam, driven directly
+    (single process, all shards addressable)."""
+
+    def test_resume_replays_only_steps_past_checkpoint(self):
+        devices = _shard_map_or_skip(3)
+        from incubator_brpc_tpu.parallel import mc_dispatch as mcd
+        from incubator_brpc_tpu.rpc.device_method import DeviceMethod
+        from incubator_brpc_tpu.transport.mc_worker import (
+            SESSION_WIDTH,
+            _scale_psum_kernel,
+            session_expected,
+        )
+
+        pids = [d.id for d in devices[:3]]
+        ops = [bytes([i + 1]) * 16 for i in range(3)]
+        dm = DeviceMethod(_scale_psum_kernel, width=SESSION_WIDTH)
+        sid = "t-unit-resume"
+        try:
+            mcd.run_dispatch_session(
+                pids, 0, dm, ops, 6, session_id=sid, checkpoint_every=2
+            )
+            assert mcd.checkpoint_watermarks(sid)[0]["watermark"] == 6
+            before = mcd.dispatch_steps.get_value()
+            row, n, _el = mcd.run_dispatch_session(
+                pids, 0, dm, ops, 12, session_id=sid, resume_from=6,
+                checkpoint_every=2,
+            )
+            # only the steps past the checkpoint re-ran
+            assert mcd.dispatch_steps.get_value() - before == 6
+            # and the result is byte-identical to an undisturbed 12-step run
+            assert dm.unpack(row, n) == session_expected(ops, 12)[0]
+        finally:
+            mcd.release_checkpoints(sid)
+
+    def test_resume_point_equal_to_final_replays_nothing(self):
+        devices = _shard_map_or_skip(3)
+        from incubator_brpc_tpu.parallel import mc_dispatch as mcd
+        from incubator_brpc_tpu.rpc.device_method import DeviceMethod
+        from incubator_brpc_tpu.transport.mc_worker import (
+            SESSION_WIDTH,
+            _scale_psum_kernel,
+            session_expected,
+        )
+
+        pids = [d.id for d in devices[:3]]
+        ops = [b"\x05" * 8, b"\x06" * 8, b"\x07" * 8]
+        dm = DeviceMethod(_scale_psum_kernel, width=SESSION_WIDTH)
+        sid = "t-unit-resume-final"
+        try:
+            mcd.run_dispatch_session(
+                pids, 0, dm, ops, 4, session_id=sid, checkpoint_every=2
+            )
+            before = mcd.dispatch_steps.get_value()
+            row, n, _el = mcd.run_dispatch_session(
+                pids, 0, dm, ops, 4, session_id=sid, resume_from=4,
+            )
+            assert mcd.dispatch_steps.get_value() - before == 0
+            assert dm.unpack(row, n) == session_expected(ops, 4)[0]
+        finally:
+            mcd.release_checkpoints(sid)
+
+    def test_replacement_reshard_round_trip(self):
+        """The reshard wire format: checkpoint_fetch's b64 rows restore a
+        party with NO local ring (the replacement's bootstrap) and the
+        replayed chain lands byte-identical."""
+        devices = _shard_map_or_skip(4)
+        import base64
+
+        from incubator_brpc_tpu.parallel import mc_dispatch as mcd
+        from incubator_brpc_tpu.rpc.device_method import DeviceMethod
+        from incubator_brpc_tpu.transport.mc_worker import (
+            SESSION_WIDTH,
+            _scale_psum_kernel,
+            session_expected,
+        )
+
+        pids = [d.id for d in devices[:3]]
+        ops = [bytes([7 * i + 1]) * 12 for i in range(3)]
+        dm = DeviceMethod(_scale_psum_kernel, width=SESSION_WIDTH)
+        sid = "t-unit-reshard"
+        try:
+            mcd.run_dispatch_session(
+                pids, 0, dm, ops, 4, session_id=sid, checkpoint_every=2
+            )
+            rows = mcd.checkpoint_fetch(sid, 4, [0, 1, 2])
+            assert set(rows) == {0, 1, 2}
+            state = {
+                i: (base64.b64decode(v["row"]), int(v["n"]))
+                for i, v in rows.items()
+            }
+            assert all(len(r) == SESSION_WIDTH for r, _n in state.values())
+            # a DIFFERENT device takes slot 0, restoring purely from the
+            # resharded bytes under a session id with no local ring
+            new_pids = [devices[3].id] + pids[1:]
+            row, n, _el = mcd.run_dispatch_session(
+                new_pids, 1, dm, ops, 8, session_id="t-unit-reshard-2",
+                resume_from=4, resume_state=state,
+            )
+            assert dm.unpack(row, n) == session_expected(ops, 8)[1]
+        finally:
+            mcd.release_checkpoints(sid)
+            mcd.release_checkpoints("t-unit-reshard-2")
+
+    def test_missing_checkpoint_raises_lookup_error(self):
+        devices = _shard_map_or_skip(3)
+        from incubator_brpc_tpu.parallel import mc_dispatch as mcd
+        from incubator_brpc_tpu.rpc.device_method import DeviceMethod
+        from incubator_brpc_tpu.transport.mc_worker import (
+            SESSION_WIDTH,
+            _scale_psum_kernel,
+        )
+
+        pids = [d.id for d in devices[:3]]
+        ops = [b"\x01" * 8] * 3
+        dm = DeviceMethod(_scale_psum_kernel, width=SESSION_WIDTH)
+        with pytest.raises(LookupError):
+            mcd.run_dispatch_session(
+                pids, 0, dm, ops, 8, session_id="t-no-such-ring",
+                resume_from=4,
+            )
+
+
+class TestElasticResumeChaosDrill:
+    """The acceptance drill: kill 1 of 3 parties mid multi-step session;
+    the session HEALS — the spare party fills the dead slot, the resume
+    barrier min-joins the survivors' checkpoint watermarks, only steps
+    past the resume point re-run, and the merged result is byte-identical
+    to an undisturbed run.  The dead party's breaker trips while the
+    survivors' stay closed, and `mc_dispatch_resumes` /
+    `mc_dispatch_replaced_parties` advance."""
+
+    DEADLINE_MS = 6000
+    STEPS = 80
+
+    @pytest.fixture
+    def mesh(self, tuned_flags):
+        import jax
+
+        from incubator_brpc_tpu.parallel.compat import resolve_shard_map
+
+        try:
+            resolve_shard_map()
+        except ImportError:
+            pytest.skip("no shard_map in this jax build")
+        if len(jax.devices()) < 5:
+            pytest.skip("needs a 5+ device mesh (3 parties + spare)")
+        tuned_flags("circuit_breaker_short_window_size", 30)
+        tuned_flags("circuit_breaker_long_window_size", 300)
+        tuned_flags("circuit_breaker_min_isolation_duration_ms", 60000)
+        tuned_flags("enable_circuit_breaker", True)
+        from incubator_brpc_tpu.rpc import device_method
+        from incubator_brpc_tpu.rpc.device_method import (
+            DeviceMethod,
+            register_device_method,
+        )
+        from incubator_brpc_tpu.transport.mc_worker import (
+            SESSION_WIDTH,
+            _scale_psum_kernel,
+        )
+
+        register_device_method(
+            "dsvc", "scale", DeviceMethod(_scale_psum_kernel, width=SESSION_WIDTH)
+        )
+        servers, channels = [], []
+        for i in range(4):  # 3 parties + 1 spare
+            s = Server(
+                ServerOptions(
+                    device_index=i + 1,
+                    enable_collective_service=True,
+                    collective_max_concurrency=0,
+                )
+            )
+            s.add_service(
+                "dsvc",
+                {"scale": device_method(_scale_psum_kernel, width=SESSION_WIDTH)},
+            )
+            assert s.start(0)
+            servers.append(s)
+            ch = Channel()
+            assert ch.init(
+                f"list://127.0.0.1:{s.port}",
+                lb_name="rr",
+                options=ChannelOptions(max_retry=1, timeout_ms=10000),
+            )
+            channels.append(ch)
+        party_ids = [d.id for d in jax.devices()[1:4]]
+        spare_dev = jax.devices()[4].id
+        yield servers, channels, party_ids, spare_dev
+        from incubator_brpc_tpu.parallel import mc_dispatch
+
+        mc_dispatch.set_step_hook(None)
+        for ch in channels:
+            if ch._lb is not None:
+                ch._lb.stop()
+        for s in servers:
+            s.stop()
+            s.join(timeout=5)
+
+    def test_kill_at_step_k_heals_byte_identical(self, mesh):
+        from incubator_brpc_tpu.parallel import mc_dispatch
+        from incubator_brpc_tpu.transport.mc_worker import session_expected
+
+        servers, channels, party_ids, spare_dev = mesh
+        operands = [bytes([i + 1]) * 8 for i in range(3)]
+        before_resumes = mc_dispatch.dispatch_resumes.get_value()
+        before_replaced = mc_dispatch.dispatch_replaced_parties.get_value()
+
+        # pace every party so the kill lands mid-session (K ~ step 12
+        # of an 80-step run at 30 ms/step, killer at 0.35 s)
+        mc_dispatch.set_step_hook(lambda step, idx: time.sleep(0.03))
+        killer = threading.Timer(
+            0.35, lambda: (servers[0].stop(), servers[0].join(timeout=3))
+        )
+        killer.start()
+        try:
+            out = mc_dispatch.propose_with_recovery(
+                channels[:3],
+                party_ids,
+                "dsvc",
+                "scale",
+                operands,
+                steps=self.STEPS,
+                proposer_index=None,
+                timeout_ms=60000,
+                session_deadline_ms=self.DEADLINE_MS,
+                spares=[(channels[3], spare_dev)],
+                checkpoint_every=2,
+            )
+        finally:
+            killer.cancel()
+            mc_dispatch.set_step_hook(None)
+
+        # healed, not shrunk: the spare filled the dead slot, the session
+        # resumed from a COMMON checkpoint instead of step 0
+        assert out["dead_party_ids"] == [party_ids[0]]
+        assert out["replaced_party_ids"] == [spare_dev]
+        assert out["resumed_from"] is not None and out["resumed_from"] > 0
+        assert out["resumed_from"] % 2 == 0  # a checkpointed step
+        assert out["final_steps"] == self.STEPS
+
+        # byte-identity with an undisturbed run of the SAME party count
+        want = session_expected(operands, self.STEPS)
+        for i, (got, exp) in enumerate(zip(out["results"], want)):
+            assert got == exp, f"slot {i} diverged after resume"
+
+        assert mc_dispatch.dispatch_resumes.get_value() > before_resumes
+        assert (
+            mc_dispatch.dispatch_replaced_parties.get_value()
+            > before_replaced
+        )
+
+        # blame: the dead party's breaker trips (connect-refused selects
+        # feed it); the survivors' stay closed
+        for _ in range(30):
+            if channels[0]._lb.isolated_servers():
+                break
+            channels[0].call_method("dsvc", "scale", b"x")
+        assert channels[0]._lb.isolated_servers(), (
+            "dead party's breaker never tripped"
+        )
+        for i in (1, 2):
+            assert not channels[i]._lb.isolated_servers(), (
+                f"survivor {i}'s breaker tripped off the resumed session"
+            )
+
+    def test_two_party_session_heals_with_spare(self, mesh):
+        """A 2-party session + one death CAN heal when a spare preserves
+        the width — the survivor-count guard only gates the shrink path."""
+        from incubator_brpc_tpu.parallel import mc_dispatch
+        from incubator_brpc_tpu.transport.mc_worker import session_expected
+
+        servers, channels, party_ids, spare_dev = mesh
+        ops = [b"\x01" * 8, b"\x02" * 8]
+        mc_dispatch.set_step_hook(lambda step, idx: time.sleep(0.03))
+        killer = threading.Timer(
+            0.3, lambda: (servers[0].stop(), servers[0].join(timeout=3))
+        )
+        killer.start()
+        try:
+            out = mc_dispatch.propose_with_recovery(
+                channels[:2],
+                party_ids[:2],
+                "dsvc",
+                "scale",
+                ops,
+                steps=40,
+                proposer_index=None,
+                timeout_ms=60000,
+                session_deadline_ms=self.DEADLINE_MS,
+                spares=[(channels[3], spare_dev)],
+                checkpoint_every=2,
+            )
+        finally:
+            killer.cancel()
+            mc_dispatch.set_step_hook(None)
+        assert out["replaced_party_ids"] == [spare_dev]
+        assert out["dead_party_ids"] == [party_ids[0]]
+        want = session_expected(ops, out["final_steps"])
+        assert [bytes(r) for r in out["results"]] == want
+
+    def test_no_spare_falls_back_to_shrink_restart(self, mesh):
+        """Without a spare the recovery path is PR-8's: a fresh session
+        from step 0 over the survivors only — never a divergent resume."""
+        from incubator_brpc_tpu.parallel import mc_dispatch
+        from incubator_brpc_tpu.transport.mc_worker import session_expected
+
+        servers, channels, party_ids, _spare = mesh
+        operands = [bytes([i + 1]) * 8 for i in range(3)]
+        mc_dispatch.set_step_hook(lambda step, idx: time.sleep(0.03))
+        killer = threading.Timer(
+            0.3, lambda: (servers[0].stop(), servers[0].join(timeout=3))
+        )
+        killer.start()
+        try:
+            out = mc_dispatch.propose_with_recovery(
+                channels[:3],
+                party_ids,
+                "dsvc",
+                "scale",
+                operands,
+                steps=30,
+                proposer_index=None,
+                timeout_ms=60000,
+                session_deadline_ms=self.DEADLINE_MS,
+                checkpoint_every=2,
+            )
+        finally:
+            killer.cancel()
+            mc_dispatch.set_step_hook(None)
+        assert out["dead_party_ids"] == [party_ids[0]]
+        assert out["replaced_party_ids"] == []
+        assert out["resumed_from"] is None  # restart, not resume
+        # the shrunk session's result matches the SURVIVOR-set model
+        want = session_expected(operands[1:], out["final_steps"])
+        assert out["results"][0] == want[0] and out["results"][1] == want[1]
+
+
+class TestStepWatchdog:
+    """`mc_dispatch_step_deadline_ms` bounds a single lockstep step:
+    a party wedged INSIDE one step aborts the session fabric-wide at
+    step granularity instead of burning the whole session deadline
+    (PR 8's documented gap)."""
+
+    @pytest.fixture
+    def mesh(self, tuned_flags):
+        import jax
+
+        from incubator_brpc_tpu.parallel.compat import resolve_shard_map
+
+        try:
+            resolve_shard_map()
+        except ImportError:
+            pytest.skip("no shard_map in this jax build")
+        if len(jax.devices()) < 4:
+            pytest.skip("needs a 4+ device mesh")
+        from incubator_brpc_tpu.rpc import device_method
+        from incubator_brpc_tpu.rpc.device_method import (
+            DeviceMethod,
+            register_device_method,
+        )
+        from incubator_brpc_tpu.transport.mc_worker import (
+            SESSION_WIDTH,
+            _scale_psum_kernel,
+        )
+
+        register_device_method(
+            "dsvc", "scale", DeviceMethod(_scale_psum_kernel, width=SESSION_WIDTH)
+        )
+        servers, channels = [], []
+        for i in range(3):
+            s = Server(
+                ServerOptions(
+                    device_index=i + 1,
+                    enable_collective_service=True,
+                    collective_max_concurrency=0,
+                )
+            )
+            s.add_service(
+                "dsvc",
+                {"scale": device_method(_scale_psum_kernel, width=SESSION_WIDTH)},
+            )
+            assert s.start(0)
+            servers.append(s)
+            ch = Channel()
+            assert ch.init(f"127.0.0.1:{s.port}")
+            channels.append(ch)
+        party_ids = [d.id for d in jax.devices()[1:4]]
+        yield servers, channels, party_ids
+        from incubator_brpc_tpu.parallel import mc_dispatch
+
+        mc_dispatch.set_step_hook(None)
+        for s in servers:
+            s.stop()
+            s.join(timeout=5)
+
+    def test_watchdog_fires_inside_stuck_step(self, mesh):
+        from incubator_brpc_tpu.parallel import mc_dispatch
+
+        servers, channels, party_ids = mesh
+        operands = [bytes([i + 1]) * 8 for i in range(3)]
+        before_aborts = mc_dispatch.dispatch_aborts.get_value()
+
+        STALL_S = 2.5
+        SESSION_DEADLINE_MS = 30000
+
+        def hook(step, idx):
+            if idx == 1 and step == 2:
+                time.sleep(STALL_S)  # wedged inside step 2
+
+        mc_dispatch.set_step_hook(hook)
+        t0 = time.monotonic()
+        with pytest.raises(mc_dispatch.SessionAborted) as exc:
+            mc_dispatch.propose_dispatch(
+                channels,
+                party_ids,
+                "dsvc",
+                "scale",
+                operands,
+                steps=30,
+                proposer_index=None,
+                timeout_ms=60000,
+                session_deadline_ms=SESSION_DEADLINE_MS,
+                step_deadline_ms=150,
+            )
+        elapsed = time.monotonic() - t0
+        mc_dispatch.set_step_hook(None)
+        # the watchdog (not the 30 s session deadline) took it down, and
+        # the blame names the step deadline
+        assert elapsed < STALL_S + 4.0
+        assert "step deadline" in str(exc.value)
+        assert mc_dispatch.dispatch_aborts.get_value() > before_aborts
+        assert wait_until(
+            lambda: mc_dispatch.active_sessions() == 0, timeout=10
+        )
+
+
+# ---------------------------------------------------------------------------
+# retry budget (SRE-style token bucket on the Channel)
+# ---------------------------------------------------------------------------
+
+
+class TestRetryBudget:
+    def test_token_bucket_unit(self):
+        from incubator_brpc_tpu.rpc.channel import (
+            _RETRY_BUDGET_CAP,
+            RetryBudget,
+        )
+
+        b = RetryBudget(0.5)
+        for _ in range(int(_RETRY_BUDGET_CAP)):
+            assert b.acquire(ErrorCode.EFAILEDSOCKET)
+        assert not b.acquire(ErrorCode.EFAILEDSOCKET)  # drained
+        # deposits refill at the ratio: 4 calls fund 2 retries
+        for _ in range(4):
+            b.on_call()
+        assert b.balance() == pytest.approx(2.0)
+        assert b.acquire(ErrorCode.EFAILEDSOCKET)
+        assert b.acquire(ErrorCode.EFAILEDSOCKET)
+        assert not b.acquire(ErrorCode.EFAILEDSOCKET)
+        # the cap bounds accumulation
+        for _ in range(10_000):
+            b.on_call()
+        assert b.balance() == pytest.approx(_RETRY_BUDGET_CAP)
+
+    def test_exempt_codes_never_draw(self):
+        from incubator_brpc_tpu.rpc.channel import (
+            RETRY_BUDGET_EXEMPT,
+            RetryBudget,
+        )
+
+        b = RetryBudget(0.1)
+        while b.acquire(ErrorCode.EFAILEDSOCKET):
+            pass  # drain it
+        for code in RETRY_BUDGET_EXEMPT:
+            assert b.acquire(code)  # exempt: passes without a token
+        assert b.balance() < 1.0
+        assert {
+            ErrorCode.EDEADLINE, ErrorCode.ESESSION, ErrorCode.ELIMIT
+        } == set(RETRY_BUDGET_EXEMPT)
+
+    def test_zero_ratio_disables(self, flags):
+        from incubator_brpc_tpu.rpc.channel import RetryBudget
+
+        b = RetryBudget(0.0)
+        for _ in range(200):
+            assert b.acquire(ErrorCode.EFAILEDSOCKET)
+
+    def test_exhaustion_fails_fast_with_original_error(self, flags):
+        """A drained budget means the FIRST error settles the call — no
+        retry storm — and the error text says why."""
+        from incubator_brpc_tpu.rpc.channel import retry_budget_exhausted
+
+        srv = Server()
+        srv.add_service("e", {"m": lambda c, r: b"ok"})
+        assert srv.start(0)
+        port = srv.port
+        srv.stop()
+        srv.join(timeout=5)  # the port now refuses connections
+
+        ch = Channel()
+        assert ch.init(
+            f"127.0.0.1:{port}",
+            options=ChannelOptions(
+                max_retry=3, timeout_ms=2000, connect_timeout=0.25
+            ),
+        )
+        # control: with budget, a connectivity failure burns its retries
+        cntl = ch.call_method("e", "m", b"x")
+        assert cntl.failed()
+        assert cntl.retried_count == 3, (
+            f"expected retries before exhaustion, got {cntl.retried_count}"
+        )
+        # drain the bucket below one token: the next failure cannot retry
+        before = retry_budget_exhausted.get_value()
+        with ch._retry_budget._lock:
+            ch._retry_budget._tokens = 0.2
+        cntl = ch.call_method("e", "m", b"x")
+        assert cntl.failed()
+        assert cntl.retried_count == 0, "budget-exhausted call retried"
+        assert "retry budget exhausted" in cntl.error_text
+        assert retry_budget_exhausted.get_value() > before
+
+    def test_budget_visible_in_vars(self, flags):
+        from incubator_brpc_tpu.bvar.variable import expose_registry
+
+        names = dict(expose_registry.snapshot())
+        assert "retry_budget_tokens" in names
+        assert "retry_budget_exhausted" in names
+        srv = Server()
+        srv.add_service("e", {"m": lambda c, r: b"ok"})
+        assert srv.start(0)
+        try:
+            ch = Channel()
+            assert ch.init(f"127.0.0.1:{srv.port}")
+            assert ch.call_method("e", "m", b"x").ok()
+            from incubator_brpc_tpu.rpc.channel import retry_budget_tokens
+
+            # the live channel's full bucket shows up in the aggregate
+            assert retry_budget_tokens.get_value() >= 50.0
+        finally:
+            srv.stop()
+            srv.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# lame-duck drain covers open streaming RPCs
+# ---------------------------------------------------------------------------
+
+
+class TestLameDuckStreamDrain:
+    @pytest.fixture
+    def stream_server(self):
+        from incubator_brpc_tpu.rpc import StreamHandler, StreamOptions, stream_accept
+
+        class Recorder(StreamHandler):
+            def __init__(self):
+                self.closed = threading.Event()
+
+            def on_closed(self, stream):
+                self.closed.set()
+
+        server = Server()
+        accepted = {}
+
+        def open_stream(cntl, request):
+            rec = Recorder()
+            s = stream_accept(cntl, StreamOptions(handler=rec))
+            assert s is not None
+            accepted["stream"], accepted["rec"] = s, rec
+            return b"accepted"
+
+        server.add_service("t", {"open_stream": open_stream})
+        assert server.start(0)
+        yield server, accepted, Recorder
+        server.stop()
+        server.join(timeout=5)
+
+    def _open(self, server, Recorder):
+        from incubator_brpc_tpu.rpc import StreamOptions, stream_create
+
+        rec = Recorder()
+        s = stream_create(StreamOptions(handler=rec))
+        ch = Channel()
+        assert ch.init(f"127.0.0.1:{server.port}")
+        cntl = ch.call_method("t", "open_stream", b"", request_stream=s)
+        assert cntl.ok(), cntl.error_text
+        assert s.wait_connected(5)
+        return s, rec
+
+    def test_drain_waits_for_stream_close(self, stream_server):
+        server, accepted, Recorder = stream_server
+        s, _rec = self._open(server, Recorder)
+        assert server._open_streams(), "server does not see its stream"
+        t0 = time.monotonic()
+        t = server.enter_lame_duck(grace_s=8.0)
+        assert t is not None
+        # the drain is blocked on the stream, not done instantly
+        time.sleep(0.4)
+        assert t.is_alive(), "drain finished under an open stream"
+        s.close()
+        t.join(timeout=6)
+        assert not t.is_alive()
+        # it proceeded on the close, long before grace expiry
+        assert time.monotonic() - t0 < 6.0
+        assert server._stopping
+
+    def test_grace_expiry_rsts_open_streams(self, stream_server):
+        server, accepted, Recorder = stream_server
+        s, rec = self._open(server, Recorder)
+        t = server.enter_lame_duck(grace_s=0.6)
+        assert t is not None
+        t.join(timeout=8)
+        assert not t.is_alive()
+        # the straggler stream died on a clean RST at grace expiry: the
+        # client handler observed the close instead of a dirty socket cut
+        assert rec.closed.wait(3), "client never saw the stream end"
+        from incubator_brpc_tpu.rpc import stream as stream_mod
+
+        assert s.state == stream_mod.CLOSED
+        assert server._stopping

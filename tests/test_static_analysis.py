@@ -938,6 +938,58 @@ class TestModelChecker:
             _fmt(res.violations)
         )
 
+    # -- the resume scope (elastic sessions: checkpoint/resume/replace) ----
+
+    def test_resume_scope_explores_exhaustively(self):
+        """The acceptance scope: step-granular progress, nondeterministic
+        per-party checkpointing, ≤1 death + ≤1 drop, the resume barrier
+        and the replacement join — exhaustively clean and well past 10k
+        states."""
+        from tools.fabricverify.models import ResumeSessionModel
+
+        res = modelcheck.explore(ResumeSessionModel(n_parties=3, steps=2))
+        assert not res.violations, _fmt(res.violations)
+        assert res.states > 10_000, res.states
+
+    def test_default_models_cover_resume_scope(self):
+        """make verify-models runs (and prints the state count of) the
+        resume scope by default."""
+        names = [m.name for m in modelcheck.default_models()]
+        assert "mc_dispatch_session_resume" in names
+
+    def test_max_resume_join_flips_red(self):
+        """Folding survivor watermarks with max instead of min elects a
+        resume point some survivor never checkpointed."""
+        from tools.fabricverify.models import ResumeSessionModel
+
+        res = modelcheck.explore(ResumeSessionModel(max_resume_join=True))
+        assert any(
+            v.rule == "model-unsafe" and "min-join" in v.message
+            for v in res.violations
+        ), _fmt(res.violations)
+
+    def test_skip_replacement_flips_red(self):
+        """Resuming without filling the dead slot re-runs steps with a
+        divergent party set — silently different math for axis-reducing
+        kernels."""
+        from tools.fabricverify.models import ResumeSessionModel
+
+        res = modelcheck.explore(ResumeSessionModel(skip_replacement=True))
+        assert any(
+            v.rule == "model-unsafe" and "divergent party set" in v.message
+            for v in res.violations
+        ), _fmt(res.violations)
+
+    def test_no_resume_timeout_deadlocks(self):
+        """A resume barrier without a drop backstop wedges the proposer
+        forever on one lost query/ack."""
+        from tools.fabricverify.models import ResumeSessionModel
+
+        res = modelcheck.explore(ResumeSessionModel(no_resume_timeout=True))
+        assert any(v.rule == "model-stuck" for v in res.violations), (
+            _fmt(res.violations)
+        )
+
     def test_counterexample_traces_attached(self):
         res = modelcheck.explore(SessionModel(drop_close_echo=True))
         v = next(v for v in res.violations if v.rule == "model-stuck")

@@ -38,7 +38,11 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from tools.fabricverify import REPO_ROOT, Violation
-from tools.fabricverify.models import BreakerModel, SessionModel
+from tools.fabricverify.models import (
+    BreakerModel,
+    ResumeSessionModel,
+    SessionModel,
+)
 
 _MAX_STATES = 500_000  # runaway-model backstop, far above the bounded scopes
 
@@ -171,6 +175,7 @@ def default_models() -> List[object]:
     return [
         SessionModel(n_parties=3, steps=2, floors=(0, 1, 3)),
         SessionModel(n_parties=3, steps=2, floors=(0, 1, 3), max_deaths=1),
+        ResumeSessionModel(n_parties=3, steps=2),
         BreakerModel(),
     ]
 
@@ -204,6 +209,10 @@ def main(argv=None) -> int:
             n_parties=args.parties, steps=args.steps, floors=floors,
             max_deaths=1,
         ),
+        # the resume scope's step-granular state space grows much faster
+        # than the base model's; its exhaustive scope is pinned at 2
+        # steps (≈430k states) regardless of --steps
+        ResumeSessionModel(n_parties=args.parties, steps=2),
         BreakerModel(),
     ]
     rc = 0

@@ -418,6 +418,444 @@ class SessionModel:
 
 
 # ---------------------------------------------------------------------------
+# mc_dispatch elastic resume protocol (the session model's resume scope)
+# ---------------------------------------------------------------------------
+
+# proposer phases of the resume scope
+R_RUN_WAIT, R_RESUME_WAIT, R_RUN2_WAIT, R_DONE, R_ABORTED = 0, 1, 2, 3, 4
+P_SPARE = 5  # a standby party outside the session
+
+
+class ResumeSessionModel:
+    """The elastic half of the session protocol
+    (parallel/mc_dispatch.py's checkpoint/resume/replacement plane),
+    modeled at step granularity:
+
+    - Parties run the lockstep chain one step at a time
+      (``collective_step`` fires only when EVERY slot's party is alive,
+      running, at the same step, in the same epoch — the device
+      collective's barrier).  Each party CHECKPOINTS nondeterministically
+      (``checkpoint_i`` lifts its watermark to its current step) — the
+      real code retains dispatch-time buffers whose READINESS lags, so
+      watermark skew across parties is inherent, not an error.
+    - The environment may kill ≤ ``max_deaths`` parties and drop ≤
+      ``max_drops`` messages (abort delivery stays reliable, as in the
+      base model — each party's own deadline is the real backstop).
+    - On detecting a death the proposer broadcasts ABORT (stamped with
+      the run EPOCH: stale aborts must not kill the healed run), then —
+      when a spare party is available — runs the RESUME BARRIER: query
+      every survivor's watermark, fold them with ``min`` (the dual of
+      the accept phase's max-join: a session can only resume from a
+      step EVERY survivor retained), bind the spare into the dead slot
+      bootstrapped at the resume point, and re-run epoch 1 from there.
+
+    Properties (each with a seeded mutation that flips it red):
+
+    - ``no_resume_timeout``: the resume barrier loses its drop backstop
+      — one dropped query/ack wedges the proposer forever
+      (``model-stuck`` under ≤1 death + ≤1 drop).
+    - ``max_resume_join``: the proposer folds watermarks with ``max`` —
+      the resume point exceeds some survivor's last checkpoint
+      (``model-unsafe``: resume point must be the min-join).
+    - ``skip_replacement``: the dead slot is never filled and survivors
+      step anyway — a resumed session re-runs steps with a DIVERGENT
+      party set, which for an axis-reducing kernel silently changes the
+      math (``model-unsafe``).
+    """
+
+    name = "mc_dispatch_session_resume"
+    source = "incubator_brpc_tpu/parallel/mc_dispatch.py"
+
+    M_RUN, M_RESP, M_ABORT, M_QUERY, M_QACK = 0, 1, 2, 3, 4
+
+    def __init__(
+        self,
+        n_parties: int = 3,
+        steps: int = 3,
+        max_drops: int = 1,
+        max_deaths: int = 1,
+        max_resume_join: bool = False,
+        skip_replacement: bool = False,
+        no_resume_timeout: bool = False,
+    ):
+        self.n = n_parties
+        self.steps = steps
+        self.max_drops = max_drops
+        self.max_deaths = max_deaths
+        self.max_resume_join = max_resume_join
+        self.skip_replacement = skip_replacement
+        self.no_resume_timeout = no_resume_timeout
+
+    # State = (phase, resume_pt, qacks, echoes, parties, spare_free,
+    #          msgs, drops, dead, deaths)
+    # - parties: per-slot (pphase, done, watermark, epoch)
+    # - qacks: per-slot survivor watermark answers while the resume
+    #   barrier gathers; at the fold they collapse into ``resume_pt`` =
+    #   (elected point, true min) and reset — keeping the whole answer
+    #   vector alive through the resumed run would multiply the space
+    #   for no property
+    # - echoes: per-slot close echoes for the CURRENT epoch
+    # - resume_pt: None until the resume barrier folded
+    # - msgs: sorted multiset of (kind, slot, value); delivery picks any
+    # Deaths are modeled at any instant the proposer still WAITS on the
+    # session (a death after it settled is outside the protocol).
+
+    def initial_state(self):
+        msgs = tuple(
+            sorted((self.M_RUN, i, (0, 0)) for i in range(self.n))
+        )
+        return (
+            R_RUN_WAIT,
+            None,
+            (None,) * self.n,
+            (None,) * self.n,
+            ((P_ACCEPTED, 0, 0, 0),) * self.n,
+            True,
+            msgs,
+            0,
+            (False,) * self.n,
+            0,
+        )
+
+    @staticmethod
+    def _without(msgs, m):
+        out = list(msgs)
+        out.remove(m)
+        return tuple(out)
+
+    @staticmethod
+    def _with(msgs, *new):
+        return tuple(sorted(msgs + tuple(new)))
+
+    def _abort_msgs(self, dead, epoch):
+        return tuple(
+            (self.M_ABORT, j, epoch) for j in range(self.n) if not dead[j]
+        )
+
+    def is_terminal(self, s) -> bool:
+        phase, _r, _q, _e, _p, _sf, msgs, _d, _dead, _dt = s
+        return phase in (R_DONE, R_ABORTED) and not msgs
+
+    def _cur_epoch(self, phase) -> int:
+        return 1 if phase in (R_RUN2_WAIT,) else 0
+
+    def actions(self, s) -> List[Tuple[str, tuple]]:
+        (phase, rpt, qacks, echoes, parties, spare_free, msgs, drops,
+         dead, deaths) = s
+        out: List[Tuple[str, tuple]] = []
+        # Partial-order reduction for the post-abort drain: once the
+        # proposer is R_ABORTED the plane is inert — every remaining
+        # delivery commutes (the epoch tombstone makes abort/run order
+        # irrelevant, RESP/QACK are ignored, QUERY answers don't change
+        # party state), so ONE canonical delivery order suffices and
+        # drops of never-read messages prove nothing.
+        drain = phase == R_ABORTED
+        for m in sorted(set(msgs)):
+            out.append((f"deliver{m}", self._deliver(s, m)))
+            if drain:
+                break
+            if m[0] != self.M_ABORT and drops < self.max_drops:
+                out.append(
+                    (f"drop{m}",
+                     (phase, rpt, qacks, echoes, parties, spare_free,
+                      self._without(msgs, m), drops + 1, dead, deaths))
+                )
+        # the environment kills a party at any instant the session is
+        # still in flight
+        if deaths < self.max_deaths and phase in (
+            R_RUN_WAIT, R_RESUME_WAIT, R_RUN2_WAIT
+        ):
+            for j in range(self.n):
+                if not dead[j]:
+                    out.append(
+                        (f"die{j}",
+                         (phase, rpt, qacks, echoes, parties, spare_free,
+                          msgs, drops,
+                          dead[:j] + (True,) + dead[j + 1:], deaths + 1))
+                    )
+        # one lockstep step: every slot's party alive, running, at the
+        # same step, in the same epoch.  The skip_replacement mutation
+        # relaxes the barrier to the ALIVE slots only — the bug where a
+        # "resumed" session quietly steps without the dead slot.  Each
+        # party independently may or may not CHECKPOINT the completed
+        # step (one branch per subset): the real rings retain
+        # dispatch-time buffers whose readiness lags, so watermark skew
+        # across parties is inherent — the min-join must absorb it.
+        active = [
+            (j, parties[j]) for j in range(self.n) if not dead[j]
+        ]
+        slots_ok = (not any(dead)) or self.skip_replacement
+        if active and slots_ok:
+            phases = {p[0] for _j, p in active}
+            dones = {p[1] for _j, p in active}
+            epochs = {p[3] for _j, p in active}
+            if (
+                phases == {P_RUNNING}
+                and len(dones) == 1
+                and len(epochs) == 1
+                and next(iter(dones)) < self.steps
+            ):
+                done = next(iter(dones)) + 1
+                # in the drain nobody will ever read a new checkpoint:
+                # skip the ckpt-subset branching (state pollution only)
+                masks = (0,) if drain else range(1 << len(active))
+                for mask in masks:
+                    newp = list(parties)
+                    newm = msgs
+                    for pos, (j, (pp, _d0, wm, pe)) in enumerate(active):
+                        ckpt = done if mask & (1 << pos) else wm
+                        if done == self.steps:
+                            newp[j] = (P_RAN, done, ckpt, pe)
+                            newm = self._with(
+                                newm, (self.M_RESP, j, (done, pe))
+                            )
+                        else:
+                            newp[j] = (P_RUNNING, done, ckpt, pe)
+                    out.append(
+                        (f"collective_step[ckpt_mask={mask}]",
+                         (phase, rpt, qacks, echoes, tuple(newp),
+                          spare_free, newm, drops, dead, deaths))
+                    )
+        # death detection → abort broadcast → resume barrier (with a
+        # spare) or plain abort (without)
+        if phase == R_RUN_WAIT:
+            waiting_on_dead = any(
+                dead[j] and echoes[j] is None for j in range(self.n)
+            )
+            if waiting_on_dead:
+                aborts = self._abort_msgs(dead, 0)
+                if spare_free:
+                    queries = tuple(
+                        (self.M_QUERY, j, 0)
+                        for j in range(self.n)
+                        if not dead[j]
+                    )
+                    out.append(
+                        ("detect_death_resume",
+                         (R_RESUME_WAIT, rpt, (None,) * self.n, echoes,
+                          parties, spare_free,
+                          self._with(msgs, *aborts, *queries), drops, dead,
+                          deaths))
+                    )
+                else:
+                    out.append(
+                        ("detect_death_abort",
+                         (R_ABORTED, rpt, qacks, echoes, parties,
+                          spare_free, self._with(msgs, *aborts), drops,
+                          dead, deaths))
+                    )
+        # the proposer's deadline: enabled only when the environment
+        # actually lost something (a drop-free path must progress through
+        # protocol actions alone).  The no_resume_timeout mutation strips
+        # the backstop from the resume barrier — one dropped query/ack
+        # then wedges the proposer forever.
+        timeout_phases = [R_RUN_WAIT, R_RUN2_WAIT]
+        if not self.no_resume_timeout:
+            timeout_phases.append(R_RESUME_WAIT)
+        if phase in timeout_phases and drops > 0:
+            ep = self._cur_epoch(phase)
+            out.append(
+                ("timeout",
+                 (R_ABORTED, rpt, qacks, echoes, parties, spare_free,
+                  self._with(msgs, *self._abort_msgs(dead, ep)), drops,
+                  dead, deaths))
+            )
+        return out
+
+    def _deliver(self, s, m) -> tuple:
+        (phase, rpt, qacks, echoes, parties, spare_free, msgs, drops,
+         dead, deaths) = s
+        msgs = self._without(msgs, m)
+        kind, i, val = m
+        same = (phase, rpt, qacks, echoes, parties, spare_free, msgs,
+                drops, dead, deaths)
+
+        if kind == self.M_ABORT:
+            if dead[i]:
+                return same
+            pphase, done, wm, ep = parties[i]
+            # epoch guard: a straggler abort from the superseded run must
+            # not kill the healed run's party.  The abort also leaves its
+            # epoch as a TOMBSTONE (ep = max(ep, abort epoch)): a run
+            # proposal of an epoch ≤ it arriving later must not start a
+            # zombie chain — the race the real code closes with
+            # mc_dispatch's _aborted_epochs map.
+            if ep > val:
+                return same
+            stone = max(ep, val)
+            newphase = (
+                P_ABORTED if pphase in (P_ACCEPTED, P_RUNNING) else pphase
+            )
+            # a left chain's progress counter is dead state: normalize it
+            # so death-timing variants collapse (the watermark stays —
+            # that ring is what a resume restores from)
+            newdone = 0 if newphase == P_ABORTED else done
+            parties = (
+                parties[:i] + ((newphase, newdone, wm, stone),)
+                + parties[i + 1:]
+            )
+            return (phase, rpt, qacks, echoes, parties, spare_free, msgs,
+                    drops, dead, deaths)
+
+        if kind == self.M_RUN:
+            if dead[i]:
+                return same
+            start, ep = val
+            pphase, done, wm, pep = parties[i]
+            if pep > ep or pphase == P_SPARE:
+                return same  # stale proposal for a superseded epoch
+            if pphase in (P_ABORTED, P_RAN) and ep <= pep:
+                # tombstoned (or already-completed) at this epoch: only a
+                # genuinely newer run (the resume fan-out) re-enters
+                return same
+            if start > wm and start > 0:
+                # asked to resume from a step this party never
+                # checkpointed: clean reject (the min-join violation's
+                # observable symptom)
+                msgs = self._with(msgs, (self.M_RESP, i, (REJECT, ep)))
+                return (phase, rpt, qacks, echoes, parties, spare_free,
+                        msgs, drops, dead, deaths)
+            if start >= self.steps:
+                # resume point == the final step: zero steps to replay —
+                # the party echoes straight from its checkpoint (the real
+                # chain's empty range(resume_from, steps) loop)
+                parties = (
+                    parties[:i] + ((P_RAN, start, wm, ep),)
+                    + parties[i + 1:]
+                )
+                msgs = self._with(msgs, (self.M_RESP, i, (start, ep)))
+                return (phase, rpt, qacks, echoes, parties, spare_free,
+                        msgs, drops, dead, deaths)
+            parties = (
+                parties[:i] + ((P_RUNNING, start, wm, ep),)
+                + parties[i + 1:]
+            )
+            return (phase, rpt, qacks, echoes, parties, spare_free, msgs,
+                    drops, dead, deaths)
+
+        if kind == self.M_QUERY:
+            if dead[i]:
+                return same
+            _pp, _d, wm, _ep = parties[i]
+            msgs = self._with(msgs, (self.M_QACK, i, wm))
+            return (phase, rpt, qacks, echoes, parties, spare_free, msgs,
+                    drops, dead, deaths)
+
+        if kind == self.M_QACK:
+            if phase != R_RESUME_WAIT or qacks[i] is not None:
+                return same
+            qacks = qacks[:i] + (val,) + qacks[i + 1:]
+            alive = [j for j in range(self.n) if not dead[j]]
+            if all(qacks[j] is not None for j in alive):
+                # the resume barrier folded: min-join over the survivor
+                # watermarks (the max_resume_join mutation folds with max
+                # — electing a step some survivor cannot restore).  The
+                # answer vector collapses into (elected, true min): the
+                # property lives on, the space doesn't.
+                fold = max if self.max_resume_join else min
+                point = fold(qacks[j] for j in alive)
+                tmin = min(qacks[j] for j in alive)
+                newp = list(parties)
+                newdead = dead
+                if not self.skip_replacement:
+                    for j in range(self.n):
+                        if dead[j]:
+                            # the replacement: bootstrapped at the resume
+                            # point (its watermark IS the fetched shard)
+                            newp[j] = (P_ACCEPTED, 0, point, 1)
+                            newdead = (
+                                newdead[:j] + (False,) + newdead[j + 1:]
+                            )
+                    spare_free = False
+                runs = tuple(
+                    (self.M_RUN, j, (point, 1))
+                    for j in range(self.n)
+                    if not newdead[j]
+                )
+                return (
+                    R_RUN2_WAIT, (point, tmin), (None,) * self.n,
+                    (None,) * self.n, tuple(newp), spare_free,
+                    self._with(msgs, *runs), drops, newdead, deaths,
+                )
+            return (phase, rpt, qacks, echoes, parties, spare_free, msgs,
+                    drops, dead, deaths)
+
+        # M_RESP
+        steps_val, ep = val
+        if (
+            phase not in (R_RUN_WAIT, R_RUN2_WAIT)
+            or ep != self._cur_epoch(phase)
+            or echoes[i] is not None
+        ):
+            return same
+        if steps_val == REJECT:
+            return (R_ABORTED, rpt, qacks, echoes, parties, spare_free,
+                    self._with(msgs, *self._abort_msgs(dead, ep)), drops,
+                    dead, deaths)
+        echoes = echoes[:i] + (steps_val,) + echoes[i + 1:]
+        if all(e is not None for e in echoes):
+            if all(e == self.steps for e in echoes):
+                return (R_DONE, rpt, qacks, echoes, parties, spare_free,
+                        msgs, drops, dead, deaths)
+            return (R_ABORTED, rpt, qacks, echoes, parties, spare_free,
+                    self._with(msgs, *self._abort_msgs(dead, ep)), drops,
+                    dead, deaths)
+        return (phase, rpt, qacks, echoes, parties, spare_free, msgs,
+                drops, dead, deaths)
+
+    # -- properties ----------------------------------------------------------
+
+    def invariant(self, s) -> str:
+        (phase, rpt, _q, _e, parties, _sf, _m, _d, dead, _dt) = s
+        if rpt is not None:
+            point, true_min = rpt
+            if point != true_min:
+                return (
+                    f"resume point {point} is not the min-join over the "
+                    f"survivor watermarks (true min {true_min}) — some "
+                    "survivor never checkpointed the elected step"
+                )
+            if any(dead):
+                for j, (pphase, done, _wm, ep) in enumerate(parties):
+                    if not dead[j] and ep == 1 and done > point:
+                        return (
+                            f"resumed session re-ran step(s) past "
+                            f"{point} with a divergent party set (dead "
+                            "slot never replaced) — an axis-reducing "
+                            "kernel silently changes its math"
+                        )
+        return ""
+
+    def terminal_ok(self, s) -> str:
+        (phase, _r, _q, echoes, parties, _sf, _m, drops, dead,
+         deaths) = s
+        for i, (pphase, _d0, _wm, _ep) in enumerate(parties):
+            if pphase == P_RUNNING and not dead[i]:
+                return (
+                    f"party {i} is alive and still stuck in the lockstep "
+                    "barrier at session end — the abort never reached it"
+                )
+        if phase == R_DONE:
+            if any(e != self.steps for e in echoes):
+                return (
+                    f"session closed DONE with echoes {echoes}, expected "
+                    f"every party to echo {self.steps}"
+                )
+        if drops == 0 and deaths == 0 and phase != R_DONE:
+            return (
+                "drop-free, death-free path ended without a converged "
+                f"close (proposer phase {phase})"
+            )
+        if drops == 0 and deaths <= self.max_deaths and phase != R_DONE:
+            return (
+                f"a path with {deaths} death(s), zero drops and a spare "
+                f"party available ended {phase} instead of healing — "
+                "the elastic resume failed to complete"
+            )
+        return ""
+
+
+# ---------------------------------------------------------------------------
 # circuit-breaker state machine
 # ---------------------------------------------------------------------------
 
