@@ -418,6 +418,152 @@ def bench_native_plane(results: dict) -> None:
     bench_native_scaling(results)
 
 
+def bench_prpc_production(results: dict) -> None:
+    """Production-shaped PRPC traffic on the native plane: compressed
+    and/or authenticated 4 KiB echo floods, all-C++ end to end (codec +
+    auth seam live in src/tbnet since this row exists). Rows:
+    - prpc_plain_4k_pump_ns: the bare same-size comparable;
+    - prpc_compressed_pump_ns: snappy, compressible 4 KiB (the ~2x-of-
+      bare acceptance row; used to pay the ~60x Python-route tax);
+    - prpc_compressed_incompressible_pump_ns: snappy over random bytes
+      (worst-case parse, no wire savings);
+    - prpc_auth_pump_ns: authenticated (token-table) flood, uncompressed;
+    - rpc_echo_prpc_snappy_us: the Python L5 Channel crossing with
+      compress+auth — and rpc_echo_prpc_snappy_python_us, the SAME wire
+      shape via the pure-Python plane (the before-number that makes the
+      60x→2x claim a measured delta)."""
+    from incubator_brpc_tpu.protocol import compress as compress_mod
+    from incubator_brpc_tpu.rpc import (
+        Channel,
+        ChannelOptions,
+        Controller,
+        Server,
+        ServerOptions,
+        TokenAuthenticator,
+        native_echo,
+    )
+    from incubator_brpc_tpu.transport import native_plane as np_mod
+
+    if not np_mod.NET_AVAILABLE:
+        return
+    token = "bench-token"
+    payload = (b"The quick brown fox jumps over the lazy dog. " * 92)[:4096]
+    incompressible = os.urandom(4096)
+
+    def make_server(**kw):
+        srv = Server(
+            ServerOptions(usercode_inline=True, native_loops=1, **kw)
+        )
+        srv.add_service("bench", {"echo": native_echo})
+        assert srv.start(0)
+        return srv
+
+    def pump_row(name, port, data, compress="", auth=""):
+        nch = np_mod.NativeClientChannel(
+            "127.0.0.1", port, protocol="baidu_std"
+        )
+        try:
+            if auth:
+                nch.set_auth(auth)
+            wire = data
+            if compress:
+                nch.set_request_compress(compress)
+                wire = compress_mod.compress(compress, data)
+            nch.pump("bench", "echo", wire, 2000, inflight=64)  # warm
+            samples = [
+                nch.pump("bench", "echo", wire, 20000, inflight=128)
+                for _ in range(5)
+            ]
+            _record(name, samples)
+            results[name] = min(samples)
+        finally:
+            nch.close()
+
+    def echo_row(name, port, opts, n):
+        """L5 compressed-echo latency through whatever plane ``opts``
+        selects — one measurement discipline for the native row and the
+        pure-Python before-number, so they stay comparable."""
+        ch = Channel()
+        assert ch.init(f"127.0.0.1:{port}", options=opts)
+        for _ in range(50):
+            cntl = Controller()
+            cntl.compress_type = "snappy"
+            c = ch.call_method("bench", "echo", payload, cntl=cntl)
+            assert c.ok(), c.error_text
+        lat = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                cntl = Controller()
+                cntl.compress_type = "snappy"
+                if ch.call_method(
+                    "bench", "echo", payload, cntl=cntl
+                ).failed():
+                    raise AssertionError(f"{name} echo failed mid-run")
+            lat.append((time.perf_counter() - t0) / n * 1e6)
+        _record(name, lat)
+        results[name] = min(lat)
+
+    # the BARE comparable runs on a no-auth server: the plain row must
+    # measure neither codec nor credential work
+    bare = make_server(native_plane=True)
+    try:
+        pump_row("prpc_plain_4k_pump_ns", bare.port, payload)
+        assert bare._native_plane.stats()["cb_frames"] == 0
+    finally:
+        bare.stop()
+
+    server = make_server(
+        native_plane=True, auth=TokenAuthenticator([token])
+    )
+    try:
+        pump_row(
+            "prpc_compressed_pump_ns", server.port, payload,
+            compress="snappy", auth=token,
+        )
+        pump_row(
+            "prpc_compressed_incompressible_pump_ns", server.port,
+            incompressible, compress="snappy", auth=token,
+        )
+        pump_row("prpc_auth_pump_ns", server.port, payload, auth=token)
+        results["prpc_compressed_vs_plain_ratio"] = (
+            results["prpc_compressed_pump_ns"]
+            / results["prpc_plain_4k_pump_ns"]
+        )
+        # the whole flood stayed off the interpreter — the claim behind
+        # every row above
+        assert server._native_plane.stats()["cb_frames"] == 0
+        echo_row(
+            "rpc_echo_prpc_snappy_us",
+            server.port,
+            ChannelOptions(
+                native_plane=True,
+                protocol="baidu_std",
+                auth=TokenAuthenticator([token]),
+            ),
+            n=500,
+        )
+    finally:
+        server.stop()
+
+    # the before-number: the SAME compressed+authenticated wire shape
+    # through the pure-Python plane end to end (Python acceptor, Socket
+    # reactor, Python codecs) — what this traffic paid before the native
+    # codec/auth seam existed
+    pyserver = make_server(auth=TokenAuthenticator([token]))
+    try:
+        echo_row(
+            "rpc_echo_prpc_snappy_python_us",
+            pyserver.port,
+            ChannelOptions(
+                protocol="baidu_std", auth=TokenAuthenticator([token])
+            ),
+            n=300,
+        )
+    finally:
+        pyserver.stop()
+
+
 def bench_native_scaling(results: dict) -> None:
     """Reactors × connections scaling matrix (the reference's per-thread
     scaling table, docs/cn/benchmark.md:112-122): R per-core reactors
@@ -772,6 +918,7 @@ BASELINES = {
     "native_pump_notes": "template-pack + pooled body reuse + meta memo; 1 shared core, both sides",
     "native_pump_scaling": "r05 one-core baseline: 544 ns/echo, ~1.9 M qps with client AND server sharing ONE core, and BENCH_r04's flat 1/2/4-conn curve (~1 M qps each — one loop thread was the ceiling). The matrix is R reactors x C connections (aggregate qps); scaling_efficiency = best 4-reactor / best 1-reactor. The reference scales 3-5 M qps/thread across 24 cores (docs/cn/benchmark.md:112-122); on this host the reachable ratio is capped by host_cpus, since the C client pumps burn the same cores the reactors serve from",
     "prpc_pump_telemetry": "prpc_pump_ns runs with the native telemetry ring ON (the default: per-method latency + sampled rpcz + limiter feedback recorded in-path); prpc_pump_notelem_ns is the same pump ring-less — the delta is the instrumentation tax (acceptance < 5%)",
+    "prpc_production_shaped": "compressed and/or authenticated PRPC floods ride the native codec/auth seam end to end (PR 11); BEFORE this seam the same wire shape fell off to the ~35 us Python route — r05-era context: prpc_pump_ns 544 ns vs rpc-over-Python ~35 us, a ~60x tax on production-shaped traffic. Measured on this 2-core container at introduction (host_calibration_ms ~6.4): prpc_plain_4k_pump_ns ~2.3 us, prpc_compressed_pump_ns (snappy+auth, 4 KiB compressible) ~4.2-4.8 us = ~1.9-2.0x of the bare same-size pump (acceptance ~2x; incompressible ~1.3x, auth-only within noise of bare — the steady-state token check is one cached-verdict load), the L5 crossing rpc_echo_prpc_snappy_us ~130 us, and rpc_echo_prpc_snappy_python_us ~950 us — the Python-plane before-number for the SAME wire shape, ~200x the interpreter-free pump and ~7x the native L5 row; compare medians WITH host_calibration_ms context per the PR 10 re-anchor note",
 }
 
 
@@ -783,6 +930,7 @@ def main() -> None:
     bench_device_echo(results)
     bench_rpc_echo(results)
     bench_native_plane(results)
+    bench_prpc_production(results)
     bench_device_rpc(results)
     bench_device_link(results)
     bench_fabricnet(results)
@@ -815,6 +963,42 @@ def main() -> None:
                     ),
                     "prpc_pump_ns": round(results.get("prpc_pump_ns", 0)) or None,
                     "prpc_pump_qps": round(results.get("prpc_pump_qps", 0)) or None,
+                    # production-shaped traffic on the native plane
+                    "prpc_plain_4k_pump_ns": (
+                        round(results.get("prpc_plain_4k_pump_ns", 0)) or None
+                    ),
+                    "prpc_compressed_pump_ns": (
+                        round(results.get("prpc_compressed_pump_ns", 0))
+                        or None
+                    ),
+                    "prpc_compressed_incompressible_pump_ns": (
+                        round(
+                            results.get(
+                                "prpc_compressed_incompressible_pump_ns", 0
+                            )
+                        )
+                        or None
+                    ),
+                    "prpc_auth_pump_ns": (
+                        round(results.get("prpc_auth_pump_ns", 0)) or None
+                    ),
+                    "prpc_compressed_vs_plain_ratio": (
+                        round(
+                            results.get("prpc_compressed_vs_plain_ratio", 0), 2
+                        )
+                        or None
+                    ),
+                    "rpc_echo_prpc_snappy_us": (
+                        round(results.get("rpc_echo_prpc_snappy_us", 0.0), 1)
+                        or None
+                    ),
+                    "rpc_echo_prpc_snappy_python_us": (
+                        round(
+                            results.get("rpc_echo_prpc_snappy_python_us", 0.0),
+                            1,
+                        )
+                        or None
+                    ),
                     # the same pump without the completion-record ring:
                     # prpc_pump_ns minus this is the telemetry tax
                     "prpc_pump_notelem_ns": (
@@ -914,6 +1098,10 @@ def main() -> None:
                     "small_frame_us": round(results["small_frame_us"], 2),
                     "native_pump_ns": round(results.get("native_pump_ns", 0)) or None,
                     "prpc_pump_ns": round(results.get("prpc_pump_ns", 0)) or None,
+                    "prpc_compressed_pump_ns": (
+                        round(results.get("prpc_compressed_pump_ns", 0))
+                        or None
+                    ),
                     "rpc_echo_us": round(results.get("rpc_echo_us", 0.0), 1) or None,
                     "rpc_echo_qps": round(results.get("rpc_echo_qps", 0)) or None,
                     "stream_gbps": round(results["stream_gbps"], 3),

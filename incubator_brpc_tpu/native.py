@@ -89,6 +89,17 @@ HANDOFF_FN = ctypes.CFUNCTYPE(
     ctypes.c_size_t,  # buffered len
 )
 CLOSED_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_uint64)
+# credential verifier (tb_server_set_auth): int (*)(void* ud,
+# const char* auth_data, size_t auth_len, const char* peer_ip, int port)
+# — auth_data is a raw pointer (may contain NULs), hence c_void_p + len
+AUTH_FN = ctypes.CFUNCTYPE(
+    ctypes.c_int,
+    ctypes.c_void_p,  # ud
+    ctypes.c_void_p,  # auth data ptr
+    ctypes.c_size_t,  # auth data len
+    ctypes.c_char_p,  # peer ip (NUL-terminated textual)
+    ctypes.c_int,  # peer port
+)
 
 
 # The declared C ABI: name -> (restype, argtypes), one entry per
@@ -252,6 +263,21 @@ SIGNATURES = {
     "tb_server_set_handoff_cb": (None, [b, HANDOFF_FN, ctypes.c_void_p]),
     "tb_server_set_closed_cb": (None, [b, CLOSED_FN, ctypes.c_void_p]),
     "tb_server_set_max_body": (None, [b, ctypes.c_size_t]),
+    # production-shaped traffic knobs: response-compression floor,
+    # decompress-bomb ceiling, and the auth seam (verifier callback or
+    # constant-time token table; rejects answered ERPCAUTH natively)
+    "tb_server_set_compress_min_bytes": (None, [b, ctypes.c_size_t]),
+    "tb_server_set_max_decompress": (None, [b, ctypes.c_size_t]),
+    "tb_server_set_auth": (ctypes.c_int, [b, AUTH_FN, ctypes.c_void_p]),
+    "tb_server_set_auth_tokens": (
+        ctypes.c_int,
+        [b, ctypes.c_char_p, ctypes.c_size_t],
+    ),
+    "tb_server_auth_rejects": (ctypes.c_uint64, [b]),
+    "tb_server_compress_stats": (
+        None,
+        [b] + [ctypes.POINTER(ctypes.c_uint64)] * 4,
+    ),
     "tb_server_get_native_max_concurrency": (
         ctypes.c_long,
         [b, ctypes.c_char_p],
@@ -330,6 +356,8 @@ SIGNATURES = {
         [ctypes.c_uint64, ctypes.c_char_p, ctypes.c_size_t],
     ),
     "tb_conn_close": (ctypes.c_int, [ctypes.c_uint64]),
+    # cache a Python-route auth verdict on the C++ conn (fast-path reuse)
+    "tb_conn_set_authenticated": (ctypes.c_int, [ctypes.c_uint64]),
     "tb_channel_connect": (
         b,
         [ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
@@ -338,6 +366,13 @@ SIGNATURES = {
     # wire protocol: 0 = tbus_std (default), 1 = baidu_std (PRPC);
     # must be set before the first send
     "tb_channel_set_protocol": (ctypes.c_int, [b, ctypes.c_int]),
+    # channel-default request compress_type (RpcMeta field 3; caller
+    # compresses payloads) and the first-request credential (field 7)
+    "tb_channel_set_compress": (ctypes.c_int, [b, ctypes.c_int]),
+    "tb_channel_set_auth": (
+        ctypes.c_int,
+        [b, ctypes.c_void_p, ctypes.c_size_t],
+    ),
     # counter-scheduled client fault injection (fail/close/delay every
     # Nth call; the native analog of the Socket.write seam)
     "tb_channel_set_fault": (
@@ -407,6 +442,16 @@ SIGNATURES = {
             ctypes.c_int,
             ctypes.c_int,
         ],
+    ),
+    # ---- codec table (protocol/compress.py prefers these over its
+    # pure-Python twins so both planes run the identical codec) ----
+    "tb_codec_compress": (
+        ctypes.c_long,
+        [ctypes.c_int, ctypes.c_void_p, ctypes.c_size_t, b],
+    ),
+    "tb_codec_decompress": (
+        ctypes.c_long,
+        [ctypes.c_int, ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t, b],
     ),
     # ---- work-stealing deque (Chase–Lev; the dispatch pool's queue) ----
     "tb_wsq_create": (b, [ctypes.c_size_t]),

@@ -276,7 +276,13 @@ def rpc_meta_to_meta(rm: RpcMeta) -> Meta:
     meta = Meta(
         service=rm.service_name,
         method=rm.method_name,
-        compress=_WIRE_TO_COMPRESS.get(rm.compress_type, ""),
+        # out-of-enum compress values surface as an unknown codec NAME so
+        # the decompress step rejects them cleanly (EREQUEST) instead of
+        # silently treating the payload as uncompressed; the native plane
+        # answers the identical error text for the identical wire value
+        compress=_WIRE_TO_COMPRESS.get(
+            rm.compress_type, f"wire-{rm.compress_type}"
+        ),
         attachment_size=rm.attachment_size,
         timeout_ms=rm.timeout_ms,
         log_id=rm.log_id,

@@ -2,15 +2,33 @@
 registered in global.cpp:342-354 for COMPRESS_TYPE_{GZIP,ZLIB,SNAPPY}).
 
 Codecs are named strings carried in Meta.compress; both sides look the name
-up here. A name always identifies exactly one algorithm ("snappy" exists
-only when the real library does; "zlib1" is the built-in cheap/fast codec).
+up here. A name always identifies exactly one algorithm.
+
+Two disciplines this registry enforces for the whole stack:
+
+- **Determinism + cross-plane byte-identity.**  The native plane
+  (src/tbnet) implements the same codecs in C++ and its output must be
+  byte-for-byte equal to this module's.  gzip therefore pins ``mtime=0``
+  (a wall-clock mtime would make even two Python compressions of the
+  same bytes differ), and "snappy" is the portable block-format encoder
+  in protocol/snappy_codec.py whose greedy parse the C++ encoder mirrors
+  line for line — NOT python-snappy, whose C encoder makes different
+  (legal) parse choices.
+
+- **A decompressed-size ceiling on every codec** (``max_decompress_bytes``
+  flag): a 100-byte bomb must not expand unbounded into server memory on
+  EITHER plane.  gzip/zlib decompress through a bounded decompressobj
+  loop; snappy rejects on its length preamble before any expansion.  The
+  ceiling error text is deterministic, so the native plane rejects
+  byte-identically.
 """
 
 from __future__ import annotations
 
-import gzip as _gzip
 import zlib as _zlib
 from typing import Callable, Dict, Tuple
+
+from incubator_brpc_tpu.protocol import snappy_codec as _snappy
 
 _codecs: Dict[str, Tuple[Callable[[bytes], bytes], Callable[[bytes], bytes]]] = {}
 
@@ -49,15 +67,118 @@ def decompress(name: str, data: bytes) -> bytes:
     return d(data)
 
 
-register_codec("gzip", lambda b: _gzip.compress(b, 6), _gzip.decompress)
-register_codec("zlib", lambda b: _zlib.compress(b, 6), _zlib.decompress)
-# "zlib1" fills snappy's cheap-and-fast role. "snappy" itself registers only
-# when the real library exists — a codec name must always identify exactly
-# one algorithm, or two peers with different installs mis-decompress.
-register_codec("zlib1", lambda b: _zlib.compress(b, 1), _zlib.decompress)
-try:
-    import snappy as _snappy  # type: ignore
+def max_decompress_bytes() -> int:
+    """The decompress ceiling (0 = unlimited), read per call so tests and
+    operators can retune it at runtime."""
+    from incubator_brpc_tpu.utils.flags import get_flag
 
-    register_codec("snappy", _snappy.compress, _snappy.decompress)
-except ImportError:
-    pass
+    return int(get_flag("max_decompress_bytes"))
+
+
+def _bounded_inflate(data: bytes, wbits: int) -> bytes:
+    """zlib-family decompress that never expands past the ceiling: the
+    decompressobj is fed with max_length so output growth stops AT the
+    bound instead of after the allocation.  One member, no trailing
+    garbage (the native plane applies the same rules)."""
+    limit = max_decompress_bytes()
+    obj = _zlib.decompressobj(wbits)
+    out = bytearray()
+    chunk = data
+    while True:
+        budget = (limit - len(out) + 1) if limit else 0
+        out += obj.decompress(chunk, budget) if limit else obj.decompress(chunk)
+        if limit and len(out) > limit:
+            raise ValueError(
+                f"decompressed size exceeds max_decompress_bytes ({limit})"
+            )
+        if obj.eof:
+            if obj.unused_data:
+                raise ValueError("trailing garbage after compressed stream")
+            return bytes(out)
+        chunk = obj.unconsumed_tail
+        if not chunk:
+            raise ValueError("truncated compressed stream")
+
+
+# deterministic gzip container: fixed header (mtime=0, XFL=0, OS=255 —
+# the bytes CPython's gzip.compress(data, 6, mtime=0) emits), raw deflate
+# level 6, CRC32 + ISIZE trailer.  Built by hand so the bytes are pinned
+# by THIS code, not by gzip-module internals that may drift.
+_GZIP_HEADER = b"\x1f\x8b\x08\x00\x00\x00\x00\x00\x00\xff"
+
+
+def _gzip_compress(data: bytes) -> bytes:
+    obj = _zlib.compressobj(6, _zlib.DEFLATED, -15, 8, 0)
+    body = obj.compress(data) + obj.flush()
+    crc = _zlib.crc32(data) & 0xFFFFFFFF
+    isize = len(data) & 0xFFFFFFFF
+    return (
+        _GZIP_HEADER
+        + body
+        + crc.to_bytes(4, "little")
+        + isize.to_bytes(4, "little")
+    )
+
+
+def _native_codec_lib():
+    """libtbutil's tb_codec_* surface when loadable (None otherwise):
+    the SAME C++ codec table the native server plane runs, so preferring
+    it keeps the planes byte-identical while sparing the Python seam the
+    interpreter-speed snappy loops."""
+    from incubator_brpc_tpu import native
+
+    lib = native.LIB
+    return lib if lib is not None and hasattr(lib, "tb_codec_compress") else None
+
+
+_SNAPPY_WIRE = 1  # options.proto CompressType SNAPPY
+
+
+def _snappy_compress(data: bytes) -> bytes:
+    lib = _native_codec_lib()
+    if lib is None:
+        return _snappy.compress(data)
+    from incubator_brpc_tpu.iobuf import IOBuf
+
+    out = IOBuf()
+    data = bytes(data)
+    rc = lib.tb_codec_compress(_SNAPPY_WIRE, data, len(data), out._h)
+    if rc < 0:  # cannot happen for snappy compress; fail loudly anyway
+        raise ValueError(f"native snappy compress failed ({rc})")
+    return out.to_bytes()
+
+
+def _snappy_decompress(data: bytes) -> bytes:
+    limit = max_decompress_bytes()
+    lib = _native_codec_lib()
+    if lib is None:
+        return _snappy.decompress(data, max_out=limit)
+    from incubator_brpc_tpu.iobuf import IOBuf
+
+    out = IOBuf()
+    data = bytes(data)
+    rc = lib.tb_codec_decompress(_SNAPPY_WIRE, data, len(data), limit, out._h)
+    if rc == -2:
+        raise ValueError(
+            f"decompressed size exceeds max_decompress_bytes ({limit})"
+        )
+    if rc < 0:
+        # same text the native plane's reject uses, so corrupt-body
+        # errors read identically on both planes
+        raise ValueError("corrupt snappy body")
+    return out.to_bytes()
+
+
+register_codec("gzip", _gzip_compress, lambda b: _bounded_inflate(b, 16 + 15))
+register_codec(
+    "zlib", lambda b: _zlib.compress(b, 6), lambda b: _bounded_inflate(b, 15)
+)
+# "zlib1" is the cheap/fast zlib variant (wire CompressType ZLIB).
+register_codec(
+    "zlib1", lambda b: _zlib.compress(b, 1), lambda b: _bounded_inflate(b, 15)
+)
+# snappy: always available — the portable block codec (snappy_codec.py)
+# needs no library, and the native tb_codec seam is preferred when
+# loadable; both make the identical parse choices, so the output bytes
+# are the same either way (tests assert it).
+register_codec("snappy", _snappy_compress, _snappy_decompress)

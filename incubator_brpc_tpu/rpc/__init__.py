@@ -18,6 +18,7 @@ from incubator_brpc_tpu.rpc.server import (
 from incubator_brpc_tpu.rpc.auth import (
     Authenticator,
     SharedSecretAuthenticator,
+    TokenAuthenticator,
 )
 from incubator_brpc_tpu.rpc.combo import (
     CallMapper,
@@ -69,6 +70,7 @@ __all__ = [
     "Channel",
     "DynamicPartitionChannel",
     "SharedSecretAuthenticator",
+    "TokenAuthenticator",
     "ChannelOptions",
     "Controller",
     "start_cancel",

@@ -80,6 +80,38 @@ class SharedSecretAuthenticator(Authenticator):
         return False
 
 
+class TokenAuthenticator(Authenticator):
+    """Static bearer-token table — the authenticator shape the native
+    plane verifies WITHOUT the interpreter: ``native_tokens()`` hands the
+    accepted credential strings to src/tbnet's constant-time token table
+    (tb_server_set_auth_tokens), so an authenticated flood never leaves
+    the C++ plane.  The Python side verifies the same table with
+    constant-time compares, so both planes accept exactly the same
+    credentials.  Rotate by listing old + new tokens during the window."""
+
+    def __init__(self, tokens, identity: str = "client"):
+        toks = [tokens] if isinstance(tokens, str) else list(tokens)
+        if not toks:
+            raise ValueError("TokenAuthenticator needs at least one token")
+        self._tokens = [str(t) for t in toks]
+        self.identity = identity
+
+    def generate_credential(self) -> str:
+        return self._tokens[0]
+
+    def verify_credential(self, auth_str: str, remote_side) -> bool:
+        cred = (auth_str or "").encode()
+        ok = False
+        for t in self._tokens:  # constant-time per token, no short-circuit
+            ok |= hmac.compare_digest(t.encode(), cred)
+        return ok
+
+    def native_tokens(self):
+        """The credential strings the C++ plane's constant-time table
+        accepts (transport/native_plane._configure_auth)."""
+        return list(self._tokens)
+
+
 def _clear_on_revive(sock) -> None:
     # a revived Socket is a NEW connection: the server side has no
     # 'authenticated' mark, so the credential must be fought again
@@ -110,5 +142,10 @@ def server_check(meta, sock, auth: Optional[Authenticator]) -> bool:
     cred = meta.extra.get("auth", "")
     if auth.verify_credential(cred, sock.remote):
         sock.context["authenticated"] = True
+        # a NativeConnSock pushes the verdict down to the C++ conn so the
+        # connection's later frames ride the native fast path
+        notify = getattr(sock, "mark_native_authenticated", None)
+        if notify is not None:
+            notify()
         return True
     return False

@@ -591,6 +591,10 @@ class Channel:
     # -- native fast path ----------------------------------------------------
 
     def _native_eligible(self, cntl: Controller) -> bool:
+        from incubator_brpc_tpu.transport.native_plane import (
+            _NATIVE_COMPRESS_WIRE,
+        )
+
         return (
             self._single_server is not None
             and not self._single_server.ip.startswith("unix://")
@@ -599,9 +603,24 @@ class Channel:
             # the two protocols the C++ channel packs natively (tbnet.h);
             # baidu_std rides the same fast path with wire-exact PRPC bytes
             and self._options.protocol in ("tbus_std", "baidu_std")
-            and self._options.auth is None
+            # auth and compression ride the fast path on baidu_std: the
+            # credential stamps RpcMeta field 7 (first-request fight in
+            # C++), compressed payloads stamp field 3 and the server's
+            # native codec table answers in kind.  tbus_std carries both
+            # in JSON meta the Python route owns, so it keeps the old
+            # gates.
+            and (
+                self._options.auth is None
+                or self._options.protocol == "baidu_std"
+            )
             and self._options.connection_type in ("single", "pooled")
-            and not cntl.compress_type
+            and (
+                not cntl.compress_type
+                or (
+                    self._options.protocol == "baidu_std"
+                    and cntl.compress_type in _NATIVE_COMPRESS_WIRE
+                )
+            )
             and not (cntl.backup_request_ms and cntl.backup_request_ms > 0)
             and not cntl._force_host
         )
@@ -616,7 +635,7 @@ class Channel:
         if cached is not None:
             cached.close()
         try:
-            return np_mod.NativeClientChannel(
+            nch = np_mod.NativeClientChannel(
                 self._single_server.ip,
                 self._single_server.port,
                 connect_timeout_ms=int(self._options.connect_timeout * 1000),
@@ -624,6 +643,22 @@ class Channel:
             )
         except OSError:
             return None
+        if (
+            self._options.auth is not None
+            and self._options.protocol == "baidu_std"
+        ):
+            # fresh connection, fresh credential: the C++ channel stamps
+            # it until the first successful response proves the conn
+            # (attach_credential's fight, natively)
+            try:
+                nch.set_auth(self._options.auth.generate_credential())
+            except Exception:
+                logger.exception(
+                    "generate_credential failed; native path disabled"
+                )
+                nch.close()
+                return None
+        return nch
 
     def _native_channel(self):
         from incubator_brpc_tpu.transport import native_plane as np_mod
@@ -679,15 +714,21 @@ class Channel:
             or preset_trace
             or in_trace_context()
         )
+        request_wire = request
+        if cntl.compress_type:
+            # same codec registry the server's C++ table mirrors: the
+            # compressed bytes are identical on both planes
+            request_wire = compress_mod.compress(cntl.compress_type, request)
         rc, err_code, resp_meta, body = nch.call(
             service,
             method,
-            request,
+            request_wire,
             attachment,
             timeout_ms=cntl.timeout_ms,
             log_id=cntl.log_id if traced else 0,
             trace_id=cntl.trace_id if traced else 0,
             span_id=cntl.span_id if traced else 0,
+            compress=cntl.compress_type or "",
         )
         if rc < 0:
             if rc == -_errno.ETIMEDOUT:
@@ -737,10 +778,25 @@ class Channel:
                 cntl.set_failed(ErrorCode.ERESPONSE, "attachment exceeds body")
             else:
                 cntl.response_meta = meta
-                cntl.response_payload = body.to_bytes(blen - att)
-                cntl.response_attachment = (
-                    body.to_bytes(att, pos=blen - att) if att else b""
-                )
+                payload = body.to_bytes(blen - att)
+                if meta is not None and meta.compress:
+                    # the server recompressed the response (floor
+                    # permitting): decompress like the Python plane's
+                    # response path
+                    try:
+                        payload = compress_mod.decompress(
+                            meta.compress, payload
+                        )
+                    except Exception as e:
+                        cntl.set_failed(
+                            ErrorCode.ERESPONSE, f"decompress failed: {e}"
+                        )
+                        payload = None
+                if payload is not None:
+                    cntl.response_payload = payload
+                    cntl.response_attachment = (
+                        body.to_bytes(att, pos=blen - att) if att else b""
+                    )
         cntl._mark_end()
         if cntl._span is not None:
             end_client_span(cntl)

@@ -1742,10 +1742,18 @@ class Server:
         elif not failed and cntl._accepted_stream_id:
             meta = Meta(stream_id=cntl._accepted_stream_id)
         if payload and cntl.compress_type:
-            if meta is None:
-                meta = Meta()
-            meta.compress = cntl.compress_type
-            payload = compress_mod.compress(cntl.compress_type, payload)
+            from incubator_brpc_tpu.utils.flags import get_flag
+
+            # response-compression floor (native_compress_min_bytes):
+            # tiny payloads skip the codec and travel uncompressed — the
+            # same floor the native plane applies, so the planes answer
+            # byte-identically (the reference's response_compress_type
+            # discipline)
+            if len(payload) >= int(get_flag("native_compress_min_bytes")):
+                if meta is None:
+                    meta = Meta()
+                meta.compress = cntl.compress_type
+                payload = compress_mod.compress(cntl.compress_type, payload)
         attachment = b"" if failed else cntl.response_attachment
         if attachment and meta is None:
             meta = Meta()

@@ -44,7 +44,13 @@ from incubator_brpc_tpu.bvar import (
     LatencyRecorder,
     PassiveStatus,
 )
-from incubator_brpc_tpu.native import CLOSED_FN, FRAME_FN, HANDOFF_FN, LIB
+from incubator_brpc_tpu.native import (
+    AUTH_FN,
+    CLOSED_FN,
+    FRAME_FN,
+    HANDOFF_FN,
+    LIB,
+)
 from incubator_brpc_tpu.utils.endpoint import EndPoint
 from incubator_brpc_tpu.utils.status import ErrorCode
 
@@ -61,9 +67,17 @@ _FLAG_STREAM = 2
 # internal callback-only flag from tbnet.cc: the frame arrived on a
 # baidu_std (PRPC) connection and its meta is RpcMeta proto bytes
 _FLAG_WIRE_PRPC = 0x100
+# internal callback-only flag: the connection's credential was verified
+# on the native plane — server_check honors the cached verdict
+_FLAG_CONN_AUTHED = 0x200
 
 # tb_channel_set_protocol values (tbnet.h)
 _CH_PROTO = {"tbus_std": 0, "baidu_std": 1}
+
+# wire CompressType <-> codec names the native plane implements (the
+# baidu_std table restricted to what the C++ codec table speaks)
+_NATIVE_COMPRESS_WIRE = {"snappy": 1, "gzip": 2, "zlib1": 3}
+_NATIVE_COMPRESS_NAMES = {v: k for k, v in _NATIVE_COMPRESS_WIRE.items()}
 
 # client fast-path instrumentation: per-call round-trip latency (Python
 # boundary included — the L5 crossing rpc_echo_us measures), transport
@@ -76,6 +90,43 @@ native_pump_ns = IntRecorder(name="native_pump_ns")
 # the same pipelined pump over the baidu_std (PRPC) wire — bench.py's
 # prpc_pump_ns row scrapes this
 prpc_pump_ns = IntRecorder(name="prpc_pump_ns")
+
+# process-wide compress/auth telemetry summed across every live native
+# plane (a stopping plane folds its final counts into the retired
+# tallies first, so neither gauge ever moves backwards)
+import weakref as _weakref  # noqa: E402  (module-bvar support)
+
+_planes_tally_lock = threading.Lock()
+_live_planes: "_weakref.WeakSet" = _weakref.WeakSet()
+_retired_compress_saved = 0
+_retired_auth_rejects = 0
+
+
+def _sum_compress_saved() -> int:
+    total = _retired_compress_saved
+    for plane in list(_live_planes):
+        st = plane.compress_stats()
+        total += max(0, st["in_raw"] - st["in_wire"])
+        total += max(0, st["out_raw"] - st["out_wire"])
+    return total
+
+
+def _sum_auth_rejects() -> int:
+    total = _retired_auth_rejects
+    for plane in list(_live_planes):
+        total += plane.stats().get("auth_rejects", 0)
+    return total
+
+
+# bytes kept OFF the wire by native codecs: (decompressed request bytes -
+# their wire bytes) + (raw response bytes - their wire bytes)
+native_compress_bytes_saved = PassiveStatus(
+    _sum_compress_saved, name="native_compress_bytes_saved"
+)
+# requests rejected ERPCAUTH by the native auth seam
+native_auth_rejects = PassiveStatus(
+    _sum_auth_rejects, name="native_auth_rejects"
+)
 
 
 def _native_kind(handler) -> Optional[int]:
@@ -229,6 +280,13 @@ class NativeConnSock:
                 logger.exception("on_failed callback raised")
         return True
 
+    def mark_native_authenticated(self) -> None:
+        """The Python route verified this connection's credential
+        (rpc/auth.server_check): cache the verdict on the C++ conn so its
+        later frames ride the native fast path without re-fighting auth."""
+        # fabriclint: allow(ffi-unchecked) -1 means the token went stale (conn died); there is nothing to cache on a dead connection
+        LIB.tb_conn_set_authenticated(self.token)
+
     def _mark_closed(self) -> None:
         """tbnet says the connection died: run failure hooks (streams)."""
         with self._state_lock:
@@ -291,6 +349,15 @@ class NativeServerPlane:
         LIB.tb_server_set_max_body(
             self._srv, int(get_flag("max_body_size")) + 64 * 1024
         )
+        # production-shaped traffic knobs, shared with the Python route so
+        # the planes answer byte-identically: the response-compression
+        # floor and the decompress-bomb ceiling
+        LIB.tb_server_set_compress_min_bytes(
+            self._srv, int(get_flag("native_compress_min_bytes"))
+        )
+        LIB.tb_server_set_max_decompress(
+            self._srv, int(get_flag("max_decompress_bytes"))
+        )
         # work-stealing dispatch pool for long-running / queue-pressured
         # native methods (0 = every native method runs inline)
         self._dispatch_workers = max(0, int(dispatch_workers))
@@ -348,15 +415,19 @@ class NativeServerPlane:
     def register_methods(self) -> None:
         """Register native-kind handlers (echo/nop) for pure-C++ dispatch;
         everything else stays on the per-frame Python route. Gates the
-        Python route enforces per request — the Authenticator and a
-        CONSTANT server-wide max_concurrency — cannot be skipped by a fast
-        path, so servers configured with either keep ALL methods on the
-        Python route (native kinds only elide work, never checks). A
-        server-wide "auto" limit is different: it IS enforceable natively,
-        as a per-method ceiling pushed through
+        Python route enforces per request must not be skippable by a fast
+        path. A CONSTANT server-wide max_concurrency has no native
+        enforcement, so servers configured with one keep ALL methods on
+        the Python route. A server-wide "auto" limit IS enforceable
+        natively, as a per-method ceiling pushed through
         tb_server_set_native_max_concurrency every time the adaptive
-        limit moves (Server._on_server_limit_change) — the native plane
-        honors the adaptive limit without the interpreter on the path."""
+        limit moves (Server._on_server_limit_change). The Authenticator
+        is ALSO enforceable natively now: a token-table authenticator
+        (``native_tokens()``) verifies constant-time in C, an arbitrary
+        one verifies through a per-connection callback deferral (one GIL
+        crossing per connection, verdict cached on the conn), and
+        rejects answer ERPCAUTH byte-identically to the Python route —
+        so auth-configured servers ride the fast path too."""
         from incubator_brpc_tpu.rpc.concurrency_limiter import (
             AutoConcurrencyLimiter,
         )
@@ -366,9 +437,13 @@ class NativeServerPlane:
         # strings) and must keep methods on the Python route like any
         # other constant
         lim = self._server._server_limiter
-        if self._server.options.auth is not None or (
-            lim is not None and not isinstance(lim, AutoConcurrencyLimiter)
-        ):
+        if lim is not None and not isinstance(lim, AutoConcurrencyLimiter):
+            return
+        auth = self._server.options.auth
+        if auth is not None and not self._configure_auth(auth):
+            # an auth seam the native plane cannot arrange (FFI rejection)
+            # must fail CLOSED: no native registrations, every frame runs
+            # the Python route's server_check
             return
         for full, prop in self._server.methods().items():
             kind = _native_kind(prop.handler)
@@ -427,6 +502,48 @@ class NativeServerPlane:
                         "method-key collision); it stays on the Python "
                         "route", full
                     )
+
+    def _configure_auth(self, auth) -> bool:
+        """Arrange native auth verification for ``auth`` (pre-listen).
+        Token-table authenticators (a ``native_tokens()`` hook returning
+        the accepted credential strings) verify entirely in C —
+        constant-time, no interpreter even on first frames.  Anything
+        else verifies through a ctypes trampoline: ONE GIL crossing per
+        connection (the verdict caches on the conn), zero on the steady
+        state.  False = the plane could not arrange it (caller falls
+        back to Python-route-only dispatch, fail closed)."""
+        tokens_hook = getattr(auth, "native_tokens", None)
+        tokens = tokens_hook() if callable(tokens_hook) else None
+        if tokens:
+            import struct as _struct
+
+            blob = b"".join(
+                _struct.pack("<I", len(t)) + t
+                for t in (
+                    s.encode() if isinstance(s, str) else bytes(s)
+                    for s in tokens
+                )
+            )
+            return LIB.tb_server_set_auth_tokens(self._srv, blob, len(blob)) == 0
+
+        def _verify(_ud, data_ptr, data_len, ip, port, _auth=auth):
+            try:
+                cred = (
+                    ctypes.string_at(data_ptr, data_len)
+                    if data_ptr and data_len
+                    else b""
+                ).decode(errors="replace")
+                remote = EndPoint(
+                    ip=(ip or b"").decode(), port=int(port)
+                )
+                return 0 if _auth.verify_credential(cred, remote) else 1
+            except Exception:
+                logger.exception("native auth verifier raised; rejecting")
+                return 1
+
+        # keepalive: the CFUNCTYPE must outlive the C++ server
+        self._auth_cb = AUTH_FN(_verify)
+        return LIB.tb_server_set_auth(self._srv, self._auth_cb, None) == 0
 
     def set_native_max_concurrency(self, full_name: str, n: int) -> bool:
         """Runtime retune of a natively-registered method's admission
@@ -491,8 +608,11 @@ class NativeServerPlane:
                 name=f"native_plane_{self.port}_{k}",
             )
             for k in ("accepted", "native_reqs", "cb_frames", "handoffs",
-                      "live_conns", "deadline_sheds")
+                      "live_conns", "deadline_sheds", "auth_rejects")
         ]
+        # the process-wide native_compress_bytes_saved / native_auth_rejects
+        # gauges sum across live planes
+        _live_planes.add(self)
         # per-reactor families (native_reactor_<port>_<i>_*): connection
         # shard occupancy, dispatched requests, and ring drops per
         # reactor — the roll-up above stays the per-port truth, these
@@ -786,7 +906,8 @@ class NativeServerPlane:
         for done, full, err, lat in feed:
             server._on_native_completion(full, err, lat, now_us=done)
         if rpcz_mod.rpcz_enabled():
-            sampled_idx = np.flatnonzero(arr["sampled"] != 0)
+            # bit 0 = sample election; bits 1-2 = request codec id
+            sampled_idx = np.flatnonzero(arr["sampled"] & 1)
             if len(sampled_idx):
                 # wall/monotonic anchor: record timestamps are
                 # CLOCK_MONOTONIC ns, spans carry wall-clock start_real_us
@@ -805,6 +926,7 @@ class NativeServerPlane:
                     if not rpcz_mod._limiter.grab():
                         break
                     service, _, method = names[idx].partition(".")
+                    codec = (int(rec["sampled"]) >> 1) & 3
                     rpcz_mod.span_store.submit(
                         rpcz_mod.Span(
                             trace_id=rpcz_mod._new_id(),
@@ -822,6 +944,17 @@ class NativeServerPlane:
                             latency_us=float(rec["latency_ns"]) / 1e3,
                             request_size=int(rec["request_size"]),
                             response_size=int(rec["response_size"]),
+                            annotations=(
+                                [(
+                                    0.0,
+                                    "compress="
+                                    + _NATIVE_COMPRESS_NAMES.get(
+                                        codec, str(codec)
+                                    ),
+                                )]
+                                if codec
+                                else []
+                            ),
                         )
                     )
 
@@ -902,7 +1035,7 @@ class NativeServerPlane:
                 payload=payload,
                 attachment=attachment,
                 correlation_id=cid_lo | (cid_hi << 32),
-                flags=flags & ~_FLAG_WIRE_PRPC,
+                flags=flags & ~(_FLAG_WIRE_PRPC | _FLAG_CONN_AUTHED),
                 error_code=error_code,
             )
             # deadline-shed baseline for the worker-pool queue ahead
@@ -911,6 +1044,10 @@ class NativeServerPlane:
             if is_prpc:
                 frame.wire_protocol = "baidu_std"
             sock = self._sock_for(token)
+            if flags & _FLAG_CONN_AUTHED:
+                # the C++ plane already verified this connection's
+                # credential: server_check must honor the cached verdict
+                sock.context["authenticated"] = True
             self._dispatch(sock, frame)
         except Exception:
             logger.exception("native frame dispatch failed")
@@ -1018,6 +1155,20 @@ class NativeServerPlane:
         self._final_reactor_stats = [
             self.reactor_stats(i) for i in range(self.num_reactors)
         ]
+        self._final_compress = self.compress_stats()
+        # fold the finals into the retired tallies so the process-wide
+        # gauges keep this plane's contribution without double-counting
+        global _retired_compress_saved, _retired_auth_rejects
+        with _planes_tally_lock:
+            if self in _live_planes:
+                _live_planes.discard(self)
+                fc = self._final_compress
+                _retired_compress_saved += max(
+                    0, fc["in_raw"] - fc["in_wire"]
+                ) + max(0, fc["out_raw"] - fc["out_wire"])
+                _retired_auth_rejects += self._final_stats.get(
+                    "auth_rejects", 0
+                )
         # loops quiescent: flush the telemetry tail so the last
         # completions still reach the summaries/limiters, THEN freeze the
         # drop counter (the flush itself can add clock-invalid discards)
@@ -1063,16 +1214,41 @@ class NativeServerPlane:
                 out["deadline_sheds"] = int(
                     LIB.tb_server_deadline_sheds(self._srv)
                 )
+                out["auth_rejects"] = int(
+                    LIB.tb_server_auth_rejects(self._srv)
+                )
                 return out
         return getattr(
             self,
             "_final_stats",
             dict.fromkeys(
                 ("accepted", "native_reqs", "cb_frames", "handoffs",
-                 "live_conns", "deadline_sheds"),
+                 "live_conns", "deadline_sheds", "auth_rejects"),
                 0,
             ),
         )
+
+    def compress_stats(self) -> Dict[str, int]:
+        """Native codec byte counters: request wire/raw bytes in,
+        response raw/wire bytes out (the native_compress_bytes_saved
+        feed)."""
+        with self._stats_lock:
+            if self._srv is None:
+                return getattr(
+                    self,
+                    "_final_compress",
+                    dict.fromkeys(
+                        ("in_wire", "in_raw", "out_raw", "out_wire"), 0
+                    ),
+                )
+            vals = [ctypes.c_uint64() for _ in range(4)]
+            LIB.tb_server_compress_stats(
+                self._srv, *[ctypes.byref(v) for v in vals]
+            )
+            return dict(
+                zip(("in_wire", "in_raw", "out_raw", "out_wire"),
+                    (v.value for v in vals))
+            )
 
     def close_idle(self, idle_s: float) -> int:
         """Cull native connections with no read activity for ``idle_s``
@@ -1211,6 +1387,32 @@ class NativeClientChannel:
                 return 0
             return int(LIB.tb_channel_cid_misroutes(self._ch))
 
+    def set_request_compress(self, name: str) -> None:
+        """Channel-default request compress_type (baidu_std only): stamps
+        RpcMeta field 3 on every request this channel emits.  The CALLER
+        compresses payloads with the matching protocol/compress.py codec
+        — the same algorithm the server's C++ table runs, so the planes
+        stay byte-identical.  "" clears."""
+        wire = _NATIVE_COMPRESS_WIRE.get(name, 0)
+        if name and wire == 0:
+            raise ValueError(f"codec {name!r} is not native-plane capable")
+        if LIB.tb_channel_set_compress(self._ch, wire) != 0:
+            raise RuntimeError("tb_channel_set_compress rejected the codec")
+
+    def set_auth(self, credential) -> None:
+        """Arm the connection's credential (RpcMeta field 7,
+        authentication_data): stamped on requests until the first
+        successful response proves the connection — the reference's
+        first-request auth fight.  A redialed channel re-arms with a
+        fresh credential."""
+        data = (
+            credential.encode()
+            if isinstance(credential, str)
+            else bytes(credential)
+        )
+        # fabriclint: allow(ffi-unchecked) current C++ always accepts; the credential is copied synchronously into the channel
+        LIB.tb_channel_set_auth(self._ch, data, len(data))
+
     def set_fault(
         self,
         fail_every: int = 0,
@@ -1328,12 +1530,16 @@ class NativeClientChannel:
         log_id: int = 0,
         trace_id: int = 0,
         span_id: int = 0,
+        compress: str = "",
     ):
         """One native round trip. Returns (rc, err_code, resp_meta_bytes,
         body: IOBuf) — rc < 0 is a transport errno, err_code the server's
         RPC error. Nonzero log_id/trace_id/span_id travel in the request
         meta exactly as the Python packers send them (Dapper
-        propagation)."""
+        propagation).  ``compress`` (baidu_std only) names the codec the
+        CALLER already compressed ``payload`` with — it rides the wire's
+        compress_type; the response body comes back as wire bytes (the
+        caller decompresses per the response meta)."""
         import errno as _errno
 
         from incubator_brpc_tpu.iobuf import IOBuf
@@ -1352,7 +1558,13 @@ class NativeClientChannel:
                     if timeout_ms and timeout_ms > 0 else 0
                 ),
             )
-            flags = FLAG_BODY_CRC if get_flag("tbus_body_crc") else 0
+            if self.protocol == "baidu_std":
+                # flags_extra carries the per-call compress_type in PRPC
+                # mode (the tbus flag space is meaningless there); the
+                # tbus body-crc flag must NOT leak into it
+                flags = _NATIVE_COMPRESS_WIRE.get(compress, 0)
+            else:
+                flags = FLAG_BODY_CRC if get_flag("tbus_body_crc") else 0
             body = IOBuf()
             tls = self._tls
             try:

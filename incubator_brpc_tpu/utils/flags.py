@@ -102,6 +102,27 @@ define_flag(
     "bthread_min_concurrency, task_control.cpp:382-390)",
 )
 define_flag("max_body_size", 64 * 1024 * 1024, "maximum message body size", lambda v: v > 0)
+define_flag(
+    "max_decompress_bytes",
+    256 * 1024 * 1024,
+    "decompressed-size ceiling for compressed request/response payloads "
+    "on BOTH planes (protocol/compress.py and the native codec table): a "
+    "tiny bomb must not expand unbounded into server memory; 0 disables "
+    "the ceiling (read per decompress on the Python plane, pushed to the "
+    "native plane at Server.start)",
+    lambda v: v >= 0,
+)
+define_flag(
+    "native_compress_min_bytes",
+    0,
+    "response-compression floor on BOTH planes: a request that arrived "
+    "compressed gets its response recompressed with the same codec only "
+    "when the payload has at least this many bytes — tiny payloads "
+    "answer uncompressed (the reference's response_compress_type "
+    "discipline); 0 = always recompress (read per response on the "
+    "Python plane, pushed to the native plane at Server.start)",
+    lambda v: v >= 0,
+)
 define_flag("socket_max_unwritten_bytes", 64 * 1024 * 1024, "write-queue backpressure threshold (EOVERCROWDED)", lambda v: v > 0)
 define_flag(
     "device_cq_threads",

@@ -56,6 +56,10 @@ constexpr uint32_t kFlagBodyCrc = 8;
 // connection and its meta is raw RpcMeta proto bytes (never on the wire;
 // must stay out of the tbus_std wire-flag space above)
 constexpr uint32_t kFlagWirePrpc = 0x100;
+// internal-only callback flag: the connection's credential was already
+// verified on the native plane — the Python route's server_check must
+// honor the cached verdict instead of demanding the credential again
+constexpr uint32_t kFlagConnAuthed = 0x200;
 constexpr size_t kHeader = 32;
 
 // baidu_std: "PRPC" + body_size(u32 BE) + meta_size(u32 BE)
@@ -298,6 +302,13 @@ struct PrpcMeta {
   long attachment = 0;
   long timeout_ms = 0;  // RpcRequestMeta.timeout_ms (field 8); 0 = none
   uint32_t error_code = 0;
+  // compress_type (field 3): dispatched through the native codec table —
+  // out-of-enum values stay here too (run_native answers the clean
+  // unknown-codec EREQUEST byte-identically to the Python route)
+  uint32_t compress = 0;
+  // authentication_data (field 7): verified natively once per connection
+  const char* auth = nullptr;
+  size_t auth_len = 0;
 };
 
 PrpcMeta scan_prpc_meta(const char* s, size_t n) {
@@ -312,8 +323,9 @@ PrpcMeta scan_prpc_meta(const char* s, size_t n) {
     if (wt == 0) {
       uint64_t v = 0;
       if (!read_varint(p, n, &off, &v)) return m;
-      if (field == 3) {  // compress_type: Python owns the codecs
-        if (v != 0) m.to_python = true;
+      if (field == 3) {  // compress_type: the native codec table owns it
+        if (v > 0xFFFFFFFFull) return m;
+        m.compress = static_cast<uint32_t>(v);
       } else if (field == 4) {
         m.cid = v;
       } else if (field == 5) {
@@ -400,7 +412,10 @@ PrpcMeta scan_prpc_meta(const char* s, size_t n) {
             return m;
           }
         }
-      } else {  // auth data (7), stream settings (8), unknown
+      } else if (field == 7) {  // authentication_data: native auth seam
+        m.auth = sub;
+        m.auth_len = sub_len;
+      } else {  // stream settings (8), unknown
         m.to_python = true;
       }
     } else if (wt == 1 || wt == 5) {
@@ -417,6 +432,363 @@ PrpcMeta scan_prpc_meta(const char* s, size_t n) {
   }
   m.ok = true;
   return m;
+}
+
+// ---------------------------------------------------------------------------
+// codecs — production-shaped PRPC traffic (compress_type field 3) stays on
+// the native plane instead of falling off to the ~35 µs Python route.
+// Wire ids follow options.proto CompressType as protocol/baidu_std.py maps
+// them: 1 = snappy, 2 = gzip, 3 = zlib ("zlib1", level 1).
+//
+// snappy is the block format hand-rolled here AND mirrored line-for-line
+// in protocol/snappy_codec.py: both encoders run the identical greedy
+// parse (same hash, same skip schedule, same emit rules), so the two
+// planes produce byte-identical compressed output — the PR 2 byte-
+// identity discipline extended to codecs.  Any standard snappy decoder
+// reads the output; this decoder reads any standard snappy stream.
+// gzip/zlib go through zlib (already linked): the gzip container is the
+// deterministic header protocol/compress.py emits (mtime=0, XFL=0,
+// OS=255, raw deflate level 6) so response recompression byte-matches
+// the Python codec there too.
+// ---------------------------------------------------------------------------
+
+constexpr uint32_t kCompressSnappy = 1;
+constexpr uint32_t kCompressGzip = 2;
+constexpr uint32_t kCompressZlib1 = 3;
+
+const char* codec_name(uint32_t id) {
+  switch (id) {
+    case kCompressSnappy: return "snappy";
+    case kCompressGzip: return "gzip";
+    case kCompressZlib1: return "zlib1";
+  }
+  return "?";
+}
+
+uint32_t load32le(const uint8_t* p) {
+  // explicit little-endian composition: the Python twin reads
+  // int.from_bytes(data[i:i+4], "little"), and the hash must match
+  return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+         (static_cast<uint32_t>(p[2]) << 16) |
+         (static_cast<uint32_t>(p[3]) << 24);
+}
+
+void put_uvarint(std::vector<uint8_t>& out, uint64_t v) {
+  while (v >= 0x80) {
+    out.push_back(static_cast<uint8_t>(v) | 0x80);
+    v >>= 7;
+  }
+  out.push_back(static_cast<uint8_t>(v));
+}
+
+// per-reactor snappy hash table: epoch-tagged slots so reuse never pays a
+// per-request memset (a stale entry from an earlier compression carries a
+// different epoch and reads as empty — invisible to the output bytes,
+// which only depend on "present or not")
+struct SnappyTable {
+  std::vector<uint64_t> slots;  // (epoch << 32) | (pos + 1)
+  uint32_t epoch = 0;
+};
+
+void snappy_emit_literal(std::vector<uint8_t>& out, const uint8_t* s,
+                         size_t n) {
+  if (n == 0) return;
+  size_t n1 = n - 1;
+  if (n1 < 60) {
+    out.push_back(static_cast<uint8_t>(n1 << 2));
+  } else if (n1 < 0x100) {
+    out.push_back(60 << 2);
+    out.push_back(static_cast<uint8_t>(n1));
+  } else if (n1 < 0x10000) {
+    out.push_back(61 << 2);
+    out.push_back(static_cast<uint8_t>(n1));
+    out.push_back(static_cast<uint8_t>(n1 >> 8));
+  } else if (n1 < 0x1000000) {
+    out.push_back(62 << 2);
+    out.push_back(static_cast<uint8_t>(n1));
+    out.push_back(static_cast<uint8_t>(n1 >> 8));
+    out.push_back(static_cast<uint8_t>(n1 >> 16));
+  } else {
+    out.push_back(63 << 2);
+    out.push_back(static_cast<uint8_t>(n1));
+    out.push_back(static_cast<uint8_t>(n1 >> 8));
+    out.push_back(static_cast<uint8_t>(n1 >> 16));
+    out.push_back(static_cast<uint8_t>(n1 >> 24));
+  }
+  out.insert(out.end(), s, s + n);
+}
+
+void snappy_emit_copy2(std::vector<uint8_t>& out, size_t off, size_t len) {
+  out.push_back(static_cast<uint8_t>(((len - 1) << 2) | 2));
+  out.push_back(static_cast<uint8_t>(off));
+  out.push_back(static_cast<uint8_t>(off >> 8));
+}
+
+void snappy_emit_copy(std::vector<uint8_t>& out, size_t off, size_t len) {
+  // the standard 60/64 split keeps every tail element >= 4 long
+  while (len >= 68) {
+    snappy_emit_copy2(out, off, 64);
+    len -= 64;
+  }
+  if (len > 64) {
+    snappy_emit_copy2(out, off, 60);
+    len -= 60;
+  }
+  if (len >= 12 || off >= 2048) {
+    snappy_emit_copy2(out, off, len);
+  } else {
+    out.push_back(static_cast<uint8_t>(((off >> 8) << 5) |
+                                       ((len - 4) << 2) | 1));
+    out.push_back(static_cast<uint8_t>(off));
+  }
+}
+
+void snappy_compress_block(const uint8_t* data, size_t n,
+                           std::vector<uint8_t>& out, SnappyTable& tbl) {
+  out.clear();
+  put_uvarint(out, n);
+  if (n == 0) return;
+  if (n < 4) {
+    snappy_emit_literal(out, data, n);
+    return;
+  }
+  size_t ts = 256;
+  int shift = 24;  // 32 - log2(ts)
+  while (ts < (1u << 14) && ts < n) {
+    ts <<= 1;
+    --shift;
+  }
+  if (tbl.slots.size() < (1u << 14)) tbl.slots.assign(1u << 14, 0);
+  const uint64_t epoch = static_cast<uint64_t>(++tbl.epoch);
+  size_t i = 0, lit = 0;
+  uint32_t skip = 32;
+  while (i + 4 <= n) {
+    uint32_t h = (load32le(data + i) * 0x1E35A7BDu) >> shift;
+    uint64_t e = tbl.slots[h];
+    tbl.slots[h] = (epoch << 32) | (i + 1);
+    size_t cand = (e >> 32) == epoch ? static_cast<size_t>(
+                                           (e & 0xFFFFFFFFu)) - 1
+                                     : static_cast<size_t>(-1);
+    if (cand != static_cast<size_t>(-1) && i - cand <= 0xFFFF &&
+        memcmp(data + cand, data + i, 4) == 0) {
+      snappy_emit_literal(out, data + lit, i - lit);
+      size_t m = 4;
+      while (i + m < n && data[cand + m] == data[i + m]) ++m;
+      snappy_emit_copy(out, i - cand, m);
+      i += m;
+      lit = i;
+      skip = 32;
+    } else {
+      i += skip >> 5;
+      ++skip;
+    }
+  }
+  snappy_emit_literal(out, data + lit, n - lit);
+}
+
+// 0 ok, -1 corrupt, -2 claimed/produced size beyond max_out
+int snappy_decompress_block(const uint8_t* in, size_t n, size_t max_out,
+                            std::vector<uint8_t>& out) {
+  size_t off = 0;
+  uint64_t ulen = 0;
+  if (!read_varint(in, n, &off, &ulen)) return -1;
+  if (ulen > max_out) return -2;
+  out.clear();
+  // the reserve is an optimization only: with the ceiling disabled a
+  // hostile length claim must not turn into a giant up-front allocation
+  // (the per-element bounds checks below still cap actual growth at the
+  // input's real expansion)
+  out.reserve(static_cast<size_t>(
+      ulen < (1u << 20) ? ulen : (1u << 20)));
+  while (off < n) {
+    uint8_t tag = in[off++];
+    if ((tag & 3) == 0) {  // literal
+      size_t len = (tag >> 2) + 1;
+      if (len > 60) {
+        size_t nb = len - 60;  // 1..4 length bytes
+        if (off + nb > n) return -1;
+        len = 0;
+        for (size_t k = 0; k < nb; ++k)
+          len |= static_cast<size_t>(in[off + k]) << (8 * k);
+        len += 1;
+        off += nb;
+      }
+      if (off + len > n || out.size() + len > ulen) return -1;
+      out.insert(out.end(), in + off, in + off + len);
+      off += len;
+    } else {  // copy
+      size_t len, cop;
+      if ((tag & 3) == 1) {
+        if (off >= n) return -1;
+        len = ((tag >> 2) & 7) + 4;
+        cop = (static_cast<size_t>(tag >> 5) << 8) | in[off++];
+      } else if ((tag & 3) == 2) {
+        if (off + 2 > n) return -1;
+        len = (tag >> 2) + 1;
+        cop = in[off] | (static_cast<size_t>(in[off + 1]) << 8);
+        off += 2;
+      } else {
+        if (off + 4 > n) return -1;
+        len = (tag >> 2) + 1;
+        cop = in[off] | (static_cast<size_t>(in[off + 1]) << 8) |
+              (static_cast<size_t>(in[off + 2]) << 16) |
+              (static_cast<size_t>(in[off + 3]) << 24);
+        off += 4;
+      }
+      if (cop == 0 || cop > out.size() || out.size() + len > ulen) return -1;
+      size_t start = out.size() - cop;
+      for (size_t k = 0; k < len; ++k) out.push_back(out[start + k]);
+    }
+  }
+  return out.size() == ulen ? 0 : -1;
+}
+
+// per-reactor codec context: reusable z_streams (deflateReset between
+// responses — deflate state is ~256 KB of allocations an inline init per
+// response would churn) + snappy table + the three scratch vectors the
+// decompress/recompress round reuses.  One per reactor, plus throwaway
+// instances on pool workers (off the reactor's hot path by definition).
+struct ZCtx {
+  SnappyTable snap;
+  std::vector<uint8_t> dbuf;  // decompressed request payload
+  std::vector<uint8_t> cbuf;  // recompressed response payload
+  std::vector<uint8_t> abuf;  // request attachment staging
+  std::vector<uint8_t> ibuf;  // contiguous compressed input staging
+  z_stream defl_raw{};        // gzip body: raw deflate, level 6
+  z_stream defl_zlib{};       // zlib1: zlib wrapper, level 1
+  z_stream infl{};            // inflate, wbits swapped per container
+  bool defl_raw_ok = false, defl_zlib_ok = false, infl_ok = false;
+  ~ZCtx() {
+    if (defl_raw_ok) deflateEnd(&defl_raw);
+    if (defl_zlib_ok) deflateEnd(&defl_zlib);
+    if (infl_ok) inflateEnd(&infl);
+  }
+};
+
+// deterministic gzip container: the exact bytes protocol/compress.py's
+// gzip codec (gzip.compress(data, 6, mtime=0) on CPython) emits — fixed
+// header, raw deflate level 6 / memLevel 8, CRC32 + ISIZE trailer
+int gzip_compress(ZCtx& z, const uint8_t* in, size_t n,
+                  std::vector<uint8_t>& out) {
+  if (!z.defl_raw_ok) {
+    if (deflateInit2(&z.defl_raw, 6, Z_DEFLATED, -15, 8,
+                     Z_DEFAULT_STRATEGY) != Z_OK)
+      return -1;
+    z.defl_raw_ok = true;
+  } else {
+    deflateReset(&z.defl_raw);
+  }
+  out.clear();
+  static const uint8_t hdr[10] = {0x1f, 0x8b, 8, 0, 0, 0, 0, 0, 0, 0xff};
+  out.insert(out.end(), hdr, hdr + 10);
+  size_t bound = deflateBound(&z.defl_raw, static_cast<uLong>(n));
+  size_t base = out.size();
+  out.resize(base + bound);
+  z.defl_raw.next_in = const_cast<Bytef*>(in);
+  z.defl_raw.avail_in = static_cast<uInt>(n);
+  z.defl_raw.next_out = out.data() + base;
+  z.defl_raw.avail_out = static_cast<uInt>(bound);
+  if (deflate(&z.defl_raw, Z_FINISH) != Z_STREAM_END) return -1;
+  out.resize(base + (bound - z.defl_raw.avail_out));
+  uint32_t crc = static_cast<uint32_t>(
+      crc32(0, reinterpret_cast<const Bytef*>(in), static_cast<uInt>(n)));
+  for (int k = 0; k < 4; ++k) out.push_back(static_cast<uint8_t>(crc >> (8 * k)));
+  uint32_t isize = static_cast<uint32_t>(n);
+  for (int k = 0; k < 4; ++k)
+    out.push_back(static_cast<uint8_t>(isize >> (8 * k)));
+  return 0;
+}
+
+int zlib1_compress(ZCtx& z, const uint8_t* in, size_t n,
+                   std::vector<uint8_t>& out) {
+  if (!z.defl_zlib_ok) {
+    if (deflateInit2(&z.defl_zlib, 1, Z_DEFLATED, 15, 8,
+                     Z_DEFAULT_STRATEGY) != Z_OK)
+      return -1;
+    z.defl_zlib_ok = true;
+  } else {
+    deflateReset(&z.defl_zlib);
+  }
+  out.clear();
+  size_t bound = deflateBound(&z.defl_zlib, static_cast<uLong>(n));
+  out.resize(bound);
+  z.defl_zlib.next_in = const_cast<Bytef*>(in);
+  z.defl_zlib.avail_in = static_cast<uInt>(n);
+  z.defl_zlib.next_out = out.data();
+  z.defl_zlib.avail_out = static_cast<uInt>(bound);
+  if (deflate(&z.defl_zlib, Z_FINISH) != Z_STREAM_END) return -1;
+  out.resize(bound - z.defl_zlib.avail_out);
+  return 0;
+}
+
+// bounded inflate shared by gzip (wbits 31) and zlib1 (wbits 15):
+// 0 ok, -1 corrupt/truncated/trailing-garbage, -2 output beyond max_out.
+// Mirrors protocol/compress.py's bounded decompressobj discipline —
+// including "one member, no trailing bytes" — so the planes agree on
+// what parses.
+int zlib_decompress(ZCtx& z, int wbits, const uint8_t* in, size_t n,
+                    size_t max_out, std::vector<uint8_t>& out) {
+  if (!z.infl_ok) {
+    if (inflateInit2(&z.infl, wbits) != Z_OK) return -1;
+    z.infl_ok = true;
+  } else if (inflateReset2(&z.infl, wbits) != Z_OK) {
+    return -1;
+  }
+  out.clear();
+  z.infl.next_in = const_cast<Bytef*>(in);
+  z.infl.avail_in = static_cast<uInt>(n);
+  for (;;) {
+    size_t base = out.size();
+    if (base > max_out) return -2;
+    // chunk = min(want, room + 1), computed without wrapping: with the
+    // ceiling disabled max_out is SIZE_MAX and `room + 1` would wrap to
+    // 0, starving inflate of output space forever
+    size_t want = std::max<size_t>(n * 2 + 64, 16384);
+    size_t room = max_out - base;
+    size_t chunk = room >= want ? want : room + 1;
+    out.resize(base + chunk);
+    z.infl.next_out = out.data() + base;
+    z.infl.avail_out = static_cast<uInt>(chunk);
+    int rc = inflate(&z.infl, Z_NO_FLUSH);
+    out.resize(base + (chunk - z.infl.avail_out));
+    if (rc == Z_STREAM_END) break;
+    if (rc != Z_OK && rc != Z_BUF_ERROR) return -1;
+    if (out.size() > max_out) return -2;
+    if (z.infl.avail_in == 0 && rc == Z_BUF_ERROR) return -1;  // truncated
+    if (z.infl.avail_in == 0 && chunk == z.infl.avail_out) return -1;
+  }
+  if (out.size() > max_out) return -2;
+  if (z.infl.avail_in != 0) return -1;  // trailing garbage
+  return 0;
+}
+
+// 0 ok, -1 corrupt, -2 beyond max_out, -3 unknown codec id
+int codec_decompress(ZCtx& z, uint32_t codec, const uint8_t* in, size_t n,
+                     size_t max_out, std::vector<uint8_t>& out) {
+  switch (codec) {
+    case kCompressSnappy:
+      return snappy_decompress_block(in, n, max_out, out);
+    case kCompressGzip:
+      return zlib_decompress(z, 15 + 16, in, n, max_out, out);
+    case kCompressZlib1:
+      return zlib_decompress(z, 15, in, n, max_out, out);
+  }
+  return -3;
+}
+
+// 0 ok (out filled), nonzero on codec trouble (caller sends uncompressed)
+int codec_compress(ZCtx& z, uint32_t codec, const uint8_t* in, size_t n,
+                   std::vector<uint8_t>& out) {
+  switch (codec) {
+    case kCompressSnappy:
+      snappy_compress_block(in, n, out, z.snap);
+      return 0;
+    case kCompressGzip:
+      return gzip_compress(z, in, n, out);
+    case kCompressZlib1:
+      return zlib1_compress(z, in, n, out);
+  }
+  return -3;
 }
 
 // big-endian u32 (the PRPC header's byte order)
@@ -454,11 +826,13 @@ constexpr size_t kClientMaxBody = 512u << 20;
 
 // Append "PRPC" header + response RpcMeta, byte-identical to
 // protocol/baidu_std.py pack_response: the response submessage is ALWAYS
-// emitted (even empty), zero scalar fields are skipped.  The caller
-// appends payload (+attachment) after.
+// emitted (even empty), zero scalar fields are skipped — including
+// compress_type (field 3), stamped when the response payload was
+// recompressed.  The caller appends payload (+attachment) after.
 void append_prpc_resp_header(tb_iobuf* out, uint64_t cid, uint32_t error_code,
                              const char* error_text, size_t text_len,
-                             size_t payload_len, size_t att_len) {
+                             size_t payload_len, size_t att_len,
+                             uint32_t compress) {
   uint8_t meta[512];
   // RpcResponseMeta submessage
   uint8_t sub[400];
@@ -479,6 +853,10 @@ void append_prpc_resp_header(tb_iobuf* out, uint64_t cid, uint32_t error_code,
   mn += put_varint(meta + mn, sn);
   memcpy(meta + mn, sub, sn);
   mn += sn;
+  if (compress != 0) {
+    meta[mn++] = 0x18;  // compress_type (field 3)
+    mn += put_varint(meta + mn, compress);
+  }
   if (cid != 0) {
     meta[mn++] = 0x20;  // correlation_id (field 4)
     mn += put_varint(meta + mn, cid);
@@ -502,19 +880,27 @@ void append_prpc_resp_header(tb_iobuf* out, uint64_t cid, uint32_t error_code,
 }
 
 // Full client-side PRPC request: `sub` is the pre-encoded RpcRequestMeta
-// submessage; the wrapper adds correlation_id + attachment_size in the
-// field order protocol/baidu_std.py emits (1, 4, 5 — compress/auth are
-// Python-route-only), then payload + attachment.
+// submessage; the wrapper adds compress_type + correlation_id +
+// attachment_size + authentication_data in the field order
+// protocol/baidu_std.py emits (1, 3, 4, 5, 7), then payload + attachment.
+// The payload is compressed by the CALLER (the Python seam shares one
+// codec with the server, so the bytes match the wire's compress_type).
 void pack_prpc_request(tb_iobuf* out, const void* sub, size_t sub_len,
                        const void* payload, size_t payload_len,
-                       const void* att, size_t att_len, uint64_t cid) {
+                       const void* att, size_t att_len, uint64_t cid,
+                       uint32_t compress, const void* auth,
+                       size_t auth_len) {
   std::vector<uint8_t> meta;
-  meta.reserve(sub_len + 24);
+  meta.reserve(sub_len + auth_len + 32);
   uint8_t tmp[10];
   meta.push_back(0x0A);  // RpcMeta.request (field 1)
   meta.insert(meta.end(), tmp, tmp + put_varint(tmp, sub_len));
   const uint8_t* sp = static_cast<const uint8_t*>(sub);
   meta.insert(meta.end(), sp, sp + sub_len);
+  if (compress != 0) {
+    meta.push_back(0x18);  // compress_type (field 3)
+    meta.insert(meta.end(), tmp, tmp + put_varint(tmp, compress));
+  }
   if (cid != 0) {
     meta.push_back(0x20);
     meta.insert(meta.end(), tmp, tmp + put_varint(tmp, cid));
@@ -522,6 +908,12 @@ void pack_prpc_request(tb_iobuf* out, const void* sub, size_t sub_len,
   if (att_len != 0) {
     meta.push_back(0x28);
     meta.insert(meta.end(), tmp, tmp + put_varint(tmp, att_len));
+  }
+  if (auth_len != 0) {
+    meta.push_back(0x3A);  // authentication_data (field 7)
+    meta.insert(meta.end(), tmp, tmp + put_varint(tmp, auth_len));
+    const uint8_t* ap = static_cast<const uint8_t*>(auth);
+    meta.insert(meta.end(), ap, ap + auth_len);
   }
   uint8_t hdr[kPrpcHeader];
   hdr[0] = 'P';
@@ -620,6 +1012,10 @@ struct NetConn : PollObj {
   // stamped once per readable burst (deadline shed baseline + idle reap);
   // written by the loop thread, read by tb_server_close_idle callers
   std::atomic<uint64_t> last_active_ms{0};
+  // per-connection auth verdict cache (brpc's first-frame auth): set by
+  // the loop thread after a native verify, or from a Python thread via
+  // tb_conn_set_authenticated when the Python route verified first
+  std::atomic<bool> authenticated{false};
   std::atomic<bool> dead{false};
   std::atomic<int> refs{0};
 };
@@ -696,6 +1092,9 @@ struct NetLoop {
   // on the cut/pack path allocates per burst or crosses a lock
   tb_iobuf* batch = nullptr;
   tb_iobuf* scratch = nullptr;
+  // per-reactor codec context: reusable z_streams, snappy table, and the
+  // decompress/recompress scratch vectors (zero cross-reactor sharing)
+  ZCtx* zctx = nullptr;
   // per-reactor counters (tb_server_reactor_stats / stats roll-up)
   std::atomic<uint64_t> live_conns{0};
   std::atomic<uint64_t> native_reqs{0};
@@ -732,6 +1131,7 @@ struct ErrorCodes {
   uint32_t elimit = 2004;
   uint32_t erequest = 1003;
   uint32_t edeadline = 4004;
+  uint32_t erpcauth = 1004;
 };
 
 // the EDEADLINE response text — MUST match utils/status.py berror(
@@ -739,6 +1139,9 @@ struct ErrorCodes {
 // answered natively is indistinguishable from one answered by the
 // Python route
 constexpr const char kDeadlineShedText[] = "Deadline expired before dispatch";
+
+// same contract for the native auth rejection: berror(ERPCAUTH)
+constexpr const char kUnauthorizedText[] = "Unauthorized";
 
 // ---------------------------------------------------------------------------
 // telemetry ring: bounded lock-free queue of completion records (Vyukov's
@@ -806,9 +1209,11 @@ void telemetry_push(TelemetryRing* r, tb_telemetry_record& rec) {
     }
   }
   // the claimed position doubles as the sample counter (exact 1/N
-  // without a second atomic on the hot path; drops never claim one)
+  // without a second atomic on the hot path; drops never claim one).
+  // Bit 0 only: the producer's codec bits (>> 1) ride through untouched.
   rec.sampled =
-      r->sample_every != 0 && pos % r->sample_every == 0 ? 1u : 0u;
+      (rec.sampled & ~1u) |
+      (r->sample_every != 0 && pos % r->sample_every == 0 ? 1u : 0u);
   cell->rec = rec;
   cell->seq.store(pos + 1, std::memory_order_release);
 }
@@ -839,6 +1244,7 @@ struct ReqCtx {
   uint32_t resp_flags; // tbus: response flags to echo (body-crc bit)
   long attachment;     // request attachment size (PRPC echo re-stamps it)
   long timeout_ms;     // propagated deadline budget (0 = none rides this)
+  uint32_t compress;   // request compress_type (0 = plain; PRPC only)
 };
 
 // ---------------------------------------------------------------------------
@@ -967,6 +1373,30 @@ struct tb_server {
   // requests answered EDEADLINE because their propagated budget expired
   // before dispatch (the deadline_shed_count feed for native ports)
   std::atomic<uint64_t> deadline_sheds{0};
+  // ---- production-shaped traffic knobs (pre-listen configuration) ----
+  // response compression floor: decompressed payloads below it answer
+  // uncompressed (native_compress_min_bytes; the Python route applies
+  // the same floor so the planes stay byte-identical)
+  size_t compress_min = 0;
+  // decompressed-size ceiling (max_decompress_bytes): a tiny bomb must
+  // not expand unbounded into server memory on either plane
+  size_t max_decompress = 256u << 20;
+  // auth seam: a verifier callback (tb_server_set_auth — the arbitrary-
+  // Authenticator deferral, one interpreter crossing per CONNECTION) or
+  // a constant-time token table (tb_server_set_auth_tokens — the
+  // steady-state path never enters the interpreter).  Verified once per
+  // connection, verdict cached on the conn (brpc's first-frame auth).
+  tb_auth_fn auth_fn = nullptr;
+  void* auth_ud = nullptr;
+  std::vector<std::string> auth_tokens;
+  std::atomic<bool> auth_enabled{false};
+  std::atomic<uint64_t> auth_rejects{0};
+  // compressed-traffic byte counters (native_compress_bytes_saved feed):
+  // request wire/raw and response raw/wire
+  std::atomic<uint64_t> c_in_wire{0};
+  std::atomic<uint64_t> c_in_raw{0};
+  std::atomic<uint64_t> c_out_raw{0};
+  std::atomic<uint64_t> c_out_wire{0};
   // lame-duck: stop accepting while existing connections drain; EVERY
   // reactor tears down its own listener on its own loop thread at its
   // next wakeup (per-reactor listeners via SO_REUSEPORT)
@@ -987,6 +1417,45 @@ uint64_t method_key(const char* name, size_t n) {
   uint64_t hi =
       crc32(0, reinterpret_cast<const Bytef*>(name), static_cast<uInt>(n));
   return lo | (hi << 32);
+}
+
+// constant-time credential compare: the loop always walks every token
+// byte, and a length mismatch folds into the same accumulator instead of
+// short-circuiting — a timing probe learns nothing about how much of a
+// token it matched
+int ct_token_match(const std::string& tok, const char* a, size_t alen) {
+  unsigned diff = static_cast<unsigned>(tok.size() ^ alen);
+  for (size_t i = 0; i < tok.size(); ++i) {
+    uint8_t b = i < alen ? static_cast<uint8_t>(a[i]) : 0;
+    diff |= static_cast<uint8_t>(tok[i]) ^ b;
+  }
+  return diff == 0 ? 1 : 0;
+}
+
+// verify a connection's first-frame credential.  Token table first (pure
+// C, constant-time, no interpreter); else the registered verifier (for a
+// Python Authenticator this is ONE GIL crossing per connection — the
+// verdict caches on the conn).  Auth enabled with neither = fail closed.
+bool verify_auth(tb_server* s, NetConn* c, const char* data, size_t len) {
+  if (!s->auth_tokens.empty()) {
+    int ok = 0;
+    for (const std::string& t : s->auth_tokens)
+      ok |= ct_token_match(t, data, len);
+    return ok != 0;
+  }
+  if (s->auth_fn != nullptr) {
+    char ip[64] = {0};
+    int port = 0;
+    sockaddr_in addr{};
+    socklen_t alen = sizeof addr;
+    if (getpeername(c->fd, reinterpret_cast<sockaddr*>(&addr), &alen) == 0 &&
+        addr.sin_family == AF_INET) {
+      inet_ntop(AF_INET, &addr.sin_addr, ip, sizeof ip);
+      port = ntohs(addr.sin_port);
+    }
+    return s->auth_fn(s->auth_ud, data, len, ip, port) == 0;
+  }
+  return false;
 }
 
 void set_nonblock(int fd) {
@@ -1070,7 +1539,7 @@ void append_error(tb_iobuf* out, const ReqCtx& rc, uint32_t code,
     append_prpc_resp_header(
         out, static_cast<uint64_t>(rc.cid_lo) |
                  (static_cast<uint64_t>(rc.cid_hi) << 32),
-        code, text, strlen(text), 0, 0);
+        code, text, strlen(text), 0, 0, 0);
     return;
   }
   char meta[256];
@@ -1086,7 +1555,7 @@ void append_error(tb_iobuf* out, const ReqCtx& rc, uint32_t code,
 void push_completion_record(TelemetryRing* tr, NativeMethod* nm,
                             uint32_t err, uint64_t t_start, uint64_t cid64,
                             size_t req_len, size_t resp_len,
-                            int reactor_id) {
+                            int reactor_id, uint32_t codec) {
   if (tr == nullptr) return;
   tb_telemetry_record rec;
   rec.method_idx = nm->index;
@@ -1098,7 +1567,11 @@ void push_completion_record(TelemetryRing* tr, NativeMethod* nm,
       req_len > 0xFFFFFFFFu ? 0xFFFFFFFFu : req_len);
   rec.response_size = static_cast<uint32_t>(
       resp_len > 0xFFFFFFFFu ? 0xFFFFFFFFu : resp_len);
-  rec.sampled = 0;  // telemetry_push elects from the claimed position
+  // bits 1-2 carry the request's codec id (0 = uncompressed); bit 0 is
+  // the sample election telemetry_push stamps from the claimed position.
+  // Out-of-enum wire values (rejected EREQUEST upstream) record as 0 —
+  // a plain mask would alias compress_type=9 onto "snappy" in /rpcz.
+  rec.sampled = (codec <= 3u ? codec : 0u) << 1;
   rec.reactor_id = static_cast<uint32_t>(reactor_id);
   telemetry_push(tr, rec);
 }
@@ -1106,18 +1579,35 @@ void push_completion_record(TelemetryRing* tr, NativeMethod* nm,
 // Pack a user-callback result (or its error) into `out` in the
 // request's wire protocol — shared by the inline dispatch and the pool
 // worker, so the two planes answer byte-identically by construction.
+// `z`/`srv` drive response recompression: a PRPC request that arrived
+// compressed gets its response compressed with the same codec when the
+// payload clears the floor (the Python _send_response discipline).
 void pack_callback_result(tb_iobuf* out, NativeMethod* nm, const ReqCtx& rc,
                           uint64_t cid64, int rc2, const char* resp,
-                          size_t resp_len, uint32_t* t_err, size_t* t_resp) {
+                          size_t resp_len, uint32_t* t_err, size_t* t_resp,
+                          tb_server* srv, ZCtx* z) {
   if (rc2 != 0) {
     nm->nerr.fetch_add(1, std::memory_order_relaxed);
     append_error(out, rc, static_cast<uint32_t>(rc2),
                  "native method failed");
     *t_err = static_cast<uint32_t>(rc2);
   } else if (rc.wire == kProtoPrpc) {
-    append_prpc_resp_header(out, cid64, 0, nullptr, 0, resp_len, 0);
-    if (resp_len) tb_iobuf_append(out, resp, resp_len);
-    *t_resp = resp_len;
+    if (rc.compress != 0 && resp_len > 0 && resp_len >= srv->compress_min &&
+        codec_compress(*z, rc.compress,
+                       reinterpret_cast<const uint8_t*>(resp), resp_len,
+                       z->cbuf) == 0) {
+      srv->c_out_raw.fetch_add(resp_len, std::memory_order_relaxed);
+      srv->c_out_wire.fetch_add(z->cbuf.size(), std::memory_order_relaxed);
+      append_prpc_resp_header(out, cid64, 0, nullptr, 0, z->cbuf.size(), 0,
+                              rc.compress);
+      if (!z->cbuf.empty())
+        tb_iobuf_append(out, z->cbuf.data(), z->cbuf.size());
+      *t_resp = z->cbuf.size();
+    } else {
+      append_prpc_resp_header(out, cid64, 0, nullptr, 0, resp_len, 0, 0);
+      if (resp_len) tb_iobuf_append(out, resp, resp_len);
+      *t_resp = resp_len;
+    }
   } else {
     uint32_t flags = kFlagResponse | rc.resp_flags;
     uint32_t crc = tb_crc32c(0, nullptr, 0);
@@ -1155,8 +1645,11 @@ void run_pool_task(WorkTask* t) {
     char* resp = nullptr;
     size_t resp_len = 0;
     int rc2 = nm->fn(nm->ud, t->req, t->req_len, &resp, &resp_len);
+    // worker-local codec context: the reactor's ZCtx belongs to its loop
+    // thread, and a deferred (slow) method is off the hot path anyway
+    ZCtx z;
     pack_callback_result(out, nm, t->rc, cid64, rc2, resp, resp_len,
-                         &t_err, &t_resp);
+                         &t_err, &t_resp, t->srv, &z);
     free(resp);
   }
   NetConn* c = conn_resolve(t->conn_token);
@@ -1169,7 +1662,8 @@ void run_pool_task(WorkTask* t) {
   if (t->t_start != 0)  // dispatch entry: queue wait is in the latency
     push_completion_record(
         t->loop->telemetry.load(std::memory_order_acquire), nm, t_err,
-        t->t_start, cid64, t->req_len, t_resp, t->loop->id);
+        t->t_start, cid64, t->req_len, t_resp, t->loop->id,
+        t->rc.compress);
   free(t->req);
   delete t;
 }
@@ -1225,7 +1719,7 @@ void run_native(NetConn* c, NativeMethod* nm, const ReqCtx& rc,
   const size_t req_len = tr != nullptr ? tb_iobuf_size(body) : 0;
   auto telemetry_done = [&](uint32_t err, size_t resp_len) {
     push_completion_record(tr, nm, err, t_start, cid64, req_len, resp_len,
-                           c->loop->id);
+                           c->loop->id, rc.compress);
   };
   // deadline shed (reference server-side timeout_ms handling): budget
   // expired between the frame's ARRIVAL (burst read stamp) and this
@@ -1253,6 +1747,51 @@ void run_native(NetConn* c, NativeMethod* nm, const ReqCtx& rc,
     append_error(out, rc, c->srv->errs.elimit, "concurrency limit reached");
     telemetry_done(c->srv->errs.elimit, 0);
     return;  // caller owns body
+  }
+  // native codec round (PRPC): decompress the payload IN PLACE so every
+  // downstream consumer — the pool copy, the echo, a user callback —
+  // sees raw bytes, exactly like the Python route's pre-handler
+  // decompress.  Rejects are answered EREQUEST, with the Python route's
+  // deterministic texts (unknown codec, ceiling) byte-for-byte.
+  ZCtx& z = *c->loop->zctx;
+  if (rc.compress != 0) {
+    const size_t wlen = tb_iobuf_size(body);
+    const size_t att = static_cast<size_t>(rc.attachment);
+    const size_t pay = wlen - att;
+    z.ibuf.resize(pay);
+    if (pay) tb_iobuf_copy_to(body, z.ibuf.data(), pay, 0);
+    int drc = codec_decompress(z, rc.compress, z.ibuf.data(), pay,
+                               c->srv->max_decompress, z.dbuf);
+    if (drc != 0) {
+      char text[160];
+      if (drc == -3) {
+        snprintf(text, sizeof text,
+                 "decompress failed: unknown compression codec 'wire-%u'",
+                 rc.compress);
+      } else if (drc == -2) {
+        snprintf(text, sizeof text,
+                 "decompress failed: decompressed size exceeds "
+                 "max_decompress_bytes (%zu)",
+                 c->srv->max_decompress);
+      } else {
+        snprintf(text, sizeof text, "decompress failed: corrupt %s body",
+                 codec_name(rc.compress));
+      }
+      nm->nerr.fetch_add(1, std::memory_order_relaxed);
+      append_error(out, rc, c->srv->errs.erequest, text);
+      if (limit) nm->nprocessing.fetch_sub(1);
+      telemetry_done(c->srv->errs.erequest, 0);
+      return;  // caller owns body
+    }
+    c->srv->c_in_wire.fetch_add(pay, std::memory_order_relaxed);
+    c->srv->c_in_raw.fetch_add(z.dbuf.size(), std::memory_order_relaxed);
+    // rebuild the body: decompressed payload + untouched attachment
+    z.abuf.resize(att);
+    if (att) tb_iobuf_copy_to(body, z.abuf.data(), att, pay);
+    tb_iobuf_clear(body);
+    if (!z.dbuf.empty())
+      tb_iobuf_append(body, z.dbuf.data(), z.dbuf.size());
+    if (att) tb_iobuf_append(body, z.abuf.data(), att);
   }
   // work-stealing deferral: user methods flagged long-running — or
   // arriving behind a queue-depth-pressured burst — hand off to the
@@ -1302,10 +1841,43 @@ void run_native(NetConn* c, NativeMethod* nm, const ReqCtx& rc,
   size_t t_resp = 0;
   if (nm->kind == kKindEcho) {
     size_t blen = tb_iobuf_size(body);
+    if (rc.wire == kProtoPrpc && rc.compress != 0) {
+      // recompress the echoed payload with the request's codec, floor
+      // honored (tiny payloads answer uncompressed — the reference's
+      // response_compress_type discipline); the attachment travels
+      // uncompressed like the Python route.  dbuf still holds the
+      // decompressed payload from the codec round above.
+      const size_t att = static_cast<size_t>(rc.attachment);
+      const size_t raw_len = blen - att;
+      uint32_t out_codec =
+          raw_len > 0 && raw_len >= c->srv->compress_min &&
+                  codec_compress(z, rc.compress, z.dbuf.data(), raw_len,
+                                 z.cbuf) == 0
+              ? rc.compress
+              : 0;
+      if (out_codec != 0) {
+        c->srv->c_out_raw.fetch_add(raw_len, std::memory_order_relaxed);
+        c->srv->c_out_wire.fetch_add(z.cbuf.size(),
+                                     std::memory_order_relaxed);
+        append_prpc_resp_header(out, cid64, 0, nullptr, 0, z.cbuf.size(),
+                                att, out_codec);
+        if (!z.cbuf.empty())
+          tb_iobuf_append(out, z.cbuf.data(), z.cbuf.size());
+        if (att) tb_iobuf_append(out, z.abuf.data(), att);
+        t_resp = z.cbuf.size() + att;
+      } else {
+        append_prpc_resp_header(out, cid64, 0, nullptr, 0, raw_len, att, 0);
+        tb_iobuf_append_iobuf(out, body);  // decompressed payload + att
+        t_resp = blen;
+      }
+      if (limit) nm->nprocessing.fetch_sub(1);
+      telemetry_done(0, t_resp);
+      return;  // caller owns body
+    }
     if (rc.wire == kProtoPrpc) {
       append_prpc_resp_header(out, cid64, 0, nullptr, 0,
                               blen - static_cast<size_t>(rc.attachment),
-                              static_cast<size_t>(rc.attachment));
+                              static_cast<size_t>(rc.attachment), 0);
     } else {
       if (rc.attachment > 0) {
         int n = snprintf(meta, sizeof meta, "{\"attachment_size\":%ld}",
@@ -1340,11 +1912,11 @@ void run_native(NetConn* c, NativeMethod* nm, const ReqCtx& rc,
     int rc2 = nm->fn(nm->ud, req, blen, &resp, &resp_len);
     if (req != stackbuf) free(req);
     pack_callback_result(out, nm, rc, cid64, rc2, resp, resp_len, &t_err,
-                         &t_resp);
+                         &t_resp, c->srv, &z);
     free(resp);
   } else {  // nop
     if (rc.wire == kProtoPrpc) {
-      append_prpc_resp_header(out, cid64, 0, nullptr, 0, 0, 0);
+      append_prpc_resp_header(out, cid64, 0, nullptr, 0, 0, 0, 0);
     } else {
       append_header(out, nullptr, 0, 0, tb_crc32c(0, nullptr, 0), rc.cid_lo,
                     rc.cid_hi, flags, 0);
@@ -1447,14 +2019,21 @@ FrameStatus process_frames_tbus(NetConn* c) {
       return FrameStatus::kKilled;
     }
     const char* cb_meta = mptr != nullptr ? mptr : mstack;  // never null
-    // native fast path: plain request frame whose meta is fully understood
-    if ((hdr.flags & (kFlagResponse | kFlagStream)) == 0) {
+    // native fast path: plain request frame whose meta is fully
+    // understood, on a connection whose auth (if the server wants any)
+    // already settled — tbus credentials ride the JSON meta's extra
+    // object, which the Python route owns, so an unproven connection's
+    // frames route there until server_check marks it (the mark flows
+    // back via tb_conn_set_authenticated)
+    if ((hdr.flags & (kFlagResponse | kFlagStream)) == 0 &&
+        (!s->auth_enabled.load(std::memory_order_relaxed) ||
+         c->authenticated.load(std::memory_order_relaxed))) {
       if (c->memo_attachment >= 0 && hdr.meta_len == c->memo_meta.size() &&
           memcmp(cb_meta, c->memo_meta.data(), hdr.meta_len) == 0 &&
           c->memo_attachment <= static_cast<long>(tb_iobuf_size(scratch))) {
         ReqCtx rc2{kProtoTbus, hdr.cid_lo, hdr.cid_hi,
                    hdr.flags & kFlagBodyCrc, c->memo_attachment,
-                   c->memo_timeout};
+                   c->memo_timeout, 0};
         run_native(c, s->native_methods[c->memo_idx], rc2, scratch, batch);
         tb_iobuf_clear(scratch);
         continue;
@@ -1480,7 +2059,7 @@ FrameStatus process_frames_tbus(NetConn* c) {
             c->memo_timeout = ml.timeout_ms;
             ReqCtx rc2{kProtoTbus, hdr.cid_lo, hdr.cid_hi,
                        hdr.flags & kFlagBodyCrc, ml.attachment,
-                       ml.timeout_ms};
+                       ml.timeout_ms, 0};
             run_native(c, s->native_methods[idx], rc2, scratch, batch);
             tb_iobuf_clear(scratch);
             continue;
@@ -1493,7 +2072,7 @@ FrameStatus process_frames_tbus(NetConn* c) {
     s->cb_frames.fetch_add(1, std::memory_order_relaxed);
     if (s->frame_cb == nullptr) {
       if ((hdr.flags & kFlagResponse) == 0) {
-        ReqCtx rc2{kProtoTbus, hdr.cid_lo, hdr.cid_hi, 0, 0, 0};
+        ReqCtx rc2{kProtoTbus, hdr.cid_lo, hdr.cid_hi, 0, 0, 0, 0};
         append_error(batch, rc2, s->errs.enomethod, "no such method");
       }
       tb_iobuf_clear(scratch);
@@ -1504,7 +2083,11 @@ FrameStatus process_frames_tbus(NetConn* c) {
     tb_iobuf* body = tb_iobuf_create();
     tb_iobuf_append_iobuf(body, scratch);
     tb_iobuf_clear(scratch);
-    s->frame_cb(s->frame_ctx, c->token, hdr.cid_lo, hdr.cid_hi, hdr.flags,
+    s->frame_cb(s->frame_ctx, c->token, hdr.cid_lo, hdr.cid_hi,
+                hdr.flags |
+                    (c->authenticated.load(std::memory_order_relaxed)
+                         ? kFlagConnAuthed
+                         : 0),
                 hdr.error_code, cb_meta, hdr.meta_len, body);
   }
 }
@@ -1555,9 +2138,26 @@ FrameStatus process_frames_prpc(NetConn* c) {
     }
     const long blen = static_cast<long>(tb_iobuf_size(scratch));
     if (!pm.is_response && !pm.to_python && pm.attachment <= blen) {
+      // auth gate (reference: VerifyRpcRequest before ProcessRpcRequest,
+      // baidu_rpc_protocol.cpp): verified ONCE per connection, verdict
+      // cached on the conn; rejects answer the berror(ERPCAUTH) frame
+      // byte-identically to the Python route and keep the conn open
+      if (s->auth_enabled.load(std::memory_order_relaxed) &&
+          !c->authenticated.load(std::memory_order_relaxed)) {
+        if (verify_auth(s, c, pm.auth, pm.auth_len)) {
+          c->authenticated.store(true, std::memory_order_relaxed);
+        } else {
+          s->auth_rejects.fetch_add(1, std::memory_order_relaxed);
+          ReqCtx rc{kProtoPrpc, static_cast<uint32_t>(pm.cid),
+                    static_cast<uint32_t>(pm.cid >> 32), 0, 0, 0, 0};
+          append_error(batch, rc, s->errs.erpcauth, kUnauthorizedText);
+          tb_iobuf_clear(scratch);
+          continue;
+        }
+      }
       ReqCtx rc{kProtoPrpc, static_cast<uint32_t>(pm.cid),
                 static_cast<uint32_t>(pm.cid >> 32), 0, pm.attachment,
-                pm.timeout_ms};
+                pm.timeout_ms, pm.compress};
       // memo keyed on the request submessage (cid lives outside it)
       if (c->memo_attachment >= 0 &&
           pm.req_sub_len == c->memo_meta.size() && pm.req_sub_len > 0 &&
@@ -1591,11 +2191,14 @@ FrameStatus process_frames_prpc(NetConn* c) {
     // unknown-method frames — flag 0x100 tells the callee the meta is
     // RpcMeta proto bytes and the connection answers in PRPC
     s->cb_frames.fetch_add(1, std::memory_order_relaxed);
-    uint32_t cb_flags = kFlagWirePrpc | (pm.is_response ? kFlagResponse : 0);
+    uint32_t cb_flags = kFlagWirePrpc | (pm.is_response ? kFlagResponse : 0) |
+                        (c->authenticated.load(std::memory_order_relaxed)
+                             ? kFlagConnAuthed
+                             : 0);
     if (s->frame_cb == nullptr) {
       if (!pm.is_response) {
         ReqCtx rc{kProtoPrpc, static_cast<uint32_t>(pm.cid),
-                  static_cast<uint32_t>(pm.cid >> 32), 0, 0, 0};
+                  static_cast<uint32_t>(pm.cid >> 32), 0, 0, 0, 0};
         append_error(batch, rc, s->errs.enomethod, "no such method");
       }
       tb_iobuf_clear(scratch);
@@ -1727,6 +2330,7 @@ tb_server* tb_server_create(int nloops) {
     // reactor-owned data pools, reused across every burst the loop cuts
     l->batch = tb_iobuf_create();
     l->scratch = tb_iobuf_create();
+    l->zctx = new ZCtx();
     epoll_event ev{};
     ev.events = EPOLLIN;
     ev.data.ptr = static_cast<PollObj*>(&l->wake);
@@ -1774,6 +2378,64 @@ void tb_server_set_closed_cb(tb_server* s, tb_closed_fn cb, void* ctx) {
 }
 
 void tb_server_set_max_body(tb_server* s, size_t bytes) { s->max_body = bytes; }
+
+void tb_server_set_compress_min_bytes(tb_server* s, size_t bytes) {
+  s->compress_min = bytes;
+}
+
+void tb_server_set_max_decompress(tb_server* s, size_t bytes) {
+  s->max_decompress = bytes != 0 ? bytes : static_cast<size_t>(-1);
+}
+
+int tb_server_set_auth(tb_server* s, tb_auth_fn fn, void* ud) {
+  // pre-listen only: loop threads read auth_fn/auth_tokens without fences
+  if (s->listening) return -1;
+  s->auth_fn = fn;
+  s->auth_ud = ud;
+  s->auth_enabled.store(fn != nullptr || !s->auth_tokens.empty(),
+                        std::memory_order_relaxed);
+  return 0;
+}
+
+int tb_server_set_auth_tokens(tb_server* s, const char* blob,
+                              size_t blob_len) {
+  // blob = repeated [u32 LE length][bytes]; replaces the table wholesale.
+  // Pre-listen only, like tb_server_set_auth.
+  if (s->listening) return -1;
+  std::vector<std::string> tokens;
+  size_t off = 0;
+  while (off < blob_len) {
+    if (off + 4 > blob_len) return -1;
+    uint32_t n = static_cast<uint8_t>(blob[off]) |
+                 (static_cast<uint32_t>(static_cast<uint8_t>(blob[off + 1]))
+                  << 8) |
+                 (static_cast<uint32_t>(static_cast<uint8_t>(blob[off + 2]))
+                  << 16) |
+                 (static_cast<uint32_t>(static_cast<uint8_t>(blob[off + 3]))
+                  << 24);
+    off += 4;
+    if (n > blob_len - off) return -1;
+    tokens.emplace_back(blob + off, n);
+    off += n;
+  }
+  s->auth_tokens = std::move(tokens);
+  s->auth_enabled.store(s->auth_fn != nullptr || !s->auth_tokens.empty(),
+                        std::memory_order_relaxed);
+  return 0;
+}
+
+uint64_t tb_server_auth_rejects(const tb_server* s) {
+  return s->auth_rejects.load(std::memory_order_relaxed);
+}
+
+void tb_server_compress_stats(const tb_server* s, uint64_t* in_wire,
+                              uint64_t* in_raw, uint64_t* out_raw,
+                              uint64_t* out_wire) {
+  if (in_wire) *in_wire = s->c_in_wire.load(std::memory_order_relaxed);
+  if (in_raw) *in_raw = s->c_in_raw.load(std::memory_order_relaxed);
+  if (out_raw) *out_raw = s->c_out_raw.load(std::memory_order_relaxed);
+  if (out_wire) *out_wire = s->c_out_wire.load(std::memory_order_relaxed);
+}
 
 namespace {
 
@@ -2100,6 +2762,7 @@ void tb_server_destroy(tb_server* s) {
     close(l->epfd);
     tb_iobuf_destroy(l->batch);
     tb_iobuf_destroy(l->scratch);
+    delete l->zctx;
     delete l->telemetry.load(std::memory_order_relaxed);
     delete l->deque;
     delete l;
@@ -2217,6 +2880,17 @@ int tb_conn_close(uint64_t token) {
   return 0;
 }
 
+int tb_conn_set_authenticated(uint64_t token) {
+  // the Python route verified this connection's credential (server_check)
+  // — cache the verdict natively so the conn's later frames ride the
+  // fast path without re-fighting auth
+  NetConn* c = conn_resolve(token);
+  if (c == nullptr) return -1;
+  c->authenticated.store(true, std::memory_order_relaxed);
+  conn_unref(c);
+  return 0;
+}
+
 // ---------------------------------------------------------------------------
 // client channel
 // ---------------------------------------------------------------------------
@@ -2265,6 +2939,15 @@ struct tb_channel {
   uint32_t fault_delay_every = 0;
   uint32_t fault_delay_ms = 0;
   uint32_t fault_err_code = 0;
+  // production-shaped request stamping (baidu_std only; set before
+  // concurrent use, like the fault schedule): a channel-default
+  // compress_type spliced into RpcMeta field 3 (per-call override rides
+  // flags_extra), and the credential for field 7 — stamped until the
+  // first successful response proves the connection (the reference's
+  // first-request auth fight), then omitted.
+  uint32_t req_compress = 0;
+  std::string auth_data;
+  std::atomic<bool> auth_proven{false};
 };
 
 namespace {
@@ -2476,10 +3159,22 @@ int channel_send_cid(tb_channel* ch, uint64_t cid, const void* meta,
                      const void* att, size_t att_len, uint32_t flags_extra,
                      uint64_t deadline) {
   tb_iobuf* frame = tb_iobuf_create();
-  if (ch->proto == 1)  // meta = RpcRequestMeta submessage; flags n/a
+  if (ch->proto == 1) {
+    // meta = RpcRequestMeta submessage.  In PRPC mode flags_extra's low
+    // bits carry a per-call compress_type (0 = the channel default) —
+    // the tbus flag space is meaningless here, so the argument is free
+    // for race-free per-call codec selection.  The credential stamps
+    // until the connection is proven.
+    uint32_t compress =
+        (flags_extra & 0xFu) != 0 ? (flags_extra & 0xFu) : ch->req_compress;
+    const bool stamp_auth =
+        !ch->auth_data.empty() &&
+        !ch->auth_proven.load(std::memory_order_relaxed);
     pack_prpc_request(frame, meta, meta_len, payload, payload_len, att,
-                      att_len, cid);
-  else
+                      att_len, cid, compress,
+                      stamp_auth ? ch->auth_data.data() : nullptr,
+                      stamp_auth ? ch->auth_data.size() : 0);
+  } else
     pack_flat(frame, meta, meta_len, payload, payload_len, att, att_len,
               static_cast<uint32_t>(cid), static_cast<uint32_t>(cid >> 32),
               flags_extra, 0);
@@ -2576,6 +3271,28 @@ int tb_channel_set_protocol(tb_channel* ch, int proto) {
   return 0;
 }
 
+int tb_channel_set_compress(tb_channel* ch, int compress_type) {
+  // channel-default request compress_type (baidu_std RpcMeta field 3);
+  // the CALLER compresses payloads with the matching codec — this only
+  // stamps the wire field.  Set before concurrent use.
+  if (compress_type < 0 || compress_type > 3) return -1;
+  ch->req_compress = static_cast<uint32_t>(compress_type);
+  return 0;
+}
+
+int tb_channel_set_auth(tb_channel* ch, const void* data, size_t len) {
+  // credential for RpcMeta field 7, stamped on requests until the first
+  // successful response proves the connection.  Set before concurrent
+  // use (a redial mints a fresh channel and re-arms it).
+  if (data == nullptr || len == 0) {
+    ch->auth_data.clear();
+  } else {
+    ch->auth_data.assign(static_cast<const char*>(data), len);
+    ch->auth_proven.store(false, std::memory_order_relaxed);
+  }
+  return 0;
+}
+
 int tb_channel_set_fault(tb_channel* ch, uint32_t fail_every,
                          uint32_t close_every, uint32_t delay_every,
                          uint32_t delay_ms, uint32_t err_code) {
@@ -2646,6 +3363,10 @@ long tb_channel_call(tb_channel* ch, const void* meta, size_t meta_len,
   uint32_t ec = p.err_code;
   pl.unlock();
   if (fail != 0) return fail;
+  // an accepted response proves the connection: later requests stop
+  // stamping the credential (an ERPCAUTH reject must NOT prove it — the
+  // next attempt still needs the credential on the wire)
+  if (ec == 0) ch->auth_proven.store(true, std::memory_order_relaxed);
   if (meta_len_out)
     *meta_len_out = static_cast<uint32_t>(std::min(meta_resp.size(), meta_cap));
   if (meta_out && meta_cap > 0 && !meta_resp.empty())
@@ -2757,7 +3478,18 @@ long tb_channel_pump(tb_channel* ch, const void* meta, size_t meta_len,
   std::vector<char> tmpl;
   size_t cid_off = 12;  // tbus: header words 3-4
   if (ch->proto == 1) {
-    size_t meta_total = 1 + varint_len(meta_len) + meta_len + 1 + 10;
+    // channel-default compress_type and (until proven) the credential
+    // ride every frame of the pump — the template is fixed, and a
+    // pipelined first burst legitimately carries the credential on each
+    // frame (the reference's FightAuthentication lets first-writers race)
+    const uint32_t compress = ch->req_compress;
+    const bool stamp_auth =
+        !ch->auth_data.empty() &&
+        !ch->auth_proven.load(std::memory_order_relaxed);
+    const size_t auth_len = stamp_auth ? ch->auth_data.size() : 0;
+    size_t meta_total = 1 + varint_len(meta_len) + meta_len +
+                        (compress ? 1 + varint_len(compress) : 0) + 1 + 10 +
+                        (auth_len ? 1 + varint_len(auth_len) + auth_len : 0);
     tmpl.resize(kPrpcHeader + meta_total + payload_len);
     uint8_t* t = reinterpret_cast<uint8_t*>(tmpl.data());
     memcpy(t, "PRPC", 4);
@@ -2768,9 +3500,19 @@ long tb_channel_pump(tb_channel* ch, const void* meta, size_t meta_len,
     o += put_varint(t + o, meta_len);
     if (meta_len) memcpy(t + o, meta, meta_len);
     o += meta_len;
+    if (compress) {
+      t[o++] = 0x18;  // compress_type (field 3)
+      o += put_varint(t + o, compress);
+    }
     t[o++] = 0x20;  // correlation_id
     cid_off = o;
     o += 10;  // patched per request
+    if (auth_len) {
+      t[o++] = 0x3A;  // authentication_data (field 7)
+      o += put_varint(t + o, auth_len);
+      memcpy(t + o, ch->auth_data.data(), auth_len);
+      o += auth_len;
+    }
     if (payload_len) memcpy(t + o, payload, payload_len);
   } else {
     tmpl.resize(32 + meta_len + payload_len);
@@ -2914,6 +3656,7 @@ long tb_channel_pump(tb_channel* ch, const void* meta, size_t meta_len,
   }
   tb_iobuf_destroy(frame);
   if (result != 0) return result;
+  ch->auth_proven.store(true, std::memory_order_relaxed);
   auto dt = std::chrono::duration_cast<std::chrono::nanoseconds>(
                 std::chrono::steady_clock::now() - t0)
                 .count();
@@ -2937,6 +3680,38 @@ void tb_channel_destroy(tb_channel* ch) {
   tb_iobuf_destroy(ch->rbuf);
   if (ch->pump_body != nullptr) tb_iobuf_destroy(ch->pump_body);
   delete ch;
+}
+
+// ---------------------------------------------------------------------------
+// codec C surface (tb_codec_*): the server's codec table exported so the
+// Python seam (protocol/compress.py) runs the SAME implementation — the
+// client-side compress before a native call, and the Python route's
+// decompress, stop paying interpreter-speed codec loops while staying
+// byte-identical to the plane by construction.
+// ---------------------------------------------------------------------------
+
+long tb_codec_compress(int codec, const void* in, size_t in_len,
+                       tb_iobuf* out) {
+  static thread_local ZCtx ctx;  // callers are arbitrary Python threads
+  int rc = codec_compress(ctx, static_cast<uint32_t>(codec),
+                          static_cast<const uint8_t*>(in), in_len, ctx.cbuf);
+  if (rc != 0) return rc == -3 ? -3 : -1;
+  if (!ctx.cbuf.empty()) tb_iobuf_append(out, ctx.cbuf.data(),
+                                         ctx.cbuf.size());
+  return static_cast<long>(ctx.cbuf.size());
+}
+
+long tb_codec_decompress(int codec, const void* in, size_t in_len,
+                         size_t max_out, tb_iobuf* out) {
+  static thread_local ZCtx ctx;
+  size_t ceil = max_out != 0 ? max_out : static_cast<size_t>(-1);
+  int rc = codec_decompress(ctx, static_cast<uint32_t>(codec),
+                            static_cast<const uint8_t*>(in), in_len, ceil,
+                            ctx.dbuf);
+  if (rc != 0) return rc;
+  if (!ctx.dbuf.empty()) tb_iobuf_append(out, ctx.dbuf.data(),
+                                         ctx.dbuf.size());
+  return static_cast<long>(ctx.dbuf.size());
 }
 
 // ---------------------------------------------------------------------------

@@ -22,11 +22,18 @@
 //   * baidu_std — "PRPC" 12-byte header + proto2 RpcMeta, the reference's
 //     canonical protocol (baidu_rpc_protocol.cpp:53-58); the RpcMeta
 //     varint/length-delimited codec is hand-rolled here, byte-compatible
-//     with protocol/baidu_std.py.  Frames whose meta carries semantics the
-//     fast path doesn't implement (compression, tracing ids, auth data,
-//     stream settings, responses) route per-frame to Python with flag bit
-//     8 (0x100) set in the callback's `flags` so the Python side decodes
-//     the meta as RpcMeta instead of JSON.
+//     with protocol/baidu_std.py.  Production-shaped frames stay native:
+//     compress_type (snappy/gzip/zlib1 via the built-in codec table,
+//     decompress on cut + floor-honoring recompress on pack) and
+//     authentication_data (verified once per connection — constant-time
+//     token table or registered verifier — rejects answered ERPCAUTH)
+//     are handled here, byte-identical to the Python codecs.  Frames
+//     whose meta carries semantics the fast path doesn't implement
+//     (tracing ids, stream settings, responses) route per-frame to
+//     Python with flag bit 8 (0x100) set in the callback's `flags` so
+//     the Python side decodes the meta as RpcMeta instead of JSON (bit
+//     9, 0x200, marks a connection whose credential already verified
+//     natively).
 #ifndef TBNET_H
 #define TBNET_H
 
@@ -65,6 +72,16 @@ typedef void (*tb_handoff_fn)(void* ctx, int fd, const void* buffered,
 // when this fires; Python uses it to drop per-connection state (streams'
 // on_failed hooks).  Not fired for handed-off connections.
 typedef void (*tb_closed_fn)(void* ctx, uint64_t conn_token);
+
+// Credential verifier (tb_server_set_auth): called ONCE per connection
+// with the first frame's authentication_data (may be NULL/empty when the
+// frame carried none) and the peer address.  Return 0 to accept; any
+// other value rejects the request with ERPCAUTH (the connection stays
+// open and may retry with a fresh credential).  Runs on a loop thread —
+// a Python trampoline here costs one GIL crossing per CONNECTION, not
+// per request (the verdict caches on the conn).
+typedef int (*tb_auth_fn)(void* ud, const char* auth_data, size_t auth_len,
+                          const char* peer_ip, int peer_port);
 
 // One completion record per natively-dispatched request (the telemetry
 // ring's element; see tb_server_set_telemetry).  Field layout is ABI:
@@ -128,6 +145,37 @@ void tb_server_set_frame_cb(tb_server* s, tb_frame_fn cb, void* ctx);
 void tb_server_set_handoff_cb(tb_server* s, tb_handoff_fn cb, void* ctx);
 void tb_server_set_closed_cb(tb_server* s, tb_closed_fn cb, void* ctx);
 void tb_server_set_max_body(tb_server* s, size_t bytes);
+// Response-compression floor (native_compress_min_bytes): a PRPC request
+// that arrived compressed gets its response recompressed with the same
+// codec ONLY when the payload has at least this many bytes — tiny
+// payloads answer uncompressed, matching the Python route's floor so the
+// planes stay byte-identical.  0 = always recompress.
+void tb_server_set_compress_min_bytes(tb_server* s, size_t bytes);
+// Decompressed-size ceiling (max_decompress_bytes): a compressed request
+// whose payload would expand past this is rejected EREQUEST instead of
+// expanding into server memory (0 = unlimited; default 256 MiB).
+void tb_server_set_max_decompress(tb_server* s, size_t bytes);
+// Install a credential verifier: PRPC frames carrying
+// authentication_data are verified natively (once per connection,
+// verdict cached) and rejects answered ERPCAUTH byte-identically to the
+// Python route.  Pre-listen only (0 ok, -1 after listen).
+int tb_server_set_auth(tb_server* s, tb_auth_fn fn, void* ud);
+// Constant-time token table (the default trampoline): blob is repeated
+// [u32 LE length][bytes] records; a credential equal to ANY token
+// verifies — entirely in C, so authenticated steady-state traffic never
+// enters the interpreter.  Replaces the previous table.  Pre-listen only
+// (0 ok, -1 after listen or on a malformed blob).
+int tb_server_set_auth_tokens(tb_server* s, const char* blob,
+                              size_t blob_len);
+// Requests rejected ERPCAUTH by the native auth seam (the
+// native_auth_rejects bvar feed).
+uint64_t tb_server_auth_rejects(const tb_server* s);
+// Compressed-traffic byte counters: request wire (compressed) and raw
+// (decompressed) bytes in, response raw and wire bytes out — the
+// native_compress_bytes_saved feed.  Any thread.
+void tb_server_compress_stats(const tb_server* s, uint64_t* in_wire,
+                              uint64_t* in_raw, uint64_t* out_raw,
+                              uint64_t* out_wire);
 // kind: 1 = echo (respond with the request body), 2 = nop (empty response).
 // max_concurrency 0 = unlimited; exceeding it answers ELIMIT natively.
 // runtime retune of a native method's admission limit (0 = unlimited)
@@ -206,6 +254,10 @@ int tb_conn_write(uint64_t token, const tb_iobuf* data);
 int tb_conn_peer(uint64_t token, char* ip_out, size_t ip_cap);
 // Fail + close the connection (0 ok, -1 stale).
 int tb_conn_close(uint64_t token);
+// Cache a Python-route auth verdict on the connection: its later frames
+// ride the native fast path without re-fighting the credential (0 ok,
+// -1 stale token).
+int tb_conn_set_authenticated(uint64_t token);
 
 // ---- client channel ----
 // Blocking connect with timeout; NULL on failure (*err_out = errno).
@@ -236,6 +288,17 @@ uint64_t tb_channel_cid_misroutes(const tb_channel* ch);
 // proto bytes (decode on the Python side); err_code_out carries the
 // RpcResponseMeta error_code.  Returns 0, or -1 for an unknown protocol.
 int tb_channel_set_protocol(tb_channel* ch, int proto);
+// Channel-default request compress_type (baidu_std RpcMeta field 3,
+// values 0-3 per options.proto).  The CALLER compresses payloads with
+// the matching codec before call/send/pump — this stamps the wire field
+// only.  In baidu_std mode the low 4 bits of call/send's flags_extra
+// override it per call.  Set before concurrent use.  0 ok, -1 bad value.
+int tb_channel_set_compress(tb_channel* ch, int compress_type);
+// Credential for RpcMeta field 7 (authentication_data), stamped on every
+// request until the first successful response proves the connection —
+// the reference's first-request auth fight.  NULL/0 clears.  Set before
+// concurrent use.  Returns 0.
+int tb_channel_set_auth(tb_channel* ch, const void* data, size_t len);
 // Counter-scheduled client-side fault injection (the native analog of
 // the Python Socket.write seam, rpc/fault_injector.py): every
 // fail_every'th tb_channel_call answers err_code (0 -> EINTERNAL)
@@ -280,6 +343,18 @@ void tb_channel_destroy(tb_channel* ch);
 long tb_channel_pump(tb_channel* ch, const void* meta, size_t meta_len,
                      const void* payload, size_t payload_len, int n,
                      int inflight, int timeout_ms);
+
+// ---- codec table (the native compress/auth seam's codecs, exported) ----
+// codec: 1 = snappy (block format), 2 = gzip (deterministic container,
+// mtime=0), 3 = zlib level 1.  Appends the result to `out` and returns
+// the byte count, or negative: -1 corrupt input, -2 output beyond
+// max_out (decompress only; 0 = unlimited), -3 unknown codec.  Any
+// thread (per-thread codec state).  protocol/compress.py prefers these
+// over its pure-Python twins so BOTH planes run the identical codec.
+long tb_codec_compress(int codec, const void* in, size_t in_len,
+                       tb_iobuf* out);
+long tb_codec_decompress(int codec, const void* in, size_t in_len,
+                         size_t max_out, tb_iobuf* out);
 
 // ---- work-stealing deque (Chase–Lev) ----
 // The dispatch pool's per-reactor queue, exported standalone so the

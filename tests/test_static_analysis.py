@@ -167,6 +167,38 @@ class TestFfiCheckerCatchesDrift:
             for v in vs
         ), _fmt(vs)
 
+    def test_width_change_in_auth_export(self, tbnet_text):
+        # ISSUE 11 acceptance: the FFI gate covers the new compress/auth
+        # surface too — a width flip in tb_server_set_auth_tokens' blob
+        # length flips the checker red
+        mut = self._mutate(
+            tbnet_text,
+            "int tb_server_set_auth_tokens(tb_server* s, const char* blob,\n"
+            "                              size_t blob_len);",
+            "int tb_server_set_auth_tokens(tb_server* s, const char* blob,\n"
+            "                              int blob_len);",
+        )
+        vs = ffi_check.check(tbnet_text=mut)
+        assert any(
+            v.rule == "ffi-type" and "tb_server_set_auth_tokens" in v.message
+            for v in vs
+        ), _fmt(vs)
+
+    def test_auth_callback_layout_drift_flips_red(self, tbnet_text):
+        # the tb_auth_fn <-> AUTH_FN layout is checked field-for-field:
+        # dropping the peer-port argument is an ffi-callback violation
+        mut = self._mutate(
+            tbnet_text,
+            "typedef int (*tb_auth_fn)(void* ud, const char* auth_data, "
+            "size_t auth_len,\n"
+            "                          const char* peer_ip, int peer_port);",
+            "typedef int (*tb_auth_fn)(void* ud, const char* auth_data, "
+            "size_t auth_len,\n"
+            "                          const char* peer_ip);",
+        )
+        vs = ffi_check.check(tbnet_text=mut)
+        assert any(v.rule == "ffi-callback" for v in vs), _fmt(vs)
+
     def test_signedness_change(self, tbnet_text):
         mut = self._mutate(
             tbnet_text,

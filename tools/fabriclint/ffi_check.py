@@ -81,6 +81,7 @@ _FUNCPTR_MIRRORS = {
         "incubator_brpc_tpu.transport.native_plane",
         "NATIVE_METHOD_FN",
     ),
+    "tb_auth_fn": ("incubator_brpc_tpu.native", "AUTH_FN"),
 }
 
 

@@ -28,9 +28,16 @@ def run_press(
     native_plane: bool = False,
     fault_rate: float = 0.0,
     fault_delay_ms: float = 0.0,
+    compress_type: str = "",
+    auth_token: str = "",
 ) -> dict:
     from incubator_brpc_tpu.bvar import LatencyRecorder
-    from incubator_brpc_tpu.rpc import Channel, ChannelOptions
+    from incubator_brpc_tpu.rpc import (
+        Channel,
+        ChannelOptions,
+        Controller,
+        TokenAuthenticator,
+    )
 
     if fault_rate > 0 or fault_delay_ms > 0:
         # one-command brownout run: arm the deterministic fault seam of
@@ -76,11 +83,20 @@ def run_press(
                 )
             )
 
+    # compressed/authenticated floods drive the NATIVE client seam when
+    # --native-plane is set: the credential and compress_type stamp the
+    # PRPC meta in C++ (baidu_std is the protocol that carries both), so
+    # one command floods a native target with production-shaped frames
+    proto = "baidu_std" if (compress_type or auth_token) else "tbus_std"
     ch = Channel()
     if not ch.init(
         server,
         options=ChannelOptions(
-            timeout_ms=timeout_ms, transport=transport, native_plane=native_plane
+            timeout_ms=timeout_ms,
+            transport=transport,
+            native_plane=native_plane,
+            protocol=proto,
+            auth=TokenAuthenticator([auth_token]) if auth_token else None,
         ),
     ):
         raise SystemExit(f"cannot init channel to {server}")
@@ -94,7 +110,11 @@ def run_press(
         ok = fail = 0
         while time.monotonic() < stop_at:
             t0 = time.perf_counter()
-            cntl = ch.call_method(service, method, payload)
+            cntl = None
+            if compress_type:
+                cntl = Controller()
+                cntl.compress_type = compress_type
+            cntl = ch.call_method(service, method, payload, cntl=cntl)
             if cntl.ok():
                 ok += 1
                 latency << (time.perf_counter() - t0) * 1e6
@@ -133,6 +153,8 @@ def run_reactor_press(
     timeout_ms: float = 1000,
     fault_rate: float = 0.0,
     fault_delay_ms: float = 0.0,
+    compress_type: str = "",
+    auth_token: str = "",
 ) -> dict:
     """Sharded-accept load run: ``reactors * conns_per_reactor`` native
     client channels (each pinned to its own client reactor shard at
@@ -174,7 +196,26 @@ def run_reactor_press(
         )
     ip, _, port = server.rpartition(":")
     nconns = max(1, reactors) * max(1, conns_per_reactor)
-    chans = [NativeClientChannel(ip, int(port)) for _ in range(nconns)]
+    # compressed/authenticated floods speak baidu_std (the protocol that
+    # carries compress_type/authentication_data) on the NATIVE client
+    # seam: the payload compresses ONCE here, the credential and codec id
+    # stamp every frame's RpcMeta in C++
+    production = bool(compress_type or auth_token)
+    proto = "baidu_std" if production else "tbus_std"
+    chans = [
+        NativeClientChannel(ip, int(port), protocol=proto)
+        for _ in range(nconns)
+    ]
+    if production:
+        from incubator_brpc_tpu.protocol import compress as compress_mod
+
+        if compress_type:
+            payload = compress_mod.compress(compress_type, payload)
+        for ch in chans:
+            if auth_token:
+                ch.set_auth(auth_token)
+            if compress_type:
+                ch.set_request_compress(compress_type)
     latency = LatencyRecorder(name=None)
     stop_at = time.monotonic() + duration
     counts = {"ok": 0, "fail": 0}
@@ -417,6 +458,20 @@ def main(argv=None) -> int:
         help="connections per reactor group for --reactors runs",
     )
     p.add_argument(
+        "--compress-type", choices=("none", "snappy", "gzip", "zlib1"),
+        default="none",
+        help="compress request payloads with this codec (baidu_std wire "
+        "compress_type; with --native-plane or --reactors the flood rides "
+        "the C++ client seam end to end — compressed once, stamped per "
+        "frame in C++)",
+    )
+    p.add_argument(
+        "--auth-token", default="",
+        help="authenticate the flood with this bearer token "
+        "(authentication_data on the first request per connection; pair "
+        "with a server running TokenAuthenticator)",
+    )
+    p.add_argument(
         "--fault-rate", type=float, default=0.0,
         help="inject transport-write failures on this fraction of "
         "operations (deterministic counter schedule; drives the "
@@ -483,6 +538,10 @@ def main(argv=None) -> int:
             timeout_ms=args.timeout_ms,
             fault_rate=args.fault_rate,
             fault_delay_ms=args.fault_delay_ms,
+            compress_type=(
+                "" if args.compress_type == "none" else args.compress_type
+            ),
+            auth_token=args.auth_token,
         )
         if stats["reactor_conns"]:
             dist = " ".join(
@@ -521,6 +580,10 @@ def main(argv=None) -> int:
         native_plane=args.native_plane,
         fault_rate=args.fault_rate,
         fault_delay_ms=args.fault_delay_ms,
+        compress_type=(
+            "" if args.compress_type == "none" else args.compress_type
+        ),
+        auth_token=args.auth_token,
     )
     print(
         f"qps={stats['qps']:.0f} ok={stats['ok']} fail={stats['fail']} "
