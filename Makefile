@@ -3,9 +3,12 @@
 #   make lint  — fabriclint (FFI signature cross-check, hot-path purity,
 #                flag/bvar registry lint, callback keepalive, tb_* return
 #                audit) AND fabricverify (lock-order graph, lifecycle
-#                balance, protocol model checking); both run, exit codes
-#                merged (tools/fabriclint + tools/fabricverify; the same
-#                checks run inside tier-1 via tests/test_static_analysis.py)
+#                balance, protocol model checking) AND fabricscan
+#                (C++-plane wire-bounds taint dataflow, reactor-ownership
+#                checking, cross-plane parity lint); all three run, exit
+#                codes merged (tools/fabriclint + tools/fabricverify +
+#                tools/fabricscan; the same checks run inside tier-1 via
+#                tests/test_static_analysis.py)
 #   make verify-models — the explicit-state model checker alone, with
 #                per-model state counts on stdout
 #   make san   — sanitizer harness: ASAN+UBSAN over the native test
@@ -25,6 +28,7 @@ lint:
 	@rc=0; \
 	$(PY) -m tools.fabriclint || rc=1; \
 	$(PY) -m tools.fabricverify || rc=1; \
+	$(PY) -m tools.fabricscan || rc=1; \
 	exit $$rc
 
 verify-models:

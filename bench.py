@@ -919,6 +919,7 @@ BASELINES = {
     "native_pump_scaling": "r05 one-core baseline: 544 ns/echo, ~1.9 M qps with client AND server sharing ONE core, and BENCH_r04's flat 1/2/4-conn curve (~1 M qps each — one loop thread was the ceiling). The matrix is R reactors x C connections (aggregate qps); scaling_efficiency = best 4-reactor / best 1-reactor. The reference scales 3-5 M qps/thread across 24 cores (docs/cn/benchmark.md:112-122); on this host the reachable ratio is capped by host_cpus, since the C client pumps burn the same cores the reactors serve from",
     "prpc_pump_telemetry": "prpc_pump_ns runs with the native telemetry ring ON (the default: per-method latency + sampled rpcz + limiter feedback recorded in-path); prpc_pump_notelem_ns is the same pump ring-less — the delta is the instrumentation tax (acceptance < 5%)",
     "prpc_production_shaped": "compressed and/or authenticated PRPC floods ride the native codec/auth seam end to end (PR 11); BEFORE this seam the same wire shape fell off to the ~35 us Python route — r05-era context: prpc_pump_ns 544 ns vs rpc-over-Python ~35 us, a ~60x tax on production-shaped traffic. Measured on this 2-core container at introduction (host_calibration_ms ~6.4): prpc_plain_4k_pump_ns ~2.3 us, prpc_compressed_pump_ns (snappy+auth, 4 KiB compressible) ~4.2-4.8 us = ~1.9-2.0x of the bare same-size pump (acceptance ~2x; incompressible ~1.3x, auth-only within noise of bare — the steady-state token check is one cached-verdict load), the L5 crossing rpc_echo_prpc_snappy_us ~130 us, and rpc_echo_prpc_snappy_python_us ~950 us — the Python-plane before-number for the SAME wire shape, ~200x the interpreter-free pump and ~7x the native L5 row; compare medians WITH host_calibration_ms context per the PR 10 re-anchor note",
+    "analysis_layer_cost": "ISSUE 12 re-run after fabricscan landed — static analysis is lint/build-time only, and the only wire-path code changes were the pump's tbus frame cap and the snappy table mask, both single O(1) compares: at host_calibration_ms 6.25 (quiet host), prpc_pump_ns 1137 (notelem 1156), prpc_plain_4k_pump_ns 2793, prpc_compressed_pump_ns 5180 (snappy+auth, compressible 4 KiB) = 1.85x plain, native_pump_ns 1295 — the plain + compressed pump headline sits inside the PR 11 introduction envelope (~2.3 us plain / 1.9-2.0x compressed at calibration ~6.4), i.e. no measurable hot-path cost from the analysis layer",
 }
 
 

@@ -453,6 +453,28 @@ SIGNATURES = {
         ctypes.c_long,
         [ctypes.c_int, ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t, b],
     ),
+    # ---- RpcMeta scanner (differential-testing surface): the server cut
+    # path's proto2 scanner over one meta blob, so the wire-decoder fuzz
+    # (tests/test_wire_differential.py) diffs it against baidu_std's
+    # pure-Python decoder on identical bytes ----
+    "tb_scan_prpc_meta": (
+        ctypes.c_long,
+        [
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t),
+        ],
+    ),
     # ---- work-stealing deque (Chase–Lev; the dispatch pool's queue) ----
     "tb_wsq_create": (b, [ctypes.c_size_t]),
     "tb_wsq_destroy": (None, [b]),

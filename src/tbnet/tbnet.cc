@@ -486,9 +486,18 @@ void put_uvarint(std::vector<uint8_t>& out, uint64_t v) {
 // different epoch and reads as empty — invisible to the output bytes,
 // which only depend on "present or not")
 struct SnappyTable {
-  std::vector<uint64_t> slots;  // (epoch << 32) | (pos + 1)
-  uint32_t epoch = 0;
+  std::vector<uint64_t> slots;  // (epoch << 32) | (pos + 1)  // fabricscan: owner(loop)
+  uint32_t epoch = 0;  // fabricscan: owner(loop)
 };
+
+// hash-table index mask: the shift (>= 18) already caps every index
+// below the table size, so masking is an identity on every input — it
+// exists to make the bound explicit (and statically checkable) at the
+// subscript itself.  Mask and allocation both derive from the same
+// bits constant so they cannot diverge (the parity pass diffs the
+// bits against snappy_codec.py's _MAX_TABLE).
+constexpr uint32_t kSnappyTableBits = 14;
+constexpr uint32_t kSnappyTableMask = (1u << kSnappyTableBits) - 1;
 
 void snappy_emit_literal(std::vector<uint8_t>& out, const uint8_t* s,
                          size_t n) {
@@ -543,6 +552,7 @@ void snappy_emit_copy(std::vector<uint8_t>& out, size_t off, size_t len) {
   }
 }
 
+// fabricscan: borrows(SnappyTable)
 void snappy_compress_block(const uint8_t* data, size_t n,
                            std::vector<uint8_t>& out, SnappyTable& tbl) {
   out.clear();
@@ -554,16 +564,18 @@ void snappy_compress_block(const uint8_t* data, size_t n,
   }
   size_t ts = 256;
   int shift = 24;  // 32 - log2(ts)
-  while (ts < (1u << 14) && ts < n) {
+  while (ts < (1u << kSnappyTableBits) && ts < n) {
     ts <<= 1;
     --shift;
   }
-  if (tbl.slots.size() < (1u << 14)) tbl.slots.assign(1u << 14, 0);
+  if (tbl.slots.size() < (1u << kSnappyTableBits))
+    tbl.slots.assign(1u << kSnappyTableBits, 0);
   const uint64_t epoch = static_cast<uint64_t>(++tbl.epoch);
   size_t i = 0, lit = 0;
   uint32_t skip = 32;
   while (i + 4 <= n) {
     uint32_t h = (load32le(data + i) * 0x1E35A7BDu) >> shift;
+    h &= kSnappyTableMask;  // identity: h < table size by construction
     uint64_t e = tbl.slots[h];
     tbl.slots[h] = (epoch << 32) | (i + 1);
     size_t cand = (e >> 32) == epoch ? static_cast<size_t>(
@@ -650,14 +662,14 @@ int snappy_decompress_block(const uint8_t* in, size_t n, size_t max_out,
 // instances on pool workers (off the reactor's hot path by definition).
 struct ZCtx {
   SnappyTable snap;
-  std::vector<uint8_t> dbuf;  // decompressed request payload
-  std::vector<uint8_t> cbuf;  // recompressed response payload
-  std::vector<uint8_t> abuf;  // request attachment staging
-  std::vector<uint8_t> ibuf;  // contiguous compressed input staging
-  z_stream defl_raw{};        // gzip body: raw deflate, level 6
-  z_stream defl_zlib{};       // zlib1: zlib wrapper, level 1
-  z_stream infl{};            // inflate, wbits swapped per container
-  bool defl_raw_ok = false, defl_zlib_ok = false, infl_ok = false;
+  std::vector<uint8_t> dbuf;  // decompressed request payload  // fabricscan: owner(loop)
+  std::vector<uint8_t> cbuf;  // recompressed response payload  // fabricscan: owner(loop)
+  std::vector<uint8_t> abuf;  // request attachment staging  // fabricscan: owner(loop)
+  std::vector<uint8_t> ibuf;  // contiguous compressed input staging  // fabricscan: owner(loop)
+  z_stream defl_raw{};        // gzip body: raw deflate, level 6  // fabricscan: owner(loop)
+  z_stream defl_zlib{};       // zlib1: zlib wrapper, level 1  // fabricscan: owner(loop)
+  z_stream infl{};            // inflate, wbits swapped per container  // fabricscan: owner(loop)
+  bool defl_raw_ok = false, defl_zlib_ok = false, infl_ok = false;  // fabricscan: owner(loop)
   ~ZCtx() {
     if (defl_raw_ok) deflateEnd(&defl_raw);
     if (defl_zlib_ok) deflateEnd(&defl_zlib);
@@ -668,6 +680,7 @@ struct ZCtx {
 // deterministic gzip container: the exact bytes protocol/compress.py's
 // gzip codec (gzip.compress(data, 6, mtime=0) on CPython) emits — fixed
 // header, raw deflate level 6 / memLevel 8, CRC32 + ISIZE trailer
+// fabricscan: borrows(ZCtx)
 int gzip_compress(ZCtx& z, const uint8_t* in, size_t n,
                   std::vector<uint8_t>& out) {
   if (!z.defl_raw_ok) {
@@ -699,6 +712,7 @@ int gzip_compress(ZCtx& z, const uint8_t* in, size_t n,
   return 0;
 }
 
+// fabricscan: borrows(ZCtx)
 int zlib1_compress(ZCtx& z, const uint8_t* in, size_t n,
                    std::vector<uint8_t>& out) {
   if (!z.defl_zlib_ok) {
@@ -726,6 +740,7 @@ int zlib1_compress(ZCtx& z, const uint8_t* in, size_t n,
 // Mirrors protocol/compress.py's bounded decompressobj discipline —
 // including "one member, no trailing bytes" — so the planes agree on
 // what parses.
+// fabricscan: borrows(ZCtx)
 int zlib_decompress(ZCtx& z, int wbits, const uint8_t* in, size_t n,
                     size_t max_out, std::vector<uint8_t>& out) {
   if (!z.infl_ok) {
@@ -763,6 +778,7 @@ int zlib_decompress(ZCtx& z, int wbits, const uint8_t* in, size_t n,
 }
 
 // 0 ok, -1 corrupt, -2 beyond max_out, -3 unknown codec id
+// fabricscan: borrows(ZCtx)
 int codec_decompress(ZCtx& z, uint32_t codec, const uint8_t* in, size_t n,
                      size_t max_out, std::vector<uint8_t>& out) {
   switch (codec) {
@@ -777,6 +793,7 @@ int codec_decompress(ZCtx& z, uint32_t codec, const uint8_t* in, size_t n,
 }
 
 // 0 ok (out filled), nonzero on codec trouble (caller sends uncompressed)
+// fabricscan: borrows(ZCtx)
 int codec_compress(ZCtx& z, uint32_t codec, const uint8_t* in, size_t n,
                    std::vector<uint8_t>& out) {
   switch (codec) {
@@ -809,6 +826,7 @@ uint32_t get_be32(const uint8_t* p) {
 // analog shared by the server cut loop and both client read paths.
 // 0 = sizes filled and sane (magic, meta <= body <= max_body);
 // 1 = fewer than 12 bytes buffered; -1 = not a PRPC frame / oversized.
+// fabricscan: sanitizes(body_len, meta_len)
 int prpc_peek(const tb_iobuf* in, uint32_t* body_len, uint32_t* meta_len,
               size_t max_body) {
   if (tb_iobuf_size(in) < kPrpcHeader) return 1;
@@ -982,33 +1000,33 @@ void pack_flat(tb_iobuf* out, const void* meta, size_t meta_len,
 struct NetLoop;
 
 struct PollObj {
-  int kind;  // 0 conn, 1 listener, 2 wake
+  int kind;  // 0 conn, 1 listener, 2 wake  // fabricscan: owner(init)
   explicit PollObj(int k) : kind(k) {}
   virtual ~PollObj() = default;
 };
 
 struct NetConn : PollObj {
   NetConn() : PollObj(0) {}
-  int fd = -1;
-  uint64_t token = 0;
-  NetLoop* loop = nullptr;
-  tb_server* srv = nullptr;
-  tb_iobuf* rbuf = nullptr;
-  tb_iobuf* wbuf = nullptr;
+  int fd = -1;  // fabricscan: owner(init)
+  uint64_t token = 0;  // fabricscan: owner(init)
+  NetLoop* loop = nullptr;  // fabricscan: owner(init)
+  tb_server* srv = nullptr;  // fabricscan: owner(init)
+  tb_iobuf* rbuf = nullptr;  // fabricscan: owner(loop)
+  tb_iobuf* wbuf = nullptr;  // fabricscan: owner(shared)
   std::mutex wmu;
-  bool want_out = false;
-  bool sniffed = false;
-  int proto = 0;  // kProtoTbus / kProtoPrpc once sniffed
+  bool want_out = false;  // fabricscan: owner(shared)
+  bool sniffed = false;  // fabricscan: owner(loop)
+  int proto = 0;  // kProtoTbus / kProtoPrpc once sniffed  // fabricscan: owner(loop)
   // one-entry meta memo: a client pumping one method sends byte-identical
   // meta every frame — remember the resolved native method for those exact
   // bytes and skip the JSON scan + name join + flatmap probe (the
   // preferred-protocol-memory idea applied to routing).  On PRPC conns the
   // memo key is the RpcRequestMeta SUBMESSAGE (the correlation id lives
   // outside it, so the submessage stays byte-identical across a pump).
-  std::string memo_meta;
-  uint64_t memo_idx = 0;
-  long memo_attachment = -1;  // -1 = no memo
-  long memo_timeout = 0;      // timeout_ms of the memoized meta bytes
+  std::string memo_meta;  // fabricscan: owner(loop)
+  uint64_t memo_idx = 0;  // fabricscan: owner(loop)
+  long memo_attachment = -1;  // -1 = no memo  // fabricscan: owner(loop)
+  long memo_timeout = 0;      // timeout_ms of the memoized meta bytes  // fabricscan: owner(loop)
   // stamped once per readable burst (deadline shed baseline + idle reap);
   // written by the loop thread, read by tb_server_close_idle callers
   std::atomic<uint64_t> last_active_ms{0};
@@ -1021,8 +1039,9 @@ struct NetConn : PollObj {
 };
 
 std::mutex g_conn_mu;
-tb_respool* g_conn_pool = nullptr;  // slots hold NetConn*
+tb_respool* g_conn_pool = nullptr;  // slots hold NetConn*  // fabricscan: owner(shared)
 
+// fabricscan: role(init)
 uint64_t conn_register(NetConn* c) {
   std::lock_guard<std::mutex> g(g_conn_mu);
   if (g_conn_pool == nullptr) g_conn_pool = tb_respool_create(sizeof(void*));
@@ -1063,20 +1082,20 @@ void conn_retire(NetConn* c) {
 
 struct Wake : PollObj {
   Wake() : PollObj(2) {}
-  int fd = -1;
+  int fd = -1;  // fabricscan: owner(init)
 };
 
 struct Listener : PollObj {
   Listener() : PollObj(1) {}
-  int fd = -1;
+  int fd = -1;  // fabricscan: owner(loop)
 };
 
 struct TelemetryRing;
 struct WorkDeque;
 
 struct NetLoop {
-  int id = 0;  // reactor index (telemetry records carry it)
-  int epfd = -1;
+  int id = 0;  // reactor index (telemetry records carry it)  // fabricscan: owner(init)
+  int epfd = -1;  // fabricscan: owner(init)
   Wake wake;
   // per-reactor listener: every reactor binds the same port with
   // SO_REUSEPORT (multi-reactor servers) so accepts run in parallel and
@@ -1085,16 +1104,16 @@ struct NetLoop {
   Listener listener;
   std::thread th;
   std::atomic<bool> stopping{false};
-  std::vector<NetConn*> conns;
+  std::vector<NetConn*> conns;  // fabricscan: owner(shared)
   std::mutex conns_mu;  // guards conns (loop thread + stop-time sweep)
   // per-reactor data pools: the burst response batch and per-frame body
   // scratch are owned by the reactor and reused across bursts — nothing
   // on the cut/pack path allocates per burst or crosses a lock
-  tb_iobuf* batch = nullptr;
-  tb_iobuf* scratch = nullptr;
+  tb_iobuf* batch = nullptr;  // fabricscan: owner(loop)
+  tb_iobuf* scratch = nullptr;  // fabricscan: owner(loop)
   // per-reactor codec context: reusable z_streams, snappy table, and the
   // decompress/recompress scratch vectors (zero cross-reactor sharing)
-  ZCtx* zctx = nullptr;
+  ZCtx* zctx = nullptr;  // fabricscan: owner(init)
   // per-reactor counters (tb_server_reactor_stats / stats roll-up)
   std::atomic<uint64_t> live_conns{0};
   std::atomic<uint64_t> native_reqs{0};
@@ -1102,15 +1121,15 @@ struct NetLoop {
   // never contend with another reactor's — set once before listen
   std::atomic<TelemetryRing*> telemetry{nullptr};
   // per-reactor work-stealing deque (dispatch pool enabled only)
-  WorkDeque* deque = nullptr;
+  WorkDeque* deque = nullptr;  // fabricscan: owner(init)
   // loop-thread-only: inline user-callback dispatches in the current
   // readable burst (the queue-depth pressure signal for pool deferral)
-  int inline_burst = 0;
+  int inline_burst = 0;  // fabricscan: owner(loop)
 };
 
 struct NativeMethod {
-  int kind;
-  uint32_t index = 0;  // position in tb_server::native_methods (telemetry key)
+  int kind;  // fabricscan: owner(init)
+  uint32_t index = 0;  // position in tb_server::native_methods (telemetry key)  // fabricscan: owner(init)
   // runtime-retunable (tb_server_set_native_max_concurrency stores from
   // the application thread while loop threads load per request)
   std::atomic<uint32_t> max_concurrency{0};
@@ -1120,9 +1139,9 @@ struct NativeMethod {
   // long-running: with a dispatch pool enabled, requests to this method
   // always defer to the pool (tb_server_set_native_long_running)
   std::atomic<uint32_t> long_running{0};
-  std::string full_name;
-  tb_native_fn fn = nullptr;  // kKindCallback
-  void* ud = nullptr;
+  std::string full_name;  // fabricscan: owner(init)
+  tb_native_fn fn = nullptr;  // kKindCallback  // fabricscan: owner(init)
+  void* ud = nullptr;  // fabricscan: owner(init)
 };
 
 struct ErrorCodes {
@@ -1170,17 +1189,17 @@ inline uint64_t telemetry_ticks() {
 
 struct TelemetryCell {
   std::atomic<uint64_t> seq{0};
-  tb_telemetry_record rec;
+  tb_telemetry_record rec;  // fabricscan: owner(shared)
 };
 
 struct TelemetryRing {
-  TelemetryCell* cells = nullptr;
-  size_t mask = 0;
-  uint32_t sample_every = 0;  // every Nth record carries sampled=1; 0 = never
+  TelemetryCell* cells = nullptr;  // fabricscan: owner(init)
+  size_t mask = 0;  // fabricscan: owner(init)
+  uint32_t sample_every = 0;  // every Nth record carries sampled=1; 0 = never  // fabricscan: owner(init)
   // tick->ns calibration anchor (taken at creation, ratio refined per
   // drain); on non-x86 ticks ARE ns and the identity ratio holds
-  uint64_t cal_ticks0 = 0;
-  uint64_t cal_mono0 = 0;
+  uint64_t cal_ticks0 = 0;  // fabricscan: owner(init)
+  uint64_t cal_mono0 = 0;  // fabricscan: owner(init)
   std::atomic<double> ns_per_tick{1.0};
   alignas(64) std::atomic<uint64_t> enqueue_pos{0};
   alignas(64) std::atomic<uint64_t> dequeue_pos{0};
@@ -1268,7 +1287,7 @@ struct WorkDeque {
   alignas(64) std::atomic<int64_t> top{0};     // thieves CAS this
   alignas(64) std::atomic<int64_t> bottom{0};  // owner only
   std::atomic<uint64_t>* cells = nullptr;
-  size_t mask = 0;
+  size_t mask = 0;  // fabricscan: owner(init)
 
   bool push(uint64_t v) {  // owner only
     int64_t b = bottom.load(std::memory_order_relaxed);
@@ -1331,16 +1350,16 @@ struct WorkDeque {
 // method, pack the response in the right wire protocol, and append the
 // completion record into the OWNING reactor's telemetry ring
 struct WorkTask {
-  NativeMethod* nm = nullptr;
-  tb_server* srv = nullptr;
-  NetLoop* loop = nullptr;  // owning reactor (ring + reactor_id)
-  uint64_t conn_token = 0;
-  ReqCtx rc{};
-  uint32_t limited = 0;    // nprocessing held across queue + run
-  uint64_t t_start = 0;    // telemetry ticks at dispatch entry (0 = off)
-  uint64_t arrival_ms = 0; // frame's burst-arrival stamp (deadline base)
-  size_t req_len = 0;
-  char* req = nullptr;     // contiguous request copy (worker frees)
+  NativeMethod* nm = nullptr;  // fabricscan: owner(worker)
+  tb_server* srv = nullptr;  // fabricscan: owner(worker)
+  NetLoop* loop = nullptr;  // owning reactor (ring + reactor_id)  // fabricscan: owner(worker)
+  uint64_t conn_token = 0;  // fabricscan: owner(worker)
+  ReqCtx rc{};  // fabricscan: owner(worker)
+  uint32_t limited = 0;    // nprocessing held across queue + run  // fabricscan: owner(worker)
+  uint64_t t_start = 0;    // telemetry ticks at dispatch entry (0 = off)  // fabricscan: owner(worker)
+  uint64_t arrival_ms = 0; // frame's burst-arrival stamp (deadline base)  // fabricscan: owner(worker)
+  size_t req_len = 0;  // fabricscan: owner(worker)
+  char* req = nullptr;     // contiguous request copy (worker frees)  // fabricscan: owner(worker)
 };
 
 struct DispatchPool {
@@ -1354,19 +1373,19 @@ struct DispatchPool {
 }  // namespace
 
 struct tb_server {
-  std::vector<NetLoop*> loops;
-  int port = 0;
+  std::vector<NetLoop*> loops;  // fabricscan: owner(init)
+  int port = 0;  // fabricscan: owner(init)
   std::atomic<size_t> next_loop{0};
-  tb_frame_fn frame_cb = nullptr;
-  void* frame_ctx = nullptr;
-  tb_handoff_fn handoff_cb = nullptr;
-  void* handoff_ctx = nullptr;
-  tb_closed_fn closed_cb = nullptr;
-  void* closed_ctx = nullptr;
-  size_t max_body = 512u << 20;
-  ErrorCodes errs;
-  tb_flatmap* methods = nullptr;  // key -> index into native_methods
-  std::vector<NativeMethod*> native_methods;
+  tb_frame_fn frame_cb = nullptr;  // fabricscan: owner(init)
+  void* frame_ctx = nullptr;  // fabricscan: owner(init)
+  tb_handoff_fn handoff_cb = nullptr;  // fabricscan: owner(init)
+  void* handoff_ctx = nullptr;  // fabricscan: owner(init)
+  tb_closed_fn closed_cb = nullptr;  // fabricscan: owner(init)
+  void* closed_ctx = nullptr;  // fabricscan: owner(init)
+  size_t max_body = 512u << 20;  // fabricscan: owner(init)
+  ErrorCodes errs;  // fabricscan: owner(init)
+  tb_flatmap* methods = nullptr;  // key -> index into native_methods  // fabricscan: owner(init)
+  std::vector<NativeMethod*> native_methods;  // fabricscan: owner(init)
   std::atomic<uint64_t> accepted{0};
   std::atomic<uint64_t> cb_frames{0};
   std::atomic<uint64_t> handoffs{0};
@@ -1377,18 +1396,18 @@ struct tb_server {
   // response compression floor: decompressed payloads below it answer
   // uncompressed (native_compress_min_bytes; the Python route applies
   // the same floor so the planes stay byte-identical)
-  size_t compress_min = 0;
+  size_t compress_min = 0;  // fabricscan: owner(init)
   // decompressed-size ceiling (max_decompress_bytes): a tiny bomb must
   // not expand unbounded into server memory on either plane
-  size_t max_decompress = 256u << 20;
+  size_t max_decompress = 256u << 20;  // fabricscan: owner(init)
   // auth seam: a verifier callback (tb_server_set_auth — the arbitrary-
   // Authenticator deferral, one interpreter crossing per CONNECTION) or
   // a constant-time token table (tb_server_set_auth_tokens — the
   // steady-state path never enters the interpreter).  Verified once per
   // connection, verdict cached on the conn (brpc's first-frame auth).
-  tb_auth_fn auth_fn = nullptr;
-  void* auth_ud = nullptr;
-  std::vector<std::string> auth_tokens;
+  tb_auth_fn auth_fn = nullptr;  // fabricscan: owner(init)
+  void* auth_ud = nullptr;  // fabricscan: owner(init)
+  std::vector<std::string> auth_tokens;  // fabricscan: owner(init)
   std::atomic<bool> auth_enabled{false};
   std::atomic<uint64_t> auth_rejects{0};
   // compressed-traffic byte counters (native_compress_bytes_saved feed):
@@ -1402,12 +1421,12 @@ struct tb_server {
   // next wakeup (per-reactor listeners via SO_REUSEPORT)
   std::atomic<bool> accept_paused{false};
   std::atomic<bool> stopped{false};
-  bool listening = false;       // pre-listen-only knobs gate on this
-  bool telemetry_enabled = false;  // per-reactor rings live in the loops
+  bool listening = false;       // pre-listen-only knobs gate on this  // fabricscan: owner(init)
+  bool telemetry_enabled = false;  // per-reactor rings live in the loops  // fabricscan: owner(init)
   // work-stealing dispatch pool (tb_server_set_dispatch_pool): null =
   // every native method runs inline on its reactor
-  DispatchPool* pool = nullptr;
-  int pool_workers = 0;
+  DispatchPool* pool = nullptr;  // fabricscan: owner(init)
+  int pool_workers = 0;  // fabricscan: owner(init)
 };
 
 namespace {
@@ -1471,6 +1490,7 @@ void set_nodelay(int fd) {
 // ---- write path (per-conn mutex; any thread) ----
 
 // under c->wmu: drain wbuf to the fd, arming/disarming EPOLLOUT
+// fabricscan: locked
 void conn_flush_locked(NetConn* c) {
   while (tb_iobuf_size(c->wbuf) > 0) {
     long rc = tb_iobuf_cut_into_fd(c->wbuf, c->fd, 4u << 20);
@@ -1582,6 +1602,7 @@ void push_completion_record(TelemetryRing* tr, NativeMethod* nm,
 // `z`/`srv` drive response recompression: a PRPC request that arrived
 // compressed gets its response compressed with the same codec when the
 // payload clears the floor (the Python _send_response discipline).
+// fabricscan: borrows(ZCtx)
 void pack_callback_result(tb_iobuf* out, NativeMethod* nm, const ReqCtx& rc,
                           uint64_t cid64, int rc2, const char* resp,
                           size_t resp_len, uint32_t* t_err, size_t* t_resp,
@@ -1668,6 +1689,7 @@ void run_pool_task(WorkTask* t) {
   delete t;
 }
 
+// fabricscan: role(worker)
 void pool_worker(tb_server* s, size_t widx) {
   DispatchPool* p = s->pool;
   const size_t nloops = s->loops.size();
@@ -2270,6 +2292,7 @@ void accept_ready(tb_server* s, Listener* lst) {
   }
 }
 
+// fabricscan: role(loop)
 void loop_run(tb_server* s, NetLoop* l) {
   epoll_event evs[128];
   while (!l->stopping.load(std::memory_order_acquire)) {
@@ -2318,6 +2341,7 @@ void loop_run(tb_server* s, NetLoop* l) {
 // server C API
 // ---------------------------------------------------------------------------
 
+// fabricscan: role(init)
 tb_server* tb_server_create(int nloops) {
   if (nloops < 1) nloops = 1;
   tb_server* s = new tb_server();
@@ -2344,6 +2368,7 @@ int tb_server_num_reactors(const tb_server* s) {
   return static_cast<int>(s->loops.size());
 }
 
+// fabricscan: role(init)
 int tb_server_set_dispatch_pool(tb_server* s, int nworkers) {
   // pre-listen only: loop threads read s->pool / deques without fences
   if (s->listening) return -1;
@@ -2362,31 +2387,38 @@ int tb_server_set_native_long_running(tb_server* s, const char* full_name,
   return -1;
 }
 
+// fabricscan: role(init)
 void tb_server_set_frame_cb(tb_server* s, tb_frame_fn cb, void* ctx) {
   s->frame_cb = cb;
   s->frame_ctx = ctx;
 }
 
+// fabricscan: role(init)
 void tb_server_set_handoff_cb(tb_server* s, tb_handoff_fn cb, void* ctx) {
   s->handoff_cb = cb;
   s->handoff_ctx = ctx;
 }
 
+// fabricscan: role(init)
 void tb_server_set_closed_cb(tb_server* s, tb_closed_fn cb, void* ctx) {
   s->closed_cb = cb;
   s->closed_ctx = ctx;
 }
 
+// fabricscan: role(init)
 void tb_server_set_max_body(tb_server* s, size_t bytes) { s->max_body = bytes; }
 
+// fabricscan: role(init)
 void tb_server_set_compress_min_bytes(tb_server* s, size_t bytes) {
   s->compress_min = bytes;
 }
 
+// fabricscan: role(init)
 void tb_server_set_max_decompress(tb_server* s, size_t bytes) {
   s->max_decompress = bytes != 0 ? bytes : static_cast<size_t>(-1);
 }
 
+// fabricscan: role(init)
 int tb_server_set_auth(tb_server* s, tb_auth_fn fn, void* ud) {
   // pre-listen only: loop threads read auth_fn/auth_tokens without fences
   if (s->listening) return -1;
@@ -2397,6 +2429,7 @@ int tb_server_set_auth(tb_server* s, tb_auth_fn fn, void* ud) {
   return 0;
 }
 
+// fabricscan: role(init)
 int tb_server_set_auth_tokens(tb_server* s, const char* blob,
                               size_t blob_len) {
   // blob = repeated [u32 LE length][bytes]; replaces the table wholesale.
@@ -2514,6 +2547,7 @@ long ring_drain(TelemetryRing* r, tb_telemetry_record* out,
 
 }  // namespace
 
+// fabricscan: role(init)
 void tb_server_set_telemetry(tb_server* s, uint32_t capacity,
                              uint32_t sample_every) {
   // pre-listen only: the per-reactor ring pointers are published once,
@@ -2623,6 +2657,7 @@ long tb_server_get_native_max_concurrency(tb_server* s,
   return -1;  // not natively registered
 }
 
+// fabricscan: role(init)
 int tb_server_register_native(tb_server* s, const char* full_name, int kind,
                               uint32_t max_concurrency) {
   if (kind != kKindEcho && kind != kKindNop) return -1;
@@ -2630,6 +2665,7 @@ int tb_server_register_native(tb_server* s, const char* full_name, int kind,
                                 max_concurrency);
 }
 
+// fabricscan: role(init)
 int tb_server_register_native_fn(tb_server* s, const char* full_name,
                                  tb_native_fn fn, void* ud,
                                  uint32_t max_concurrency) {
@@ -2638,6 +2674,7 @@ int tb_server_register_native_fn(tb_server* s, const char* full_name,
                                 max_concurrency);
 }
 
+// fabricscan: role(init)
 int tb_server_listen(tb_server* s, const char* ip, int port) {
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
@@ -2711,6 +2748,7 @@ int tb_server_listen(tb_server* s, const char* ip, int port) {
 
 int tb_server_port(const tb_server* s) { return s->port; }
 
+// fabricscan: role(stop)
 void tb_server_stop(tb_server* s) {
   if (s->stopped.exchange(true)) return;
   for (NetLoop* l : s->loops) {
@@ -2755,6 +2793,7 @@ void tb_server_stop(tb_server* s) {
   }
 }
 
+// fabricscan: role(stop)
 void tb_server_destroy(tb_server* s) {
   tb_server_stop(s);
   for (NetLoop* l : s->loops) {
@@ -2898,34 +2937,34 @@ int tb_conn_set_authenticated(uint64_t token) {
 namespace {
 
 struct Pending {
-  bool targeted;
-  bool done = false;
-  uint32_t err_code = 0;
-  int fail = 0;   // -errno when the channel died under us
-  std::string meta;
-  tb_iobuf* body;  // targeted: caller's out buffer; any-mode: owned temp
+  bool targeted;  // fabricscan: owner(shared)
+  bool done = false;  // fabricscan: owner(shared)
+  uint32_t err_code = 0;  // fabricscan: owner(shared)
+  int fail = 0;   // -errno when the channel died under us  // fabricscan: owner(shared)
+  std::string meta;  // fabricscan: owner(shared)
+  tb_iobuf* body;  // targeted: caller's out buffer; any-mode: owned temp  // fabricscan: owner(shared)
 };
 
 }  // namespace
 
 struct tb_channel {
-  int fd = -1;
-  int proto = 0;  // 0 = tbus_std, 1 = baidu_std (PRPC)
+  int fd = -1;  // fabricscan: owner(init)
+  int proto = 0;  // 0 = tbus_std, 1 = baidu_std (PRPC)  // fabricscan: owner(init)
   // client reactor shard, pinned at connect: the top 8 bits of every cid
   // this channel mints carry it, so completions route to the owning
   // channel's pending table without any cross-channel map and a frame
   // carrying another shard's tag is detectably misrouted
-  uint32_t shard = 0;
+  uint32_t shard = 0;  // fabricscan: owner(init)
   std::atomic<uint64_t> cid_misroutes{0};
   std::mutex wmu;  // writers (pack + writev serialize)
   std::mutex rmu;  // reader election
   std::mutex pmu;  // pending table + done queue + cv
   std::condition_variable pcv;
-  std::unordered_map<uint64_t, Pending*> pending;
-  std::deque<std::pair<uint64_t, Pending*>> doneq;  // any-mode completions
+  std::unordered_map<uint64_t, Pending*> pending;  // fabricscan: owner(shared)
+  std::deque<std::pair<uint64_t, Pending*>> doneq;  // any-mode completions  // fabricscan: owner(shared)
   std::atomic<uint64_t> next_cid{1};
-  tb_iobuf* rbuf = nullptr;
-  tb_iobuf* pump_body = nullptr;  // reused per-response cut target (pump)
+  tb_iobuf* rbuf = nullptr;  // fabricscan: owner(shared)
+  tb_iobuf* pump_body = nullptr;  // reused per-response cut target (pump)  // fabricscan: owner(shared)
   std::atomic<int> err{0};  // sticky -errno
   // counter-scheduled fault injection (tb_channel_set_fault): the native
   // analog of the Python Socket.write seam — every fail_every'th call
@@ -2934,19 +2973,19 @@ struct tb_channel {
   // sleeps delay_ms first.  All zero = disabled (the steady-state cost
   // is one load).
   std::atomic<uint64_t> fault_counter{0};
-  uint32_t fault_fail_every = 0;
-  uint32_t fault_close_every = 0;
-  uint32_t fault_delay_every = 0;
-  uint32_t fault_delay_ms = 0;
-  uint32_t fault_err_code = 0;
+  uint32_t fault_fail_every = 0;  // fabricscan: owner(init)
+  uint32_t fault_close_every = 0;  // fabricscan: owner(init)
+  uint32_t fault_delay_every = 0;  // fabricscan: owner(init)
+  uint32_t fault_delay_ms = 0;  // fabricscan: owner(init)
+  uint32_t fault_err_code = 0;  // fabricscan: owner(init)
   // production-shaped request stamping (baidu_std only; set before
   // concurrent use, like the fault schedule): a channel-default
   // compress_type spliced into RpcMeta field 3 (per-call override rides
   // flags_extra), and the credential for field 7 — stamped until the
   // first successful response proves the connection (the reference's
   // first-request auth fight), then omitted.
-  uint32_t req_compress = 0;
-  std::string auth_data;
+  uint32_t req_compress = 0;  // fabricscan: owner(init)
+  std::string auth_data;  // fabricscan: owner(init)
   std::atomic<bool> auth_proven{false};
 };
 
@@ -2995,6 +3034,7 @@ void channel_fail(tb_channel* ch, int err) {
 // was consumed (fills cid/meta/err_code and cuts payload+attachment into
 // the pending's dst under pmu — same locking contract as the tbus path),
 // 0 when incomplete, -EPROTO on garbage.  Caller holds rmu.
+// fabricscan: locked
 int prpc_complete_one(tb_channel* ch) {
   uint32_t body_len = 0, meta_len = 0;
   int prc = prpc_peek(ch->rbuf, &body_len, &meta_len, kClientMaxBody);
@@ -3044,6 +3084,7 @@ int prpc_complete_one(tb_channel* ch) {
 
 // read whatever arrives within `slice_ms`, completing pendings.  Caller
 // holds rmu.  Returns false when the channel failed.
+// fabricscan: locked
 bool pump_once(tb_channel* ch, int slice_ms) {
   pollfd pf{ch->fd, POLLIN, 0};
   int rc = poll(&pf, 1, slice_ms);
@@ -3081,7 +3122,7 @@ bool pump_once(tb_channel* ch, int slice_ms) {
     int prc = tb_tbus_peek(ch->rbuf, &hdr);
     if (prc == 1) break;
     if (prc == -1 || hdr.meta_len > hdr.body_len ||
-        hdr.body_len > (512u << 20)) {
+        hdr.body_len > kClientMaxBody) {
       channel_fail(ch, -EPROTO);
       return false;
     }
@@ -3209,6 +3250,7 @@ bool wait_or_pump(tb_channel* ch, std::unique_lock<std::mutex>& pl,
 
 }  // namespace
 
+// fabricscan: role(init)
 tb_channel* tb_channel_connect(const char* ip, int port, int timeout_ms,
                                int* err_out) {
   int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
@@ -3265,12 +3307,14 @@ uint64_t tb_channel_cid_misroutes(const tb_channel* ch) {
   return ch->cid_misroutes.load(std::memory_order_relaxed);
 }
 
+// fabricscan: role(init)
 int tb_channel_set_protocol(tb_channel* ch, int proto) {
   if (proto != 0 && proto != 1) return -1;
   ch->proto = proto;
   return 0;
 }
 
+// fabricscan: role(init)
 int tb_channel_set_compress(tb_channel* ch, int compress_type) {
   // channel-default request compress_type (baidu_std RpcMeta field 3);
   // the CALLER compresses payloads with the matching codec — this only
@@ -3280,6 +3324,7 @@ int tb_channel_set_compress(tb_channel* ch, int compress_type) {
   return 0;
 }
 
+// fabricscan: role(init)
 int tb_channel_set_auth(tb_channel* ch, const void* data, size_t len) {
   // credential for RpcMeta field 7, stamped on requests until the first
   // successful response proves the connection.  Set before concurrent
@@ -3293,6 +3338,7 @@ int tb_channel_set_auth(tb_channel* ch, const void* data, size_t len) {
   return 0;
 }
 
+// fabricscan: role(init)
 int tb_channel_set_fault(tb_channel* ch, uint32_t fail_every,
                          uint32_t close_every, uint32_t delay_every,
                          uint32_t delay_ms, uint32_t err_code) {
@@ -3623,7 +3669,12 @@ long tb_channel_pump(tb_channel* ch, const void* meta, size_t meta_len,
         tb_tbus_hdr hdr;
         int prc2 = tb_tbus_peek(ch->rbuf, &hdr);
         if (prc2 == 1) break;
-        if (prc2 == -1 || hdr.meta_len > hdr.body_len) {
+        // the frame cap was missing here (fabricscan wire-bounds catch):
+        // without it a hostile server claiming a ~4 GiB body_len makes
+        // the "wait for the full frame" test below grow rbuf without
+        // bound — the exact DoS pump_once's cap already closed
+        if (prc2 == -1 || hdr.meta_len > hdr.body_len ||
+            hdr.body_len > kClientMaxBody) {
           result = -EPROTO;
           break;
         }
@@ -3663,6 +3714,7 @@ long tb_channel_pump(tb_channel* ch, const void* meta, size_t meta_len,
   return static_cast<long>(dt / n);
 }
 
+// fabricscan: role(stop)
 void tb_channel_destroy(tb_channel* ch) {
   channel_fail(ch, -ECANCELED);
   if (ch->fd >= 0) close(ch->fd);
@@ -3712,6 +3764,35 @@ long tb_codec_decompress(int codec, const void* in, size_t in_len,
   if (!ctx.dbuf.empty()) tb_iobuf_append(out, ctx.dbuf.data(),
                                          ctx.dbuf.size());
   return static_cast<long>(ctx.dbuf.size());
+}
+
+// ---------------------------------------------------------------------------
+// RpcMeta scanner C surface (tb_scan_prpc_meta): the scanner the server
+// cut path and the client pump run, exported so the differential
+// wire-decoder fuzz (tests/test_wire_differential.py) can feed identical
+// meta bytes to this and to protocol/baidu_std.py's decoder and assert
+// the twins agree on accept/reject and on every decoded field.
+// ---------------------------------------------------------------------------
+
+long tb_scan_prpc_meta(const void* meta, size_t meta_len,
+                       uint64_t* cid_out, long* attachment_out,
+                       long* timeout_ms_out, uint32_t* compress_out,
+                       uint32_t* error_code_out,
+                       char* svc_out, size_t svc_cap, size_t* svc_len_out,
+                       char* mth_out, size_t mth_cap, size_t* mth_len_out) {
+  PrpcMeta pm = scan_prpc_meta(static_cast<const char*>(meta), meta_len);
+  if (!pm.ok) return -1;  // the connection-kill reject verdict
+  if (pm.svc_len > svc_cap || pm.mth_len > mth_cap) return -2;
+  *cid_out = pm.cid;
+  *attachment_out = pm.attachment;
+  *timeout_ms_out = pm.timeout_ms;
+  *compress_out = pm.compress;
+  *error_code_out = pm.error_code;
+  if (pm.svc_len != 0) memcpy(svc_out, pm.svc, pm.svc_len);
+  *svc_len_out = pm.svc_len;
+  if (pm.mth_len != 0) memcpy(mth_out, pm.mth, pm.mth_len);
+  *mth_len_out = pm.mth_len;
+  return (pm.to_python ? 1 : 0) | (pm.is_response ? 2 : 0);
 }
 
 // ---------------------------------------------------------------------------

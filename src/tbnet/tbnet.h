@@ -356,6 +356,24 @@ long tb_codec_compress(int codec, const void* in, size_t in_len,
 long tb_codec_decompress(int codec, const void* in, size_t in_len,
                          size_t max_out, tb_iobuf* out);
 
+// ---- RpcMeta scanner (differential-testing surface) ----
+// Runs the SAME proto2 scanner the server cut path and the client pump
+// run over one RpcMeta blob, so tests can feed identical bytes to this
+// and to protocol/baidu_std.py's decoder and diff the verdicts.
+// Returns -1 when the scanner rejects (the connection-kill path), -2
+// when a decoded service/method name exceeds its caller cap, else a
+// flags bitmask: bit 0 = fields beyond the native fast path's scope
+// (the frame would route to Python), bit 1 = response meta.  On accept
+// every out-param is filled (names copied raw — they may contain NULs;
+// read *svc_len_out/*mth_len_out, not strlen).  Diagnostic surface, not
+// a hot path.
+long tb_scan_prpc_meta(const void* meta, size_t meta_len,
+                       uint64_t* cid_out, long* attachment_out,
+                       long* timeout_ms_out, uint32_t* compress_out,
+                       uint32_t* error_code_out,
+                       char* svc_out, size_t svc_cap, size_t* svc_len_out,
+                       char* mth_out, size_t mth_cap, size_t* mth_len_out);
+
 // ---- work-stealing deque (Chase–Lev) ----
 // The dispatch pool's per-reactor queue, exported standalone so the
 // TSAN stress (and any future native scheduler) can drive it directly:

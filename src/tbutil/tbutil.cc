@@ -815,6 +815,10 @@ int tb_tbus_peek(const tb_iobuf* in, tb_tbus_hdr* out) {
 // protected here and bulk bytes ride the transport's own integrity.
 constexpr uint32_t kFlagBodyCrc = 8;
 
+// Callers bound the header's claimed sizes BEFORE cutting: peek fills
+// them raw off the wire, and this function trusts them to size the meta
+// copy-out and the body cut.
+// fabricscan: requires-bounded(arg2.body_len, arg2.meta_len)
 int tb_tbus_cut(tb_iobuf* in, const tb_tbus_hdr* hdr, void* meta_out,
                 tb_iobuf* body_out) {
   if (hdr->meta_len > hdr->body_len) return -3;

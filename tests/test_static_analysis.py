@@ -1066,3 +1066,333 @@ class TestJsonReports:
         from tools.fabricverify import RULES as VRULES
 
         assert set(VRULES) <= set(RULES)
+
+
+# ---------------------------------------------------------------------------
+# fabricscan — C++-plane static analysis (tools/fabricscan; third sibling,
+# same annotation grammar: wire-bounds taint dataflow, reactor-ownership
+# checking, cross-plane parity lint)
+# ---------------------------------------------------------------------------
+
+from tools.fabricscan import cmodel as scan_cmodel
+from tools.fabricscan import ownership as scan_ownership
+from tools.fabricscan import parity as scan_parity
+from tools.fabricscan import wirebounds as scan_wirebounds
+from tools.fabricscan import run_all as scan_run_all
+
+
+@pytest.fixture(scope="module")
+def tbnet_cc_text():
+    with open(os.path.join(REPO, "src", "tbnet", "tbnet.cc")) as fh:
+        return fh.read()
+
+
+def _mutate_cc(text, old, new):
+    assert old in text, f"mutation anchor missing: {old!r}"
+    mutated = text.replace(old, new)
+    assert mutated != text
+    return mutated
+
+
+class TestScanRepoIsClean:
+    """The live C++ tree passes all three passes — this IS the lint gate
+    for src/tbnet + src/tbutil (the same run as `make lint`)."""
+
+    def test_wire_bounds_clean(self):
+        vs = scan_wirebounds.check()
+        assert not vs, _fmt(vs)
+
+    def test_ownership_clean(self):
+        vs = scan_ownership.check()
+        assert not vs, _fmt(vs)
+
+    def test_plane_parity_clean(self):
+        vs = scan_parity.check()
+        assert not vs, _fmt(vs)
+
+    def test_run_all_aggregate(self):
+        vs = scan_run_all()
+        assert not vs, _fmt(vs)
+
+    def test_fabricscan_json_clean(self, capsys):
+        from tools.fabricscan.__main__ import main as scan_main
+
+        assert scan_main(["--json"]) == 0
+        assert json.loads(capsys.readouterr().out) == []
+
+    def test_scan_rules_registered_in_shared_grammar(self):
+        # one scanner validates every allow(): fabricscan's ids must be
+        # in fabriclint.RULES or its exemptions would be bad-allow
+        from tools.fabricscan import RULES as SRULES
+
+        assert set(SRULES) <= set(RULES)
+
+
+class TestScanCoverageIsAllowlistFree:
+    """ISSUE 12 acceptance: the analysis covers what it claims to cover,
+    with NO allow() escape hatches on the checked surfaces."""
+
+    @pytest.fixture(scope="class")
+    def model(self):
+        return scan_cmodel.parse_native_plane()
+
+    def test_cpp_model_parses_everything(self, model):
+        # the cdecl discipline lifted to bodies: an unparsed definition
+        # is an unchecked definition
+        assert model.unparsed == []
+
+    def test_cutter_call_graph_is_visited(self, model):
+        # every wire-bounds root resolves, and the closure reaches the
+        # functions the frame path actually rides — scanner, codec
+        # table, tbus header pair, varint reader
+        for root in scan_wirebounds.ROOTS:
+            assert root in model.funcs, f"root {root} vanished"
+        reach = scan_wirebounds.checked_functions(model)
+        for expected in (
+            "process_frames", "scan_prpc_meta", "prpc_peek", "read_varint",
+            "codec_decompress", "snappy_decompress_block", "zlib_decompress",
+            "tb_tbus_peek", "tb_tbus_cut", "run_native",
+            "tb_channel_pump", "pump_once", "prpc_complete_one",
+            "tb_scan_prpc_meta",
+        ):
+            assert expected in reach, f"{expected} fell out of the checked"\
+                " call graph"
+
+    def test_netloop_netconn_fields_all_owned(self, model):
+        # every mutable NetLoop/NetConn field carries a declared owner —
+        # the multi-reactor structures are fully covered, not sampled
+        for sname in ("NetLoop", "NetConn"):
+            owned = scan_ownership.owned_fields(model, sname)
+            assert owned, f"{sname} lost its fields"
+            missing = [f for f, o in owned.items() if o is None]
+            assert not missing, f"{sname} fields without owners: {missing}"
+
+    def test_checked_structs_all_owned(self, model):
+        # the wider claim: every mutable field on every checked struct
+        missing = []
+        for sname in scan_ownership.CHECKED_STRUCTS:
+            for f, o in scan_ownership.owned_fields(model, sname).items():
+                if o is None:
+                    missing.append(f"{sname}.{f}")
+        assert not missing, missing
+
+    def test_no_scan_rule_allowlisted_in_cpp(self):
+        # allowlist-free: fixes, not exemptions (the PR 6/7 discipline) —
+        # no allow() for any fabricscan rule anywhere in the C++ plane
+        from tools.fabricscan import RULES as SRULES
+
+        for path in (scan_cmodel.TBNET_CC, scan_cmodel.TBUTIL_CC):
+            anns = scan_annotations(path)
+            allowed_scan = [
+                (line, rule)
+                for line, items in anns.allows.items()
+                for rule, _reason in items
+                if rule in SRULES
+            ]
+            assert not allowed_scan, (
+                f"{path}: fabricscan violations must be fixed, not "
+                f"allowlisted: {allowed_scan}"
+            )
+
+
+class TestWireBoundsCatchesMutations:
+    """Seeded mutations flip wire-bounds red (≥2 per ISSUE 12)."""
+
+    def test_dropped_pump_frame_cap(self, tbnet_cc_text):
+        # the guard the pass found missing at introduction: without the
+        # client-side cap a hostile tbus body_len grows rbuf unbounded
+        mut = _mutate_cc(
+            tbnet_cc_text,
+            " ||\n            hdr.body_len > kClientMaxBody",
+            "",
+        )
+        vs = scan_wirebounds.check(tbnet_text=mut)
+        assert any(
+            v.rule == "wire-bounds" and "tb_channel_pump" in v.message
+            and "hdr.body_len" in v.message
+            for v in vs
+        ), _fmt(vs)
+
+    def test_dropped_submessage_length_guard(self, tbnet_cc_text):
+        # the scanner's `len > n - off` subtraction idiom removed: the
+        # tainted submessage length reaches read_varint's bound unguarded
+        mut = _mutate_cc(
+            tbnet_cc_text,
+            "if (!read_varint(p, n, &off, &len) || len > n - off) return m;",
+            "if (!read_varint(p, n, &off, &len)) return m;",
+        )
+        vs = scan_wirebounds.check(tbnet_text=mut)
+        assert any(
+            v.rule == "wire-bounds" and "scan_prpc_meta" in v.message
+            and "sub_len" in v.message
+            for v in vs
+        ), _fmt(vs)
+
+    def test_dropped_snappy_table_mask(self, tbnet_cc_text):
+        # the hash-table subscript loses its explicit cap: the value
+        # loaded out of the input buffer indexes slots unguarded
+        mut = _mutate_cc(
+            tbnet_cc_text,
+            "    h &= kSnappyTableMask;",
+            "",
+        )
+        vs = scan_wirebounds.check(tbnet_text=mut)
+        assert any(
+            v.rule == "wire-bounds" and "snappy_compress_block" in v.message
+            for v in vs
+        ), _fmt(vs)
+
+
+class TestOwnershipCatchesMutations:
+    """Seeded mutations flip ownership/owner-missing red (≥2)."""
+
+    def test_stripped_owner_annotation(self, tbnet_cc_text):
+        # unannotated mutable shared state is itself a violation
+        mut = _mutate_cc(
+            tbnet_cc_text,
+            "int inline_burst = 0;  // fabricscan: owner(loop)",
+            "int inline_burst = 0;",
+        )
+        vs = scan_ownership.check(tbnet_text=mut)
+        assert any(
+            v.rule == "owner-missing" and "inline_burst" in v.message
+            for v in vs
+        ), _fmt(vs)
+
+    def test_loop_owned_field_written_from_python_role(self, tbnet_cc_text):
+        # a loop-owned field touched from a Python-caller export without
+        # an atomic/ring/lock — PR 9's invariant, checked
+        mut = _mutate_cc(
+            tbnet_cc_text,
+            "int tb_server_num_reactors(const tb_server* s) {\n"
+            "  return static_cast<int>(s->loops.size());",
+            "int tb_server_num_reactors(const tb_server* s) {\n"
+            "  s->loops[0]->inline_burst = 0;\n"
+            "  return static_cast<int>(s->loops.size());",
+        )
+        vs = scan_ownership.check(tbnet_text=mut)
+        assert any(
+            v.rule == "ownership" and "inline_burst" in v.message
+            and "tb_server_num_reactors" in v.message
+            for v in vs
+        ), _fmt(vs)
+
+    def test_setter_losing_init_seed_flips_red(self, tbnet_cc_text):
+        # init-owned = write-once setup: a pre-listen setter that loses
+        # its role(init) seed becomes an arbitrary-Python-thread export
+        # writing an init-owned field — flagged
+        mut = _mutate_cc(
+            tbnet_cc_text,
+            "// fabricscan: role(init)\n"
+            "void tb_server_set_max_body",
+            "void tb_server_set_max_body",
+        )
+        vs = scan_ownership.check(tbnet_text=mut)
+        assert any(
+            v.rule == "ownership" and "tb_server.max_body" in v.message
+            and "tb_server_set_max_body" in v.message
+            for v in vs
+        ), _fmt(vs)
+
+
+class TestPlaneParityCatchesMutations:
+    """Seeded constant drift between the twins flips plane-parity red
+    (≥2): wire numbers, enum ids, error texts, codec constants."""
+
+    def test_skewed_rpc_meta_field_number(self, tbnet_cc_text):
+        mut = _mutate_cc(
+            tbnet_cc_text,
+            "} else if (field == 4) {\n        m.cid = v;",
+            "} else if (field == 6) {\n        m.cid = v;",
+        )
+        vs = scan_parity.check(tbnet_text=mut)
+        assert any(
+            v.rule == "plane-parity" and "correlation_id" in v.message
+            for v in vs
+        ), _fmt(vs)
+
+    def test_skewed_codec_enum_id(self, tbnet_cc_text):
+        mut = _mutate_cc(
+            tbnet_cc_text,
+            "constexpr uint32_t kCompressGzip = 2;",
+            "constexpr uint32_t kCompressGzip = 4;",
+        )
+        vs = scan_parity.check(tbnet_text=mut)
+        assert any(
+            v.rule == "plane-parity" and "gzip" in v.message for v in vs
+        ), _fmt(vs)
+
+    def test_skewed_berror_text(self, tbnet_cc_text):
+        mut = _mutate_cc(
+            tbnet_cc_text,
+            'kDeadlineShedText[] = "',
+            'kDeadlineShedText[] = "x',
+        )
+        vs = scan_parity.check(tbnet_text=mut)
+        assert any(
+            v.rule == "plane-parity" and "EDEADLINE" in v.message
+            for v in vs
+        ), _fmt(vs)
+
+    def test_skewed_snappy_hash_multiplier(self, tbnet_cc_text):
+        mut = _mutate_cc(tbnet_cc_text, "0x1E35A7BDu", "0x1E35A7BFu")
+        vs = scan_parity.check(tbnet_text=mut)
+        assert any(
+            v.rule == "plane-parity" and "hash multiplier" in v.message
+            for v in vs
+        ), _fmt(vs)
+
+    def test_refactored_anchor_screams_not_silently_passes(self,
+                                                           tbnet_cc_text):
+        # extraction anchors are load-bearing: refactoring a constant out
+        # from under its regex must fail loudly (scan-parse), never
+        # silently compare nothing
+        mut = _mutate_cc(
+            tbnet_cc_text,
+            "constexpr uint32_t kMagicPrpc = ",
+            "constexpr uint32_t kMagicPrpcRenamed = ",
+        )
+        vs = scan_parity.check(tbnet_text=mut)
+        assert any(
+            v.rule == "scan-parse" and "PRPC magic" in v.message
+            for v in vs
+        ), _fmt(vs)
+
+
+class TestFfiCountIsGenerated:
+    """ISSUE 12 satellite: the FFI surface size quoted in the docs is
+    generated from native.SIGNATURES, not hand-kept prose — the number
+    in PARITY row 53 can't rot."""
+
+    def test_parity_row_53_count_matches_signatures(self):
+        from incubator_brpc_tpu import native
+
+        n = len(native.SIGNATURES)
+        with open(os.path.join(REPO, "docs", "PARITY.md")) as fh:
+            parity_text = fh.read()
+        assert f"{n} functions" in parity_text, (
+            f"docs/PARITY.md row 53 must quote the generated count "
+            f"({n} functions == len(native.SIGNATURES))"
+        )
+        # and no stale hand-kept count survives
+        import re as _re
+
+        for m in _re.finditer(r"(?<![~\d])(\d+) functions", parity_text):
+            assert int(m.group(1)) == n, (
+                f"stale FFI count {m.group(0)!r} in docs/PARITY.md "
+                f"(SIGNATURES has {n})"
+            )
+
+    def test_analysis_md_count_matches_signatures(self):
+        from incubator_brpc_tpu import native
+
+        n = len(native.SIGNATURES)
+        with open(os.path.join(REPO, "docs", "ANALYSIS.md")) as fh:
+            text = fh.read()
+        import re as _re
+
+        for m in _re.finditer(r"(?<![~\d])(\d+) functions", text):
+            assert int(m.group(1)) == n, (
+                f"stale FFI count {m.group(0)!r} in docs/ANALYSIS.md "
+                f"(SIGNATURES has {n})"
+            )

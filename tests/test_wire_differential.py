@@ -1,0 +1,325 @@
+"""Differential wire-decoder fuzz: the C++ RpcMeta scanner vs the
+Python decoder on identical bytes (ISSUE 12 satellite).
+
+PR 11's snappy fuzz proved the codec twins agree byte-for-byte on
+random input; this module extends that oracle one layer up, to the RPC
+meta parsers — the exact code fabricscan's wire-bounds pass guards.
+``tb_scan_prpc_meta`` exports the scanner the server cut path and the
+client pump run, and every test here feeds the same blob to it and to
+``protocol/baidu_std.py``'s ``RpcMeta.decode`` and diffs the verdicts:
+
+- **native accept ⇒ Python accept**, and every decoded field agrees
+  (cid, attachment, compress, timeout, error_code, service, method,
+  response-ness) modulo the documented width clamps;
+- **Python reject ⇒ native reject** (a meta the pure-Python plane
+  refuses must never ride the native fast path);
+- native-only rejects are allowed ONLY for the documented
+  native-stricter caps (compress beyond u32, attachment/timeout beyond
+  2^31) — anything else is drift between the twins.
+
+Runs inside tier-1 and inside ``make san``'s ASAN subset (random bytes
+through a hand-rolled C++ parser is exactly what ASAN exists to watch).
+
+The bottom class is the regression test for the wire-bounds violation
+fabricscan found at introduction: ``tb_channel_pump``'s tbus read path
+trusted a hostile server's claimed ``body_len`` with no frame cap, so a
+~4 GiB claim grew the client's read buffer without bound while it
+"waited for the full frame".  The cap now answers -EPROTO immediately;
+docs/ANALYSIS.md documents the find.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import random
+import socket
+import struct
+import threading
+
+import pytest
+
+from incubator_brpc_tpu.protocol import baidu_std
+from incubator_brpc_tpu.protocol.baidu_std import RpcMeta
+from incubator_brpc_tpu.protocol.tbus_std import ParseError
+from incubator_brpc_tpu.transport import native_plane
+
+pytestmark = pytest.mark.skipif(
+    not native_plane.NET_AVAILABLE, reason="native runtime unavailable"
+)
+
+_M64 = (1 << 64) - 1
+_CAP = 4096  # name caps handed to the native scanner
+
+
+def _native_scan(meta: bytes):
+    """Run the C++ scanner; None on reject, else a comparable dict."""
+    from incubator_brpc_tpu.native import LIB
+
+    cid = ctypes.c_uint64()
+    att = ctypes.c_long()
+    tmo = ctypes.c_long()
+    comp = ctypes.c_uint32()
+    ec = ctypes.c_uint32()
+    svc = ctypes.create_string_buffer(_CAP)
+    mth = ctypes.create_string_buffer(_CAP)
+    sl = ctypes.c_size_t()
+    ml = ctypes.c_size_t()
+    rc = LIB.tb_scan_prpc_meta(
+        meta, len(meta), ctypes.byref(cid), ctypes.byref(att),
+        ctypes.byref(tmo), ctypes.byref(comp), ctypes.byref(ec),
+        svc, _CAP, ctypes.byref(sl), mth, _CAP, ctypes.byref(ml),
+    )
+    if rc == -1:
+        return None
+    assert rc >= 0, f"name cap too small for fuzz meta ({rc})"
+    return {
+        "cid": cid.value,
+        "attachment": att.value,
+        "timeout_ms": tmo.value,
+        "compress": comp.value,
+        "error_code": ec.value,
+        "svc": svc.raw[: sl.value],
+        "mth": mth.raw[: ml.value],
+        "to_python": bool(rc & 1),
+        "is_response": bool(rc & 2),
+    }
+
+
+def _python_scan(meta: bytes):
+    try:
+        return RpcMeta.decode(meta)
+    except ParseError:
+        return None
+
+
+def _native_stricter_cap(rm: RpcMeta) -> bool:
+    """The documented clamps where the C++ scanner rejects metas the
+    permissive Python decoder still represents: values beyond the widths
+    the native plane can carry (u32 compress, 2^31 attachment/timeout).
+    Compared mod 2^64 because the C++ varint reader wraps there."""
+    return (
+        (rm.compress_type & _M64) > 0xFFFFFFFF
+        or (rm.attachment_size & _M64) > (1 << 31)
+        or (rm.timeout_ms & _M64) > (1 << 31)
+    )
+
+
+def _assert_agree(meta: bytes):
+    nat = _native_scan(meta)
+    py = _python_scan(meta)
+    label = meta.hex()
+    if nat is None:
+        # native reject: Python rejected too, or the meta trips a
+        # documented native-stricter width clamp — nothing else
+        assert py is None or _native_stricter_cap(py), (
+            f"native rejected a meta Python accepts with in-range "
+            f"fields: {label}"
+        )
+        return
+    # native accept ⇒ Python accept, fields agree (mod the wraps)
+    assert py is not None, f"Python rejected a native-accepted meta: {label}"
+    assert nat["cid"] == py.correlation_id & _M64, label
+    assert nat["attachment"] == py.attachment_size & _M64, label
+    assert nat["compress"] == py.compress_type & _M64, label
+    assert nat["timeout_ms"] == py.timeout_ms & _M64, label
+    assert nat["error_code"] == py.error_code & 0xFFFFFFFF, label
+    assert nat["is_response"] == py.is_response, label
+    assert nat["svc"].decode("utf-8", errors="replace") == py.service_name, (
+        label
+    )
+    assert nat["mth"].decode("utf-8", errors="replace") == py.method_name, (
+        label
+    )
+
+
+class TestMetaScannerDifferential:
+    def test_structured_request_metas_agree_exactly(self):
+        rng = random.Random(0x12A)
+        for _ in range(200):
+            rm = RpcMeta(
+                service_name="".join(
+                    rng.choice("abcXYZ_09.") for _ in range(rng.randrange(1, 24))
+                ),
+                method_name="".join(
+                    rng.choice("abcXYZ_09") for _ in range(rng.randrange(1, 24))
+                ),
+                compress_type=rng.choice([0, 1, 2, 3, 17, 0xFFFFFFFF]),
+                correlation_id=rng.getrandbits(rng.choice([8, 32, 63, 64])),
+                attachment_size=rng.choice([0, 1, 4096, 1 << 31]),
+                timeout_ms=rng.choice([0, 1, 250, 1 << 31]),
+            )
+            blob = rm.encode()
+            nat = _native_scan(blob)
+            assert nat is not None, blob.hex()
+            assert not nat["is_response"], blob.hex()
+            _assert_agree(blob)
+
+    def test_structured_response_metas_agree_exactly(self):
+        rng = random.Random(0x12B)
+        for _ in range(200):
+            rm = RpcMeta(
+                is_response=True,
+                error_code=rng.choice([0, 1, 1007, (1 << 31) - 1]),
+                error_text=rng.choice(["", "boom", "x" * 200]),
+                correlation_id=rng.getrandbits(64),
+                compress_type=rng.choice([0, 1, 2, 3]),
+            )
+            blob = rm.encode()
+            nat = _native_scan(blob)
+            assert nat is not None, blob.hex()
+            assert nat["is_response"], blob.hex()
+            _assert_agree(blob)
+
+    def test_mutated_valid_metas_agree(self):
+        # byte flips, truncations, insertions, splices of real metas —
+        # the classic decoder-differential recipe
+        rng = random.Random(0x12C)
+        bases = [
+            RpcMeta(
+                service_name="EchoService",
+                method_name="Echo",
+                correlation_id=0x1122334455667788,
+                attachment_size=64,
+                compress_type=1,
+                timeout_ms=1500,
+            ).encode(),
+            RpcMeta(
+                is_response=True,
+                error_code=1004,
+                error_text="deadline",
+                correlation_id=99,
+            ).encode(),
+            RpcMeta(
+                service_name="s",
+                method_name="m",
+                authentication_data=b"tok\x00en",
+            ).encode(),
+        ]
+        for _ in range(600):
+            blob = bytearray(rng.choice(bases))
+            for _ in range(rng.randrange(1, 4)):
+                op = rng.randrange(4)
+                if op == 0 and blob:  # flip
+                    i = rng.randrange(len(blob))
+                    blob[i] ^= 1 << rng.randrange(8)
+                elif op == 1 and blob:  # truncate
+                    del blob[rng.randrange(len(blob)):]
+                elif op == 2:  # insert
+                    blob.insert(
+                        rng.randrange(len(blob) + 1), rng.getrandbits(8)
+                    )
+                else:  # splice a random run
+                    junk = bytes(
+                        rng.getrandbits(8) for _ in range(rng.randrange(1, 9))
+                    )
+                    at = rng.randrange(len(blob) + 1)
+                    blob[at:at] = junk
+            _assert_agree(bytes(blob))
+
+    def test_random_streams_agree(self):
+        rng = random.Random(0x12D)
+        for _ in range(800):
+            blob = bytes(
+                rng.getrandbits(8) for _ in range(rng.randrange(0, 96))
+            )
+            _assert_agree(blob)
+
+    def test_adversarial_shapes_agree(self):
+        tag = baidu_std._tag
+        varint = baidu_std._varint
+        cases = [
+            b"",  # empty meta: both accept, all defaults
+            tag(1, 2) + varint((1 << 64) - 1),  # wrap-length submessage
+            tag(1, 2) + varint(1 << 32) + b"x",  # length beyond buffer
+            tag(4, 0) + b"\xff" * 10,  # overlong varint (11 bytes w/ key)
+            tag(4, 0) + b"\xff" * 9 + b"\x01",  # 10-byte cid, bit 63
+            tag(4, 0) + b"\x80" * 9 + b"\x7f",  # cid with bits beyond 64
+            tag(3, 0) + varint(1 << 33),  # compress beyond u32 (native cap)
+            tag(5, 0) + varint((1 << 31) + 1),  # attachment beyond clamp
+            tag(1, 2)
+            + varint(len(varint((1 << 31) + 1)) + 1)
+            + tag(8, 0)
+            + varint((1 << 31) + 1),  # timeout beyond clamp, in the sub
+            tag(6, 1) + b"\x01" * 8,  # fixed64: skipped by both
+            tag(6, 1) + b"\x01" * 7,  # truncated fixed64
+            tag(6, 5) + b"\x01" * 4,  # fixed32
+            tag(6, 5) + b"\x01",  # truncated fixed32
+            tag(6, 3),  # group-start: rejected by both
+            tag(6, 4),  # group-end
+            b"\x0f",  # wire type 7
+            tag(1, 2) + varint(2) + tag(1, 2) + varint(5),  # nested overrun
+            tag(2, 2) + varint(2) + tag(1, 0) + b"\x80",  # sub truncated varint
+            tag(7, 2) + varint(3) + b"a\x00b",  # auth with NUL
+            tag(1, 2) + b"\x00",  # empty request sub
+            tag(2, 2) + b"\x00" + tag(1, 2) + b"\x00",  # response + request
+        ]
+        for blob in cases:
+            _assert_agree(blob)
+
+    def test_native_stricter_rejects_are_exactly_the_caps(self):
+        # the three documented clamps DO reject natively while Python
+        # accepts — pinned so a future widening shows up here
+        tag = baidu_std._tag
+        varint = baidu_std._varint
+        for blob in (
+            tag(3, 0) + varint(1 << 33),
+            tag(5, 0) + varint((1 << 31) + 1),
+            tag(1, 2)
+            + varint(len(varint((1 << 31) + 1)) + 1)
+            + tag(8, 0)
+            + varint((1 << 31) + 1),
+        ):
+            assert _native_scan(blob) is None, blob.hex()
+            py = _python_scan(blob)
+            assert py is not None and _native_stricter_cap(py), blob.hex()
+
+
+class TestPumpHostileFrameCap:
+    """Regression for the wire-bounds violation fabricscan found at
+    introduction (ISSUE 12): the pump's tbus read path must reject a
+    hostile claimed body_len instead of growing rbuf toward ~4 GiB."""
+
+    def test_pump_rejects_oversized_body_claim(self):
+        from incubator_brpc_tpu.protocol import tbus_std
+
+        hostile_header = struct.pack(
+            "<8I",
+            tbus_std.MAGIC,
+            600 << 20,  # claimed body: beyond the 512 MiB client cap
+            tbus_std.FLAG_RESPONSE,
+            1, 0,  # cid lo/hi
+            0, 0, 0,  # meta_len / crc / error
+        )
+        lst = socket.socket()
+        lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        lst.bind(("127.0.0.1", 0))
+        lst.listen(1)
+        port = lst.getsockname()[1]
+
+        def serve():
+            conn, _ = lst.accept()
+            try:
+                conn.recv(4096)  # whatever the pump sent first
+                conn.sendall(hostile_header)
+                # keep the conn open: without the cap the client would
+                # sit in "wait for the full frame" until timeout
+                conn.recv(4096)
+            except OSError:
+                pass
+            finally:
+                conn.close()
+
+        th = threading.Thread(target=serve, daemon=True)
+        th.start()
+        nch = native_plane.NativeClientChannel("127.0.0.1", port)
+        try:
+            with pytest.raises(OSError) as ei:
+                nch.pump("svc", "echo", b"x", 4, inflight=2, timeout_ms=8000)
+            import errno
+
+            # -EPROTO promptly — NOT -ETIMEDOUT after buffering the claim
+            assert ei.value.errno == errno.EPROTO
+        finally:
+            nch.close()
+            lst.close()
+            th.join(timeout=5)

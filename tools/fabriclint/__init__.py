@@ -67,6 +67,17 @@ VERIFY_RULES = (
     "model-unrevivable",    # state from which recovery is unreachable
 )
 
+# Rules owned by the C++-plane analyzer (tools/fabricscan) — registered
+# here for the same reason as VERIFY_RULES: one annotation grammar, one
+# scanner validating every allow() either tool can exempt.
+SCAN_RULES = (
+    "wire-bounds",      # tainted wire length reaches a sink unguarded
+    "ownership",        # owned field touched from the wrong thread role
+    "owner-missing",    # mutable shared C++ state with no declared owner
+    "plane-parity",     # a mirrored constant drifted between the planes
+    "scan-parse",       # C++ the model/extractors could not cover
+)
+
 RULES = (
     "ffi-missing",      # sigs entry with no header declaration
     "ffi-unbound",      # header function with no sigs entry
@@ -86,7 +97,7 @@ RULES = (
     "ffi-keepalive",
     "ffi-unchecked",
     "bad-allow",
-) + VERIFY_RULES
+) + VERIFY_RULES + SCAN_RULES
 
 
 @dataclass

@@ -54,7 +54,14 @@ ASAN_SO = os.path.join(SRC_DIR, "build", "libtbutil_asan.so")
 TSAN_SO = os.path.join(SRC_DIR, "build", "libtbutil_tsan.so")
 TSAN_SUPP = os.path.join(REPO_ROOT, "tools", "fabriclint", "tsan.supp")
 
-ASAN_TESTS = ["tests/test_native_plane.py", "tests/test_native_baidu.py"]
+ASAN_TESTS = [
+    "tests/test_native_plane.py",
+    "tests/test_native_baidu.py",
+    # differential wire-decoder fuzz (ISSUE 12): random/mutated RpcMeta
+    # blobs through the native scanner — exactly the hand-rolled parsing
+    # ASAN exists to watch
+    "tests/test_wire_differential.py",
+]
 TSAN_TESTS = [
     # the lock-free telemetry ring under multi-producer fire (PR 6),
     # including the multi-reactor (4-ring) parametrization

@@ -1,0 +1,72 @@
+"""fabricscan — static analysis for the C++ native plane.
+
+fabriclint (PR 6) checks the FFI seam and fabricverify (PR 7) the
+Python concurrency plane; this third sibling covers the side both of
+them stop at: the ~5 kLoC of hand-rolled C++ in ``src/tbnet`` +
+``src/tbutil`` where the repo's three hardest invariants actually live.
+It parses the C++ into a lightweight statement/dataflow model (no clang
+— ``cmodel.py`` extends the ``cdecl.py`` philosophy to function bodies)
+and runs three passes:
+
+- **wire-bounds** (wirebounds.py): taint dataflow over every function
+  reachable from the frame cutter, the meta scanners, and the codec
+  table — a wire-derived length reaching an index/memcpy/allocation
+  without a dominating bounds check is a violation.
+- **ownership** (ownership.py): every mutable field of the
+  multi-reactor structures carries a declared owner
+  (``// fabricscan: owner(loop|worker|shared|init)``); thread roles
+  propagate over the call graph and a loop-owned field touched from
+  another role without an atomic/ring/lock is a violation.  PR 9's
+  "zero cross-reactor locks" claim, checked instead of commented.
+- **plane-parity** (parity.py): the constant surfaces mirrored between
+  the planes (PRPC header, RpcMeta field numbers, codec ids, berror
+  texts, snappy constants, flag defaults) extracted from both sources
+  and diffed at lint time.
+
+Exemptions use fabriclint's grammar — the same marker, the same
+enforced-non-empty reason — and fabricscan's rule ids are registered in
+``tools.fabriclint.RULES`` (``SCAN_RULES``) so one scanner validates
+every annotation in the tree.  The ``// fabricscan: <directive>``
+comments (owner/role/locked/borrows/sanitizes/requires-bounded) are a
+separate, declarative grammar owned by ``cmodel.py``.
+
+Run everything: ``python -m tools.fabricscan`` (or ``make lint``, which
+merges all three tools' exit codes); the same checks run inside tier-1
+via tests/test_static_analysis.py.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from tools.fabriclint import (  # noqa: F401  (re-exported surface)
+    REPO_ROOT,
+    Violation,
+    allowed,
+    scan_annotations,
+    to_records,
+)
+
+# The rule ids this tool owns — defined once in fabriclint.SCAN_RULES
+# (where they register into the shared RULES grammar); re-exported here
+# so --list-rules/--rule filtering can never drift from the scanner.
+from tools.fabriclint import SCAN_RULES as RULES  # noqa: E402
+
+
+def run_all() -> List[Violation]:
+    """Run all three passes; returns unexempted violations."""
+
+    from tools.fabricscan import ownership, parity, wirebounds
+
+    out: List[Violation] = []
+    out.extend(wirebounds.check())
+    out.extend(ownership.check())
+    out.extend(parity.check())
+    seen = set()
+    unique: List[Violation] = []
+    for v in out:
+        key = (v.rule, v.path, v.line, v.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(v)
+    return unique

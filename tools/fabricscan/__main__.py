@@ -1,0 +1,57 @@
+"""CLI: ``python -m tools.fabricscan`` (one third of the ``make lint``
+entry point; the three tools' exit codes are merged there).
+
+Runs the wire-bounds, ownership, and plane-parity passes over
+src/tbnet + src/tbutil and prints violations one per line
+(``path:line: [rule] message``); exits 1 when any survive their
+annotations.
+
+- ``--json``: machine-readable report — a JSON array of
+  ``{rule, file, line, reason}`` records on stdout (the same schema as
+  the fabriclint/fabricverify CLIs), so CI tooling can diff violation
+  sets across commits.
+- ``--rule <name>`` filters to one rule id; ``--list-rules`` prints the
+  ids this tool owns.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    from tools.fabricscan import RULES, run_all, to_records
+
+    ap = argparse.ArgumentParser(prog="fabricscan")
+    ap.add_argument("--rule", help="only report this rule id")
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print rule ids and exit"
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit {rule, file, line, reason} records as a JSON array",
+    )
+    args = ap.parse_args(argv)
+    if args.list_rules:
+        for r in RULES:
+            print(r)
+        return 0
+    violations = run_all()
+    if args.rule:
+        violations = [v for v in violations if v.rule == args.rule]
+    if args.json:
+        print(json.dumps(to_records(violations), indent=2))
+        return 1 if violations else 0
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"fabricscan: {len(violations)} violation(s)", file=sys.stderr)
+        return 1
+    print("fabricscan: clean", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,623 @@
+"""Lightweight C++ statement/dataflow model for the tb native plane.
+
+fabriclint's ``cdecl.py`` models the *declarations* of the C ABI; this
+module extends the same philosophy to function *bodies*: a tokenizing,
+deliberately non-general parser that extracts, from ``src/tbnet/tbnet.cc``
+and ``src/tbutil/tbutil.cc``:
+
+- every function definition (free functions, anonymous-namespace helpers,
+  struct methods inline and out-of-line) with its parameter list and body
+  text anchored to absolute line numbers;
+- every struct definition with its field declarations classified as
+  atomic / sync-primitive / const / plain-mutable;
+- module-level globals;
+- a call graph (callee names resolved against the defined function set);
+- the ``// fabricscan:`` annotation directives that drive the ownership
+  and wire-bounds passes.
+
+The sources are hand-written C++ in a narrow idiom (no templates beyond
+``std::`` containers in field types, no overloading of the analyzed
+functions, no macros in bodies), so a few hundred lines of scanner cover
+them completely — and anything the scanner cannot classify is reported
+via ``Model.unparsed`` (the cdecl discipline: an unparsed definition is
+an unchecked definition, which the clean gate turns into a violation).
+
+Annotation directives (C++ comments; distinct from the shared
+``// fabriclint: allow(rule) reason`` exemption grammar, which stays
+owned by tools/fabriclint):
+
+``// fabricscan: owner(loop|worker|shared|init)``
+    on a struct field or global: who may touch it (see ownership.py).
+``// fabricscan: role(loop|worker|python|init|stop)``
+    on a function: the thread context(s) it is entered from (seeds for
+    call-graph propagation).
+``// fabricscan: locked``
+    on a function: its callers hold the guarding mutex (the ``_locked``
+    suffix convention, made checkable).
+``// fabricscan: borrows(Type[, Type...])``
+    on a function: it accesses instances of these checked struct types
+    through its parameters, and the instance's ownership is the CALLER's
+    obligation at the call site (per-instance contexts like ZCtx).
+``// fabricscan: sanitizes(name[, name...])``
+    on a function: its out-parameters of these names are bounds-checked
+    before being stored (wirebounds verifies the stores ARE guarded, and
+    callers treat the outputs as clean).
+``// fabricscan: requires-bounded(argN.field[, ...])``
+    on a function: callers must pass the N-th argument (1-based) with
+    ``field`` already bounds-checked; inside the function the field is
+    treated as sanitized.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.fabriclint import REPO_ROOT
+
+TBNET_CC = os.path.join(REPO_ROOT, "src", "tbnet", "tbnet.cc")
+TBUTIL_CC = os.path.join(REPO_ROOT, "src", "tbutil", "tbutil.cc")
+
+_KEYWORDS = {
+    "if", "for", "while", "switch", "return", "sizeof", "catch", "do",
+    "else", "new", "delete", "case", "default", "goto", "static_cast",
+    "reinterpret_cast", "const_cast", "dynamic_cast", "alignas", "decltype",
+}
+
+_DIRECTIVE_RE = re.compile(r"//\s*fabricscan:\s*([a-z-]+)(?:\(([^)]*)\))?")
+
+
+@dataclass
+class Directive:
+    kind: str        # owner | role | locked | borrows | sanitizes | requires-bounded
+    args: List[str]
+    line: int
+
+
+@dataclass
+class CppField:
+    struct: str
+    name: str
+    type_text: str
+    line: int
+    owner: Optional[str] = None     # from owner(...) directive
+    is_atomic: bool = False
+    is_sync: bool = False
+    is_const: bool = False
+
+
+@dataclass
+class CppGlobal:
+    name: str
+    type_text: str
+    line: int
+    owner: Optional[str] = None
+    is_atomic: bool = False
+    is_sync: bool = False
+    is_const: bool = False
+
+
+@dataclass
+class CppFunc:
+    name: str                 # short name (method name for methods)
+    qname: str                # Struct::name for methods, else name
+    struct: Optional[str]     # enclosing/owning struct for methods
+    line: int                 # line of the signature
+    body: str                 # body text, braces excluded
+    body_offset_line: int     # absolute line number of the body's first line
+    params: List[Tuple[str, str]] = field(default_factory=list)  # (type, name)
+    is_ctor: bool = False
+    roles: Set[str] = field(default_factory=set)       # seeded + propagated
+    seeded_roles: Set[str] = field(default_factory=set)
+    locked: bool = False
+    borrows: Set[str] = field(default_factory=set)
+    sanitizes: Set[str] = field(default_factory=set)
+    requires_bounded: List[Tuple[int, str]] = field(default_factory=list)
+    calls: Set[str] = field(default_factory=set)       # qnames of callees
+    path: str = ""            # source file (survives merge_models)
+
+
+@dataclass
+class Model:
+    path: str
+    funcs: Dict[str, CppFunc] = field(default_factory=dict)       # by qname
+    by_short: Dict[str, List[CppFunc]] = field(default_factory=dict)
+    structs: Dict[str, Dict[str, CppField]] = field(default_factory=dict)
+    struct_lines: Dict[str, int] = field(default_factory=dict)
+    globals: Dict[str, CppGlobal] = field(default_factory=dict)
+    unparsed: List[Tuple[int, str]] = field(default_factory=list)
+    directives: Dict[int, List[Directive]] = field(default_factory=dict)
+
+    def directive_for(
+        self, line: int, kind: str, lookback: int = 2
+    ) -> Optional[Directive]:
+        """A directive applies on its own line or up to ``lookback``
+        lines above (a function signature may carry a one/two-line
+        comment block).  Field directives pass ``lookback=0``: fields
+        are consecutive single lines, so a lookback would let an
+        unannotated field silently inherit its neighbour's owner()
+        instead of firing owner-missing."""
+
+        for ln in range(line, line - lookback - 1, -1):
+            for d in self.directives.get(ln, ()):
+                if d.kind == kind:
+                    return d
+        return None
+
+
+def _scan_directives(text: str) -> Dict[int, List[Directive]]:
+    out: Dict[int, List[Directive]] = {}
+    for i, ln in enumerate(text.split("\n"), 1):
+        if "fabricscan:" not in ln:
+            continue
+        for m in _DIRECTIVE_RE.finditer(ln):
+            kind = m.group(1)
+            args = [
+                a.strip() for a in (m.group(2) or "").split(",") if a.strip()
+            ]
+            out.setdefault(i, []).append(Directive(kind, args, i))
+    return out
+
+
+def _blank_comments_and_strings(text: str) -> str:
+    """Blank comments and string/char literal CONTENTS, preserving
+    newlines and overall offsets so line math stays exact."""
+
+    out = list(text)
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == "/" and i + 1 < n and text[i + 1] == "/":
+            j = text.find("\n", i)
+            j = n if j < 0 else j
+            for k in range(i, j):
+                out[k] = " "
+            i = j
+        elif c == "/" and i + 1 < n and text[i + 1] == "*":
+            j = text.find("*/", i + 2)
+            j = n - 2 if j < 0 else j
+            for k in range(i, j + 2):
+                if out[k] != "\n":
+                    out[k] = " "
+            i = j + 2
+        elif c == '"' or c == "'":
+            q = c
+            j = i + 1
+            while j < n:
+                if text[j] == "\\":
+                    j += 2
+                    continue
+                if text[j] == q:
+                    break
+                j += 1
+            for k in range(i + 1, min(j, n)):
+                if out[k] != "\n":
+                    out[k] = " "
+            i = min(j, n - 1) + 1
+        else:
+            i += 1
+    return "".join(out)
+
+
+def _blank_preprocessor(text: str) -> str:
+    lines = text.split("\n")
+    for i, ln in enumerate(lines):
+        if ln.lstrip().startswith("#"):
+            lines[i] = ""
+    return "\n".join(lines)
+
+
+_SYNC_TYPES = ("std::mutex", "std::condition_variable", "std::thread")
+
+_FIELD_RE = re.compile(
+    r"^(?P<type>.+?[\s*&>])(?P<name>[A-Za-z_]\w*)\s*(?:\[[^\]]*\]\s*)*"
+    r"(?:\{[^{}]*\}|=[^;]*)?$",
+    re.S,
+)
+
+
+def _split_top_commas(seg: str) -> List[str]:
+    parts, buf, depth = [], [], 0
+    for ch in seg:
+        if ch in "<({[":
+            depth += 1
+        elif ch in ">)}]":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+    parts.append("".join(buf))
+    return parts
+
+
+def _parse_field(segment: str) -> Optional[List[Tuple[str, str]]]:
+    """One struct-field / global declaration -> [(type_text, name), ...]
+    (multi-declarator ``bool a = false, b = false;`` yields several)."""
+
+    seg = " ".join(segment.split())
+    seg = re.sub(r"\balignas\([^)]*\)\s*", "", seg)
+    for skip in ("typedef ", "using ", "friend ", "template", "enum "):
+        if seg.startswith(skip):
+            return None
+    if re.fullmatch(r"(?:struct|class)\s+\w+", seg):
+        return None  # forward declaration, not a data member
+    if "(" in seg.split("{")[0].split("=")[0]:
+        return None  # method declaration / fn-ptr field: not a data field
+    parts = _split_top_commas(seg)
+    m = _FIELD_RE.match(parts[0].strip())
+    if m is None:
+        return None
+    out = [(m.group("type").strip(), m.group("name"))]
+    for extra in parts[1:]:
+        em = re.match(r"^\s*([A-Za-z_]\w*)\s*(?:\{[^{}]*\}|=.*)?$", extra)
+        if em:
+            out.append((m.group("type").strip(), em.group(1)))
+    return out
+
+
+def _classify(type_text: str) -> Tuple[bool, bool, bool]:
+    is_atomic = "std::atomic" in type_text
+    is_sync = any(s in type_text for s in _SYNC_TYPES)
+    is_const = type_text.startswith(("const ", "constexpr ", "static constexpr"))
+    return is_atomic, is_sync, is_const
+
+
+_PARAM_NAME_RE = re.compile(r"^(.*?)([A-Za-z_]\w*)(\s*\[\s*\d*\s*\])?$")
+
+
+def _parse_params(arglist: str) -> List[Tuple[str, str]]:
+    out: List[Tuple[str, str]] = []
+    arglist = arglist.strip()
+    if arglist in ("", "void"):
+        return out
+    depth = 0
+    parts, buf = [], []
+    for ch in arglist:
+        if ch in "<(":
+            depth += 1
+        elif ch in ">)":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+    parts.append("".join(buf))
+    for raw in parts:
+        raw = " ".join(raw.split())
+        if not raw:
+            continue
+        raw = raw.split("=")[0].strip()  # default args
+        m = _PARAM_NAME_RE.match(raw)
+        if m and m.group(1).strip():
+            out.append((m.group(1).strip(), m.group(2)))
+        else:
+            out.append((raw, ""))  # unnamed parameter
+    return out
+
+
+def _match_function_header(segment: str) -> Optional[Tuple[str, str, str]]:
+    """If `segment` (text before a '{' at decl depth) is a function
+    definition header, return (ret_and_quals, name, arglist)."""
+
+    seg = " ".join(segment.split())
+    # drop a ctor-initializer list: everything after the LAST ')' that is
+    # followed by ':' (but not '::')
+    # find the argument list: the last top-level (...) group
+    depth = 0
+    close = -1
+    opens: List[int] = []
+    pairs: List[Tuple[int, int]] = []
+    for i, ch in enumerate(seg):
+        if ch == "(":
+            depth += 1
+            opens.append(i)
+        elif ch == ")":
+            depth -= 1
+            if depth == 0 and opens:
+                pairs.append((opens[0], i))
+                opens = []
+    if not pairs:
+        return None
+    # the FIRST paren group whose suffix looks like a function tail is the
+    # argument list (a ctor-initializer list after it may carry more
+    # parens: `NetConn() : PollObj(0)` — the arglist is the first group)
+    for op, cl in pairs:
+        tail = seg[cl + 1:].strip()
+        if tail and not re.fullmatch(
+            r"(?:const|noexcept|override|final)?\s*(?::(?!:).*)?", tail
+        ):
+            continue
+        head = seg[:op].rstrip()
+        m = re.search(r"(~?[A-Za-z_][\w:]*)\s*$", head)
+        if not m:
+            return None
+        name = m.group(1).lstrip("~")
+        short = name.rsplit("::", 1)[-1]
+        if short in _KEYWORDS:
+            return None
+        ret = head[: m.start()].strip()
+        if ret.endswith(("=", "return", ",")):  # assignment w/ call, etc.
+            return None
+        return ret, name, seg[op + 1: cl]
+    return None
+
+
+def parse_file(path: str, text: Optional[str] = None) -> Model:
+    if text is None:
+        with open(path, "r") as fh:
+            text = fh.read()
+    model = Model(path=path)
+    model.directives = _scan_directives(text)
+    clean = _blank_preprocessor(_blank_comments_and_strings(text))
+
+    n = len(clean)
+    line = 1
+    i = 0
+    seg_start = 0
+    seg_line = 1
+    # context stack: list of ("namespace"|"struct"|"enum"|"extern", name)
+    ctx: List[Tuple[str, str]] = []
+
+    def cur_struct() -> Optional[str]:
+        for kind, name in reversed(ctx):
+            if kind == "struct":
+                return name
+        return None
+
+    def attach_fn(ret: str, name: str, arglist: str, sig_line: int,
+                  body: str, body_line: int) -> None:
+        struct = cur_struct()
+        if "::" in name:
+            struct, short = name.rsplit("::", 1)
+        else:
+            short = name
+        qname = f"{struct}::{short}" if struct else short
+        fn = CppFunc(
+            name=short, qname=qname, struct=struct, line=sig_line,
+            body=body, body_offset_line=body_line,
+            params=_parse_params(arglist),
+            is_ctor=(struct is not None and short == struct)
+            or short.startswith("~"),
+        )
+        d = model.directive_for(sig_line, "role")
+        if d:
+            fn.seeded_roles = set(d.args)
+        if model.directive_for(sig_line, "locked"):
+            fn.locked = True
+        d = model.directive_for(sig_line, "borrows")
+        if d:
+            fn.borrows = set(d.args)
+        d = model.directive_for(sig_line, "sanitizes")
+        if d:
+            fn.sanitizes = set(d.args)
+        d = model.directive_for(sig_line, "requires-bounded")
+        if d:
+            for a in d.args:
+                m = re.fullmatch(r"arg(\d+)\.(\w+)", a)
+                if m:
+                    fn.requires_bounded.append((int(m.group(1)), m.group(2)))
+                else:
+                    model.unparsed.append(
+                        (sig_line, f"bad requires-bounded arg {a!r}")
+                    )
+        model.funcs[qname] = fn
+        model.by_short.setdefault(short, []).append(fn)
+
+    def attach_field(segment: str, at_line: int) -> None:
+        struct = cur_struct()
+        parsed = _parse_field(segment)
+        if parsed is None:
+            s = " ".join(segment.split())
+            # method declarations / defaulted dtors inside a struct are
+            # not data fields, and forward declarations (`struct NetLoop;`)
+            # carry no state; skip both quietly
+            if (
+                s
+                and "(" not in s
+                and not s.startswith(("public", "private", "protected"))
+                and not re.fullmatch(r"(?:struct|class)\s+\w+", s)
+            ):
+                model.unparsed.append((at_line, s[:80]))
+            return
+        d = model.directive_for(at_line, "owner", lookback=0)
+        owner = d.args[0] if d and d.args else None
+        for type_text, name in parsed:
+            is_atomic, is_sync, is_const = _classify(type_text)
+            if struct is not None:
+                model.structs.setdefault(struct, {})[name] = CppField(
+                    struct, name, type_text, at_line, owner,
+                    is_atomic, is_sync, is_const,
+                )
+            else:
+                model.globals[name] = CppGlobal(
+                    name, type_text, at_line, owner,
+                    is_atomic, is_sync, is_const,
+                )
+
+    pending = ""  # declaration text preceding a brace initializer
+
+    def _consume_balanced(j: int) -> int:
+        nonlocal line
+        depth = 1
+        while j < n and depth > 0:
+            cj = clean[j]
+            if cj == "{":
+                depth += 1
+            elif cj == "}":
+                depth -= 1
+            elif cj == "\n":
+                line += 1
+            j += 1
+        return j
+
+    while i < n:
+        ch = clean[i]
+        if ch == "\n":
+            line += 1
+            i += 1
+            continue
+        if not pending and not clean[seg_start:i].strip() and not ch.isspace():
+            seg_line = line
+        if ch == ";":
+            segment = (pending + " " + clean[seg_start:i]).strip()
+            pending = ""
+            at_decl_depth = not ctx or ctx[-1][0] in (
+                "namespace", "struct", "extern"
+            )
+            if segment and at_decl_depth:
+                in_struct = bool(ctx) and ctx[-1][0] == "struct"
+                if in_struct or "(" not in segment.split("=")[0]:
+                    attach_field(segment, seg_line)
+            i += 1
+            seg_start = i
+            continue
+        if ch == "{":
+            segment = clean[seg_start:i].strip()
+            seg1 = " ".join(segment.split())
+            sm = re.match(
+                r"^(?:typedef\s+)?(?:struct|class)\s+([A-Za-z_]\w*)"
+                r"(?:\s*(?::|final).*)?$",
+                seg1,
+            )
+            if seg1.startswith("namespace") and not pending:
+                ctx.append(
+                    ("namespace",
+                     seg1.split()[-1] if len(seg1.split()) > 1 else "")
+                )
+                i += 1
+                seg_start = i
+                continue
+            # extern "C" { ... }: a transparent linkage block (string
+            # contents are blanked, so the segment reads `extern " "`)
+            if re.fullmatch(r'extern\s*"[^"]*"', seg1) and not pending:
+                ctx.append(("extern", ""))
+                i += 1
+                seg_start = i
+                continue
+            if (seg1.startswith("enum") and "(" not in seg1 and not pending):
+                ctx.append(("enum", ""))
+                i += 1
+                seg_start = i
+                continue
+            if sm and "(" not in seg1 and not pending:
+                ctx.append(("struct", sm.group(1)))
+                model.struct_lines[sm.group(1)] = seg_line
+                i += 1
+                seg_start = i
+                continue
+            fh = _match_function_header(segment) if not pending else None
+            if fh is not None:
+                ret, name, arglist = fh
+                body_line = line
+                j = _consume_balanced(i + 1)
+                body = clean[i + 1: j - 1]
+                attach_fn(ret, name, arglist, seg_line, body, body_line)
+                i = j
+                seg_start = i
+                continue
+            # brace initializer on a declaration (`std::atomic<u32> x{0};`):
+            # stash the declaration text, skip the initializer, and let the
+            # terminating ';' attach the field/global
+            pending = (pending + " " + segment).strip()
+            i = _consume_balanced(i + 1)
+            seg_start = i
+            continue
+        if ch == "}":
+            if ctx:
+                ctx.pop()
+            i += 1
+            seg_start = i
+            continue
+        i += 1
+
+    for fn in model.funcs.values():
+        fn.path = path
+    _resolve_calls(model)
+    return model
+
+
+_CALL_RE = re.compile(r"(\.|->)?\s*\b([A-Za-z_]\w*)\s*\(")
+
+
+def _resolve_calls(model: Model) -> None:
+    for fn in model.funcs.values():
+        for m in _CALL_RE.finditer(fn.body):
+            short = m.group(2)
+            if short in _KEYWORDS:
+                continue
+            cands = model.by_short.get(short)
+            if not cands:
+                continue
+            is_member_call = m.group(1) is not None
+            for cand in cands:
+                if cand.struct is not None and not is_member_call:
+                    # a struct method invoked without an object: only via
+                    # unqualified call inside the same struct
+                    if fn.struct != cand.struct:
+                        continue
+                fn.calls.add(cand.qname)
+        # thread/ctor-style callee references without '(' directly after
+        # (std::thread(loop_run, ...), emplace_back(pool_worker, s, w))
+        for m in re.finditer(r"\b(thread|emplace_back)\s*\(\s*([A-Za-z_]\w*)",
+                             fn.body):
+            cands = model.by_short.get(m.group(2))
+            if cands:
+                for cand in cands:
+                    if cand.struct is None:
+                        fn.calls.add(cand.qname)
+
+
+def merge_models(models: List[Model]) -> Model:
+    merged = Model(path="+".join(m.path for m in models))
+    for m in models:
+        merged.funcs.update(m.funcs)
+        for k, v in m.by_short.items():
+            merged.by_short.setdefault(k, []).extend(v)
+        merged.structs.update(m.structs)
+        merged.struct_lines.update(m.struct_lines)
+        merged.globals.update(m.globals)
+        merged.unparsed.extend(m.unparsed)
+        for k, v in m.directives.items():
+            merged.directives.setdefault(k, []).extend(v)
+    # re-resolve calls so cross-file edges (tbnet -> tbutil) appear
+    _resolve_calls(merged)
+    return merged
+
+
+def parse_native_plane(
+    tbnet_text: Optional[str] = None, tbutil_text: Optional[str] = None
+) -> Model:
+    """The merged model of src/tbnet/tbnet.cc + src/tbutil/tbutil.cc.
+    Text overrides exist for the seeded-mutation meta-tests."""
+
+    a = parse_file(TBNET_CC, text=tbnet_text)
+    b = parse_file(TBUTIL_CC, text=tbutil_text)
+    return merge_models([a, b])
+
+
+def reachable(model: Model, roots: List[str]) -> Set[str]:
+    """Call-graph closure from root function qnames (unknown roots are
+    the caller's problem — report them as coverage violations)."""
+
+    seen: Set[str] = set()
+    stack = [r for r in roots if r in model.funcs]
+    while stack:
+        q = stack.pop()
+        if q in seen:
+            continue
+        seen.add(q)
+        for callee in model.funcs[q].calls:
+            if callee not in seen:
+                stack.append(callee)
+    return seen
+
+
+def line_of(fn: CppFunc, pos: int) -> int:
+    """Absolute line number of a character offset inside fn.body."""
+
+    return fn.body_offset_line + fn.body.count("\n", 0, pos)

@@ -1,0 +1,407 @@
+"""ownership — reactor-ownership checking for the multi-reactor plane.
+
+PR 9's headline claim — "zero cross-reactor locks on the
+cut→decode→dispatch→pack path" — rests on a threading discipline that
+lived only in comments: every mutable field of the native plane's
+shared structures is owned by exactly one thread context, and foreign
+contexts reach it only through atomics, a lock, or the telemetry ring's
+sequence protocol.  This pass makes the discipline declared and
+checked:
+
+**Owners** (``// fabricscan: owner(...)`` on the field or global)
+
+- ``loop``    — the reactor loop thread that owns the enclosing
+  instance (NetConn fields, the reactor's ZCtx/scratch, …).  Accesses
+  are legal from loop-role code, from init (before the threads exist)
+  and from stop (after they joined).
+- ``worker``  — dispatch-pool worker context (WorkTask fields after the
+  publication handoff).  Same init/stop latitude.
+- ``shared``  — any thread, but every access must be visibly justified:
+  the function is marked ``// fabricscan: locked`` (its callers hold
+  the guarding mutex), or a lock acquisition appears in the function
+  before the access, or an acquire-load of an atomic appears before it
+  (the ring's per-cell seq protocol).
+- ``init``    — written only during single-threaded setup (construction
+  sites, ``role(init)`` functions = the pre-listen/pre-connect
+  configuration surface), read-only afterwards from anywhere.
+
+Fields that are ``std::atomic``, sync primitives (mutex/cv/thread),
+``const``, or themselves checked-struct values (ownership lives on the
+inner fields) need no annotation.  Everything else mutable on a checked
+struct without an owner is an ``owner-missing`` violation — unannotated
+shared mutable state is the bug class this pass exists for.
+
+**Roles** propagate over the call graph: seeds come from
+``// fabricscan: role(...)`` (``loop_run`` is the loop thread,
+``pool_worker`` the worker, the pre-listen setters are ``init``, the
+teardown entry points ``stop``) and every un-seeded ``extern "C"``
+``tb_*`` export defaults to ``python`` (an arbitrary interpreter
+thread).  A seeded function keeps ONLY its seed — a thread entry point
+does not inherit the role of the code that spawned it — while unseeded
+functions take the union of their callers' roles.
+
+Accesses are found by typing each function's parameters and locals
+against the checked structs and walking member chains
+(``c->loop->batch``): per-instance ownership falls out of the chain —
+reaching a reactor's ZCtx from python role goes through the loop-owned
+pointer and is flagged there, while a worker's stack-local ZCtx is a
+fresh instance and exempt.  ``// fabricscan: borrows(Type)`` on a
+function moves the obligation to its call sites (the codec helpers run
+on whichever instance the caller hands them).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.fabriclint import Violation, allowed, scan_annotations
+from tools.fabricscan import cmodel
+from tools.fabricscan.cmodel import CppFunc, Model
+
+OWNERS = ("loop", "worker", "shared", "init")
+ROLES = ("loop", "worker", "python", "init", "stop")
+
+# structures reachable from more than one thread (or pinned to one, which
+# is exactly the claim being checked).  Value-only scratch types (ReqCtx,
+# PrpcMeta, MetaLite, Scan) never escape a stack frame and stay out.
+CHECKED_STRUCTS = (
+    "PollObj", "Wake", "Listener", "NetConn", "NetLoop", "NativeMethod",
+    "tb_server", "TelemetryRing", "TelemetryCell", "ZCtx", "SnappyTable",
+    "WorkDeque", "WorkTask", "DispatchPool", "tb_channel", "Pending",
+    "tb_wsq",
+)
+
+# owner -> roles whose access needs no further justification
+_FREE_ROLES = {
+    "loop": {"loop", "init", "stop"},
+    "worker": {"worker", "init", "stop"},
+}
+
+_MUTATORS = (
+    "assign", "clear", "push_back", "pop_back", "emplace_back", "resize",
+    "reserve", "insert", "erase",
+)
+
+_LOCKY_RE = re.compile(
+    r"lock_guard\s*<|unique_lock\s*<|\.\s*lock\s*\(|try_lock\s*\(|"
+    r"memory_order_acquire"
+)
+
+
+@dataclass
+class _Access:
+    struct: str
+    fld: str
+    pos: int       # offset of the member name in fn.body
+    is_write: bool
+
+
+def _struct_of_type(type_text: str) -> Optional[str]:
+    for s in CHECKED_STRUCTS:
+        if re.search(rf"\b{s}\b", type_text):
+            return s
+    return None
+
+
+def field_needs_owner(f) -> bool:
+    """Mutable plain state needs a declared owner; atomics, sync
+    primitives, consts, and checked-struct-valued members (ownership
+    lives on the inner fields) do not."""
+
+    if f.is_atomic or f.is_sync or f.is_const:
+        return False
+    if _struct_of_type(f.type_text) and "*" not in f.type_text:
+        return False  # embedded checked struct: inner fields carry owners
+    return True
+
+
+# ---------------------------------------------------------------------------
+# role propagation
+# ---------------------------------------------------------------------------
+
+
+def seed_and_propagate(model: Model) -> List[Violation]:
+    out: List[Violation] = []
+    for fn in model.funcs.values():
+        for r in fn.seeded_roles:
+            if r not in ROLES:
+                out.append(
+                    Violation(
+                        "scan-parse", model.path, fn.line,
+                        f"{fn.qname}: role({r}) is not one of "
+                        f"{'/'.join(ROLES)}",
+                    )
+                )
+        fn.roles = set(fn.seeded_roles)
+        # the C API surface: python threads, unless the seed says the
+        # call is part of single-threaded setup/teardown
+        if not fn.roles and fn.struct is None and fn.name.startswith("tb_"):
+            fn.roles = {"python"}
+    changed = True
+    while changed:
+        changed = False
+        for fn in model.funcs.values():
+            for callee_q in fn.calls:
+                callee = model.funcs[callee_q]
+                if callee.seeded_roles:
+                    continue  # thread entries keep their seed only
+                add = fn.roles - callee.roles
+                if add:
+                    callee.roles |= add
+                    changed = True
+    return out
+
+
+# ---------------------------------------------------------------------------
+# access extraction
+# ---------------------------------------------------------------------------
+
+_DECL_RE_TMPL = (
+    r"(?:^|[;{{}}()]|\bconst\b)\s*(?:static\s+thread_local\s+|"
+    r"static\s+|thread_local\s+)*"
+    r"(?P<type>{structs})\s*(?P<ref>[*&]*)\s+(?P<name>\w+)\s*(?P<init>=|;|\{{|:)"
+)
+
+
+def _local_env(fn: CppFunc) -> Tuple[Dict[str, str], Set[str]]:
+    """(var -> struct) for typed locals/params, plus the EXEMPT set:
+    value locals (fresh instances) and news (construction context)."""
+
+    env: Dict[str, str] = {}
+    exempt: Set[str] = set()
+    for ptype, pname in fn.params:
+        s = _struct_of_type(ptype)
+        if s and pname:
+            env[pname] = s
+    decl_re = re.compile(
+        _DECL_RE_TMPL.format(structs="|".join(CHECKED_STRUCTS))
+    )
+    for m in decl_re.finditer(fn.body):
+        s, name = m.group("type"), m.group("name")
+        env[name] = s
+        if "*" not in m.group("ref") and "&" not in m.group("ref"):
+            exempt.add(name)  # fresh value instance on this frame
+    # construction context: `X = new S(...)` exempts accesses through X
+    # (the object is unpublished while this function fills it in)
+    for m in re.finditer(
+        rf"([\w.>\-]+)\s*=\s*new\s+(?:{'|'.join(CHECKED_STRUCTS)})\b",
+        fn.body,
+    ):
+        exempt.add(m.group(1).replace("->", "."))
+    return env, exempt
+
+
+_CHAIN_RE_TMPL = r"\b{var}\s*((?:(?:->|\.)\s*\w+\s*(?:\[[^\]]*\])?)+)"
+_MEMBER_RE = re.compile(r"(?:->|\.)\s*(\w+)")
+
+
+def _is_write(body: str, end: int) -> bool:
+    tail = body[end: end + 60]
+    tail = re.sub(r"^\s*(?:\[[^\]]*\]\s*)*", "", tail)  # skip subscripts
+    if re.match(r"(?:\+\+|--|(?:<<|>>|[+\-*/|&^%])?=(?!=))", tail):
+        return True
+    m = re.match(r"\.\s*(\w+)\s*\(", tail)
+    if m and m.group(1) in _MUTATORS:
+        return True
+    return False
+
+
+def _accesses(fn: CppFunc, model: Model) -> List[_Access]:
+    env, exempt = _local_env(fn)
+    body = fn.body
+    out: List[_Access] = []
+
+    def walk(root_struct: str, chain_text: str, base_pos: int,
+             root_exempt: bool) -> None:
+        cur: Optional[str] = root_struct
+        for m in _MEMBER_RE.finditer(chain_text):
+            if cur is None:
+                break
+            member = m.group(1)
+            f = model.structs.get(cur, {}).get(member)
+            if f is None:
+                break  # a method call or an unmodeled member: chain ends
+            if not root_exempt and cur in CHECKED_STRUCTS:
+                out.append(
+                    _Access(cur, member, base_pos + m.start(1),
+                            _is_write(body, base_pos + m.end(1)))
+                )
+            cur = _struct_of_type(f.type_text)
+
+    for var, s in env.items():
+        var_exempt = var in exempt
+        for m in re.finditer(_CHAIN_RE_TMPL.format(var=re.escape(var)),
+                             body):
+            walk(s, m.group(1), m.start(1), var_exempt)
+    # construction-exempt chains spelled as chains (`s->pool = new ...;
+    # s->pool->workers...`): re-run suppression by prefix
+    # (handled below in check_function by position filtering)
+    # bare this-members inside methods of checked structs
+    if fn.struct in CHECKED_STRUCTS and not fn.is_ctor:
+        fields = model.structs.get(fn.struct, {})
+        for name, f in fields.items():
+            for m in re.finditer(rf"(?<![\w.>])\b{name}\b(?!\s*\()", body):
+                # skip if actually a chained member (preceded by -> or .)
+                pre = body[max(0, m.start() - 2): m.start()]
+                if pre.endswith(("->", ".")):
+                    continue
+                out.append(
+                    _Access(fn.struct, name, m.start(),
+                            _is_write(body, m.end()))
+                )
+    return out
+
+
+def _chain_exempt_prefixes(fn: CppFunc) -> List[str]:
+    """Textual prefixes (as they appear in the body) whose accesses are
+    construction-time: `<prefix> = new <CheckedStruct>`."""
+
+    out = []
+    for m in re.finditer(
+        rf"([\w.>\-]+(?:->|\.)[\w.>\-]+|\w+)\s*=\s*new\s+"
+        rf"(?:{'|'.join(CHECKED_STRUCTS)})\b",
+        fn.body,
+    ):
+        out.append(m.group(1))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the check
+# ---------------------------------------------------------------------------
+
+
+def _lock_positions(fn: CppFunc) -> List[int]:
+    return [m.start() for m in _LOCKY_RE.finditer(fn.body)]
+
+
+def check_function(fn: CppFunc, model: Model) -> List[Violation]:
+    if fn.is_ctor:
+        return []
+    out: List[Violation] = []
+    locks = _lock_positions(fn)
+    chain_exempt = _chain_exempt_prefixes(fn)
+    seen: Set[Tuple[str, str, int]] = set()
+    for acc in _accesses(fn, model):
+        if acc.struct in fn.borrows:
+            continue
+        f = model.structs[acc.struct][acc.fld]
+        if not field_needs_owner(f):
+            continue
+        owner = f.owner
+        if owner is None:
+            continue  # owner-missing reported once, at the field
+        # construction-exempt prefix?
+        stmt_start = fn.body.rfind(";", 0, acc.pos) + 1
+        region = fn.body[stmt_start: acc.pos + len(acc.fld) + 4]
+        if any(p in region for p in chain_exempt):
+            continue
+        line = cmodel.line_of(fn, acc.pos)
+        key = (acc.struct, acc.fld, line)
+        if key in seen:
+            continue
+        ok = False
+        why = ""
+        if owner in _FREE_ROLES:
+            bad = fn.roles - _FREE_ROLES[owner]
+            ok = fn.roles and not bad
+            why = (
+                f"{acc.struct}.{acc.fld} is {owner}-owned but "
+                f"{fn.qname} runs in role(s) "
+                f"{','.join(sorted(bad)) or '?'} — use an atomic, the "
+                "ring, or a lock"
+            )
+            if not fn.roles:
+                why = (
+                    f"{fn.qname} touches {owner}-owned "
+                    f"{acc.struct}.{acc.fld} but has no derivable role — "
+                    "seed it with // fabricscan: role(...)"
+                )
+        elif owner == "shared":
+            ok = fn.locked or any(p < acc.pos for p in locks)
+            why = (
+                f"{acc.struct}.{acc.fld} is shared but {fn.qname} "
+                "reaches it with no lock acquisition, acquire-load, or "
+                "locked marker before the access"
+            )
+        elif owner == "init":
+            ok = (not acc.is_write) or (
+                fn.roles and fn.roles <= {"init", "stop"}
+            )
+            why = (
+                f"{acc.struct}.{acc.fld} is init-owned (write-once "
+                f"setup) but {fn.qname} writes it from role(s) "
+                f"{','.join(sorted(fn.roles)) or '?'}"
+            )
+        else:
+            ok = False
+            why = (
+                f"{acc.struct}.{acc.fld}: unknown owner {owner!r} "
+                f"(expected {'/'.join(OWNERS)})"
+            )
+        if not ok:
+            seen.add(key)
+            out.append(Violation("ownership", model.path, line, why))
+    return out
+
+
+def check(tbnet_text: Optional[str] = None) -> List[Violation]:
+    model = cmodel.parse_file(cmodel.TBNET_CC, text=tbnet_text)
+    out: List[Violation] = []
+    ann = scan_annotations(cmodel.TBNET_CC, tbnet_text)
+    out.extend(seed_and_propagate(model))
+
+    # unannotated mutable state on checked structs / globals
+    for sname in CHECKED_STRUCTS:
+        for f in model.structs.get(sname, {}).values():
+            if field_needs_owner(f) and f.owner is None:
+                out.append(
+                    Violation(
+                        "owner-missing", model.path, f.line,
+                        f"{sname}.{f.name} ({f.type_text}) is mutable "
+                        "shared state with no declared owner — add "
+                        "// fabricscan: owner(loop|worker|shared|init)",
+                    )
+                )
+            elif f.owner is not None and f.owner not in OWNERS:
+                out.append(
+                    Violation(
+                        "scan-parse", model.path, f.line,
+                        f"{sname}.{f.name}: owner({f.owner}) is not one "
+                        f"of {'/'.join(OWNERS)}",
+                    )
+                )
+    for g in model.globals.values():
+        if g.is_atomic or g.is_sync or g.is_const:
+            continue
+        if g.type_text.startswith(("constexpr", "static constexpr")):
+            continue
+        if g.owner is None:
+            out.append(
+                Violation(
+                    "owner-missing", model.path, g.line,
+                    f"global {g.name} ({g.type_text}) is mutable shared "
+                    "state with no declared owner",
+                )
+            )
+
+    for fn in model.funcs.values():
+        out.extend(check_function(fn, model))
+
+    return [
+        v for v in out
+        if not allowed(ann, v.rule, v.line)
+    ]
+
+
+def owned_fields(model: Model, sname: str) -> Dict[str, Optional[str]]:
+    """field -> owner for every field of `sname` that needs one (the
+    tier-1 coverage gate asserts none are None for NetLoop/NetConn)."""
+
+    return {
+        f.name: f.owner
+        for f in model.structs.get(sname, {}).values()
+        if field_needs_owner(f)
+    }

@@ -1,0 +1,559 @@
+"""plane-parity — mechanical diff of the constants mirrored across the
+C++ and Python planes.
+
+The native plane's acceptance contract since PR 2 is byte-identity with
+the Python implementation: same frame headers, same RpcMeta field
+numbers, same codec ids, same error codes and ``berror`` texts, same
+snappy parse constants, same ceilings.  Until now that contract was
+guarded only by round-trip tests — a skewed constant showed up (at
+best) as a byte-identity test flake three layers away.  This pass
+extracts each mirrored surface FROM BOTH SOURCES mechanically and diffs
+them at lint time:
+
+- **PRPC framing**: ``kMagicPrpc``/``kPrpcHeader`` vs ``baidu_std.py``'s
+  ``MAGIC``/``HEADER_BYTES``.
+- **tbus framing**: magic, 32-byte header, the four wire flag bits vs
+  ``tbus_std.py``.
+- **RpcMeta field numbers**, both directions: the scanner's decode
+  branches (``field == N`` classified by the ``m.<attr>`` they fill)
+  and the packers' tag bytes (classified by the value they emit) vs the
+  decode ``elif`` chain and ``_f_varint/_f_bytes/_tag`` calls in
+  ``baidu_std.py``.
+- **Codec enum**: ``kCompressSnappy/Gzip/Zlib1`` + ``codec_name`` vs
+  ``_COMPRESS_TO_WIRE``.
+- **Error codes and texts**: the ``ErrorCodes`` defaults vs
+  ``utils/status.py`` ``ErrorCode``; ``kDeadlineShedText``/
+  ``kUnauthorizedText`` vs ``berror``'s descriptions; the three
+  decompress-reject texts vs the composed Python route text
+  (``"decompress failed: " + <codec error>``).
+- **Snappy constants**: hash multiplier, table size, skip schedule
+  seed, shift seed vs ``snappy_codec.py``.
+- **Flag defaults stamped into C++**: ``compress_min``/
+  ``max_decompress`` initializers vs the ``native_compress_min_bytes``/
+  ``max_decompress_bytes`` flag defaults.
+
+A missing extraction anchor is itself a violation (``scan-parse``): if
+either side is refactored out from under a regex, the pass screams
+instead of silently comparing nothing.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import struct
+from typing import Dict, List, Optional, Tuple
+
+from tools.fabriclint import REPO_ROOT, Violation, allowed, scan_annotations
+from tools.fabricscan import cmodel
+
+PKG = os.path.join(REPO_ROOT, "incubator_brpc_tpu")
+
+PY_FILES = {
+    "baidu_std": os.path.join(PKG, "protocol", "baidu_std.py"),
+    "tbus_std": os.path.join(PKG, "protocol", "tbus_std.py"),
+    "snappy": os.path.join(PKG, "protocol", "snappy_codec.py"),
+    "compress": os.path.join(PKG, "protocol", "compress.py"),
+    "status": os.path.join(PKG, "utils", "status.py"),
+    "flags": os.path.join(PKG, "utils", "flags.py"),
+    "server": os.path.join(PKG, "rpc", "server.py"),
+}
+
+
+def _line_of(text: str, pos: int) -> int:
+    return text.count("\n", 0, pos) + 1
+
+
+class _Joined:
+    """Adjacent C++ string literals joined into one logical match."""
+
+    def __init__(self, text: str, start: int):
+        self._text = text
+        self._start = start
+
+    def group(self, _i: int = 1) -> str:
+        return self._text
+
+    def start(self, _i: int = 0) -> int:
+        return self._start
+
+
+class _Side:
+    """One plane's source text + the extraction bookkeeping."""
+
+    def __init__(self, path: str, text: str, out: List[Violation]):
+        self.path = path
+        self.text = text
+        self.out = out
+
+    def grab(self, pattern: str, what: str) -> Optional[re.Match]:
+        m = re.search(pattern, self.text)
+        if m is None:
+            self.out.append(
+                Violation(
+                    "scan-parse", self.path, 1,
+                    f"plane-parity anchor missing: {what} "
+                    f"(pattern {pattern!r} found nothing — re-point the "
+                    "extractor at the refactored code)",
+                )
+            )
+        return m
+
+    def int_at(self, pattern: str, what: str) -> Optional[Tuple[int, int]]:
+        m = self.grab(pattern, what)
+        if m is None:
+            return None
+        return int(m.group(1), 0), _line_of(self.text, m.start(1))
+
+
+def _diff(out: List[Violation], what: str,
+          cc: Optional[Tuple[object, int]], cc_path: str,
+          py: Optional[Tuple[object, int]], py_path: str) -> None:
+    if cc is None or py is None:
+        return  # the missing anchor already screamed
+    cval, cline = cc
+    pval, _ = py
+    if cval != pval:
+        out.append(
+            Violation(
+                "plane-parity", cc_path, cline,
+                f"{what}: C++ has {cval!r}, "
+                f"{os.path.relpath(py_path, REPO_ROOT)} has {pval!r} — "
+                "the twin implementations drifted",
+            )
+        )
+
+
+# ---------------------------------------------------------------------------
+# surface extractors
+# ---------------------------------------------------------------------------
+
+
+def _framing(out, cc: _Side, baidu: _Side, tbus: _Side) -> None:
+    m = cc.int_at(r"kMagicPrpc = (0x[0-9A-Fa-f]+)", "PRPC magic")
+    if m is not None:
+        cc_magic = (struct.pack("<I", m[0]).decode("ascii"), m[1])
+        p = baidu.grab(r'MAGIC = b"(\w+)"', "PRPC magic")
+        if p is not None:
+            _diff(out, "PRPC magic", cc_magic, cc.path,
+                  (p.group(1), 0), baidu.path)
+    _diff(out, "PRPC header bytes",
+          cc.int_at(r"kPrpcHeader = (\d+)", "PRPC header size"), cc.path,
+          baidu.int_at(r"HEADER_BYTES = (\d+)", "PRPC header size"),
+          baidu.path)
+    _diff(out, "tbus magic",
+          cc.int_at(r"\bkMagic = (0x[0-9A-Fa-f]+)", "tbus magic"), cc.path,
+          tbus.int_at(r"\bMAGIC = (0x[0-9A-Fa-f]+)", "tbus magic"),
+          tbus.path)
+    _diff(out, "tbus header bytes",
+          cc.int_at(r"\bkHeader = (\d+)", "tbus header size"), cc.path,
+          tbus.int_at(r"\bHEADER_BYTES = (\d+)", "tbus header size"),
+          tbus.path)
+    for cname, pname in (
+        ("kFlagResponse", "FLAG_RESPONSE"), ("kFlagStream", "FLAG_STREAM"),
+        ("kFlagHasMeta", "FLAG_HAS_META"), ("kFlagBodyCrc", "FLAG_BODY_CRC"),
+    ):
+        _diff(out, f"tbus flag {pname}",
+              cc.int_at(rf"{cname} = (\d+)", cname), cc.path,
+              tbus.int_at(rf"{pname} = (\d+)", pname), tbus.path)
+
+
+# semantic -> the attribute the C++ scanner fills / the Python decode sets
+_CC_DECODE_ATTRS = {
+    "request submessage": r"m\.req_sub\b",
+    "response submessage": r"m\.is_response",
+    "compress_type": r"m\.compress\b",
+    "correlation_id": r"m\.cid\b",
+    "attachment_size": r"m\.attachment\b",
+    "authentication_data": r"m\.auth\b",
+}
+_CC_SUB_ATTRS = {
+    "service_name": r"m\.svc\b",
+    "method_name": r"m\.mth\b",
+    "timeout_ms": r"m\.timeout_ms\b",
+    "error_code": r"m\.error_code\b",
+}
+_PY_DECODE_ATTRS = {
+    "compress_type": r"m\.compress_type = ",
+    "correlation_id": r"m\.correlation_id = ",
+    "attachment_size": r"m\.attachment_size = ",
+    "authentication_data": r"m\.authentication_data = ",
+}
+_PY_SUB_ATTRS = {
+    "service_name": r"m\.service_name = ",
+    "method_name": r"m\.method_name = ",
+    "timeout_ms": r"m\.timeout_ms = ",
+    "error_code": r"m\.error_code = ",
+}
+
+
+def _classify_branches(side: _Side, branch_re: str,
+                       attrs: Dict[str, str],
+                       window: int) -> Dict[str, Tuple[int, int]]:
+    """{semantic: (field_no, line)} — each `<var> == N` branch classified
+    by the first known attribute assigned in its window."""
+
+    found: Dict[str, Tuple[int, int]] = {}
+    for m in re.finditer(branch_re, side.text):
+        ctx = side.text[m.end(): m.end() + window]
+        best = None
+        for sem, attr_re in attrs.items():
+            am = re.search(attr_re, ctx)
+            if am and (best is None or am.start() < best[1]):
+                best = (sem, am.start())
+        if best and best[0] not in found:
+            found[best[0]] = (int(m.group(1)), _line_of(side.text, m.start()))
+    return found
+
+
+def _rpc_meta_decode(out, cc: _Side, baidu: _Side) -> None:
+    cc_map = _classify_branches(cc, r"\bfield == (\d+)\b",
+                                _CC_DECODE_ATTRS, 260)
+    cc_map.update(_classify_branches(cc, r"\bf2 == (\d+)\b",
+                                     _CC_SUB_ATTRS, 200))
+    py_map = _classify_branches(baidu, r"field_no == (\d+)\b",
+                                _PY_DECODE_ATTRS, 120)
+    py_map.update(_classify_branches(baidu, r"\bf2 == (\d+)\b",
+                                     _PY_SUB_ATTRS, 120))
+    # the submessage routing fields come from the tag-structured branches
+    pm = baidu.grab(r"if field_no == (\d+) and wt == 2:\s*\n\s*for f2",
+                    "RpcMeta request-submessage decode branch")
+    if pm:
+        py_map["request submessage"] = (
+            int(pm.group(1)), _line_of(baidu.text, pm.start()))
+    pm = baidu.grab(
+        r"elif field_no == (\d+) and wt == 2:\s*\n\s*m\.is_response",
+        "RpcMeta response-submessage decode branch")
+    if pm:
+        py_map["response submessage"] = (
+            int(pm.group(1)), _line_of(baidu.text, pm.start()))
+    for sem in sorted(set(_CC_DECODE_ATTRS) | set(_CC_SUB_ATTRS)):
+        if sem not in cc_map:
+            out.append(Violation(
+                "scan-parse", cc.path, 1,
+                f"plane-parity: no decode branch found for {sem} in the "
+                "C++ meta scanners"))
+            continue
+        if sem not in py_map:
+            out.append(Violation(
+                "scan-parse", baidu.path, 1,
+                f"plane-parity: no decode branch found for {sem} in "
+                "baidu_std.py"))
+            continue
+        _diff(out, f"RpcMeta decode field number of {sem}",
+              cc_map[sem], cc.path, py_map[sem], baidu.path)
+
+
+# pack-side: tag byte classified by the value emitted right after it
+_CC_PACK_CTX = {
+    "request submessage": r"put_varint\(tmp, sub_len\)",
+    "compress_type": r"put_varint\(tmp, compress\)",
+    "correlation_id": r"put_varint\(tmp, cid\)",
+    "attachment_size": r"put_varint\(tmp, att_len\)",
+    "authentication_data": r"put_varint\(tmp, auth_len\)",
+}
+_CC_RESP_CTX = {
+    "response submessage": r"put_varint\(meta \+ mn, sn\)",
+    "error_code": r"put_varint\(sub \+ sn, error_code\)",
+    "error_text": r"put_varint\(sub \+ sn, text_len\)",
+}
+_CC_PUMP_CTX = {
+    "request submessage": r"put_varint\(t \+ o, meta_len\)",
+    "compress_type": r"put_varint\(t \+ o, compress\)",
+    "correlation_id": r"cid_off = o",
+    "authentication_data": r"put_varint\(t \+ o, auth_len\)",
+}
+
+
+def _cc_pack_tags(side: _Side, ctxmap: Dict[str, str],
+                  where: str) -> Dict[str, Tuple[int, int]]:
+    found: Dict[str, Tuple[int, int]] = {}
+    for m in re.finditer(
+        r"(?:push_back\(|\[\w+\+\+\] = )(0x[0-9A-Fa-f]{2})\)?;", side.text
+    ):
+        ctx = side.text[m.end(): m.end() + 160]
+        # the NEAREST context wins: a tag's window may run into the next
+        # tag's emit call
+        best = None
+        for sem, ctx_re in ctxmap.items():
+            cm = re.search(ctx_re, ctx)
+            if cm and (best is None or cm.start() < best[1]):
+                best = (sem, cm.start())
+        if best and best[0] not in found:
+            tag = int(m.group(1), 16)
+            found[best[0]] = (tag >> 3, _line_of(side.text, m.start(1)))
+    for sem in ctxmap:
+        if sem not in found:
+            side.out.append(Violation(
+                "scan-parse", side.path, 1,
+                f"plane-parity: no pack tag found for {sem} in {where}"))
+    return found
+
+
+def _rpc_meta_pack(out, cc: _Side, baidu: _Side) -> None:
+    py: Dict[str, Tuple[int, int]] = {}
+
+    def py_field(pattern: str, sem: str) -> None:
+        m = baidu.grab(pattern, f"{sem} encode call")
+        if m:
+            py[sem] = (int(m.group(1)), _line_of(baidu.text, m.start()))
+
+    py_field(r"_tag\((\d+), 2\) \+ _varint\(len\(sub\)\) \+ sub\n"
+             r"\s*else:", "response submessage")
+    # request tag: the non-response arm
+    m = baidu.grab(
+        r"else:\s*\n\s*sub = encode_request_submeta\((?:.|\n)*?"
+        r"_tag\((\d+), 2\)", "request submessage encode")
+    if m:
+        py["request submessage"] = (int(m.group(1)),
+                                    _line_of(baidu.text, m.start(1)))
+    py_field(r"_f_varint\((\d+), self\.compress_type\)", "compress_type")
+    py_field(r"_f_varint\((\d+), self\.correlation_id\)", "correlation_id")
+    py_field(r"_f_varint\((\d+), self\.attachment_size\)", "attachment_size")
+    py_field(r"_f_bytes\((\d+), self\.authentication_data\)",
+             "authentication_data")
+    py_field(r"_f_varint\((\d+), self\.error_code\)", "error_code")
+    py_field(r"_f_bytes\(\s*(\d+), self\.error_text", "error_text")
+
+    req = _cc_pack_tags(cc, _CC_PACK_CTX, "pack_prpc_request")
+    resp = _cc_pack_tags(cc, _CC_RESP_CTX, "append_prpc_resp_header")
+    pump = _cc_pack_tags(cc, _CC_PUMP_CTX, "tb_channel_pump's template")
+    for sem, ccv in {**req, **resp}.items():
+        if sem in py:
+            _diff(out, f"RpcMeta pack field number of {sem}",
+                  ccv, cc.path, py[sem], baidu.path)
+    for sem, ccv in pump.items():
+        if sem in py:
+            _diff(out, f"RpcMeta pump-template field number of {sem}",
+                  ccv, cc.path, py[sem], baidu.path)
+    # submeta twins (service/method/timeout) ride encode_request_submeta
+    cm = _classify_branches(cc, r"\bf2 == (\d+)\b", _CC_SUB_ATTRS, 200)
+    for pat, sem in (
+        (r"_f_bytes\((\d+), service\.encode\(\)\)", "service_name"),
+        (r"_f_bytes\((\d+), method\.encode\(\)\)", "method_name"),
+        (r"_f_varint\((\d+), timeout_ms\)", "timeout_ms"),
+    ):
+        m = baidu.grab(pat, f"submeta {sem}")
+        if m and sem in cm:
+            _diff(out, f"RpcRequestMeta field number of {sem}",
+                  cm[sem], cc.path,
+                  (int(m.group(1)), 0), baidu.path)
+
+
+def _codec_enum(out, cc: _Side, baidu: _Side) -> None:
+    names = {}
+    for m in re.finditer(
+        r"case (kCompress\w+): return \"(\w+)\";", cc.text
+    ):
+        names[m.group(1)] = m.group(2)
+    cc_map: Dict[str, Tuple[int, int]] = {}
+    for cname, wire_name in names.items():
+        v = cc.int_at(rf"{cname} = (\d+)", cname)
+        if v is not None:
+            cc_map[wire_name] = v
+    if not cc_map:
+        cc.grab(r"case kCompressNothing", "codec_name mapping")  # scream
+    pm = baidu.grab(r"_COMPRESS_TO_WIRE = \{([^}]*)\}",
+                    "codec wire-id table")
+    if pm is None:
+        return
+    py_map = {
+        k: int(v)
+        for k, v in re.findall(r'"(\w*)": (\d+)', pm.group(1))
+    }
+    pline = _line_of(baidu.text, pm.start())
+    for name, ccv in sorted(cc_map.items()):
+        if name not in py_map:
+            out.append(Violation(
+                "plane-parity", cc.path, ccv[1],
+                f"codec {name!r} (wire id {ccv[0]}) has no entry in "
+                "baidu_std._COMPRESS_TO_WIRE"))
+            continue
+        _diff(out, f"codec wire id of {name!r}", ccv, cc.path,
+              (py_map[name], pline), baidu.path)
+
+
+_CC_ERRS = {
+    "enomethod": "ENOMETHOD", "elimit": "ELIMIT", "erequest": "EREQUEST",
+    "edeadline": "EDEADLINE", "erpcauth": "ERPCAUTH",
+}
+
+
+def _error_surface(out, cc: _Side, status: _Side) -> None:
+    for cfield, pname in _CC_ERRS.items():
+        _diff(out, f"error code {pname}",
+              cc.int_at(rf"\b{cfield} = (\d+);", f"ErrorCodes.{cfield}"),
+              cc.path,
+              status.int_at(rf"\b{pname} = (\d+)", f"ErrorCode.{pname}"),
+              status.path)
+    for cname, pname in (
+        ("kDeadlineShedText", "EDEADLINE"),
+        ("kUnauthorizedText", "ERPCAUTH"),
+    ):
+        cm = cc.grab(rf'{cname}\[\] = "([^"]*)"', cname)
+        pm = status.grab(
+            rf'ErrorCode\.{pname}: "([^"]*)"', f"berror({pname}) text"
+        )
+        if cm and pm:
+            _diff(out, f"berror({pname}) text",
+                  (cm.group(1), _line_of(cc.text, cm.start())), cc.path,
+                  (pm.group(1), 0), status.path)
+
+
+def _decompress_texts(out, cc: _Side, compress: _Side, snappy: _Side,
+                      server: _Side, baidu: _Side) -> None:
+    sm = server.grab(r'f"decompress failed: \{e\}"',
+                     "server decompress-reject prefix")
+    prefix = "decompress failed: " if sm else None
+    if prefix is None:
+        return
+
+    def norm_cc(fmt: str) -> str:
+        return fmt.replace("%u", "{}").replace("%zu", "{}")
+
+    # unknown codec: compress.py text + baidu_std's wire-N surfacing
+    cm = cc.grab(r'"(decompress failed: unknown compression codec [^"]*)"',
+                 "unknown-codec reject text")
+    pm = compress.grab(r'f"unknown compression codec \{name!r\}"',
+                       "unknown-codec text")
+    wm = baidu.grab(r'f"wire-\{rm\.compress_type\}"',
+                    "out-of-enum codec name surfacing")
+    if cm and pm and wm:
+        py_text = prefix + "unknown compression codec 'wire-{}'"
+        _diff(out, "unknown-codec reject text",
+              (norm_cc(cm.group(1)), _line_of(cc.text, cm.start())),
+              cc.path, (py_text, 0), compress.path)
+    # ceiling text (one template shared by the zlib loop and snappy;
+    # the C++ literal is split across adjacent string fragments)
+    cm = cc.grab(
+        r'"(decompress failed: decompressed size exceeds [^"]*)"'
+        r'((?:\s*"[^"]*")*)',
+        "decompress-ceiling reject text")
+    if cm is not None:
+        joined = cm.group(1) + "".join(
+            re.findall(r'"([^"]*)"', cm.group(2)))
+        cm = _Joined(joined, cm.start())
+    pm = compress.grab(
+        r'f"decompressed size exceeds max_decompress_bytes \(\{\w+\}\)"',
+        "ceiling text (compress.py)")
+    sm2 = snappy.grab(
+        r'f"decompressed size exceeds max_decompress_bytes \(\{\w+\}\)"',
+        "ceiling text (snappy_codec.py)")
+    if cm and pm and sm2:
+        py_text = prefix + "decompressed size exceeds " \
+            "max_decompress_bytes ({})"
+        _diff(out, "decompress-ceiling reject text",
+              (norm_cc(cm.group(1)), _line_of(cc.text, cm.start())),
+              cc.path, (py_text, 0), compress.path)
+    # corrupt-body text, instantiated for snappy on both sides
+    cm = cc.grab(r'"(decompress failed: corrupt %s body)"',
+                 "corrupt-body reject text")
+    pm = compress.grab(r'"corrupt snappy body"', "corrupt-snappy text")
+    if cm and pm:
+        _diff(out, "corrupt-body reject text (snappy)",
+              (cm.group(1).replace("%s", "snappy"),
+               _line_of(cc.text, cm.start())),
+              cc.path, (prefix + "corrupt snappy body", 0), compress.path)
+
+
+def _snappy_constants(out, cc: _Side, snappy: _Side) -> None:
+    _diff(out, "snappy hash multiplier",
+          cc.int_at(r"load32le\(data \+ i\) \* (0x[0-9A-Fa-f]+)u",
+                    "snappy hash multiplier"),
+          cc.path,
+          snappy.int_at(r"_HASH_MUL = (0x[0-9A-Fa-f]+)",
+                        "snappy hash multiplier"),
+          snappy.path)
+    cm = cc.grab(r"constexpr uint32_t kSnappyTableBits = (\d+);",
+                 "snappy table size")
+    pm = snappy.int_at(r"_MAX_TABLE = 1 << (\d+)", "snappy table size")
+    if cm and pm:
+        _diff(out, "snappy table size (log2)",
+              (int(cm.group(1)), _line_of(cc.text, cm.start())), cc.path,
+              pm, snappy.path)
+    _diff(out, "snappy skip-schedule seed",
+          cc.int_at(r"uint32_t skip = (\d+);", "snappy skip seed"), cc.path,
+          snappy.int_at(r"\n    skip = (\d+)\n", "snappy skip seed"),
+          snappy.path)
+    _diff(out, "snappy shift seed",
+          cc.int_at(r"int shift = (\d+);\s*// 32 - log2",
+                    "snappy shift seed"), cc.path,
+          snappy.int_at(r"\n    shift = (\d+)", "snappy shift seed"),
+          snappy.path)
+
+
+def _int_expr(s: str) -> Optional[int]:
+    s = s.strip().rstrip(",")
+    if not re.fullmatch(r"[\d\s*+<u()]+", s):
+        return None
+    return int(eval(s.replace("u", "")))  # arithmetic-only by the regex
+
+
+def _flag_defaults(out, cc: _Side, flags: _Side) -> None:
+    for cc_pat, flag, what in (
+        (r"size_t compress_min = ([^;]+);", "native_compress_min_bytes",
+         "response-compression floor default"),
+        (r"size_t max_decompress = ([^;]+);", "max_decompress_bytes",
+         "decompress-ceiling default"),
+    ):
+        cm = cc.grab(cc_pat, what)
+        pm = flags.grab(
+            rf'define_flag\(\s*"{flag}",\s*([^,]+),', f"{flag} default"
+        )
+        if not (cm and pm):
+            continue
+        ccv = _int_expr(cm.group(1))
+        pyv = _int_expr(pm.group(1))
+        if ccv is None or pyv is None:
+            out.append(Violation(
+                "scan-parse", cc.path, _line_of(cc.text, cm.start()),
+                f"plane-parity: could not evaluate {what} initializers "
+                f"({cm.group(1)!r} vs {pm.group(1)!r})"))
+            continue
+        _diff(out, what,
+              (ccv, _line_of(cc.text, cm.start())), cc.path,
+              (pyv, 0), flags.path)
+
+
+# ---------------------------------------------------------------------------
+
+
+def check(tbnet_text: Optional[str] = None,
+          overrides: Optional[Dict[str, str]] = None) -> List[Violation]:
+    overrides = overrides or {}
+    out: List[Violation] = []
+
+    if tbnet_text is None:
+        with open(cmodel.TBNET_CC) as fh:
+            tbnet_text = fh.read()
+    cc = _Side(cmodel.TBNET_CC, tbnet_text, out)
+
+    sides: Dict[str, _Side] = {}
+    for key, path in PY_FILES.items():
+        text = overrides.get(key)
+        if text is None:
+            with open(path) as fh:
+                text = fh.read()
+        sides[key] = _Side(path, text, out)
+
+    _framing(out, cc, sides["baidu_std"], sides["tbus_std"])
+    _rpc_meta_decode(out, cc, sides["baidu_std"])
+    _rpc_meta_pack(out, cc, sides["baidu_std"])
+    _codec_enum(out, cc, sides["baidu_std"])
+    _error_surface(out, cc, sides["status"])
+    _decompress_texts(out, cc, sides["compress"], sides["snappy"],
+                      sides["server"], sides["baidu_std"])
+    _snappy_constants(out, cc, sides["snappy"])
+    _flag_defaults(out, cc, sides["flags"])
+
+    # exemptions are looked up in the file each violation is anchored in
+    # (a C++ drift in tbnet.cc, a missing-anchor scream in the Python
+    # twin) — an allow() only silences violations in its own file
+    texts = {cmodel.TBNET_CC: tbnet_text}
+    for key, path in PY_FILES.items():
+        texts[path] = sides[key].text
+    anns = {p: scan_annotations(p, t) for p, t in texts.items()}
+    return [
+        v for v in out
+        if v.path not in anns or not allowed(anns[v.path], v.rule, v.line)
+    ]

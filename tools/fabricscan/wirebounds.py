@@ -1,0 +1,497 @@
+"""wire-bounds — taint dataflow over the native plane's wire parsers.
+
+The repo's hardest native invariant: **every wire-derived length is
+bounds-checked before it touches memory**.  PR 2's `len > n - off`
+subtraction idiom, PR 11's `max_decompress_bytes` ceiling, and the
+`body_len > max_body` frame caps all exist to enforce it — but until now
+nothing checked that every NEW use of a wire length repeats the
+discipline.  This pass does, intraprocedurally, over every function
+reachable from the frame cutter, the meta scanners, and the codec table:
+
+**Taint sources**
+- the value out-param of ``read_varint`` (4th argument);
+- assignments from ``get_be32``/``load32le``/``strtol``;
+- assignments whose RHS loads a byte out of a buffer (``= in[...]``);
+- length-ish fields of wire-derived structs: ``tb_tbus_hdr.body_len`` /
+  ``.meta_len`` (the tbus header is raw — callers own its bounds),
+  ``PrpcMeta``/``MetaLite`` length fields at consumer sites.
+
+**Sinks** (a tainted value reaching one unguarded is a violation)
+- subscript indices (loop guards accepted — the cursor idiom);
+- size arguments of mem functions / allocations / iobuf primitives
+  (``memcpy``/``memcmp``/``malloc``/``.resize``/``.reserve``/
+  ``tb_iobuf_copy_to``/``cutn``/``popn``) — strong guards only;
+- pointer arithmetic (``base + len`` assigned to a pointer);
+- the buffer-bound argument of ``read_varint`` (arg 2);
+- stores through the out-params of a ``// fabricscan: sanitizes(...)``
+  function (the declaration that callers may trust its outputs);
+- arguments to ``// fabricscan: requires-bounded(argN.field)`` functions
+  whose named field is tainted and unguarded at the call site.
+
+**Guards** — a relational comparison of the tainted name against a bound
+that is not the live buffer size (comparing a claimed length against
+``tb_iobuf_size(...)`` just grows the buffer to meet a hostile claim —
+the DoS this pass exists to catch).  Guards in ``for``/``while``
+conditions are *weak* (accepted for subscript/deref sinks only); ``if``
+conditions and ternaries are *strong*.  A guard against another tainted
+value sanitizes only once that value is itself sanitized (the
+``meta_len <= body_len <= max_body`` chain).
+
+Boundary contracts (documented in docs/ANALYSIS.md): function parameters
+are clean unless the function participates in a contract annotation —
+call sites of the checked scope are themselves in scope, so a parameter
+fed a tainted argument is caught at the caller.  ``ReqCtx`` construction
+sites are checked (every tainted initializer must be sanitized); the
+struct's fields are then trusted downstream (run_native's hot path does
+not re-check what the cutter already proved).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.fabriclint import Violation, allowed, scan_annotations
+from tools.fabricscan import cmodel
+from tools.fabricscan.cmodel import CppFunc, Model
+
+# entry points of the checked call graph: the server frame cutter (both
+# protocols ride process_frames), the client read paths, the raw tbus
+# header parser pair, and the codec table
+ROOTS = [
+    "process_frames",
+    "tb_channel_pump",
+    "pump_once",
+    "prpc_complete_one",
+    "tb_tbus_peek",
+    "tb_tbus_cut",
+    "codec_decompress",
+    "tb_scan_prpc_meta",
+]
+
+# wire-derived structs and their length-ish fields (the taint boundary:
+# non-length fields — cids, flags, codes — cannot index memory)
+WIRE_STRUCT_FIELDS = {
+    "tb_tbus_hdr": ("body_len", "meta_len"),
+    "PrpcMeta": ("attachment", "svc_len", "mth_len", "auth_len",
+                 "req_sub_len"),
+    "MetaLite": ("attachment",),
+}
+
+_REL = r"(?:<=|>=|==|<(?![<=])|>(?![>=]))"
+
+_SINK_CALL_FNS = (
+    "memcpy", "memmove", "memcmp", "malloc",
+    "tb_iobuf_copy_to", "tb_iobuf_cutn", "tb_iobuf_popn",
+)
+_SINK_METHODS = ("resize", "reserve", "assign")
+
+
+@dataclass
+class _Taint:
+    token: str          # the tracked lvalue text (may be dotted)
+    pos: int            # first tainted position in the body
+    sanitized_at: Optional[int] = None  # first strong-guard position
+    weak_at: Optional[int] = None       # first (any) guard position
+    bounded_by: Optional[str] = None    # tainted bound (chain rule)
+    bound_pos: Optional[int] = None
+
+
+def _balanced(text: str, open_pos: int) -> int:
+    """Index one past the matching close paren for the '(' at open_pos."""
+
+    depth = 0
+    for i in range(open_pos, len(text)):
+        if text[i] == "(":
+            depth += 1
+        elif text[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return i + 1
+    return len(text)
+
+
+def _split_args(argtext: str) -> List[str]:
+    # depth tracks ()[]{}, NOT <>: `ch->rbuf` and comparisons would skew
+    # an angle-bracket count (template-arg commas are always inside the
+    # value's own parens in this codebase)
+    out, buf, depth = [], [], 0
+    for ch in argtext:
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        if ch == "," and depth == 0:
+            out.append("".join(buf).strip())
+            buf = []
+        else:
+            buf.append(ch)
+    out.append("".join(buf).strip())
+    return out
+
+
+def _loop_intervals(body: str) -> List[Tuple[int, int]]:
+    """(start, end) spans of for/while condition parens (weak guards)."""
+
+    out = []
+    for m in re.finditer(r"\b(?:for|while)\s*\(", body):
+        op = body.index("(", m.start())
+        out.append((op, _balanced(body, op)))
+    return out
+
+
+def _in_intervals(pos: int, ivs: List[Tuple[int, int]]) -> bool:
+    return any(a <= pos < b for a, b in ivs)
+
+
+def _find_taints(fn: CppFunc, model: Model) -> Dict[str, _Taint]:
+    body = fn.body
+    taints: Dict[str, _Taint] = {}
+
+    def add(token: str, pos: int) -> None:
+        if token not in taints or pos < taints[token].pos:
+            taints[token] = _Taint(token, pos)
+
+    # read_varint value out-param (4th arg)
+    for m in re.finditer(r"\bread_varint\s*\(", body):
+        end = _balanced(body, m.end() - 1)
+        args = _split_args(body[m.end(): end - 1])
+        if len(args) == 4:
+            v = args[3].lstrip("&").strip()
+            if re.fullmatch(r"[\w.>\-]+", v):
+                add(v.replace("->", "."), m.start())
+
+    # assignments from wire loaders (the loader must belong to THIS
+    # declarator: no commas or CLOSE parens between the `=` and the call,
+    # so a multi-declarator line taints each variable separately and a
+    # loader inside an earlier call's completed arg list doesn't leak —
+    # open parens are allowed so grouped/cast forms like
+    # `h = (load32le(p) * kMul) >> shift` still taint)
+    for m in re.finditer(
+        r"((?:\w+(?:->|\.))*\w+)\s*=(?!=)\s*[^;,)]*?"
+        r"\b(?:get_be32|load32le|strtol)\s*\(",
+        body,
+    ):
+        add(m.group(1).replace("->", "."), m.start())
+
+    # assignments loading a byte/word out of a buffer: `x = ...buf[...]`
+    for m in re.finditer(
+        r"((?:\w+(?:->|\.))*\w+)\s*(?:\|=|=(?!=))\s*[^;]*?\[",
+        body,
+    ):
+        # skip compound lvalues with their own subscript (`out[k] = ...`)
+        stmt_start = body.rfind(";", 0, m.start()) + 1
+        lhs_region = body[stmt_start: m.start() + len(m.group(1))]
+        if "[" in lhs_region.split("=")[0] and "]" in lhs_region.split("=")[0]:
+            continue
+        # address-of is not a load: `mptr = &mheap[0]` takes a buffer
+        # ELEMENT ADDRESS, no wire byte flows into the value
+        rhs = body[m.start(): body.find(";", m.start())]
+        rhs = rhs.split("=", 1)[1].strip() if "=" in rhs else rhs
+        if rhs.startswith("&"):
+            continue
+        add(m.group(1).replace("->", "."), m.start())
+
+    # wire-struct locals/params
+    bounded = {(idx, f) for idx, f in fn.requires_bounded}
+    bounded_fields_by_param: Dict[str, Set[str]] = {}
+    for idx, fname in bounded:
+        if 1 <= idx <= len(fn.params):
+            bounded_fields_by_param.setdefault(
+                fn.params[idx - 1][1], set()
+            ).add(fname)
+    for sname, fields in WIRE_STRUCT_FIELDS.items():
+        # parameters of that struct type
+        for ptype, pname in fn.params:
+            if sname in ptype and pname:
+                for f in fields:
+                    if f in bounded_fields_by_param.get(pname, ()):
+                        continue  # contract: caller already bounded it
+                    tok = f"{pname}.{f}"
+                    if re.search(
+                        rf"\b{re.escape(pname)}\s*(?:->|\.)\s*{f}\b", body
+                    ):
+                        add(tok, 0)
+        # locals: `tb_tbus_hdr hdr;` / `PrpcMeta pm = scan_prpc_meta(...)`
+        for m in re.finditer(rf"\b{sname}\s+(\w+)\s*[;=]", body):
+            var = m.group(1)
+            for f in fields:
+                if re.search(rf"\b{re.escape(var)}\s*\.\s*{f}\b", body):
+                    add(f"{var}.{f}", m.start())
+    return taints
+
+
+def _token_re(token: str) -> str:
+    """Regex matching the token with -> and . spellings unified."""
+
+    parts = [re.escape(p) for p in token.split(".")]
+    return r"(?<![\w.])" + r"\s*(?:->|\.)\s*".join(parts) + r"(?![\w(])"
+
+
+def _is_live_size(other: str) -> bool:
+    """Is this bound the LIVE size of a growable read buffer?  Comparing
+    a claimed length against ``tb_iobuf_size(<rbuf>)`` just waits for
+    more hostile bytes to arrive (the DoS class this pass exists to
+    catch), as does ``nbytes`` (the iobuf's own size field inside
+    tbutil).  Comparing against the size of an already-cut frame body
+    (the reactor ``scratch``, a pump body) is a REAL bound — the frame's
+    total was capped before the cut — so those pass."""
+
+    if "nbytes" in other:
+        return True
+    return "tb_iobuf_size" in other and "rbuf" in other
+
+
+def _guard_pass(fn: CppFunc, taints: Dict[str, _Taint],
+                loops: List[Tuple[int, int]]) -> bool:
+    body = fn.body
+    changed = False
+    for t in taints.values():
+        if t.sanitized_at is not None:
+            continue
+        tre = _token_re(t.token)
+        # masking caps the value outright: `h &= kTableMask;`
+        for m in re.finditer(rf"{tre}\s*&=\s*[^;]+;", body):
+            pos = m.start()
+            if t.sanitized_at is None or pos < t.sanitized_at:
+                t.sanitized_at = pos
+                if t.weak_at is None or pos < t.weak_at:
+                    t.weak_at = pos
+                changed = True
+        # relational comparison against a bound; the token may sit inside
+        # a small additive expression (`sl + 1 + mn < sizeof full`)
+        for m in re.finditer(
+            rf"(?:{tre}\s*(?:[-+][\w.\s>+\-]{{0,40}}?)?{_REL}"
+            rf"\s*(?P<rhs>[^;&|?,]{{0,80}})"
+            rf"|(?<![<>=!])(?P<lhs>[^;&|?,(]{{0,80}}?){_REL}\s*{tre})",
+            body,
+        ):
+            other = (m.group("rhs") or m.group("lhs") or "").strip()
+            if _is_live_size(other):
+                continue
+            if re.match(r"0[^\w.]", other + " "):
+                continue  # `len > 0` is a sign/emptiness check, not a bound
+            # a bound that is itself tainted only counts once that bound
+            # is clean — sanitized before this guard, or within the SAME
+            # statement (the `meta > body || body > max` kill idiom
+            # checks both halves on one condition)
+            dep = None
+            for u in taints.values():
+                if u.token == t.token:
+                    continue
+                if re.search(_token_re(u.token), other):
+                    dep = u
+                    break
+            pos = m.start()
+            if dep is not None:
+                stmt_end = body.find(";", pos)
+                stmt_end = len(body) if stmt_end < 0 else stmt_end
+                if dep.sanitized_at is None or dep.sanitized_at > stmt_end:
+                    continue
+            if _in_intervals(pos, loops):
+                if t.weak_at is None or pos < t.weak_at:
+                    t.weak_at = pos
+                    changed = True
+            else:
+                if t.sanitized_at is None or pos < t.sanitized_at:
+                    t.sanitized_at = pos
+                    if t.weak_at is None or pos < t.weak_at:
+                        t.weak_at = pos
+                    changed = True
+    return changed
+
+
+def _find_guards(fn: CppFunc, taints: Dict[str, _Taint]) -> None:
+    body = fn.body
+    loops = _loop_intervals(body)
+    # iterate: chains (`meta_len <= body_len` sanitizes meta_len once
+    # body_len is sanitized) need a fixpoint
+    for _ in range(4):
+        if not _guard_pass(fn, taints, loops):
+            break
+    # propagate through simple copies: `W = <expr containing V>` where V
+    # unsanitized at the copy makes W tainted from there (already covered
+    # when the RHS loads from a buffer; here: plain var-to-var copies)
+    for t in list(taints.values()):
+        tre = _token_re(t.token)
+        for m in re.finditer(
+            rf"((?:\w+(?:->|\.))*\w+)\s*=\s*[^;=][^;]*?{tre}", fn.body
+        ):
+            dst = m.group(1).replace("->", ".")
+            if dst == t.token or dst in taints:
+                continue
+            if t.sanitized_at is not None and t.sanitized_at < m.start():
+                continue  # copy of an already-sanitized value is clean
+            taints[dst] = _Taint(dst, m.start())
+    # (extra rounds of guard search for the propagated tokens)
+    for _ in range(2):
+        if not _guard_pass(fn, taints, loops):
+            break
+
+
+def _sinks(
+    fn: CppFunc, taints: Dict[str, _Taint], model: Model
+) -> List[Tuple[int, str, _Taint, bool]]:
+    """(pos, description, taint, weak_ok) for every tainted sink use."""
+
+    body = fn.body
+    out: List[Tuple[int, str, _Taint, bool]] = []
+    for t in taints.values():
+        tre = _token_re(t.token)
+        # subscript index
+        for m in re.finditer(rf"\[[^\][]{{0,60}}{tre}[^\][]{{0,60}}\]", body):
+            out.append((m.start(), f"subscript index `{t.token}`", t, True))
+        # pointer arithmetic assigned somewhere
+        for m in re.finditer(
+            rf"=\s*[\w.>\-]+\s*\+\s*{tre}|=\s*{tre}\s*\+\s*[\w.>\-]+", body
+        ):
+            out.append(
+                (m.start(), f"pointer arithmetic with `{t.token}`", t, False)
+            )
+        # growth methods
+        for m in re.finditer(
+            rf"\.\s*(?:{'|'.join(_SINK_METHODS)})\s*\(", body
+        ):
+            end = _balanced(body, m.end() - 1)
+            if re.search(tre, body[m.end(): end - 1]):
+                out.append(
+                    (m.start(),
+                     f"allocation/growth sized by `{t.token}`", t, False)
+                )
+    # call-argument sinks
+    for m in re.finditer(
+        rf"\b(?:{'|'.join(_SINK_CALL_FNS)})\s*\(", body
+    ):
+        end = _balanced(body, body.index("(", m.start()))
+        argtext = body[body.index("(", m.start()) + 1: end - 1]
+        for t in taints.values():
+            if re.search(_token_re(t.token), argtext):
+                name = body[m.start(): body.index("(", m.start())]
+                out.append(
+                    (m.start(), f"`{t.token}` reaches {name}()", t, False)
+                )
+    # read_varint's buffer bound (arg 2)
+    for m in re.finditer(r"\bread_varint\s*\(", body):
+        end = _balanced(body, m.end() - 1)
+        args = _split_args(body[m.end(): end - 1])
+        if len(args) == 4:
+            for t in taints.values():
+                if re.search(_token_re(t.token), args[1]):
+                    out.append(
+                        (m.start(),
+                         f"`{t.token}` used as read_varint bound", t, False)
+                    )
+    # stores through sanitizing out-params
+    for pname in fn.sanitizes:
+        for m in re.finditer(
+            rf"\*\s*{re.escape(pname)}\s*=\s*([^;]+);", body
+        ):
+            rhs = m.group(1)
+            for t in taints.values():
+                if re.search(_token_re(t.token), rhs):
+                    out.append(
+                        (m.start(),
+                         f"tainted `{t.token}` escapes through sanitized "
+                         f"out-param *{pname}", t, False)
+                    )
+    # requires-bounded call sites
+    for callee_q in fn.calls:
+        callee = model.funcs.get(callee_q)
+        if callee is None or not callee.requires_bounded:
+            continue
+        for m in re.finditer(rf"\b{re.escape(callee.name)}\s*\(", body):
+            end = _balanced(body, body.index("(", m.start()))
+            args = _split_args(body[body.index("(", m.start()) + 1: end - 1])
+            for idx, fname in callee.requires_bounded:
+                if idx > len(args):
+                    continue
+                base = args[idx - 1].lstrip("&").strip()
+                if not re.fullmatch(r"[\w.>\-]+", base):
+                    continue
+                tok = f"{base.replace('->', '.')}.{fname}"
+                t = taints.get(tok)
+                if t is not None:
+                    out.append(
+                        (m.start(),
+                         f"`{tok}` passed to {callee.name}() which requires "
+                         f"it bounded", t, False)
+                    )
+    # ReqCtx construction: every tainted initializer must be sanitized
+    for m in re.finditer(r"\bReqCtx\s+\w+\s*\{", body):
+        close = body.find("}", m.end())
+        inits = body[m.end(): close]
+        for t in taints.values():
+            if re.search(_token_re(t.token), inits):
+                out.append(
+                    (m.start(),
+                     f"tainted `{t.token}` flows into ReqCtx (trusted "
+                     "downstream)", t, False)
+                )
+    return out
+
+
+def analyze_function(fn: CppFunc, model: Model) -> List[Violation]:
+    taints = _find_taints(fn, model)
+    if not taints:
+        return []
+    _find_guards(fn, taints)
+    out: List[Violation] = []
+    seen: Set[Tuple[str, int]] = set()
+    for pos, desc, t, weak_ok in _sinks(fn, taints, model):
+        ok_at = t.weak_at if weak_ok else t.sanitized_at
+        if ok_at is not None and ok_at <= pos:
+            continue
+        line = cmodel.line_of(fn, pos)
+        key = (t.token, line)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(
+            Violation(
+                "wire-bounds", model_path_of(fn, model), line,
+                f"{fn.qname}: {desc} with no dominating bounds check "
+                f"(tainted at line {cmodel.line_of(fn, t.pos)})",
+            )
+        )
+    return out
+
+
+def model_path_of(fn: CppFunc, model: Model) -> str:
+    # every CppFunc records the file it was parsed from (merge_models
+    # preserves it), so a tbutil helper reached from a tbnet root reports
+    # at its real path:line instead of indexing into the wrong file
+    return fn.path or model.path.split("+", 1)[0]
+
+
+def checked_functions(model: Model) -> Set[str]:
+    return cmodel.reachable(model, ROOTS)
+
+
+def check(
+    tbnet_text: Optional[str] = None, tbutil_text: Optional[str] = None
+) -> List[Violation]:
+    model = cmodel.parse_native_plane(tbnet_text, tbutil_text)
+    out: List[Violation] = []
+    anns = {
+        cmodel.TBNET_CC: scan_annotations(cmodel.TBNET_CC, tbnet_text),
+        cmodel.TBUTIL_CC: scan_annotations(cmodel.TBUTIL_CC, tbutil_text),
+    }
+    for root in ROOTS:
+        if root not in model.funcs:
+            out.append(
+                Violation(
+                    "scan-parse", model.path.split("+")[0], 1,
+                    f"wire-bounds root {root!r} not found in the model — "
+                    "the cutter call graph is unchecked",
+                )
+            )
+    reach = checked_functions(model)
+    for q in sorted(reach):
+        fn = model.funcs[q]
+        for v in analyze_function(fn, model):
+            ann = anns.get(v.path)
+            if ann is not None and allowed(ann, "wire-bounds", v.line):
+                continue
+            out.append(v)
+    return out
