@@ -27,6 +27,7 @@ from typing import List, Optional, Tuple
 import json
 import logging
 import os
+import re
 
 from incubator_brpc_tpu.utils.flags import get_flag
 
@@ -306,6 +307,82 @@ def render_trace_tree(spans: List[Span]) -> List[str]:
     for sp in spans:  # cycles with no root: still shown, flat
         if sp.span_id not in seen:
             walk(sp)
+    return lines
+
+
+# the overlap scheduler's span annotation schema (parallel/mc_dispatch.py
+# _start_step_span/_start_chunk_span; docs/OBSERVABILITY.md): a step's
+# compute span vs its chunk sub-collectives' dispatch→ack spans
+_COMPUTE_ANN_RE = re.compile(
+    r"^compute step=(\d+)/(\d+) chunks=(\d+) schedule=(\S+)$"
+)
+_CHUNK_ANN_RE = re.compile(r"^chunk=(\d+)/(\d+) step=(\d+)$")
+
+
+def overlap_report(spans: List[Span]) -> List[str]:
+    """Quantify compute/communication overlap in one collective session's
+    trace (the T3 proof view, docs/DEVICE_PLANE.md "overlap scheduler").
+
+    Each chunk span is a sub-collective's dispatch→ack interval; step
+    k's chunks are checked against step k+1's COMPUTE span — an ack that
+    lands inside the next step's compute window is communication hidden
+    behind compute, while a trace whose every chunk closes before the
+    next compute span begins has regressed to the serialized schedule.
+    Chunks are paired only with compute spans of the SAME party's chain
+    (chunk spans parent to their step's compute span; step spans share a
+    per-party session parent) — concurrent parties in one store run with
+    mutual skew that would otherwise read as overlap.
+    Returns human lines: one per overlapped chunk plus a verdict summary
+    (``OVERLAPPED`` / ``SERIALIZED``); empty when the trace carries no
+    chunk annotations (not an overlap session)."""
+    by_id = {sp.span_id: sp for sp in spans}
+    computes: dict = {}  # (party key, step index) -> (start_us, end_us)
+    chunks = []  # (step, j, C, party key, start_us, end_us)
+    for sp in spans:
+        for _, text in sp.annotations:
+            m = _COMPUTE_ANN_RE.match(text)
+            if m is not None:
+                computes[(sp.parent_span_id, int(m.group(1)))] = (
+                    sp.start_real_us, sp.start_real_us + sp.latency_us
+                )
+                continue
+            m = _CHUNK_ANN_RE.match(text)
+            if m is not None:
+                parent = by_id.get(sp.parent_span_id)
+                party = parent.parent_span_id if parent is not None else 0
+                chunks.append((
+                    int(m.group(3)), int(m.group(1)), int(m.group(2)),
+                    party,
+                    sp.start_real_us, sp.start_real_us + sp.latency_us,
+                ))
+    if not chunks:
+        return []
+    chunks.sort()
+    lines = []
+    judged = overlapped = 0
+    for step, j, c, party, cs, ce in chunks:
+        nxt = computes.get((party, step + 1))
+        if nxt is None:
+            continue  # last step (or its compute span wasn't sampled)
+        judged += 1
+        ov = min(ce, nxt[1]) - max(cs, nxt[0])
+        if ov > 0:
+            overlapped += 1
+            lines.append(
+                f"step {step} chunk {j}/{c}: ack {ov:.0f}us inside step "
+                f"{step + 1}'s compute window — overlapped"
+            )
+        else:
+            lines.append(
+                f"step {step} chunk {j}/{c}: closed {-ov:.0f}us before "
+                f"step {step + 1}'s compute began — serialized"
+            )
+    verdict = "OVERLAPPED" if overlapped else "SERIALIZED"
+    lines.append(
+        f"# overlap: {overlapped}/{judged} chunk acks inside the next "
+        f"step's compute window — {verdict}"
+        + ("" if judged else " (no adjacent compute spans sampled)")
+    )
     return lines
 
 

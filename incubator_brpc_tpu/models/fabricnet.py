@@ -175,30 +175,33 @@ def _moe(moe_w1, moe_w2, gate_w, x):
     return (ungrouped * g).reshape(mb, sl, d)
 
 
-def _ring_attn_block(wqkv, wo, heads, x):
+def _ring_attn_block(wqkv, wo, heads, x, prefetch: bool = False):
     """Causal ring attention over 'sp' (models/ring_attention.py) with
-    per-stage projections — the long-context sequence-parallel block."""
+    per-stage projections — the long-context sequence-parallel block.
+    ``prefetch`` emits each hop's KV rotation before the held block's
+    fold (rotate-while-computing; bit-identical output)."""
     from incubator_brpc_tpu.models.ring_attention import ring_attention
 
     mb, sl, d = x.shape
     q = (x @ wqkv[0]).reshape(mb, sl, heads, d // heads)
     k = (x @ wqkv[1]).reshape(mb, sl, heads, d // heads)
     v = (x @ wqkv[2]).reshape(mb, sl, heads, d // heads)
-    out = ring_attention(q, k, v, axis="sp", causal=True)
+    out = ring_attention(q, k, v, axis="sp", causal=True, prefetch=prefetch)
     return out.reshape(mb, sl, d) @ wo
 
 
-def _stage_fn(sp_params, heads, x):
+def _stage_fn(sp_params, heads, prefetch, x):
     """One pipeline stage: L residual [tp-MLP] layers + sp sequence block
     (ring attention, or ring-mean context when heads=0) + ep MoE block.
-    ``heads`` is static config, threaded via partial — never through the
-    (traced-array) param pytree."""
+    ``heads``/``prefetch`` are static config, threaded via partial — never
+    through the (traced-array) param pytree."""
     L = sp_params["w_in"].shape[0]
     for l in range(L):
         x = x + _mlp_tp(sp_params["w_in"][l], sp_params["w_out"][l], _rms_norm(x))
     if heads:
         x = x + _ring_attn_block(
-            sp_params["wqkv"], sp_params["wo"], heads, _rms_norm(x)
+            sp_params["wqkv"], sp_params["wo"], heads, _rms_norm(x),
+            prefetch=prefetch,
         )
     else:
         x = x + _ring_context(x)
@@ -232,8 +235,15 @@ def _pipeline(stage, xs):
     return outs
 
 
-def _local_forward(cfg: FabricNetConfig, params, x):
-    """Per-rank forward body (inside shard_map). x: (B_local, S_local, d)."""
+def _local_forward(
+    cfg: FabricNetConfig, params, x, microbatches: int = 0,
+    prefetch: bool = False,
+):
+    """Per-rank forward body (inside shard_map). x: (B_local, S_local, d).
+    ``microbatches`` overrides the config's pipeline microbatch count (the
+    overlap schedule feeds one outer slice per inner pipeline fill);
+    ``prefetch`` selects the ring attention rotate-while-computing
+    emission (bit-identical, see models/ring_attention.py)."""
     # squeeze this rank's pipeline-stage slice (leading pp dim is size 1 here)
     sp_params = {
         "w_in": params["w_in"][0],
@@ -246,9 +256,9 @@ def _local_forward(cfg: FabricNetConfig, params, x):
         sp_params["wqkv"] = params["wqkv"][0]
         sp_params["wo"] = params["wo"][0]
     bl, sl, d = x.shape
-    m = cfg.microbatches
+    m = microbatches or cfg.microbatches
     xs = x.reshape(m, bl // m, sl, d)
-    outs = _pipeline(partial(_stage_fn, sp_params, cfg.heads), xs)
+    outs = _pipeline(partial(_stage_fn, sp_params, cfg.heads, prefetch), xs)
     out = outs.reshape(bl, sl, d)
     return out @ params["head"]
 
@@ -257,6 +267,41 @@ def _local_loss(cfg: FabricNetConfig, params, x, y):
     out = _local_forward(cfg, params, x)
     local = jnp.mean(jnp.square(out - y))
     return lax.pmean(local, ("dp", "ep", "sp", "tp", "pp"))
+
+
+_ALL_AXES = ("dp", "ep", "sp", "tp", "pp")
+
+
+def _slice_local_loss(cfg: FabricNetConfig, prefetch: bool, params, x, y):
+    """One microbatch slice's local loss (inside shard_map): the slice
+    pipelines with a single inner microbatch — the outer schedule IS the
+    microbatch loop.  ``prefetch`` selects the ring attention
+    rotate-while-computing emission (bit-identical)."""
+    out = _local_forward(cfg, params, x, microbatches=1, prefetch=prefetch)
+    local = jnp.mean(jnp.square(out - y))
+    return lax.pmean(local, _ALL_AXES)
+
+
+def _microbatch_slicer(cfg: FabricNetConfig, mesh: jax.sharding.Mesh):
+    """Jitted per-rank reshape (B, S, d) -> (M, B/M, S, d): each rank
+    splits its LOCAL batch rows into the M schedule slices — slicing the
+    global batch axis outside shard_map would gather a contiguous global
+    block that lives on a subset of the dp/ep ranks instead."""
+    x_spec, _ = batch_specs()
+    from incubator_brpc_tpu.parallel.compat import shard_map_compat
+
+    m_slices = cfg.microbatches
+
+    def body(x):
+        bl = x.shape[0]
+        return x.reshape(m_slices, bl // m_slices, *x.shape[1:])
+
+    return jax.jit(shard_map_compat(
+        body,
+        mesh=mesh,
+        in_specs=(x_spec,),
+        out_specs=P(None, ("dp", "ep"), "sp", None),
+    ))
 
 
 def make_forward_step(cfg: FabricNetConfig, mesh: jax.sharding.Mesh):
@@ -273,11 +318,80 @@ def make_forward_step(cfg: FabricNetConfig, mesh: jax.sharding.Mesh):
     return jax.jit(fwd)
 
 
-def make_train_step(cfg: FabricNetConfig, mesh: jax.sharding.Mesh):
+def make_train_step(
+    cfg: FabricNetConfig, mesh: jax.sharding.Mesh, schedule: str = "fused"
+):
     """Jitted FULL training step (forward + backward + SGD update) with all
-    five parallelism axes live. Returns (step, init_fn)."""
+    five parallelism axes live. Returns the jitted step function.
+
+    ``schedule`` selects how gradient collectives meet compute:
+
+    - ``"fused"`` (default, the pre-overlap path unchanged): one
+      value_and_grad through the shard_map boundary — the boundary
+      transpose emits the gradient psums after the whole backward.
+    - ``"serialized"``: the microbatch-sliced A/B baseline — slice m's
+      per-leaf gradient psums are barriered before slice m+1's forward
+      (compute waits for the full collective, the ~75% MFU shape).
+    - ``"overlapped"``: same sliced dataflow with the barrier dropped —
+      slice m's chunked psums overlap slice m+1's compute, and ring
+      attention prefetches its KV rotation (T3).  Bit-identical loss and
+      grads to ``"serialized"``.
+    """
     x_spec, y_spec = batch_specs()
     from incubator_brpc_tpu.parallel.compat import shard_map_compat
+
+    if schedule not in ("fused", "serialized", "overlapped"):
+        raise ValueError(f"unknown schedule {schedule!r}")
+    if schedule != "fused":
+        # The T3 microbatch schedule (docs/DEVICE_PLANE.md "overlap
+        # scheduler"): grads accumulate per microbatch slice, each
+        # slice's gradient reduction firing as per-param-leaf psums at
+        # its OWN shard_map boundary transpose — the chunked collective
+        # (one sub-collective per leaf, not one fused all-grads psum).
+        # "serialized" pins slice m+1's forward behind slice m's psums
+        # with an optimization_barrier — the compute-waits-for-full-
+        # collective shape fabricnet was stuck at; "overlapped" drops
+        # the barrier, so slice m's collectives are dataflow-independent
+        # of slice m+1's compute and the scheduler runs them behind it.
+        # The barrier is an identity — both schedules run IDENTICAL ops,
+        # so loss and grads are bit-identical between them.
+        overlap = schedule == "overlapped"
+        m_slices = cfg.microbatches
+        slice_loss = shard_map_compat(
+            partial(_slice_local_loss, cfg, overlap),
+            mesh=mesh,
+            in_specs=(param_specs(cfg.heads), x_spec, y_spec),
+            out_specs=P(),
+        )
+        grad_fn = jax.value_and_grad(slice_loss)
+        slicer = _microbatch_slicer(cfg, mesh)
+
+        def step(params, x, y):
+            xs, ys = slicer(x), slicer(y)
+            acc = None
+            loss_acc = jnp.zeros((), dtype=jnp.float32)
+            gate = None  # previous slice's reduced grads
+            for m in range(m_slices):
+                xm, ym = xs[m], ys[m]
+                if gate is not None and not overlap:
+                    # serialized: slice m's input becomes data-dependent
+                    # on every gradient psum of slice m-1
+                    xm, gate = lax.optimization_barrier((xm, gate))
+                l_m, g_m = grad_fn(params, xm, ym)
+                acc = g_m if acc is None else jax.tree_util.tree_map(
+                    jnp.add, acc, g_m
+                )
+                loss_acc = loss_acc + l_m.astype(jnp.float32)
+                gate = g_m
+            inv = 1.0 / m_slices
+            new_params = jax.tree_util.tree_map(
+                lambda p, g: p - cfg.lr * (g * jnp.asarray(inv, g.dtype)
+                                           ).astype(p.dtype),
+                params, acc,
+            )
+            return new_params, loss_acc * inv
+
+        return jax.jit(step, donate_argnums=(0,))
 
     loss_fn = shard_map_compat(
         partial(_local_loss, cfg),

@@ -51,10 +51,18 @@ def ring_attention(
     v: jnp.ndarray,
     axis: str = "sp",
     causal: bool = True,
+    prefetch: bool = False,
 ) -> jnp.ndarray:
     """Exact attention with K/V ringing over ``axis``. Call inside
     shard_map with q/k/v sharded on their sequence dim; shapes per rank:
-    (B, T_local, H, D). Returns (B, T_local, H, D)."""
+    (B, T_local, H, D). Returns (B, T_local, H, D).
+
+    ``prefetch=True`` emits each hop's ppermute BEFORE the held block's
+    attention fold (rotate-while-computing, the T3 overlap shape): the
+    next KV block's transfer is independent of the fold, so the compiler
+    may overlap the ring hop with the blockwise attention compute
+    instead of serializing transfer-then-fold. Bit-identical output —
+    the dataflow is unchanged, only the emission order moves."""
     from incubator_brpc_tpu.parallel.compat import axis_size
 
     sp = axis_size(axis)
@@ -94,10 +102,18 @@ def ring_attention(
 
     def hop(carry, r):
         m, l, o, k_r, v_r = carry
-        m, l, o = block_merge(m, l, o, k_r, v_r, r)
-        # pass KV to the right neighbor (window=1 ring stream)
-        k_next = lax.ppermute(k_r, axis, perm)
-        v_next = lax.ppermute(v_r, axis, perm)
+        if prefetch:
+            # rotate while computing: the transfer of the held block to
+            # the right neighbor starts before (independently of) the
+            # fold that consumes the SAME held block locally
+            k_next = lax.ppermute(k_r, axis, perm)
+            v_next = lax.ppermute(v_r, axis, perm)
+            m, l, o = block_merge(m, l, o, k_r, v_r, r)
+        else:
+            m, l, o = block_merge(m, l, o, k_r, v_r, r)
+            # pass KV to the right neighbor (window=1 ring stream)
+            k_next = lax.ppermute(k_r, axis, perm)
+            v_next = lax.ppermute(v_r, axis, perm)
         return (m, l, o, k_next, v_next), None
 
     # sp-1 hops WITH a permute, then the last held block folds outside the
@@ -130,7 +146,9 @@ def full_attention(q, k, v, causal: bool = True):
     return jnp.einsum("bhts,bshd->bthd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-def make_ring_attention_step(mesh: jax.sharding.Mesh, causal: bool = True):
+def make_ring_attention_step(
+    mesh: jax.sharding.Mesh, causal: bool = True, prefetch: bool = False
+):
     """Jitted sharded entry: q/k/v sharded over 'sp' on the sequence dim,
     replicated elsewhere (batch could additionally shard over dp/ep —
     kept sequence-only here since this layer IS the sp showcase)."""
@@ -139,7 +157,7 @@ def make_ring_attention_step(mesh: jax.sharding.Mesh, causal: bool = True):
     from incubator_brpc_tpu.parallel.compat import shard_map_compat
 
     fn = shard_map_compat(
-        partial(ring_attention, axis="sp", causal=causal),
+        partial(ring_attention, axis="sp", causal=causal, prefetch=prefetch),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

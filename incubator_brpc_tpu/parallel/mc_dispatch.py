@@ -115,6 +115,10 @@ DISPATCH_METHOD = "collective_dispatch"
 MAX_STEPS = 100_000
 MAX_WIDTH = 1 << 20
 MAX_PARTIES = 1024
+# chunked overlap sessions (T3): a step's operand may split into at most
+# this many independently-dispatched sub-collectives — past ~64 the
+# per-chunk dispatch overhead swamps the overlap win (docs/DEVICE_PLANE.md)
+MAX_CHUNKS = 64
 
 # plane-level observability: sessions/steps/errors/rejects across every
 # kernel, plus a latency summary; per-kernel counters are minted lazily
@@ -127,6 +131,25 @@ dispatch_aborts = Adder(name="mc_dispatch_aborts")
 dispatch_resumes = Adder(name="mc_dispatch_resumes")
 dispatch_replaced_parties = Adder(name="mc_dispatch_replaced_parties")
 dispatch_session_us = LatencyRecorder(name="mc_dispatch_session_us")
+# the overlap scheduler's proof-of-overlap counters: chunk sub-collectives
+# dispatched, and how many of them were dispatched while the SAME slice's
+# predecessor collective was still in flight (the non-blocking ack probe
+# said not-ready) — their ratio is the measured overlap, scrapeable as
+# mc_dispatch_overlap_ratio.  Tallied once per session, not per chunk.
+dispatch_chunks = Adder(name="mc_dispatch_chunks")
+dispatch_overlapped_chunks = Adder(name="mc_dispatch_overlapped_chunks")
+
+
+def _overlap_ratio() -> float:
+    total = dispatch_chunks.get_value()
+    if not total:
+        return 0.0
+    return dispatch_overlapped_chunks.get_value() / total
+
+
+overlap_ratio_gauge = PassiveStatus(
+    _overlap_ratio, name="mc_dispatch_overlap_ratio"
+)
 
 _method_counters: Dict[Tuple[str, str], Adder] = {}
 _method_counters_lock = threading.Lock()
@@ -564,9 +587,11 @@ def resume_point(watermarks: Dict[int, Optional[dict]]) -> int:
 
 # Between-step seam: chaos drills park parties here (deterministically
 # mid-session) and production leaves it None.  Called as fn(step_index)
-# — or fn(step_index, own_index) when it accepts two arguments, so a
-# drill can target ONE party — before each lockstep step on every party
-# running a registered session.
+# — or fn(step_index, own_index) / fn(step_index, own_index, chunk) when
+# it accepts more arguments, so a drill can target ONE party, or one
+# CHUNK of a step (half-acked-step chaos) — before each lockstep step
+# (1/2-arg forms fire once per step; the 3-arg form fires before every
+# chunk dispatch of a chunked overlap session).
 _step_hook: Optional[Callable] = None
 
 
@@ -580,8 +605,17 @@ def set_step_hook(fn: Optional[Callable]) -> None:
         except (TypeError, ValueError):
             nparams = 1
         if nparams < 2:
-            inner = fn
-            fn = lambda step, idx, _f=inner: _f(step)  # noqa: E731
+            inner1 = fn
+            fn = (  # noqa: E731
+                lambda step, idx, chunk, _f=inner1:
+                _f(step) if chunk == 0 else None
+            )
+        elif nparams < 3:
+            inner2 = fn
+            fn = (  # noqa: E731
+                lambda step, idx, chunk, _f=inner2:
+                _f(step, idx) if chunk == 0 else None
+            )
     _step_hook = fn
 
 
@@ -636,7 +670,9 @@ def _devices_by_id(ids: List[int]):
 
 
 _step_cache: Dict[tuple, tuple] = {}  # (fp, party ids) -> (step_fn, dm)
-_step_cache_lock = threading.Lock()
+# chunk split/concat programs: (party ids, width, chunks) -> (split, concat)
+_chunk_ops_cache: Dict[tuple, tuple] = {}
+_step_cache_lock = threading.Lock()  # guards BOTH caches (never nested)
 
 
 def _make_step(dm, mesh, sharding, party_ids):
@@ -646,7 +682,11 @@ def _make_step(dm, mesh, sharding, party_ids):
     axis-reducing kernels produce the same bytes on both planes. Cached
     per (kernel fingerprint, party set): the ParallelChannel lowering
     runs one session per combo CALL, and re-tracing every call would put
-    XLA compilation on the request path (combo's _fused_cache, here)."""
+    XLA compilation on the request path (combo's _fused_cache, here).
+    Overlap sessions call the SAME cached program at a chunk's width —
+    jit re-specializes per input shape, and a chunk-safe kernel applied
+    to a slice yields the slice of the full-width result, so the chunked
+    chain's bytes match the unchunked chain's."""
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -674,6 +714,79 @@ def _make_step(dm, mesh, sharding, party_ids):
     return cached[0]
 
 
+def _make_chunk_ops(mesh, sharding, width: int, chunks: int, party_ids):
+    """Jitted split/concat between the full-width session row and its C
+    leading-axis chunks.  Pure per-shard slicing — NO collectives, so the
+    parties need no rendezvous to run them, and both directions dispatch
+    async (the operands never leave their devices).  Cached like the step
+    program: re-tracing per session would put XLA on the request path."""
+    import jax
+    import jax.numpy as jnp
+
+    key = (tuple(party_ids), int(width), int(chunks))
+    with _step_cache_lock:
+        cached = _chunk_ops_cache.get(key)
+        if cached is None:
+            cw = width // chunks
+
+            def split(full):
+                return tuple(
+                    full[:, j * cw:(j + 1) * cw] for j in range(chunks)
+                )
+
+            def concat(*parts):
+                return jnp.concatenate(parts, axis=1)
+
+            cached = (
+                jax.jit(split, out_shardings=(sharding,) * chunks),
+                jax.jit(concat, out_shardings=sharding),
+            )
+            _chunk_ops_cache[key] = cached
+    return cached
+
+
+# fabriclint: hotpath
+def _chunk_ready(arr) -> bool:
+    """Non-blocking chunk-ack probe — the overlap scheduler's per-chunk
+    observation point.  Reads the buffer's completion state without
+    synchronizing (``jax.Array.is_ready``); a runtime without the probe
+    reports ready, which only degrades telemetry, never correctness (the
+    device executes the chunk chain in dataflow order regardless)."""
+    fn = getattr(arr, "is_ready", None)
+    if fn is None:
+        return True
+    try:
+        return bool(fn())
+    except Exception:  # noqa: BLE001 — runtime quirk: assume complete
+        return True
+
+
+def _validate_chunks(dm, chunks, service: str, method: str) -> int:
+    """Chunk admission, identical at every seam (proposer, accepting
+    party's handler, the session runner): one copy so a future rule
+    change can never let a proposal through one seam that another
+    rejects.  Returns the normalized chunk count; raises ValueError
+    (the handlers map it to a clean EREQUEST reject before lockstep)."""
+    chunks = int(chunks or 1)
+    if not (1 <= chunks <= MAX_CHUNKS):
+        raise ValueError(f"chunks {chunks} outside 1..{MAX_CHUNKS}")
+    if dm.width % chunks != 0:
+        raise ValueError(
+            f"chunks {chunks} does not divide method width {dm.width}"
+        )
+    if chunks > 1 and not getattr(dm, "chunkable", False):
+        # chunk-safety is a registration-time declaration: a mismatch
+        # must reject before lockstep, exactly like a fingerprint
+        # mismatch — a silently mis-chunked kernel would diverge, not
+        # fail
+        raise ValueError(
+            f"device method {service}.{method} is not registered "
+            "chunkable (chunked overlap sessions need the chunk-safety "
+            "declaration)"
+        )
+    return chunks
+
+
 def run_dispatch_session(
     party_ids: List[int],
     own_index: int,
@@ -689,6 +802,10 @@ def run_dispatch_session(
     checkpoint_every: int = 0,
     step_deadline_ms: float = 0.0,
     session_epoch: int = 0,
+    chunks: int = 1,
+    double_buffer: bool = False,
+    trace_id: int = 0,
+    parent_span_id: int = 0,
 ) -> Tuple[np.ndarray, int, float]:
     """Run this party's side of a K-step session of ``dm``'s kernel;
     returns (own final row, own final n, elapsed seconds). Every party
@@ -712,7 +829,25 @@ def run_dispatch_session(
     replacement party is bootstrapped with) — and replays only steps
     > R; ``step_deadline_ms`` arms a watchdog that aborts the session
     fabric-wide when a SINGLE step (or the final fetch) stalls, instead
-    of waiting out the whole session deadline."""
+    of waiting out the whole session deadline.
+
+    Overlap extensions (T3, docs/DEVICE_PLANE.md "the overlap
+    scheduler"): ``chunks=C`` splits every step's operand on its leading
+    axis into C independently-dispatched sub-collectives (the kernel
+    must be registered ``chunkable`` and C must divide the width); each
+    chunk is acked independently (a non-blocking readiness probe riding
+    the step-ack discipline) and stamps its OWN watchdog progress, so a
+    long overlapped step is never falsely aborted and an abort reason
+    names step+chunk.  ``double_buffer=True`` keeps two step slots in
+    flight: the ack of step k's chunk j is what (at the dataflow level)
+    triggers step k+1's slice j — the host never blocks (zero host sync
+    on the hot path; the device orders the chunk chain by dependency),
+    whereas ``double_buffer=False`` with chunks inserts the serialized
+    step-granularity ack barrier the A/B bench compares against.
+    Checkpoints always capture WHOLE steps (the chunk slices re-concat
+    before entering the ring), so a resume point is never a torn chunk.
+    ``chunks=1, double_buffer=False`` is exactly the pre-overlap code
+    path."""
     import jax
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -722,6 +857,8 @@ def run_dispatch_session(
         raise ValueError("one operand per party required")
     if not (0 <= resume_from <= steps):
         raise ValueError(f"resume_from {resume_from} outside 0..{steps}")
+    chunks = _validate_chunks(dm, chunks, service, method)
+    chunked = chunks > 1 or double_buffer
     mesh = Mesh(np.asarray(devices), ("par",))
     sharding = NamedSharding(mesh, P("par"))
     step_fn = _make_step(dm, mesh, sharding, party_ids)
@@ -769,20 +906,30 @@ def run_dispatch_session(
     ns = jax.make_array_from_single_device_arrays((n,), sharding, n_shards)
 
     # the per-step watchdog: ``progress`` is (step index, last progress
-    # instant), advanced by the chain before every dispatch and before
-    # the final fetch; a stall past the step deadline aborts the session
-    # FABRIC-WIDE (abort_session → every local registrant's event + the
-    # proposer's watcher sees the ESESSION answers), so one wedged step
-    # costs the fabric a step deadline, not a session deadline.  The
-    # wedged party itself still finishes its blocking device call first
-    # — what the watchdog bounds is how long everyone ELSE waits.
+    # instant, chunk index), advanced by the chain before every dispatch
+    # and before the final fetch; a stall past the step deadline aborts
+    # the session FABRIC-WIDE (abort_session → every local registrant's
+    # event + the proposer's watcher sees the ESESSION answers), so one
+    # wedged step costs the fabric a step deadline, not a session
+    # deadline.  The wedged party itself still finishes its blocking
+    # device call first — what the watchdog bounds is how long everyone
+    # ELSE waits.  A CHUNKED step is C progress stamps, not one: each
+    # sub-collective advances the stamp, so a long overlapped step is
+    # never falsely aborted, a stall is attributed to step+chunk, and
+    # with double-buffering a stalled last chunk of step k is named as
+    # step k's — not misread as step k+1 hanging.
     # Dispatches are ASYNC (the host loop stamps per-step progress while
     # XLA pipelines the compute), so the final fetch is where the whole
     # replayed chain's device time is actually awaited: its allowance is
     # one step deadline PER replayed step, not one — a healthy long
     # session must not be aborted for merely computing.
     wd_stop = None
-    progress = [resume_from, time.monotonic()]
+    progress = [resume_from, time.monotonic(), -1]
+    # per-slice ack watermark: acked[j] = lowest step whose chunk-j ack
+    # has NOT been observed yet; the fetch-phase abort reason names the
+    # oldest unacked (step, chunk) so a wedged sub-collective is
+    # attributed, not just "the fetch is slow"
+    acked = [resume_from] * chunks
     if step_deadline_ms and step_deadline_ms > 0 and session_id:
         wd_stop = threading.Event()
         budget_s = step_deadline_ms / 1000.0
@@ -793,10 +940,18 @@ def run_dispatch_session(
             while not wd_stop.wait(poll):
                 allowed = budget_s if progress[0] < steps else fetch_allow_s
                 if time.monotonic() - progress[1] > allowed:
-                    what = (
-                        f"step {progress[0]}" if progress[0] < steps
-                        else "final fetch"
-                    )
+                    if progress[0] < steps:
+                        what = f"step {progress[0]}"
+                        if progress[2] >= 0:
+                            what += f" chunk {progress[2]}/{chunks}"
+                    else:
+                        what = "final fetch"
+                        oldest = min(acked)
+                        if chunked and oldest < steps:
+                            what += (
+                                f" (oldest unacked step {oldest} chunk "
+                                f"{acked.index(oldest)}/{chunks})"
+                            )
                     abort_session(
                         sid,
                         f"{what} exceeded the {step_deadline_ms:g}ms "
@@ -809,42 +964,138 @@ def run_dispatch_session(
             target=_watch_steps, name="mc-step-watchdog", daemon=True
         ).start()
     t0 = time.perf_counter()
+    chunk_tally = 0  # sub-collectives dispatched (folded into bvars once)
+    overlap_tally = 0  # dispatched while the same slice's predecessor flew
+    pending_spans: List[list] = [[] for _ in range(chunks)]
+    step_span = None
     try:
-        for step_i in range(resume_from, steps):
-            # fault plane: an aborted session exits the chain HERE,
-            # between dispatches, with a clean ESESSION — dispatches are
-            # async (XLA pipelines them), so the check costs nothing and
-            # the party never enters a barrier its dead peer cannot
-            # join.  A party already blocked INSIDE one collective
-            # finishes that step first (or hits the runtime's own
-            # collective timeout) — the between-step check, every
-            # party's deadline watch, and the per-step watchdog are what
-            # bound the hang.
-            if should_abort is not None:
-                why = should_abort()
-                if why:
-                    raise SessionAborted(why)
-            progress[0], progress[1] = step_i, time.monotonic()
-            hook = _step_hook
-            if hook is not None:
-                hook(step_i, own_index)  # chaos-drill seam
-            x, ns = step_fn(x, ns)  # chained: operands stay on-device
-            completed = step_i + 1
-            if ring is not None and completed % checkpoint_every == 0:
-                # retaining the global arrays IS the checkpoint: the
-                # buffers stay device-resident, no host sync happens
-                # here, and the ring caps how many stay alive
-                ring.put(
-                    completed, x, ns,
-                    int(get_flag("mc_dispatch_checkpoint_depth")),
+        if not chunked:
+            for step_i in range(resume_from, steps):
+                # fault plane: an aborted session exits the chain HERE,
+                # between dispatches, with a clean ESESSION — dispatches
+                # are async (XLA pipelines them), so the check costs
+                # nothing and the party never enters a barrier its dead
+                # peer cannot join.  A party already blocked INSIDE one
+                # collective finishes that step first (or hits the
+                # runtime's own collective timeout) — the between-step
+                # check, every party's deadline watch, and the per-step
+                # watchdog are what bound the hang.
+                if should_abort is not None:
+                    why = should_abort()
+                    if why:
+                        raise SessionAborted(why)
+                progress[0], progress[1] = step_i, time.monotonic()
+                hook = _step_hook
+                if hook is not None:
+                    hook(step_i, own_index, 0)  # chaos-drill seam
+                x, ns = step_fn(x, ns)  # chained: operands stay on-device
+                completed = step_i + 1
+                if ring is not None and completed % checkpoint_every == 0:
+                    # retaining the global arrays IS the checkpoint: the
+                    # buffers stay device-resident, no host sync happens
+                    # here, and the ring caps how many stay alive
+                    ring.put(
+                        completed, x, ns,
+                        int(get_flag("mc_dispatch_checkpoint_depth")),
+                    )
+        else:
+            # -- the overlap scheduler: chunked sub-collectives ---------
+            # the chunk sub-collective is step_fn itself applied to a
+            # slice (jit re-specializes per shape; chunk-safety makes
+            # the slice's bytes the slice of the full-width bytes)
+            chunk_fn = step_fn
+            concat_fn = None
+            if chunks > 1:
+                split_fn, concat_fn = _make_chunk_ops(
+                    mesh, sharding, dm.width, chunks, party_ids
                 )
+                xs = list(split_fn(x))
+            else:
+                xs = [x]
+            for step_i in range(resume_from, steps):
+                step_span = _start_step_span(
+                    service, method, step_i, steps, chunks, double_buffer,
+                    trace_id, parent_span_id,
+                )
+                for j in range(chunks):
+                    # the fault plane extends per-chunk: an abort lands
+                    # BETWEEN sub-collectives, and the torn step (some
+                    # chunks dispatched, others not) never checkpoints —
+                    # a resume point is always a whole-step boundary
+                    if should_abort is not None:
+                        why = should_abort()
+                        if why:
+                            raise SessionAborted(why)
+                    progress[0], progress[2] = step_i, j
+                    progress[1] = time.monotonic()
+                    hook = _step_hook
+                    if hook is not None:
+                        hook(step_i, own_index, j)  # chaos-drill seam
+                    if double_buffer and step_i > resume_from:
+                        # chunk-ack observation riding the step-ack
+                        # discipline: xs[j] IS step k-1's chunk-j
+                        # output.  Ready → the ack is observed (spans
+                        # close, the watermark advances).  Not ready →
+                        # the predecessor sub-collective is still in
+                        # flight while the next slice dispatches: that
+                        # IS the overlap, tallied.  Never blocks — the
+                        # device orders the chain by dataflow, so the
+                        # ack-gates-dispatch discipline holds on-device
+                        # with zero host sync added.
+                        if _chunk_ready(xs[j]):
+                            acked[j] = step_i
+                            _close_spans(pending_spans[j])
+                        else:
+                            overlap_tally += 1
+                    # ns is NOT rethreaded through the chunk programs:
+                    # the chunkable contract passes n through unchanged,
+                    # so consuming a chunk's m output would only hand
+                    # every slice of step k+1 a dataflow edge on step
+                    # k's chunk-0 program — partially re-serializing the
+                    # overlap the schedule exists to remove
+                    new_x, _ = chunk_fn(xs[j], ns)
+                    xs[j] = new_x
+                    chunk_tally += 1
+                    csp = _start_chunk_span(
+                        service, method, step_i, j, chunks, step_span,
+                        trace_id, parent_span_id,
+                    )
+                    if csp is not None:
+                        pending_spans[j].append(csp)
+                completed = step_i + 1
+                if not double_buffer:
+                    # serialized schedule: the step-granularity ack
+                    # barrier the overlap replaces — every chunk of this
+                    # step observed complete before the next dispatches
+                    # (the A/B baseline; a stalled chunk is named by its
+                    # own progress stamp)
+                    for j in range(chunks):
+                        progress[0], progress[2] = step_i, j
+                        progress[1] = time.monotonic()
+                        jax.block_until_ready(xs[j])
+                        acked[j] = completed
+                        _close_spans(pending_spans[j])
+                if ring is not None and completed % checkpoint_every == 0:
+                    # whole-step checkpoint: the chunk slices re-concat
+                    # (async, device-resident) before entering the ring
+                    # — a torn chunk can never become a resume point
+                    x_ck = concat_fn(*xs) if chunks > 1 else xs[0]
+                    ring.put(
+                        completed, x_ck, ns,
+                        int(get_flag("mc_dispatch_checkpoint_depth")),
+                    )
+                if step_span is not None:
+                    _end_session_span(step_span)
+                    step_span = None
+            x = concat_fn(*xs) if chunks > 1 else xs[0]
         if should_abort is not None:
             # last look before the blocking fetch: the final collect is
             # the one host-blocking point of the chain
             why = should_abort()
             if why:
                 raise SessionAborted(why)
-        progress[0], progress[1] = steps, time.monotonic()
+        progress[0], progress[2] = steps, -1
+        progress[1] = time.monotonic()
         own_row = own_n = None
         for s in x.addressable_shards:
             # a process can address several mesh devices (single-
@@ -854,9 +1105,27 @@ def run_dispatch_session(
         for s in ns.addressable_shards:
             if s.device == own_dev:
                 own_n = int(np.asarray(s.data).reshape(-1)[0])
+        # the fetch materialized the whole chain: every outstanding chunk
+        # ack is implied — close the remaining spans at their true ack
+        # instant and settle the watermark
+        for j in range(chunks):
+            acked[j] = steps
+            _close_spans(pending_spans[j])
     finally:
         if wd_stop is not None:
             wd_stop.set()
+        if chunk_tally:
+            dispatch_chunks << chunk_tally
+        if overlap_tally:
+            dispatch_overlapped_chunks << overlap_tally
+        # an abort mid-step leaves spans open: close them as errored so
+        # the trace shows the torn step instead of losing it
+        from incubator_brpc_tpu.utils.status import ErrorCode as _EC
+
+        for j in range(chunks):
+            _close_spans(pending_spans[j], error_code=int(_EC.ESESSION))
+        if step_span is not None:
+            _end_session_span(step_span, error_code=int(_EC.ESESSION))
     elapsed = time.perf_counter() - t0
     assert own_row is not None and own_n is not None
     dispatch_sessions << 1
@@ -964,6 +1233,63 @@ def _end_session_span(span, error_code: int = 0) -> None:
     from incubator_brpc_tpu.builtin.rpcz import end_custom_span
 
     end_custom_span(span, error_code=error_code)
+
+
+def _start_step_span(
+    service, method, step_i, steps, chunks, double_buffer,
+    trace_id, parent_span_id,
+):
+    """One step's COMPUTE span in an overlapped session: covers the host
+    dispatch window of the step's sub-collectives; its children are the
+    chunk spans, and a chunk span of step k that closes inside step
+    k+1's window is the trace-level proof of overlap."""
+    from incubator_brpc_tpu.builtin.rpcz import (
+        SPAN_TYPE_COLLECTIVE,
+        start_custom_span,
+    )
+
+    span = start_custom_span(
+        SPAN_TYPE_COLLECTIVE, service, method,
+        trace_id=trace_id, parent_span_id=parent_span_id,
+    )
+    if span is not None:
+        span.annotate(
+            f"compute step={step_i}/{steps} chunks={chunks} "
+            f"schedule={'double_buffer' if double_buffer else 'serialized'}"
+        )
+    return span
+
+
+def _start_chunk_span(
+    service, method, step_i, j, chunks, step_span, trace_id, parent_span_id
+):
+    """One chunk sub-collective's span, nested inside its step's compute
+    span (``chunk=<j>/<C>`` annotation schema, docs/OBSERVABILITY.md);
+    ended at the chunk's ACK observation, so its interval is
+    dispatch→ack — time-overlapping the next slice's compute span when
+    the schedule actually overlaps."""
+    from incubator_brpc_tpu.builtin.rpcz import (
+        SPAN_TYPE_COLLECTIVE,
+        start_custom_span,
+    )
+
+    span = start_custom_span(
+        SPAN_TYPE_COLLECTIVE, service, method,
+        trace_id=step_span.trace_id if step_span is not None else trace_id,
+        parent_span_id=(
+            step_span.span_id if step_span is not None else parent_span_id
+        ),
+    )
+    if span is not None:
+        span.annotate(f"chunk={j}/{chunks} step={step_i}")
+    return span
+
+
+def _close_spans(spans: list, error_code: int = 0) -> None:
+    """End-and-drain a slice's pending chunk spans (ack observed, or the
+    session tore down) — draining keeps a second close idempotent."""
+    while spans:
+        _end_session_span(spans.pop(0), error_code=error_code)
 
 
 # -- server half ---------------------------------------------------------------
@@ -1140,6 +1466,14 @@ def make_dispatch_handler(server):
         try:
             run_epoch = int(req.get("epoch", 0) or 0)
             resume_from = int(req.get("resume_from", 0) or 0)
+            # overlap fields: the proposer stamps the chunk count and
+            # schedule into the run proposal (session-uniform — every
+            # party must dispatch the same sub-collective sequence or
+            # the chunk collectives cannot rendezvous)
+            chunks = _validate_chunks(
+                dm, req.get("chunks", 1), service, method
+            )
+            double_buffer = bool(req.get("double_buffer", False))
             if "checkpoint_every" in req:
                 checkpoint_every = int(req["checkpoint_every"] or 0)
             else:
@@ -1162,7 +1496,7 @@ def make_dispatch_handler(server):
             from incubator_brpc_tpu.utils.status import ErrorCode
 
             dispatch_rejects << 1
-            cntl.set_failed(ErrorCode.EREQUEST, f"bad resume fields: {e}")
+            cntl.set_failed(ErrorCode.EREQUEST, f"bad run fields: {e}")
             return b""
         st = None
         sock_hook = None
@@ -1231,6 +1565,16 @@ def make_dispatch_handler(server):
                 checkpoint_every=checkpoint_every,
                 step_deadline_ms=step_deadline_ms,
                 session_epoch=run_epoch,
+                chunks=chunks, double_buffer=double_buffer,
+                # step/chunk spans nest inside the session span (or the
+                # proposing RPC's trace when the session span was not
+                # sampled this time)
+                trace_id=(
+                    span.trace_id if span is not None else cntl.trace_id
+                ),
+                parent_span_id=(
+                    span.span_id if span is not None else cntl.span_id
+                ),
             )
         except SessionAborted as e:
             from incubator_brpc_tpu.utils.status import ErrorCode
@@ -1300,8 +1644,18 @@ def propose_dispatch(
     checkpoint_every: Optional[int] = None,
     step_deadline_ms: Optional[float] = None,
     epoch: int = 0,
+    chunks: int = 1,
+    double_buffer: bool = False,
 ) -> dict:
     """Schedule an N-party session of a registered device method.
+
+    ``chunks``/``double_buffer`` select the overlap schedule (T3): every
+    step's operand splits into ``chunks`` independently-acked
+    sub-collectives, and with ``double_buffer`` two step slots stay in
+    flight (see :func:`run_dispatch_session`).  The proposer stamps both
+    into the run proposal — the schedule is session-uniform, like the
+    checkpoint cadence — and validates chunk-safety against its own
+    registry before the accept fan-out.
 
     ``party_ids`` are global device ids in mesh order; ``operands[i]`` is
     party i's initial row. ``channels[j]`` is a host channel to the
@@ -1353,6 +1707,7 @@ def propose_dispatch(
             raise ValueError(
                 f"operand of {len(op)}B exceeds method width {dm.width}"
             )
+    chunks = _validate_chunks(dm, chunks, service, method)
 
     # session identity + deadline: what the fault plane keys on.  Every
     # party gets the SAME budget, measured from its own clock at proposal
@@ -1413,6 +1768,13 @@ def propose_dispatch(
             # without a ring (the replacement)
             d["checkpoint_every"] = ckpt_every
             d["step_deadline_ms"] = step_ms
+            # the overlap schedule is session-uniform: every party must
+            # dispatch the same chunk sequence or the sub-collectives
+            # cannot rendezvous
+            if chunks > 1:
+                d["chunks"] = chunks
+            if double_buffer:
+                d["double_buffer"] = True
             if resume_from > 0:
                 d["resume_from"] = resume_from
                 # bootstrap rows ride only to the parties that need them
@@ -1595,6 +1957,9 @@ def propose_dispatch(
                     resume_state=resume_state,
                     checkpoint_every=ckpt_every, step_deadline_ms=step_ms,
                     session_epoch=epoch,
+                    chunks=chunks, double_buffer=double_buffer,
+                    trace_id=span.trace_id if span is not None else 0,
+                    parent_span_id=span.span_id if span is not None else 0,
                 )
             except SessionAborted as e:
                 _end_session_span(span, error_code=ErrorCode.ESESSION)
@@ -1802,6 +2167,8 @@ def propose_with_recovery(
     spares=None,
     checkpoint_every: Optional[int] = None,
     step_deadline_ms: Optional[float] = None,
+    chunks: int = 1,
+    double_buffer: bool = False,
 ) -> dict:
     """:func:`propose_dispatch` with the elastic recovery path: a session
     that aborts on PARTY DEATH heals instead of restarting from nothing
@@ -1857,6 +2224,7 @@ def propose_with_recovery(
                 checkpoint_every=checkpoint_every,
                 step_deadline_ms=step_deadline_ms,
                 epoch=attempt,
+                chunks=chunks, double_buffer=double_buffer,
             )
             out["dead_party_ids"] = dropped
             out["replaced_party_ids"] = replaced

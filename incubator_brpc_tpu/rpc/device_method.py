@@ -35,11 +35,29 @@ DEFAULT_WIDTH = 4096
 
 
 class DeviceMethod:
-    """A jittable bytes-in/bytes-out kernel with fixed row geometry."""
+    """A jittable bytes-in/bytes-out kernel with fixed row geometry.
 
-    def __init__(self, kernel: Callable, width: int = DEFAULT_WIDTH):
+    ``chunkable=True`` declares the kernel CHUNK-SAFE: applying it to any
+    contiguous slice of the row produces the same bytes as slicing the
+    full-width result (elementwise along the width, collectives included
+    — psum of a slice is the slice of the psum), and it passes ``n``
+    through unchanged.  Only chunk-safe kernels may run chunked overlap
+    sessions (``parallel/mc_dispatch.py``: the step's operand is split on
+    its leading axis into independently-dispatched sub-collectives); a
+    session proposing ``chunks > 1`` against a method registered without
+    the declaration is cleanly rejected before any lockstep entry.  The
+    declaration is a capability, not part of the kernel's identity — it
+    does not enter the fingerprint."""
+
+    def __init__(
+        self,
+        kernel: Callable,
+        width: int = DEFAULT_WIDTH,
+        chunkable: bool = False,
+    ):
         self.kernel = kernel
         self.width = width
+        self.chunkable = bool(chunkable)
         self._jitted = None
         self._lock = threading.Lock()
         self._fingerprint: Optional[str] = None
@@ -148,14 +166,20 @@ def registry_fingerprints() -> Dict[str, str]:
     return {f"{s}.{m}": dm.fingerprint() for (s, m), dm in items}
 
 
-def device_method(kernel: Callable, width: int = DEFAULT_WIDTH) -> Callable:
+def device_method(
+    kernel: Callable,
+    width: int = DEFAULT_WIDTH,
+    chunkable: bool = False,
+) -> Callable:
     """Wrap a device kernel into a host RPC handler.
 
     The handler runs the SAME jitted kernel the fused collective path
     runs, on this process's default device — point-to-point calls and the
     fused ParallelChannel dispatch therefore return identical bytes.
+    ``chunkable`` declares chunk-safety for overlap sessions (see
+    :class:`DeviceMethod`).
     """
-    dm = DeviceMethod(kernel, width=width)
+    dm = DeviceMethod(kernel, width=width, chunkable=chunkable)
 
     def handler(cntl, request: bytes) -> bytes:
         row, n = dm.pack(request)

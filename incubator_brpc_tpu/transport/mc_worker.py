@@ -157,7 +157,10 @@ def run_server(args) -> int:
         _scale_psum_kernel_wrong if args.wrong_kernel else _scale_psum_kernel
     )
     server.add_service(
-        "dsvc", {"scale": _device_method(kernel, width=SESSION_WIDTH)}
+        "dsvc",
+        # chunkable: psum + elementwise treats every width slice alike
+        # and passes n through — chunked overlap sessions are admitted
+        {"scale": _device_method(kernel, width=SESSION_WIDTH, chunkable=True)},
     )
     if args.chaos_kill_at_step >= 0:
         # the deterministic chaos drill: this party "dies" at EXACTLY
@@ -378,7 +381,10 @@ def run_fabric_client(args) -> int:
 
         # the PROPOSER validates against its local registry too
         register_device_method(
-            "dsvc", "scale", DeviceMethod(_scale_psum_kernel, width=SESSION_WIDTH)
+            "dsvc", "scale",
+            DeviceMethod(
+                _scale_psum_kernel, width=SESSION_WIDTH, chunkable=True
+            ),
         )
         req = bytes(range(48))
         cntl = pc.call_method(
@@ -485,7 +491,8 @@ def run_session_client(args) -> int:
     # the proposer validates (service, method) against its LOCAL registry
     # exactly like every accepting party
     register_device_method(
-        "dsvc", "scale", DeviceMethod(_scale_psum_kernel, width=SESSION_WIDTH)
+        "dsvc", "scale",
+        DeviceMethod(_scale_psum_kernel, width=SESSION_WIDTH, chunkable=True),
     )
     ports = [int(p) for p in args.rpc_ports.split(",")]
     spare_procs = set(
@@ -548,6 +555,7 @@ def run_session_client(args) -> int:
     out = propose_dispatch(
         chans, party_ids, "dsvc", "scale", operands,
         steps=steps, proposer_index=client_index, timeout_ms=120000,
+        chunks=args.chunks, double_buffer=args.double_buffer,
     )
     want = session_expected(operands, out["final_steps"])
     for i, (got, exp) in enumerate(zip(out["results"], want)):
@@ -557,6 +565,8 @@ def run_session_client(args) -> int:
         "steps": out["final_steps"],
         "per_step_ms": out["elapsed_s"] / out["final_steps"] * 1e3,
         "method": "dsvc.scale",
+        "chunks": args.chunks,
+        "double_buffer": bool(args.double_buffer),
     }
     print("CLIENT_OK " + json.dumps(stats), flush=True)
     _quit_servers(ports)
@@ -876,12 +886,17 @@ def orchestrate_session(
     steps: int = 4,
     wrong_kernel: bool = False,
     timeout: float = 300.0,
+    chunks: int = 1,
+    double_buffer: bool = False,
 ):
     """Spawn ``n_parties - 1`` server processes + one session client (all
     one jax.distributed group) and run an N-party collective-method-plane
     session of the user kernel. ``wrong_kernel`` arms ONE server with a
     same-name/different-body kernel so the fingerprint reject path is
-    what the run proves. Returns the client's session stats."""
+    what the run proves. ``chunks``/``double_buffer`` run the session on
+    the overlap schedule (chunked sub-collectives, two step slots in
+    flight) — byte-identity against the integer model still gates.
+    Returns the client's session stats."""
     ports = _free_ports(n_parties)
     coord, rpc_ports = ports[0], ports[1:]
     specs = []
@@ -898,7 +913,10 @@ def orchestrate_session(
         "--proc-id", str(n_parties - 1),
         "--rpc-ports", ",".join(map(str, rpc_ports)),
         "--collective-steps", str(steps),
+        "--chunks", str(chunks),
     ]
+    if double_buffer:
+        client.append("--double-buffer")
     if wrong_kernel:
         client.append("--expect-reject")
     specs.append(("session-client", "session-client", tuple(client)))
@@ -1021,6 +1039,8 @@ def main(argv=None) -> int:
     ap.add_argument("--spare-procs", type=str, default="")  # session client
     ap.add_argument("--expect-resume", action="store_true")  # session client
     ap.add_argument("--checkpoint-every", type=int, default=0)  # client
+    ap.add_argument("--chunks", type=int, default=1)  # session client
+    ap.add_argument("--double-buffer", action="store_true")  # session client
     args = ap.parse_args(argv)
     if args.proc_id < 0:
         # pair convention: server is the coordinator, client is last

@@ -40,6 +40,7 @@ from typing import Dict, List, Optional, Tuple
 from tools.fabricverify import REPO_ROOT, Violation
 from tools.fabricverify.models import (
     BreakerModel,
+    OverlapSessionModel,
     ResumeSessionModel,
     SessionModel,
 )
@@ -176,6 +177,7 @@ def default_models() -> List[object]:
         SessionModel(n_parties=3, steps=2, floors=(0, 1, 3)),
         SessionModel(n_parties=3, steps=2, floors=(0, 1, 3), max_deaths=1),
         ResumeSessionModel(n_parties=3, steps=2),
+        OverlapSessionModel(n_parties=3, steps=3, chunks=3),
         BreakerModel(),
     ]
 
@@ -213,6 +215,9 @@ def main(argv=None) -> int:
         # than the base model's; its exhaustive scope is pinned at 2
         # steps (≈430k states) regardless of --steps
         ResumeSessionModel(n_parties=args.parties, steps=2),
+        # the overlap scope is chunk-granular — pinned at 3 parties /
+        # 3 steps / 3 chunks (~177k states) for the same reason
+        OverlapSessionModel(n_parties=3, steps=3, chunks=3),
         BreakerModel(),
     ]
     rc = 0

@@ -856,6 +856,342 @@ class ResumeSessionModel:
 
 
 # ---------------------------------------------------------------------------
+# mc_dispatch overlap scheduler (chunked double-buffered sessions, T3)
+# ---------------------------------------------------------------------------
+
+# overlap-scope proposer phases
+O_RUN_WAIT, O_DONE, O_ABORTED = 0, 1, 2
+
+
+class OverlapSessionModel:
+    """The chunked overlap schedule of the session run phase
+    (parallel/mc_dispatch.py ``run_dispatch_session`` with ``chunks=C,
+    double_buffer=True`` — docs/DEVICE_PLANE.md "overlap scheduler"),
+    modeled at CHUNK granularity:
+
+    - Each party dispatches sub-collectives in the schedule order (step
+      k slice 0..C-1, then step k+1): ``dispatch_i`` advances a linear
+      cursor.  The two-slot double buffer is the dispatch GATE: slice
+      j of step k+1 dispatches only after the party OBSERVED the ack of
+      step k's chunk j (at the device level this is the dataflow edge
+      the real code relies on; the host never blocks).
+    - A chunk (k, j) COMPLETES (``complete_j``) only when EVERY party is
+      alive and has dispatched it — the per-chunk collective rendezvous
+      — and completion is dataflow-ordered per slice, so a per-slice
+      count suffices.
+    - A party OBSERVES a chunk ack (``ack_i``) only after the chunk
+      completed — the chunk-ack riding the step-ack discipline.
+    - The environment kills ≤ ``max_deaths`` parties and drops ≤
+      ``max_drops`` control messages at any instant — including mid-
+      step with half a step's chunks acked (the torn-step scope); the
+      proposer detects the death and broadcasts ABORT, which must
+      unwedge every survivor whatever its cursor/ack skew.
+
+    Mutations (the meta-tested seeded bugs):
+
+    - ``ack_before_complete``: a party observes a chunk's ack as soon
+      as it DISPATCHED it, not when the sub-collective completed — the
+      overlap degenerates to unbounded pipelining and the ack no longer
+      witnesses anything (``model-unsafe``: acked past completed).
+    - ``no_ack_gate``: the dispatch of step k+1's slice j no longer
+      waits for step k's chunk-j ack — more than two step slots in
+      flight on one slice (``model-unsafe``: the double-buffer window
+      invariant).
+    """
+
+    name = "mc_dispatch_session_overlap"
+    source = "incubator_brpc_tpu/parallel/mc_dispatch.py"
+
+    M_RUN, M_RESP, M_ABORT = 0, 1, 2
+
+    def __init__(
+        self,
+        n_parties: int = 2,
+        steps: int = 2,
+        chunks: int = 2,
+        max_drops: int = 1,
+        max_deaths: int = 1,
+        ack_before_complete: bool = False,
+        no_ack_gate: bool = False,
+    ):
+        self.n = n_parties
+        self.steps = steps
+        self.chunks = chunks
+        self.max_drops = max_drops
+        self.max_deaths = max_deaths
+        self.ack_before_complete = ack_before_complete
+        self.no_ack_gate = no_ack_gate
+
+    # State = (phase, echoes, parties, completed, msgs, drops, dead,
+    #          deaths)
+    # - parties[i] = (pphase, disp, acked): ``disp`` is the linear chunk
+    #   cursor (chunk (k, j) dispatched iff disp > k*C + j), ``acked`` a
+    #   per-slice tuple of observed-ack step counts
+    # - completed[j] = consecutively completed chunks on slice j (the
+    #   per-slice dataflow order makes a count exact)
+    # - msgs: sorted multiset of (kind, party, value) control messages
+    #   (the chunk plane itself is shared-state actions, not messages:
+    #   it is the device fabric, not the rpc plane)
+
+    def initial_state(self):
+        msgs = tuple(
+            sorted((self.M_RUN, i, self.steps) for i in range(self.n))
+        )
+        return (
+            O_RUN_WAIT,
+            (None,) * self.n,
+            ((P_ACCEPTED, 0, (0,) * self.chunks),) * self.n,
+            (0,) * self.chunks,
+            msgs,
+            0,
+            (False,) * self.n,
+            0,
+        )
+
+    @staticmethod
+    def _without(msgs, m):
+        out = list(msgs)
+        out.remove(m)
+        return tuple(out)
+
+    @staticmethod
+    def _with(msgs, *new):
+        return tuple(sorted(msgs + tuple(new)))
+
+    def _abort_msgs(self, dead):
+        return tuple(
+            (self.M_ABORT, j, 0) for j in range(self.n) if not dead[j]
+        )
+
+    def _dispatched(self, disp: int, k: int, j: int) -> bool:
+        return disp > k * self.chunks + j
+
+    def is_terminal(self, s) -> bool:
+        phase, _e, _p, _c, msgs, _d, _dead, _dt = s
+        return phase in (O_DONE, O_ABORTED) and not msgs
+
+    def actions(self, s):
+        (phase, echoes, parties, completed, msgs, drops, dead, deaths) = s
+        out: List[Tuple[str, tuple]] = []
+        total = self.steps * self.chunks
+        for m in sorted(set(msgs)):
+            out.append((f"deliver{m}", self._deliver(s, m)))
+            # abort delivery stays reliable — each party's own deadline
+            # is the real backstop, exactly as in the base model
+            if m[0] != self.M_ABORT and drops < self.max_drops:
+                out.append(
+                    (f"drop{m}",
+                     (phase, echoes, parties, completed,
+                      self._without(msgs, m), drops + 1, dead, deaths))
+                )
+        if deaths < self.max_deaths and phase == O_RUN_WAIT:
+            for j in range(self.n):
+                if not dead[j]:
+                    out.append(
+                        (f"die{j}",
+                         (phase, echoes, parties, completed, msgs, drops,
+                          dead[:j] + (True,) + dead[j + 1:], deaths + 1))
+                    )
+        # per-party chunk-plane actions
+        for i in range(self.n):
+            if dead[i]:
+                continue
+            pphase, disp, acked = parties[i]
+            if pphase != P_RUNNING:
+                continue
+            # dispatch the next sub-collective in schedule order, gated
+            # by the two-slot double buffer: slice j of step k waits for
+            # the OBSERVED ack of step k-1's chunk j (the no_ack_gate
+            # mutation removes the wait)
+            if disp < total:
+                k, j = divmod(disp, self.chunks)
+                if k == 0 or acked[j] >= k or self.no_ack_gate:
+                    newp = (
+                        parties[:i] + ((P_RUNNING, disp + 1, acked),)
+                        + parties[i + 1:]
+                    )
+                    out.append(
+                        (f"dispatch{i}[{k},{j}]",
+                         (phase, echoes, newp, completed, msgs, drops,
+                          dead, deaths))
+                    )
+            # observe a chunk ack: the completion of (acked[j], j) — the
+            # ack_before_complete mutation lets a dispatched chunk ack
+            # without its collective having completed
+            for j in range(self.chunks):
+                a = acked[j]
+                if a >= self.steps or not self._dispatched(disp, a, j):
+                    continue
+                if a < completed[j] or self.ack_before_complete:
+                    newa = acked[:j] + (a + 1,) + acked[j + 1:]
+                    full = (
+                        disp == total
+                        and all(
+                            newa[q] == self.steps
+                            for q in range(self.chunks)
+                        )
+                    )
+                    newph = P_RAN if full else P_RUNNING
+                    newp = (
+                        parties[:i] + ((newph, disp, newa),)
+                        + parties[i + 1:]
+                    )
+                    newm = msgs
+                    if full:
+                        newm = self._with(
+                            msgs, (self.M_RESP, i, self.steps)
+                        )
+                    out.append(
+                        (f"ack{i}[{a},{j}]",
+                         (phase, echoes, newp, completed, newm, drops,
+                          dead, deaths))
+                    )
+        # chunk completion: the per-chunk collective rendezvous — every
+        # party alive and dispatched, per-slice dataflow order
+        if not any(dead):
+            for j in range(self.chunks):
+                k = completed[j]
+                if k >= self.steps:
+                    continue
+                if all(
+                    p[0] in (P_RUNNING, P_RAN)
+                    and self._dispatched(p[1], k, j)
+                    for p in parties
+                ):
+                    newc = (
+                        completed[:j] + (k + 1,) + completed[j + 1:]
+                    )
+                    out.append(
+                        (f"complete[{k},{j}]",
+                         (phase, echoes, parties, newc, msgs, drops,
+                          dead, deaths))
+                    )
+        # death detection: a dead party the proposer still waits on
+        # triggers the fabric-wide abort broadcast
+        if phase == O_RUN_WAIT:
+            if any(
+                dead[j] and echoes[j] is None for j in range(self.n)
+            ):
+                out.append(
+                    ("detect_death",
+                     (O_ABORTED, echoes, parties, completed,
+                      self._with(msgs, *self._abort_msgs(dead)), drops,
+                      dead, deaths))
+                )
+        # deadline backstop: only when the environment actually lost
+        # something — a drop-free path must progress on its own
+        if phase == O_RUN_WAIT and drops > 0:
+            out.append(
+                ("timeout",
+                 (O_ABORTED, echoes, parties, completed,
+                  self._with(msgs, *self._abort_msgs(dead)), drops, dead,
+                  deaths))
+            )
+        return out
+
+    def _deliver(self, s, m) -> tuple:
+        (phase, echoes, parties, completed, msgs, drops, dead, deaths) = s
+        msgs = self._without(msgs, m)
+        kind, i, val = m
+        same = (phase, echoes, parties, completed, msgs, drops, dead,
+                deaths)
+
+        if kind == self.M_ABORT:
+            if dead[i]:
+                return same
+            pphase, disp, acked = parties[i]
+            if pphase in (P_ACCEPTED, P_RUNNING):
+                # mid-step, half-acked, whatever: the survivor leaves
+                # its chunk pipeline; cursor state is dead — normalize
+                # so death-timing variants collapse
+                parties = (
+                    parties[:i]
+                    + ((P_ABORTED, 0, (0,) * self.chunks),)
+                    + parties[i + 1:]
+                )
+            return (phase, echoes, parties, completed, msgs, drops, dead,
+                    deaths)
+
+        if kind == self.M_RUN:
+            if dead[i]:
+                return same
+            pphase, disp, acked = parties[i]
+            if pphase == P_ACCEPTED:
+                parties = (
+                    parties[:i] + ((P_RUNNING, disp, acked),)
+                    + parties[i + 1:]
+                )
+            return (phase, echoes, parties, completed, msgs, drops, dead,
+                    deaths)
+
+        # M_RESP
+        if phase != O_RUN_WAIT or echoes[i] is not None:
+            return same
+        echoes = echoes[:i] + (val,) + echoes[i + 1:]
+        if all(e is not None for e in echoes):
+            if all(e == self.steps for e in echoes):
+                return (O_DONE, echoes, parties, completed, msgs, drops,
+                        dead, deaths)
+            return (O_ABORTED, echoes, parties, completed,
+                    self._with(msgs, *self._abort_msgs(dead)), drops,
+                    dead, deaths)
+        return (phase, echoes, parties, completed, msgs, drops, dead,
+                deaths)
+
+    # -- properties ----------------------------------------------------------
+
+    def invariant(self, s) -> str:
+        (_ph, _e, parties, completed, _m, _d, dead, _dt) = s
+        for i, (pphase, disp, acked) in enumerate(parties):
+            if dead[i] or pphase not in (P_RUNNING, P_RAN):
+                continue
+            for j in range(self.chunks):
+                if acked[j] > completed[j]:
+                    return (
+                        f"party {i} observed the ack of step "
+                        f"{acked[j] - 1} chunk {j} before the "
+                        "sub-collective completed — a chunk ack must "
+                        "witness completion"
+                    )
+                # steps whose chunk j this party has dispatched
+                ds = max(0, (disp - j - 1) // self.chunks + 1)
+                if ds > acked[j] + 1:
+                    return (
+                        f"party {i} dispatched step {ds - 1}'s chunk "
+                        f"{j} with only {acked[j]} acks observed on "
+                        "that slice — more than two step slots in "
+                        "flight (the double-buffer window)"
+                    )
+        return ""
+
+    def terminal_ok(self, s) -> str:
+        (phase, echoes, parties, _c, _m, drops, dead, deaths) = s
+        for i, (pphase, _disp, _acked) in enumerate(parties):
+            if pphase == P_RUNNING and not dead[i]:
+                return (
+                    f"party {i} is alive and still inside its chunk "
+                    "pipeline at session end — the abort never reached "
+                    "it (half-acked step left wedged)"
+                )
+        if phase == O_DONE:
+            for i, (pphase, disp, acked) in enumerate(parties):
+                if pphase != P_RAN or any(
+                    a != self.steps for a in acked
+                ):
+                    return (
+                        f"close converged but party {i} ended "
+                        f"{(pphase, disp, acked)} — not every chunk "
+                        "acked"
+                    )
+        if drops == 0 and deaths == 0 and phase != O_DONE:
+            return (
+                "drop-free, death-free path ended without a converged "
+                f"close (proposer phase {phase})"
+            )
+        return ""
+
+
+# ---------------------------------------------------------------------------
 # circuit-breaker state machine
 # ---------------------------------------------------------------------------
 

@@ -212,8 +212,16 @@ def rpcz_mode(
     error_only: bool = False,
 ) -> int:
     """Print a target's recent sampled spans (or one assembled trace as
-    an indented parent→child tree when --trace-id is given)."""
-    from incubator_brpc_tpu.builtin.rpcz import render_trace_tree, span_line
+    an indented parent→child tree when --trace-id is given).  A trace
+    carrying overlap-session chunk spans (``chunk=j/C`` annotations)
+    additionally gets the overlap report — per-chunk ack-vs-next-compute
+    timing with an OVERLAPPED/SERIALIZED verdict, so a schedule that
+    regressed to serialization is visible at a glance."""
+    from incubator_brpc_tpu.builtin.rpcz import (
+        overlap_report,
+        render_trace_tree,
+        span_line,
+    )
 
     host, _, port = target.rpartition(":")
     if not host or not port.isdigit():
@@ -226,7 +234,7 @@ def rpcz_mode(
         print(f"rpc_view: rpcz scrape of {target} failed: {e}", file=sys.stderr)
         return 1
     if trace_id and min_latency_us is None and not error_only:
-        lines = render_trace_tree(spans)
+        lines = render_trace_tree(spans) + overlap_report(spans)
     else:
         lines = [span_line(sp) for sp in spans]
     print(f"# /rpcz of {target} — {len(spans)} spans")
