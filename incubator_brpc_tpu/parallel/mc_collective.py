@@ -146,12 +146,22 @@ _pmean_lock = __import__("threading").Lock()
 
 
 def _pmean_dm(width_bytes: int):
+    from incubator_brpc_tpu.parallel import quantized as _quantized
     from incubator_brpc_tpu.rpc.device_method import DeviceMethod
 
     with _pmean_lock:
         dm = _pmean_dms.get(width_bytes)
         if dm is None:
-            dm = DeviceMethod(_pmean_bytes_kernel, width=width_bytes)
+            # chunkable: pmean is elementwise along the width (psum of a
+            # slice IS the slice of the psum) and passes n through — the
+            # chunk-safety contract verbatim (the declaration is a
+            # capability, not kernel identity: fingerprints unchanged)
+            dm = DeviceMethod(
+                _pmean_bytes_kernel, width=width_bytes, chunkable=True
+            )
+            # the quantize= session knob resolves through these variants
+            # (block-aligned widths only; others reject pre-lockstep)
+            _quantized.attach_pmean_variants(dm, width_bytes)
             _pmean_dms[width_bytes] = dm
         return dm
 

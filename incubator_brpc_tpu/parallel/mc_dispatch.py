@@ -138,6 +138,13 @@ dispatch_session_us = LatencyRecorder(name="mc_dispatch_session_us")
 # mc_dispatch_overlap_ratio.  Tallied once per session, not per chunk.
 dispatch_chunks = Adder(name="mc_dispatch_chunks")
 dispatch_overlapped_chunks = Adder(name="mc_dispatch_overlapped_chunks")
+# the quantized-collective plane (parallel/quantized.py): sessions that
+# ran a quantized kernel variant, and the cumulative wire bytes the
+# quantization removed vs the same session at exact float32 width
+# (parties x replayed steps x (width - quantized wire bytes), tallied
+# once per session)
+dispatch_quantized_sessions = Adder(name="mc_dispatch_quantized_sessions")
+dispatch_bytes_saved = Adder(name="mc_dispatch_bytes_saved")
 
 
 def _overlap_ratio() -> float:
@@ -384,6 +391,74 @@ def abort_sessions_for_devices(device_ids, reason: str) -> int:
 _MAX_CHECKPOINT_SESSIONS = 16
 
 
+class _QuantCk:
+    """A quantized checkpoint payload: the ring entry of a QUANTIZED
+    session stores the block-quantized representation (values + int8
+    scale exponents) instead of the float32 rows — the same ~4x the wire
+    saves, applied to the ring's device memory (the gauge below reflects
+    it).  Power-of-two scales make dequantize→requantize exactly
+    idempotent (parallel/quantized.py), so a chain restored from this
+    entry replays byte-identically to the undisturbed run."""
+
+    __slots__ = ("q", "e", "mode", "block", "width")
+
+    def __init__(self, q, e, mode: str, block: int, width: int):
+        self.q = q
+        self.e = e
+        self.mode = mode
+        self.block = int(block)
+        self.width = int(width)
+
+    def arrays(self):
+        return (self.q, self.e)
+
+    def shard_row(self, dev):
+        """Materialize the full-width uint8 row retained for one device
+        (host-side dequantize via the numpy twin — bitwise equal to the
+        jax arithmetic, resume-path only), or None when this payload
+        holds no shard on that device."""
+        from incubator_brpc_tpu.parallel import quantized as _quantized
+
+        q_sh = next(
+            (s for s in self.q.addressable_shards if s.device == dev), None
+        )
+        e_sh = next(
+            (s for s in self.e.addressable_shards if s.device == dev), None
+        )
+        if q_sh is None or e_sh is None:
+            return None
+        f = _quantized.np_dequantize(
+            np.asarray(q_sh.data).reshape(-1),
+            np.asarray(e_sh.data).reshape(-1),
+            self.mode,
+            self.block,
+        )
+        row = np.frombuffer(f.astype(np.float32).tobytes(), dtype=np.uint8)
+        return row.copy()
+
+
+def _payload_arrays(payload):
+    """The jax arrays inside a ring payload — raw row array, or the
+    quantized pair — for readiness probes."""
+    if isinstance(payload, _QuantCk):
+        return payload.arrays()
+    return (payload,)
+
+
+def _payload_shard_row(payload, dev) -> Optional[np.ndarray]:
+    """Full-width uint8 row this payload retains on one device, or
+    None.  One accessor for both entry formats so the reshard and
+    restore paths cannot diverge on representation."""
+    if isinstance(payload, _QuantCk):
+        return payload.shard_row(dev)
+    sh = next(
+        (s for s in payload.addressable_shards if s.device == dev), None
+    )
+    if sh is None:
+        return None
+    return np.asarray(sh.data).reshape(-1).astype(np.uint8)
+
+
 class _CheckpointRing:
     __slots__ = ("session_id", "own_index", "party_ids", "entries",
                  "entry_bytes")
@@ -414,7 +489,7 @@ class _CheckpointRing:
         buffers are actually computed — a step wedged behind a dead
         party's collective must never be elected as the resume point
         (materializing it would hang the resume barrier itself)."""
-        for arr in (x, ns):
+        for arr in (*_payload_arrays(x), ns):
             fn = getattr(arr, "is_ready", None)
             if callable(fn):
                 try:
@@ -531,7 +606,6 @@ def _checkpoint_rows(
         if entry is None:
             continue
         x, ns = entry
-        by_dev_row = {s.device: s for s in x.addressable_shards}
         by_dev_n = {s.device: s for s in ns.addressable_shards}
         for slot in want:
             if slot in out or not (0 <= slot < len(ring.party_ids)):
@@ -540,10 +614,13 @@ def _checkpoint_rows(
                 dev = _devices_by_id([ring.party_ids[slot]])[0]
             except ValueError:
                 continue
-            sh, sn = by_dev_row.get(dev), by_dev_n.get(dev)
-            if sh is None or sn is None:
+            # the wire format is always the FULL-WIDTH row: a quantized
+            # ring dequantizes here (exact — power-of-two scales), so
+            # the reshard protocol never forks on representation
+            row = _payload_shard_row(x, dev)
+            sn = by_dev_n.get(dev)
+            if row is None or sn is None:
                 continue
-            row = np.asarray(sh.data).reshape(-1).astype(np.uint8)
             out[slot] = (
                 row.tobytes(),
                 int(np.asarray(sn.data).reshape(-1)[0]),
@@ -672,7 +749,9 @@ def _devices_by_id(ids: List[int]):
 _step_cache: Dict[tuple, tuple] = {}  # (fp, party ids) -> (step_fn, dm)
 # chunk split/concat programs: (party ids, width, chunks) -> (split, concat)
 _chunk_ops_cache: Dict[tuple, tuple] = {}
-_step_cache_lock = threading.Lock()  # guards BOTH caches (never nested)
+# checkpoint quantizers: (party ids, width, mode, block) -> jitted qz
+_ck_quant_cache: Dict[tuple, object] = {}
+_step_cache_lock = threading.Lock()  # guards ALL three caches (never nested)
 
 
 def _make_step(dm, mesh, sharding, party_ids):
@@ -745,6 +824,38 @@ def _make_chunk_ops(mesh, sharding, width: int, chunks: int, party_ids):
     return cached
 
 
+def _make_ck_quant(mesh, sharding, dm, party_ids):
+    """Jitted checkpoint quantizer for a quantized session: global uint8
+    rows (n, width) -> (wire values, int8 exponents), both sharded over
+    the party axis.  Pure per-row arithmetic — no collectives, so the
+    parties need no rendezvous and the dispatch stays async (retaining
+    the quantized arrays IS the checkpoint, same as the raw path).
+    Cached like the step program."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from incubator_brpc_tpu.parallel.quantized import _jq_quantize
+
+    mode, block = dm.quant_mode, dm.quant_block
+    key = (tuple(party_ids), int(dm.width), mode, int(block))
+    with _step_cache_lock:
+        cached = _ck_quant_cache.get(key)
+        if cached is None:
+            out_sh = NamedSharding(mesh, P("par"))
+
+            def qz(x, _m=mode, _b=block):
+                import jax.numpy as jnp
+
+                f = jax.lax.bitcast_convert_type(
+                    x.reshape(x.shape[0], -1, 4), jnp.float32
+                )
+                return _jq_quantize(f, _m, _b)
+
+            cached = jax.jit(qz, out_shardings=(out_sh, out_sh))
+            _ck_quant_cache[key] = cached
+    return cached
+
+
 # fabriclint: hotpath
 def _chunk_ready(arr) -> bool:
     """Non-blocking chunk-ack probe — the overlap scheduler's per-chunk
@@ -784,7 +895,126 @@ def _validate_chunks(dm, chunks, service: str, method: str) -> int:
             "chunkable (chunked overlap sessions need the chunk-safety "
             "declaration)"
         )
+    align = int(getattr(dm, "chunk_align", 1) or 1)
+    if chunks > 1 and (dm.width // chunks) % align != 0:
+        # block-wise quantized kernels: a chunk cut mid-scale-block
+        # would recompute block scales from partial blocks and diverge
+        # from the full-width bytes — alignment is part of chunk-safety
+        raise ValueError(
+            f"chunk width {dm.width // chunks} is not a multiple of "
+            f"{service}.{method}'s {align}-byte block alignment"
+        )
     return chunks
+
+
+def _validate_chunk_order(chunk_order, chunks: int) -> List[int]:
+    """Session-uniform chunk dispatch order (the topology-aware
+    scheduler's stamp): None is mesh order; anything else must be a
+    permutation of the chunk set — every party dispatches the same
+    sub-collective sequence or the chunk collectives cannot
+    rendezvous.  Raises ValueError (handlers reject EREQUEST)."""
+    if chunk_order is None:
+        return list(range(chunks))
+    order = [int(j) for j in chunk_order]
+    if sorted(order) != list(range(chunks)):
+        raise ValueError(
+            f"chunk_order {order} is not a permutation of 0..{chunks - 1}"
+        )
+    return order
+
+
+# -- topology-aware scheduling (TASP, PAPERS.md 2509.26541) --------------------
+#
+# The N-party fan-out and the chunk routes were dispatched in MESH order
+# — blind to the fabric.  The DeviceLinkMap star has been measuring
+# per-link rtt and bytes/s since PR 1; `link_profile()` (transport/
+# device_link.py) snapshots those recorders, and the scheduler orders
+# work by MEASURED speed instead: the slowest link's party is proposed
+# to first — it needs the longest lead before every barrier (the TASP
+# rule: schedule the scarce link before the fast ones).  The chunk
+# dispatch order is derived from the same profile (see
+# schedule_session_order for exactly what that does and does not buy —
+# chunk sub-collectives are symmetric across links).  The chosen order
+# and the profile it came from are stamped into the run proposal and
+# the rpcz session span, so a surprising schedule is auditable after
+# the fact.
+
+
+def _profile_speed(info) -> Optional[tuple]:
+    """Sort key for one party's measured link: (GB/s ascending, rtt
+    DESCENDING) — slowest first; None when the link has no telemetry
+    (no evidence of being slow: it keeps mesh order at the tail)."""
+    if not info:
+        return None
+    gbps = float(info.get("gbps", 0.0) or 0.0)
+    rtt = float(info.get("rtt_us", 0.0) or 0.0)
+    if gbps <= 0.0 and rtt <= 0.0:
+        return None
+    return (gbps, -rtt)
+
+
+def schedule_session_order(
+    party_ids: List[int], profile, chunks: int = 1
+) -> Tuple[List[int], List[int], str]:
+    """The TASP join of a link profile and a session shape: returns
+    (party_order, chunk_order, note).  ``party_order`` is every party
+    index, measured links slowest-first, unmeasured parties trailing in
+    mesh order — the load-bearing half: the fan-out RPC to the slowest
+    link's party is issued first.  ``chunk_order`` is a deterministic
+    dispatch permutation derived from the same measurements via a
+    round-robin ROUTE LABEL (slice j labeled to party ``j % n``): chunk
+    sub-collectives move EVERY party's slice, so no chunk belongs to a
+    link — on XLA's symmetric lowering the order is latency-neutral,
+    and its value is being a pure auditable function of the profile
+    that fronts the slices labeled to slow parties on runtimes that do
+    schedule sub-collective transfers in dispatch order.  Reordering
+    never changes bytes (asserted by the overlap-composition tests).
+    ``note`` is the audit string the rpcz span records.  With no
+    measured link both orders degenerate to mesh order — the
+    pre-topology behavior."""
+    n = len(party_ids)
+    profile = profile or {}
+    measured, unmeasured = [], []
+    for i, pid in enumerate(party_ids):
+        key = _profile_speed(profile.get(int(pid)))
+        if key is None:
+            unmeasured.append(i)
+        else:
+            measured.append((key, i))
+    measured.sort()
+    party_order = [i for _k, i in measured] + unmeasured
+    # rank only MEASURED parties: with an empty profile the chunk sort
+    # key is (inf, j) everywhere and the order stays mesh
+    rank = {i: pos for pos, (_k, i) in enumerate(measured)}
+    chunk_order = sorted(
+        range(int(chunks)),
+        key=lambda j: (rank.get(j % n, float("inf")), j),
+    )
+    if measured:
+        gbps = {
+            int(party_ids[i]): round(
+                float(profile[int(party_ids[i])].get("gbps", 0.0) or 0.0), 4
+            )
+            for _k, i in measured
+        }
+        note = f"link_order={party_order} profile_gbps={gbps}"
+        if chunks > 1:
+            note += f" chunk_order={chunk_order}"
+    else:
+        note = ""
+    return party_order, chunk_order, note
+
+
+def _default_link_profile():
+    """The scheduler's default telemetry source: this process's live
+    device-link star (best-effort — a process with no links schedules
+    in mesh order)."""
+    try:
+        from incubator_brpc_tpu.transport.device_link import link_profile
+
+        return link_profile()
+    except Exception:  # noqa: BLE001 — scheduling is advisory, never fatal
+        return {}
 
 
 def run_dispatch_session(
@@ -804,6 +1034,8 @@ def run_dispatch_session(
     session_epoch: int = 0,
     chunks: int = 1,
     double_buffer: bool = False,
+    quantize: str = "none",
+    chunk_order=None,
     trace_id: int = 0,
     parent_span_id: int = 0,
 ) -> Tuple[np.ndarray, int, float]:
@@ -847,10 +1079,29 @@ def run_dispatch_session(
     Checkpoints always capture WHOLE steps (the chunk slices re-concat
     before entering the ring), so a resume point is never a torn chunk.
     ``chunks=1, double_buffer=False`` is exactly the pre-overlap code
-    path."""
+    path.
+
+    Quantized extensions (parallel/quantized.py): ``quantize`` selects
+    the kernel variant this chain binds — "none" runs ``dm`` itself,
+    "int8"/"int4" resolve ``dm.quantized(mode)`` (no variant = clean
+    ValueError before any dispatch); a quantized session also stores its
+    checkpoint ring entries in the QUANTIZED representation (same ~4x as
+    the wire), and the power-of-two scale discipline keeps resume replay
+    byte-identical.  ``chunk_order`` is the topology-aware scheduler's
+    session-uniform dispatch permutation over the chunk set (None = mesh
+    order); chunk sub-collectives are independent, so the order never
+    changes bytes — only which slice fronts the schedule."""
     import jax
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+    qdm = dm.quantized(quantize) if hasattr(dm, "quantized") else dm
+    if qdm is None:
+        raise ValueError(
+            f"device method {service}.{method} has no {quantize} "
+            "quantized variant"
+        )
+    dm = qdm
+    quant_mode = getattr(dm, "quant_mode", "none") or "none"
     devices = _devices_by_id(party_ids)
     n = len(devices)
     if len(operands) != n:
@@ -858,6 +1109,7 @@ def run_dispatch_session(
     if not (0 <= resume_from <= steps):
         raise ValueError(f"resume_from {resume_from} outside 0..{steps}")
     chunks = _validate_chunks(dm, chunks, service, method)
+    chunk_order = _validate_chunk_order(chunk_order, chunks)
     chunked = chunks > 1 or double_buffer
     mesh = Mesh(np.asarray(devices), ("par",))
     sharding = NamedSharding(mesh, P("par"))
@@ -873,10 +1125,26 @@ def run_dispatch_session(
     ring = None
     if checkpoint_every and checkpoint_every > 0 and session_id:
         n_addr = sum(1 for d in devices if d in addressable)
+        # a quantized session retains QUANTIZED ring entries: the per-
+        # entry device cost drops from width float32-bytes per row to
+        # the wire footprint — deep rings get the same ~4x the wire got
+        row_cost = dm.wire_bytes() if quant_mode != "none" else dm.width
         ring = _checkpoint_ring(
             session_id, own_index, party_ids,
-            entry_bytes=n_addr * (dm.width + 4),
+            entry_bytes=n_addr * (row_cost + 4),
         )
+    ck_qz = None
+    if ring is not None and quant_mode != "none":
+        ck_qz = _make_ck_quant(mesh, sharding, dm, party_ids)
+
+    def _ck_payload(rows):
+        """What enters the ring: the raw row array, or (quantized
+        session) its block-quantized twin — dispatched async like the
+        chain itself, no host sync here either."""
+        if ck_qz is None:
+            return rows
+        q_arr, e_arr = ck_qz(rows)
+        return _QuantCk(q_arr, e_arr, quant_mode, dm.quant_block, dm.width)
     restored = None
     if resume_from > 0:
         restored = _restore_state(
@@ -995,7 +1263,7 @@ def run_dispatch_session(
                     # buffers stay device-resident, no host sync happens
                     # here, and the ring caps how many stay alive
                     ring.put(
-                        completed, x, ns,
+                        completed, _ck_payload(x), ns,
                         int(get_flag("mc_dispatch_checkpoint_depth")),
                     )
         else:
@@ -1017,7 +1285,11 @@ def run_dispatch_session(
                     service, method, step_i, steps, chunks, double_buffer,
                     trace_id, parent_span_id,
                 )
-                for j in range(chunks):
+                # chunk_order: the stamped topology-derived dispatch
+                # permutation (independent sub-collectives: order
+                # changes dispatch sequence, never bytes — see
+                # schedule_session_order for its exact semantics)
+                for j in chunk_order:
                     # the fault plane extends per-chunk: an abort lands
                     # BETWEEN sub-collectives, and the torn step (some
                     # chunks dispatched, others not) never checkpoints —
@@ -1069,7 +1341,7 @@ def run_dispatch_session(
                     # step observed complete before the next dispatches
                     # (the A/B baseline; a stalled chunk is named by its
                     # own progress stamp)
-                    for j in range(chunks):
+                    for j in chunk_order:
                         progress[0], progress[2] = step_i, j
                         progress[1] = time.monotonic()
                         jax.block_until_ready(xs[j])
@@ -1081,7 +1353,7 @@ def run_dispatch_session(
                     # — a torn chunk can never become a resume point
                     x_ck = concat_fn(*xs) if chunks > 1 else xs[0]
                     ring.put(
-                        completed, x_ck, ns,
+                        completed, _ck_payload(x_ck), ns,
                         int(get_flag("mc_dispatch_checkpoint_depth")),
                     )
                 if step_span is not None:
@@ -1132,6 +1404,14 @@ def run_dispatch_session(
     dispatch_steps << (steps - resume_from)
     dispatch_session_us << elapsed * 1e6
     _method_counter(service, method) << 1
+    if quant_mode != "none":
+        # the quantization dividend, tallied once per session: bytes
+        # the wire did NOT carry vs the exact float32 row at this
+        # width, across every party and replayed step
+        dispatch_quantized_sessions << 1
+        saved = (dm.width - dm.wire_bytes()) * n * (steps - resume_from)
+        if saved > 0:
+            dispatch_bytes_saved << saved
     return own_row, own_n, elapsed
 
 
@@ -1149,12 +1429,16 @@ def _restore_state(
 
     ring = _checkpoint_lookup(session_id, own_index) if session_id else None
     entry = ring.get(int(step)) if ring is not None else None
-    by_dev_row, by_dev_n, old_pids = {}, {}, ()
+    payload, by_dev_n, old_pids = None, {}, ()
     if entry is not None:
-        old_x, old_ns = entry
-        by_dev_row = {s.device: s for s in old_x.addressable_shards}
+        payload, old_ns = entry
         by_dev_n = {s.device: s for s in old_ns.addressable_shards}
         old_pids = ring.party_ids
+    pay_devs = (
+        [s.device for s in _payload_arrays(payload)[0].addressable_shards]
+        if payload is not None
+        else []
+    )
     state = resume_state or {}
     row_shards, n_shards = [], []
     for i, dev in enumerate(devices):
@@ -1162,11 +1446,25 @@ def _restore_state(
             continue
         src_dev = None
         if i < len(old_pids):
-            src = [d for d in by_dev_row if d.id == old_pids[i]]
+            src = [d for d in pay_devs if d.id == old_pids[i]]
             src_dev = src[0] if src else None
-        if src_dev is not None:
-            row_buf = by_dev_row[src_dev].data
+        if src_dev is not None and src_dev in by_dev_n:
             n_buf = by_dev_n[src_dev].data
+            if isinstance(payload, _QuantCk):
+                # quantized ring: the retained entry is the block-
+                # quantized representation — dequantize on the host
+                # (exact, power-of-two scales) and re-place.  The first
+                # replayed step re-quantizes to the identical wire
+                # bytes (idempotence), so the chain stays byte-
+                # identical to the undisturbed run.
+                row = payload.shard_row(src_dev)
+                if row is None:
+                    return None
+                row_shards.append(jax.device_put(row.reshape(1, -1), dev))
+                n_shards.append(jax.device_put(np.asarray(n_buf), dev))
+                continue
+            by_dev_row = {s.device: s for s in payload.addressable_shards}
+            row_buf = by_dev_row[src_dev].data
             if src_dev != dev:
                 # a replaced slot restored from a survivor's ring: the
                 # retained buffer lives on the OLD device — move it
@@ -1203,6 +1501,7 @@ def _start_session_span(
     trace_id: int = 0,
     parent_span_id: int = 0,
     resume_from: int = 0,
+    extra: str = "",
 ):
     from incubator_brpc_tpu.builtin.rpcz import (
         SPAN_TYPE_COLLECTIVE,
@@ -1225,6 +1524,9 @@ def _start_session_span(
             # a resumed chain: the span shows how much work the
             # checkpoint saved (only steps > resume_from re-ran)
             note += f" resume_from={resume_from}"
+        if extra:
+            # quantize= / link-order audit trail (docs/OBSERVABILITY.md)
+            note += " " + extra
         span.annotate(note)
     return span
 
@@ -1310,6 +1612,7 @@ def _validate_proposal(req: dict):
         service = str(req["service"])
         method = str(req["method"])
         fingerprint = str(req["fingerprint"])
+        quantize = str(req.get("quantize", "") or "none")
     except (ValueError, KeyError, TypeError) as e:
         return None, None, None, None, (
             ErrorCode.EREQUEST, f"bad dispatch proposal: {e}"
@@ -1324,6 +1627,13 @@ def _validate_proposal(req: dict):
         return None, None, None, None, (
             ErrorCode.EREQUEST, "dispatch proposal out of bounds"
         )
+    from incubator_brpc_tpu.parallel.quantized import QUANT_MODES
+
+    if quantize not in QUANT_MODES:
+        dispatch_rejects << 1
+        return None, None, None, None, (
+            ErrorCode.EREQUEST, f"unknown quantize mode {quantize!r}"
+        )
     dm = resolve_method(service, method, width)
     if dm is None:
         dispatch_rejects << 1
@@ -1331,6 +1641,17 @@ def _validate_proposal(req: dict):
             ErrorCode.ENOMETHOD,
             f"no device method {service}.{method} with width {width} "
             f"registered in this process",
+        )
+    dm = dm.quantized(quantize)
+    if dm is None:
+        # the session is quantized but this method registered no such
+        # variant here — same class of divergence as a fingerprint
+        # mismatch, same clean pre-lockstep reject
+        dispatch_rejects << 1
+        return None, None, None, None, (
+            ErrorCode.EREQUEST,
+            f"device method {service}.{method} has no {quantize} "
+            f"quantized variant registered in this process",
         )
     ours = dm.fingerprint()
     if ours != fingerprint:
@@ -1473,6 +1794,9 @@ def make_dispatch_handler(server):
             chunks = _validate_chunks(
                 dm, req.get("chunks", 1), service, method
             )
+            chunk_order = _validate_chunk_order(
+                req.get("chunk_order"), chunks
+            )
             double_buffer = bool(req.get("double_buffer", False))
             if "checkpoint_every" in req:
                 checkpoint_every = int(req["checkpoint_every"] or 0)
@@ -1551,10 +1875,18 @@ def make_dispatch_handler(server):
                 return "session deadline exceeded"
             return None
 
+        quant_note = ""
+        if getattr(dm, "quant_mode", "none") != "none":
+            quant_note = f"quantize={dm.quant_mode}"
+        if chunk_order != list(range(chunks)):
+            # the proposer's topology-derived route, auditable per party
+            quant_note = (
+                quant_note + f" chunk_order={chunk_order}"
+            ).strip()
         span = _start_session_span(
             service, method, dm.fingerprint(), party_ids, own_index, steps,
             trace_id=cntl.trace_id, parent_span_id=cntl.span_id,
-            resume_from=resume_from,
+            resume_from=resume_from, extra=quant_note,
         )
         try:
             own_row, own_n, elapsed = run_dispatch_session(
@@ -1566,6 +1898,7 @@ def make_dispatch_handler(server):
                 step_deadline_ms=step_deadline_ms,
                 session_epoch=run_epoch,
                 chunks=chunks, double_buffer=double_buffer,
+                chunk_order=chunk_order,
                 # step/chunk spans nest inside the session span (or the
                 # proposing RPC's trace when the session span was not
                 # sampled this time)
@@ -1646,6 +1979,9 @@ def propose_dispatch(
     epoch: int = 0,
     chunks: int = 1,
     double_buffer: bool = False,
+    quantize: str = "none",
+    link_profile=None,
+    chunk_order=None,
 ) -> dict:
     """Schedule an N-party session of a registered device method.
 
@@ -1656,6 +1992,22 @@ def propose_dispatch(
     into the run proposal — the schedule is session-uniform, like the
     checkpoint cadence — and validates chunk-safety against its own
     registry before the accept fan-out.
+
+    ``quantize`` ("none"/"int8"/"int4") binds the session to the named
+    method's QUANTIZED variant (parallel/quantized.py): the proposal
+    stamps the mode and the VARIANT's fingerprint, every party resolves
+    the same variant locally and fingerprint-validates it at accept —
+    exact vs quantized can never silently mix in one lockstep chain.
+    A method with no such variant rejects cleanly before any fan-out.
+
+    Topology awareness (TASP): the accept and run fan-outs are issued
+    slowest-measured-link FIRST, and with ``chunks > 1`` the stamped
+    ``chunk_order`` front-loads the slices owned by the slowest parties
+    — both derived from ``link_profile`` ({device id: {"gbps",
+    "rtt_us", ...}}, default this process's live DeviceLinkMap snapshot)
+    and recorded in the rpcz session span so the chosen order is
+    auditable.  Pass ``chunk_order`` explicitly to override the derived
+    route (it must be a permutation of the chunk set).
 
     ``party_ids`` are global device ids in mesh order; ``operands[i]`` is
     party i's initial row. ``channels[j]`` is a host channel to the
@@ -1701,6 +2053,14 @@ def propose_dispatch(
             f"device method {service}.{method} not registered locally "
             f"(the proposer validates against its own registry too)"
         )
+    quantize = (quantize or "none").strip() or "none"
+    qdm = dm.quantized(quantize)
+    if qdm is None:
+        raise LookupError(
+            f"device method {service}.{method} has no {quantize} "
+            "quantized variant registered locally"
+        )
+    dm = qdm
     fingerprint = dm.fingerprint()
     for op in operands:
         if len(op) > dm.width:
@@ -1708,6 +2068,23 @@ def propose_dispatch(
                 f"operand of {len(op)}B exceeds method width {dm.width}"
             )
     chunks = _validate_chunks(dm, chunks, service, method)
+    # topology-aware route: fan out slowest-measured-link first, and
+    # front-load the chunk slices that cover the slowest parties (the
+    # schedule is advisory for latency, load-bearing for audit — the
+    # note below lands in the rpcz session span)
+    if link_profile is None:
+        link_profile = _default_link_profile()
+    party_order, auto_chunk_order, sched_note = schedule_session_order(
+        party_ids, link_profile, chunks
+    )
+    if chunk_order is None:
+        chunk_order = auto_chunk_order
+    else:
+        chunk_order = _validate_chunk_order(chunk_order, chunks)
+    sched_extra = (
+        (f"quantize={quantize} " if quantize != "none" else "")
+        + sched_note
+    ).strip()
 
     # session identity + deadline: what the fault plane keys on.  Every
     # party gets the SAME budget, measured from its own clock at proposal
@@ -1749,6 +2126,12 @@ def propose_dispatch(
             "method": method,
             "fingerprint": fingerprint,
         }
+        if quantize != "none":
+            # session-uniform, validated at accept AND run: the
+            # fingerprint above IS the quantized variant's, so a party
+            # missing the variant (or holding a different one) rejects
+            # before lockstep like any other kernel divergence
+            d["quantize"] = quantize
         if phase:
             d["phase"] = phase
         else:
@@ -1773,6 +2156,11 @@ def propose_dispatch(
             # cannot rendezvous
             if chunks > 1:
                 d["chunks"] = chunks
+                if chunk_order != list(range(chunks)):
+                    # the topology-derived route rides the run proposal
+                    # (session-uniform: every party must dispatch the
+                    # same sub-collective sequence to rendezvous)
+                    d["chunk_order"] = chunk_order
             if double_buffer:
                 d["double_buffer"] = True
             if resume_from > 0:
@@ -1797,10 +2185,18 @@ def propose_dispatch(
         # scheduling rides the host plane — the shared control-call shape
         return _control_call(ch, payload, timeout_ms)
 
+    # fan-out order: slowest measured link FIRST (TASP) — that party's
+    # accept/run RPC needs the longest lead before each barrier; parties
+    # with no telemetry keep mesh order at the tail.  The channel list
+    # itself stays positional (callers index it by remote slot).
+    fan = sorted(
+        zip(channels, remote_indexes),
+        key=lambda p: party_order.index(p[1]),
+    )
+
     # Phase 1 — accept barrier + the monotone-max step-count join
     accepts = [
-        _call(ch, proposal(idx, steps, phase="accept"))
-        for ch, idx in zip(channels, remote_indexes)
+        _call(ch, proposal(idx, steps, phase="accept")) for ch, idx in fan
     ]
     deadline = time.monotonic() + timeout_ms / 1000.0
     final = steps
@@ -1815,11 +2211,10 @@ def propose_dispatch(
         final = max(final, int(ack.get("target", steps)))
 
     # Phase 2 — run fan-out (async: a sync proposal would deadlock — the
-    # first party's collective blocks on parties never told to start)
-    pending = [
-        _call(ch, proposal(idx, final))
-        for ch, idx in zip(channels, remote_indexes)
-    ]
+    # first party's collective blocks on parties never told to start),
+    # in the same slowest-first order as the accept fan-out
+    pending = [_call(ch, proposal(idx, final)) for ch, idx in fan]
+    fan_indexes = [idx for _ch, idx in fan]
     from incubator_brpc_tpu.utils.status import ErrorCode
 
     # connectivity-class failures of a RUN rpc = the party is DEAD for
@@ -1851,7 +2246,7 @@ def propose_dispatch(
                 "epoch": int(epoch),
             }
         ).encode()
-        for ch, idx in zip(channels, remote_indexes):
+        for ch, idx in fan:
             if idx in skip:
                 continue
             try:
@@ -1887,7 +2282,7 @@ def propose_dispatch(
         while not watch_stop.wait(0.01):
             done = True
             now = time.monotonic()
-            for (cntl, ev), idx in zip(pending, remote_indexes):
+            for (cntl, ev), idx in zip(pending, fan_indexes):
                 if not ev.is_set():
                     done = False
                     continue
@@ -1931,6 +2326,16 @@ def propose_dispatch(
     own_elapsed = None
     results: List[Optional[bytes]] = [None] * n
     abort_exc: Optional[SessionAborted] = None
+    sched_span = None
+    if proposer_index is None and sched_extra:
+        # a pure scheduler leaves the audit span too: the quantize mode,
+        # chosen link order and the profile it came from must be
+        # traceable even when the proposer runs no chain of its own
+        # (index=-1 marks the scheduler role)
+        sched_span = _start_session_span(
+            service, method, fingerprint, party_ids, -1, final,
+            resume_from=resume_from, extra=sched_extra,
+        )
     try:
         if proposer_index is not None:
 
@@ -1946,7 +2351,7 @@ def propose_dispatch(
 
             span = _start_session_span(
                 service, method, fingerprint, party_ids, proposer_index,
-                final, resume_from=resume_from,
+                final, resume_from=resume_from, extra=sched_extra,
             )
             try:
                 own_row, own_n, own_elapsed = run_dispatch_session(
@@ -1958,6 +2363,7 @@ def propose_dispatch(
                     checkpoint_every=ckpt_every, step_deadline_ms=step_ms,
                     session_epoch=epoch,
                     chunks=chunks, double_buffer=double_buffer,
+                    chunk_order=chunk_order,
                     trace_id=span.trace_id if span is not None else 0,
                     parent_span_id=span.span_id if span is not None else 0,
                 )
@@ -1995,7 +2401,7 @@ def propose_dispatch(
                 session_id=session_id,
                 final_steps=final,
             )
-        for (cntl, ev), idx in zip(pending, remote_indexes):
+        for (cntl, ev), idx in zip(pending, fan_indexes):
             if cntl.failed():  # defensive: the watcher classifies these
                 raise RuntimeError(
                     f"dispatch peer failed: {cntl.error_text}"
@@ -2027,12 +2433,30 @@ def propose_dispatch(
     finally:
         watch_stop.set()
         _unregister_session(st)
+        if sched_span is not None:
+            _end_session_span(
+                sched_span,
+                error_code=(
+                    int(ErrorCode.ESESSION)
+                    if (st.abort_event.is_set() or abort_exc is not None)
+                    else 0
+                ),
+            )
     return {
         "results": results,
         "final_steps": final,
         "elapsed_s": own_elapsed,
         "session_id": session_id,
         "resumed_from": resume_from if resume_from > 0 else None,
+        "quantize": quantize,
+        # the proposer-side wire accounting the dryrun gate and bench
+        # compare: bytes every party put on the party axis across the
+        # REPLAYED steps (exact rows ship dm.width per party per step;
+        # a resumed run only moved steps past the checkpoint — same
+        # basis as mc_dispatch_bytes_saved)
+        "wire_bytes": dm.wire_bytes() * n * (final - resume_from),
+        "link_order": party_order,
+        "chunk_order": chunk_order,
     }
 
 
@@ -2169,6 +2593,8 @@ def propose_with_recovery(
     step_deadline_ms: Optional[float] = None,
     chunks: int = 1,
     double_buffer: bool = False,
+    quantize: str = "none",
+    link_profile=None,
 ) -> dict:
     """:func:`propose_dispatch` with the elastic recovery path: a session
     that aborts on PARTY DEATH heals instead of restarting from nothing
@@ -2225,6 +2651,7 @@ def propose_with_recovery(
                 step_deadline_ms=step_deadline_ms,
                 epoch=attempt,
                 chunks=chunks, double_buffer=double_buffer,
+                quantize=quantize, link_profile=link_profile,
             )
             out["dead_party_ids"] = dropped
             out["replaced_party_ids"] = replaced

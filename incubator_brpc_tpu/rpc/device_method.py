@@ -58,9 +58,47 @@ class DeviceMethod:
         self.kernel = kernel
         self.width = width
         self.chunkable = bool(chunkable)
+        # chunk boundaries must fall on multiples of this many bytes for
+        # the chunk-safety contract to hold (1 = any divisor of width).
+        # Block-wise quantized kernels set it to the scale-block byte
+        # span: a chunk cut mid-block would recompute block scales from a
+        # partial block and diverge from the full-width bytes.
+        self.chunk_align = 1
+        # quantization surface (parallel/quantized.py): "none" for exact
+        # kernels; a quantized VARIANT carries its mode + block size, and
+        # collective_bytes declares how many bytes this kernel actually
+        # puts on the wire per party per step (None = the full row width
+        # — the exact-kernel default). Variants are separate DeviceMethods
+        # (own kernel, own fingerprint) reachable via quantized().
+        self.quant_mode = "none"
+        self.quant_block = 0
+        self.quant_variants: Dict[str, "DeviceMethod"] = {}
+        self.collective_bytes: Optional[int] = None
         self._jitted = None
         self._lock = threading.Lock()
         self._fingerprint: Optional[str] = None
+
+    def quantized(self, mode: Optional[str]) -> Optional["DeviceMethod"]:
+        """Resolve the session-uniform ``quantize=`` knob against this
+        method: "none" (or empty) is the method itself; a quantized mode
+        returns the registered variant — a DISTINCT DeviceMethod whose
+        fingerprint the accept phase validates like any other — or None
+        when the method declares no such variant (the clean pre-lockstep
+        reject)."""
+        mode = (mode or "none").strip() or "none"
+        if mode == "none" or mode == self.quant_mode:
+            return self
+        return self.quant_variants.get(mode)
+
+    def wire_bytes(self) -> int:
+        """Bytes this kernel ships across the party axis per party per
+        step — the quantized wire footprint when declared, else the full
+        row width (the exact float path)."""
+        return (
+            int(self.collective_bytes)
+            if self.collective_bytes
+            else int(self.width)
+        )
 
     def fingerprint(self) -> str:
         """Stable identity of the kernel+geometry, advertised by servers in
@@ -154,6 +192,15 @@ def register_device_method(service: str, method: str, dm: DeviceMethod) -> None:
 def lookup_device_method(service: str, method: str) -> Optional[DeviceMethod]:
     with _registry_lock:
         return _registry.get((service, method))
+
+
+def unregister_device_method(service: str, method: str) -> Optional[DeviceMethod]:
+    """Remove a registration (tests restoring a clean registry; a
+    registered name SHADOWS the builtin width-minting resolvers, so a
+    leaked fixture registration changes resolution for every later
+    width).  Returns the removed DeviceMethod or None."""
+    with _registry_lock:
+        return _registry.pop((service, method), None)
 
 
 def registry_fingerprints() -> Dict[str, str]:

@@ -650,6 +650,27 @@ class DeviceLink:
         with self._lock:
             return self._inflight
 
+    def profile(self) -> dict:
+        """Structured snapshot of this link's PR 1 recorders — the
+        telemetry that was scrape-only until the topology-aware
+        scheduler needed a programmatic read (parallel/mc_dispatch).
+        ``gbps`` sums both directions' measured bytes/s; a fresh link
+        reads 0.0 until the 1 Hz bvar sampler has a window."""
+        out_bps = float(self._m_out_rate.get_value() or 0.0)
+        in_bps = float(self._m_in_rate.get_value() or 0.0)
+        return {
+            "link_id": int(self.link_id),
+            "devices": [getattr(d, "id", None) for d in self.devices],
+            "rtt_us": float(self._m_rtt.latency()),
+            "rtt_p99_us": float(self._m_rtt.latency_percentile(0.99)),
+            "steps": int(self._m_rtt.count()),
+            "out_bytes_s": out_bps,
+            "in_bytes_s": in_bps,
+            "out_bytes": int(self._m_out_bytes.get_value()),
+            "in_bytes": int(self._m_in_bytes.get_value()),
+            "gbps": (out_bps + in_bps) / 1e9,
+        }
+
 
 class DeviceSocket:
     """Socket-shaped endpoint over one side of a DeviceLink: the messenger,
@@ -1005,8 +1026,37 @@ class DeviceLinkMap:
         with self._lock:
             return [ds for ds in self._links.values() if ds.state == CONNECTED]
 
+    def link_profile(self) -> Dict[int, dict]:
+        """Per-PEER-device snapshot of the live star's measured link
+        telemetry: {peer global device id: DeviceLink.profile()}.  This
+        is what the topology-aware session scheduler consumes (order
+        party fan-out and chunk routes by measured GB/s instead of mesh
+        order — TASP) and what ``rpc_view --links`` renders: the
+        rtt/bytes-per-second recorders have been live since PR 1, but
+        scrape-only.  Two links to one peer device (distinct geometry
+        keys) keep the faster-measured entry — the scheduler wants the
+        best current estimate of the PEER, not of any one link."""
+        prof: Dict[int, dict] = {}
+        for ds in self.live_links():
+            link = ds.link
+            peer = link.devices[1 - ds.side]
+            pid = getattr(peer, "id", None)
+            if pid is None:
+                continue
+            p = link.profile()
+            have = prof.get(int(pid))
+            if have is None or p["gbps"] > have["gbps"]:
+                prof[int(pid)] = p
+        return prof
+
 
 device_link_map = DeviceLinkMap()
+
+
+def link_profile() -> Dict[int, dict]:
+    """The process-global star's per-peer telemetry snapshot (see
+    :meth:`DeviceLinkMap.link_profile`)."""
+    return device_link_map.link_profile()
 
 
 def make_handshake_handler(server):

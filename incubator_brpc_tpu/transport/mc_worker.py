@@ -529,6 +529,21 @@ def run_session_client(args) -> int:
         bytes((7 * i + j) % 256 for j in range(64 + 8 * i)) for i in range(n)
     ]
     steps = args.collective_steps or 4
+    if args.quantize != "none":
+        if args.expect_resume:
+            # the quantized elastic drill lives in-process
+            # (tests/test_robustness.py); this role is the wire-ratio /
+            # error-bound A/B — refuse the combination loudly instead of
+            # silently ignoring one flag
+            print(
+                "CLIENT_FAIL --quantize with --expect-resume is not a "
+                "supported role combination",
+                flush=True,
+            )
+            return 1
+        return _run_session_client_quantized(
+            args, chans, party_ids, client_index, steps, ports
+        )
     if args.expect_resume:
         return _run_session_client_resume(
             args, chans, spares, party_ids, client_index, operands, steps,
@@ -567,6 +582,79 @@ def run_session_client(args) -> int:
         "method": "dsvc.scale",
         "chunks": args.chunks,
         "double_buffer": bool(args.double_buffer),
+    }
+    print("CLIENT_OK " + json.dumps(stats), flush=True)
+    _quit_servers(ports)
+    return 0
+
+
+def _run_session_client_quantized(
+    args, chans, party_ids, client_index, steps, ports
+) -> int:
+    """Quantized-collective gate half (--quantize int8|int4): run the
+    SAME float32 operands through an EXACT pmean session and a QUANTIZED
+    one (interleaved on one fabric), then report the two numbers the
+    dryrun gate asserts — bytes-on-wire ratio (quantized / exact, ~0.26x
+    for int8, ~0.13x for int4) and the max |quantized - exact| error,
+    which must sit inside the documented bound
+    (parallel/quantized.pmean_error_bound)."""
+    import numpy as np
+
+    from incubator_brpc_tpu.parallel import quantized as _q
+    from incubator_brpc_tpu.parallel.mc_collective import _pmean_dm
+    from incubator_brpc_tpu.parallel.mc_dispatch import propose_dispatch
+    from incubator_brpc_tpu.rpc.device_method import register_device_method
+
+    n = len(party_ids)
+    width = SESSION_WIDTH  # 512 B = 128 floats = 4 blocks of 32
+    # the proposer resolves (service, method) in its own registry first
+    register_device_method("_collective", "pmean", _pmean_dm(width))
+    rng = np.random.default_rng(1234)
+    rows = [
+        (rng.standard_normal(width // 4) * (1.0 + i)).astype(np.float32)
+        for i in range(n)
+    ]
+    operands = [r.tobytes() for r in rows]
+    # the overlap schedule rides along when asked (the quantized pmean
+    # variants are chunkable; width 512 block-aligns chunks 1/2/4):
+    # both arms run the SAME schedule so the A/B isolates quantization
+    sched = dict(chunks=args.chunks, double_buffer=args.double_buffer)
+    exact = propose_dispatch(
+        chans, party_ids, "_collective", "pmean", operands,
+        steps=steps, proposer_index=client_index, timeout_ms=120000,
+        **sched,
+    )
+    quant = propose_dispatch(
+        chans, party_ids, "_collective", "pmean", operands,
+        steps=steps, proposer_index=client_index, timeout_ms=120000,
+        quantize=args.quantize, **sched,
+    )
+    assert quant["final_steps"] == exact["final_steps"]
+    bound = _q.pmean_error_bound(rows, exact["final_steps"], args.quantize)
+    max_err = 0.0
+    for got, ref in zip(quant["results"], exact["results"]):
+        qv = np.frombuffer(got, dtype=np.float32)
+        ev = np.frombuffer(ref, dtype=np.float32)
+        max_err = max(max_err, float(np.abs(qv - ev).max()))
+    ratio = quant["wire_bytes"] / exact["wire_bytes"]
+    if max_err > bound:
+        print(
+            f"CLIENT_FAIL quantized error {max_err} above bound {bound}",
+            flush=True,
+        )
+        return 1
+    stats = {
+        "parties": n,
+        "steps": quant["final_steps"],
+        "quantize": args.quantize,
+        "chunks": args.chunks,
+        "double_buffer": bool(args.double_buffer),
+        "wire_bytes_exact": exact["wire_bytes"],
+        "wire_bytes_quantized": quant["wire_bytes"],
+        "wire_ratio": ratio,
+        "max_error": max_err,
+        "error_bound": bound,
+        "method": "_collective.pmean",
     }
     print("CLIENT_OK " + json.dumps(stats), flush=True)
     _quit_servers(ports)
@@ -888,6 +976,7 @@ def orchestrate_session(
     timeout: float = 300.0,
     chunks: int = 1,
     double_buffer: bool = False,
+    quantize: str = "none",
 ):
     """Spawn ``n_parties - 1`` server processes + one session client (all
     one jax.distributed group) and run an N-party collective-method-plane
@@ -896,7 +985,10 @@ def orchestrate_session(
     what the run proves. ``chunks``/``double_buffer`` run the session on
     the overlap schedule (chunked sub-collectives, two step slots in
     flight) — byte-identity against the integer model still gates.
-    Returns the client's session stats."""
+    ``quantize`` switches the client to the quantized-pmean A/B role:
+    one exact and one quantized session over the same float operands,
+    reporting the wire-bytes ratio and the max error vs the documented
+    bound (the dryrun quantized gate).  Returns the client's stats."""
     ports = _free_ports(n_parties)
     coord, rpc_ports = ports[0], ports[1:]
     specs = []
@@ -914,6 +1006,7 @@ def orchestrate_session(
         "--rpc-ports", ",".join(map(str, rpc_ports)),
         "--collective-steps", str(steps),
         "--chunks", str(chunks),
+        "--quantize", quantize,
     ]
     if double_buffer:
         client.append("--double-buffer")
@@ -1041,6 +1134,10 @@ def main(argv=None) -> int:
     ap.add_argument("--checkpoint-every", type=int, default=0)  # client
     ap.add_argument("--chunks", type=int, default=1)  # session client
     ap.add_argument("--double-buffer", action="store_true")  # session client
+    # quantized collectives (parallel/quantized): exact vs int8/int4 A/B
+    ap.add_argument(
+        "--quantize", choices=["none", "int8", "int4"], default="none"
+    )  # session client
     args = ap.parse_args(argv)
     if args.proc_id < 0:
         # pair convention: server is the coordinator, client is last

@@ -2323,6 +2323,97 @@ class TestElasticResumeChaosDrill:
         want = session_expected(ops, out["final_steps"])
         assert [bytes(r) for r in out["results"]] == want
 
+    def test_quantized_overlapped_session_resumes_byte_identical(self, mesh):
+        """The quantized-collective composition drill (ISSUE 14): an
+        int8 chunked double-buffered pmean session killed mid-run heals
+        through the SAME elastic path — and because quantized
+        checkpoint rings store the block-quantized representation with
+        power-of-two scales (dequantize→requantize is exactly
+        idempotent), the healed chain's bytes equal an undisturbed
+        run's.  No silent float32 inflation on resume: the retained
+        entry is the quantized twin, at the wire's ~4x discount."""
+        import numpy as np
+
+        from incubator_brpc_tpu.parallel import mc_dispatch, quantized
+        from incubator_brpc_tpu.parallel.mc_collective import _pmean_dm
+        from incubator_brpc_tpu.rpc.device_method import (
+            register_device_method,
+        )
+
+        from incubator_brpc_tpu.rpc.device_method import (
+            lookup_device_method,
+            unregister_device_method,
+        )
+
+        servers, channels, party_ids, spare_dev = mesh
+        width = 256  # 64 floats = 2 blocks; chunks=2 stays block-aligned
+        prev = lookup_device_method("_collective", "pmean")
+        register_device_method("_collective", "pmean", _pmean_dm(width))
+        rng = np.random.default_rng(21)
+        rows = [
+            (rng.standard_normal(width // 4) * (i + 1)).astype(np.float32)
+            for i in range(3)
+        ]
+        operands = [r.tobytes() for r in rows]
+        kw = dict(
+            steps=40,
+            proposer_index=None,
+            timeout_ms=60000,
+            session_deadline_ms=self.DEADLINE_MS,
+            checkpoint_every=2,
+            quantize="int8",
+            chunks=2,
+            double_buffer=True,
+        )
+        mc_dispatch.set_step_hook(lambda step, idx: time.sleep(0.03))
+        try:
+            # the undisturbed control: same schedule, nobody dies
+            control = mc_dispatch.propose_with_recovery(
+                channels[:3], party_ids, "_collective", "pmean",
+                operands, **kw,
+            )
+            killer = threading.Timer(
+                0.35, lambda: (servers[0].stop(), servers[0].join(timeout=3))
+            )
+            killer.start()
+            try:
+                out = mc_dispatch.propose_with_recovery(
+                    channels[:3], party_ids, "_collective", "pmean",
+                    operands, spares=[(channels[3], spare_dev)], **kw,
+                )
+            finally:
+                killer.cancel()
+        finally:
+            mc_dispatch.set_step_hook(None)
+            # restore exactly: a leaked registration shadows the
+            # width-minting pmean resolver for later suites
+            if prev is not None:
+                register_device_method("_collective", "pmean", prev)
+            else:
+                unregister_device_method("_collective", "pmean")
+        assert out["replaced_party_ids"] == [spare_dev]
+        assert out["resumed_from"] is not None and out["resumed_from"] > 0
+        assert out["resumed_from"] % 2 == 0
+        # replay byte-identity for the quantized session killed mid-run
+        for i in range(3):
+            assert out["results"][i] == control["results"][i], (
+                f"slot {i} diverged after quantized resume"
+            )
+        # the wire accounting carried the quantized footprint, counted
+        # over the REPLAYED steps only (the healed run re-moved just
+        # the steps past the resume point)
+        assert out["quantize"] == "int8"
+        replayed = out["final_steps"] - out["resumed_from"]
+        assert out["wire_bytes"] == (
+            quantized.wire_bytes(width, "int8") * 3 * replayed
+        )
+        # and the result sits inside the documented error bound of the
+        # exact mean (steps compound conservatively)
+        exact = np.mean(np.stack(rows), axis=0, dtype=np.float32)
+        bound = quantized.pmean_error_bound(rows, out["final_steps"], "int8")
+        got = np.frombuffer(out["results"][0], dtype=np.float32)
+        assert float(np.abs(got - exact).max()) <= bound
+
     def test_no_spare_falls_back_to_shrink_restart(self, mesh):
         """Without a spare the recovery path is PR-8's: a fresh session
         from step 0 over the survivors only — never a divergent resume."""

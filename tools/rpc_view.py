@@ -172,6 +172,69 @@ def metrics_mode(target: str, interval: float, prefix: str = "") -> int:
     return 0
 
 
+_LINK_SERIES_RE = re.compile(r"^device_link_(\d+)_([a-z0-9_]+?)(\{.*\})?$")
+
+
+def links_table(values: dict) -> list:
+    """Rows for every device link in one /brpc_metrics scrape: the
+    per-link rtt/bytes-per-second recorders (PR 1) grouped by link id —
+    the scrape-side rendering of ``DeviceLinkMap.link_profile()``, so an
+    operator can see the same speeds the topology-aware session
+    scheduler orders by."""
+    links: dict = {}
+    for key, val in values.items():
+        m = _LINK_SERIES_RE.match(key)
+        if m is None:
+            continue
+        link_id, field, label = int(m.group(1)), m.group(2), m.group(3)
+        row = links.setdefault(link_id, {})
+        if field == "step_rtt_us" and label == '{quantile="0.99"}':
+            row["rtt_p99_us"] = val
+        elif field == "step_rtt_us_sum":
+            row["rtt_sum"] = val
+        elif field == "step_rtt_us_count":
+            row["steps"] = val
+        elif field == "out_bytes_second" and not label:
+            row["out_bps"] = val
+        elif field == "in_bytes_second" and not label:
+            row["in_bps"] = val
+    out = []
+    for link_id in sorted(links):
+        row = links[link_id]
+        steps = row.get("steps", 0.0)
+        rtt = (row.get("rtt_sum", 0.0) / steps) if steps else 0.0
+        out_bps = row.get("out_bps", 0.0)
+        in_bps = row.get("in_bps", 0.0)
+        out.append(
+            f"device_link_{link_id}: rtt={rtt:.1f}us "
+            f"p99={row.get('rtt_p99_us', 0.0):.1f}us "
+            f"steps={int(steps)} out={out_bps:.0f}B/s in={in_bps:.0f}B/s "
+            f"gbps={(out_bps + in_bps) / 1e9:.6f}"
+        )
+    return out
+
+
+def links_mode(target: str) -> int:
+    """Print the target's per-device-link telemetry (rtt + bytes/s per
+    direction + GB/s) — the measured speeds the scheduler uses."""
+    host, _, port = target.rpartition(":")
+    if not host or not port.isdigit():
+        print(f"bad --target {target!r} (want host:port)", file=sys.stderr)
+        return 2
+    try:
+        values, _types = scrape_metrics(target, prefix="device_link_")
+    except OSError as e:
+        print(f"rpc_view: target {target} unreachable: {e}", file=sys.stderr)
+        return 1
+    lines = links_table(values)
+    print(f"# device links of {target} — {len(lines)} live")
+    for line in lines:
+        print(line)
+    if not lines:
+        print("# (no per-link series: no live device links, or all retired)")
+    return 0
+
+
 def scrape_rpcz(
     target: str,
     trace_id: str = "",
@@ -380,6 +443,12 @@ def main(argv=None) -> int:
         "(or one trace tree with --trace-id)",
     )
     p.add_argument(
+        "--links",
+        action="store_true",
+        help="scrape --target's per-device-link telemetry (rtt + bytes/s "
+        "+ GB/s per link — what the topology-aware scheduler orders by)",
+    )
+    p.add_argument(
         "--trace-id",
         default="",
         help="rpcz mode: assemble and print this trace (hex) as a tree",
@@ -397,6 +466,10 @@ def main(argv=None) -> int:
     )
     args = p.parse_args(argv)
 
+    if args.links:
+        if not args.target:
+            p.error("--links requires --target host:port")
+        return links_mode(args.target)
     if args.rpcz:
         if not args.target:
             p.error("--rpcz requires --target host:port")
