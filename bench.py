@@ -334,6 +334,49 @@ def bench_native_plane(results: dict) -> None:
         results["prpc_pump_qps"] = 1e9 / best
     finally:
         nchp.close()
+
+    # traced flood on the same plane (ISSUE 15): every frame carries the
+    # Dapper trace fields + the head-based sampled bit in its
+    # RpcRequestMeta (the pump's counter-scheduled traced template), and
+    # the cutter decodes them natively — BEFORE this PR the same wire
+    # shape fell off to the ~35 us Python route (the ~60x observability
+    # tax ROADMAP item 1 names).  Acceptance: within ~1.15x of the bare
+    # pump, cb_frames == 0 (checked in tests/test_tracing.py).
+    from incubator_brpc_tpu.utils.flags import flag_registry as _freg
+    from incubator_brpc_tpu.utils.flags import set_flag_unchecked as _setf
+
+    old_rpcz = _freg.get("enable_rpcz")
+    _setf("enable_rpcz", True)  # production-shaped: spans actually collect
+    ncht = np_mod.NativeClientChannel(
+        "127.0.0.1", server.port, protocol="baidu_std"
+    )
+    try:
+        ncht.pump("bench", "echo", payload, 2000, inflight=64)  # warm
+        # INTERLEAVED bare/traced rounds: the ratio is the claim, and on
+        # a shared host back-to-back blocks would attribute scheduler
+        # noise to the trace seam — each round flips the template
+        bare_i, traced = [], []
+        for _ in range(5):
+            ncht.set_trace(trace_id=0, every=0)
+            bare_i.append(
+                ncht.pump("bench", "echo", payload, 50000, inflight=128)
+            )
+            ncht.set_trace(
+                trace_id=0xBE7C4, span_id=1, parent_span_id=0x1,
+                sampled=1, every=1,
+            )
+            traced.append(
+                ncht.pump("bench", "echo", payload, 50000, inflight=128)
+            )
+        _record("prpc_traced_pump_ns", traced)
+        results["prpc_traced_pump_ns"] = min(traced)
+        results["prpc_traced_vs_bare"] = min(traced) / min(bare_i)
+        cb = server._native_plane.stats()["cb_frames"]
+        results["prpc_traced_cb_frames"] = cb
+        assert cb == 0, "traced pump frames fell off the fast path"
+    finally:
+        ncht.close()
+        _setf("enable_rpcz", old_rpcz)
     server.stop()
 
     # the telemetry tax: prpc_pump_ns above runs with the completion-record
@@ -1258,6 +1301,7 @@ BASELINES = {
     "fabricnet_mfu": "vs v5e peak bf16 197 TFLOP/s",
     "native_pump_notes": "template-pack + pooled body reuse + meta memo; 1 shared core, both sides",
     "native_pump_scaling": "r05 one-core baseline: 544 ns/echo, ~1.9 M qps with client AND server sharing ONE core, and BENCH_r04's flat 1/2/4-conn curve (~1 M qps each — one loop thread was the ceiling). The matrix is R reactors x C connections (aggregate qps); scaling_efficiency = best 4-reactor / best 1-reactor. The reference scales 3-5 M qps/thread across 24 cores (docs/cn/benchmark.md:112-122); on this host the reachable ratio is capped by host_cpus, since the C client pumps burn the same cores the reactors serve from",
+    "prpc_traced_pump": "every frame of the traced pump carries RpcRequestMeta trace fields 3-6 + the field-9 sampled bit (ISSUE 15) and is decoded/dispatched natively with rpcz ON — the per-frame cost over the bare pump is the trace decode + the name-keyed memo (the byte memo can't hit per-call span ids) + the 64-byte (vs 48) completion record + forced span collection on the drain; bare/traced rounds are INTERLEAVED so prpc_traced_vs_bare survives shared-host noise; acceptance ~1.15x of the bare pump with cb_frames == 0. Measured at introduction on this 2-core container (host_calibration_ms ~6.5): prpc_traced_pump_ns 1735 vs bare 1631 interleaved = 1.06x, cb_frames 0. BEFORE this PR any nonzero trace id routed the frame to the ~35 us Python route: same host (2026-08-03, host_calibration_ms ~6.4), a traced per-call echo was ~186 us vs ~92 us untraced per-call and ~1.1 us bare pump, with cb_frames == 100% of traced requests — the before-number for the Python-routed traced echo",
     "prpc_pump_telemetry": "prpc_pump_ns runs with the native telemetry ring ON (the default: per-method latency + sampled rpcz + limiter feedback recorded in-path); prpc_pump_notelem_ns is the same pump ring-less — the delta is the instrumentation tax (acceptance < 5%)",
     "prpc_production_shaped": "compressed and/or authenticated PRPC floods ride the native codec/auth seam end to end (PR 11); BEFORE this seam the same wire shape fell off to the ~35 us Python route — r05-era context: prpc_pump_ns 544 ns vs rpc-over-Python ~35 us, a ~60x tax on production-shaped traffic. Measured on this 2-core container at introduction (host_calibration_ms ~6.4): prpc_plain_4k_pump_ns ~2.3 us, prpc_compressed_pump_ns (snappy+auth, 4 KiB compressible) ~4.2-4.8 us = ~1.9-2.0x of the bare same-size pump (acceptance ~2x; incompressible ~1.3x, auth-only within noise of bare — the steady-state token check is one cached-verdict load), the L5 crossing rpc_echo_prpc_snappy_us ~130 us, and rpc_echo_prpc_snappy_python_us ~950 us — the Python-plane before-number for the SAME wire shape, ~200x the interpreter-free pump and ~7x the native L5 row; compare medians WITH host_calibration_ms context per the PR 10 re-anchor note",
     "fabricnet_overlap": "T3 compute/communication overlap (ISSUE 13): serialized vs overlapped are the SAME sliced microbatch schedule (identical ops, bit-identical losses — asserted) differing only in the optimization_barrier that pins each slice's gradient collectives before the next slice's forward; the idle-gap row is per-step ms the barrier costs. HONEST HOST NOTE: on a 1-device mesh the cross-party psums are trivial, and on a 2-core CPU container XLA has no second compute stream to hide collectives behind — the gap here measures scheduling freedom, not ICI overlap; read it as overlapped >= serialized plus the multi-device mc_session rows, with host_calibration_ms context, per the PR 10 re-anchor discipline. The config stays at bench scale everywhere (a scaled-down CPU config measured the gap inside noise); on a CPU backend only the scan length halves (fabricnet_overlap_config records dims + scan length; emulated bf16 runs this config at ~20 s/step) — compare rows only at matching configs. The >= 85% MFU acceptance belongs to a real multi-chip mesh. Measured at introduction on this CPU container (host_calibration_ms 6.27): serialized 20078 ms/step vs overlapped 19859 at n10 (idle gap 219 ms/step) and 20445 vs 20370 at the shipped n5 (gap 74 ms/step), bit-identical losses both; mc_session chunked 2-party A/B: per-step ms statistically tied across schedules on this host (0.56-1.03 run-to-run spread swamps the delta — CPU XLA runs collectives inline, nothing to hide them behind), while mc_dispatch_overlap_ratio 0.92-0.94 (double-buffered arm only — the serialized control's never-overlapped chunks are excluded from the denominator) shows the schedule itself kept ~15/16 chunk dispatches in flight past the predecessor's ack",

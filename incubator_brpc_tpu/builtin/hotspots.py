@@ -20,14 +20,36 @@ from collections import Counter
 from typing import Dict, List, Tuple
 
 _profile_lock = threading.Lock()  # one profiling run at a time
+# monotonic deadline of the run currently holding _profile_lock (0 = no
+# run): lets a refused caller compute an honest Retry-After instead of
+# guessing — read without the lock (a torn read only skews the hint)
+_profile_until = 0.0
+
+
+class ProfileInProgress(RuntimeError):
+    """Another sampling run holds ``_profile_lock``; ``retry_after_s``
+    estimates when it finishes (the HTTP side turns this into a 503 +
+    Retry-After instead of surfacing a raw error)."""
+
+    def __init__(self, retry_after_s: float) -> None:
+        self.retry_after_s = max(1.0, retry_after_s)
+        super().__init__(
+            "another profiling run is in progress "
+            f"(retry in ~{self.retry_after_s:.0f}s)"
+        )
 
 
 def sample_cpu(seconds: float = 1.0, hz: int = 100) -> Dict[str, object]:
     """Sample all threads' stacks for ``seconds`` at ``hz``. Returns
-    {samples, stacks: [(count, stack_text)], flat: [(count, leaf)]}."""
+    {samples, stacks: [(count, stack_text)], flat: [(count, leaf)]}.
+    One run at a time: a concurrent caller gets :class:`ProfileInProgress`
+    (with a retry estimate) immediately — the lock is never waited on, so
+    an HTTP scrape can't pile threads up behind a long window."""
+    global _profile_until
     if not _profile_lock.acquire(blocking=False):
-        raise RuntimeError("another profiling run is in progress")
+        raise ProfileInProgress(_profile_until - time.monotonic())
     try:
+        _profile_until = time.monotonic() + max(0.01, seconds)
         me = threading.get_ident()
         interval = 1.0 / max(1, hz)
         stacks: Counter = Counter()
@@ -55,6 +77,7 @@ def sample_cpu(seconds: float = 1.0, hz: int = 100) -> Dict[str, object]:
             "flat": flat.most_common(),
         }
     finally:
+        _profile_until = 0.0
         _profile_lock.release()
 
 

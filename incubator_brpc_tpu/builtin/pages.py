@@ -3,7 +3,9 @@ index, vars, status, flags, rpcz, connections, health, version — wired
 into every server automatically by Server::AddBuiltinServices,
 server.cpp:433).
 
-Each page is ``fn(server, frame) -> (status, content_type, body_bytes)``.
+Each page is ``fn(server, frame) -> (status, content_type, body_bytes)``
+— optionally with a fourth element, a ``{header: value}`` dict of extra
+response headers (Retry-After on a 503, etc.).
 User handlers registered via ``Server.add_http_handler`` are consulted
 after the builtin table (the reference forbids shadowing builtins too).
 """
@@ -354,12 +356,29 @@ def _hotspots(server, frame) -> Resp:
         if folded:
             return 200, "text/plain", hotspots.render_contention_folded().encode()
         return 200, "text/plain", hotspots.render_contention_text().encode()
+    # the sampling window is remote-controlled: clamp it to [0.05, 10] s
+    # (and reject NaN/inf) so a scrape can't pin a server thread for
+    # minutes with ?seconds=600 — the reference bounds its profiling
+    # windows the same way
     try:
-        seconds = min(10.0, float(frame.query.get("seconds", "1")))
+        seconds = float(frame.query.get("seconds", "1"))
+        if math.isnan(seconds):
+            raise ValueError
     except ValueError:
         return 400, "text/plain", b"bad seconds\n"
+    seconds = min(10.0, max(0.05, seconds))
     try:
         result = hotspots.sample_cpu(seconds=seconds)
+    except hotspots.ProfileInProgress as e:
+        # 503-with-retry, not an exception trace: one run at a time is
+        # the contract, and the Retry-After tells the scraper when the
+        # current window ends
+        return (
+            503,
+            "text/plain",
+            f"{e}\n".encode(),
+            {"Retry-After": str(int(math.ceil(e.retry_after_s)))},
+        )
     except RuntimeError as e:
         return 503, "text/plain", f"{e}\n".encode()
     if folded:

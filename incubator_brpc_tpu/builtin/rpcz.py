@@ -101,11 +101,34 @@ class SpanStore:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._spans: deque = deque(maxlen=int(get_flag("rpcz_max_spans")))
+        # trace_id -> [spans], maintained at submit/eviction so
+        # ``by_trace`` (the /rpcz?trace_id= query and the fleet puller)
+        # is an O(spans-in-trace) lookup instead of an O(ring) scan
+        # under the store lock — a fleet assembly pull must not stall
+        # the submit path every hot drain races
+        self._by_trace: dict = {}
         # the file has no shared invariant with the ring: its own lock, so
         # disk flushes never stall ring submits or /rpcz queries
         self._db_lock = threading.Lock()
         self._db_file = None
         self._db_path = ""
+
+    def _index_add(self, span: Span) -> None:
+        if span.trace_id:
+            self._by_trace.setdefault(span.trace_id, []).append(span)
+
+    def _index_drop(self, span: Span) -> None:
+        if not span.trace_id:
+            return
+        bucket = self._by_trace.get(span.trace_id)
+        if bucket is None:
+            return
+        try:
+            bucket.remove(span)
+        except ValueError:
+            pass
+        if not bucket:
+            del self._by_trace[span.trace_id]
 
     def submit(self, span: Span) -> None:
         # re-check the ring-size flag per submit: ``rpcz_max_spans`` is
@@ -131,6 +154,11 @@ class SpanStore:
 
         with self._lock:
             if self._spans.maxlen != maxlen:
+                if maxlen is not None and len(self._spans) > maxlen:
+                    # the shrink evicts from the left: drop those spans
+                    # from the trace index too
+                    for old in list(self._spans)[: len(self._spans) - maxlen]:
+                        self._index_drop(old)
                 self._spans = deque(self._spans, maxlen=maxlen)
             # walk stale wall-clock spans off the left; exempt
             # (non-wall-time) heads are set aside so they don't shield
@@ -145,12 +173,22 @@ class SpanStore:
                     exempt_heads.append(self._spans.popleft())
                     continue
                 if head.start_real_us + head.latency_us < horizon_us:
-                    self._spans.popleft()
+                    self._index_drop(self._spans.popleft())
                     continue
                 break  # completion-ordered: the rest are fresher
             while exempt_heads:
                 self._spans.appendleft(exempt_heads.pop())
+            if (
+                self._spans.maxlen is not None
+                and len(self._spans) == self._spans.maxlen
+                and self._spans
+            ):
+                # deque(maxlen) evicts the head SILENTLY on append —
+                # capture it first or the index leaks the evicted span
+                self._index_drop(self._spans[0])
             self._spans.append(span)
+            if self._spans and self._spans[-1] is span:
+                self._index_add(span)  # maxlen=0 discards the append
         dbdir = str(get_flag("rpcz_database_dir"))
         if dbdir:
             self._persist(dbdir, span)
@@ -201,12 +239,15 @@ class SpanStore:
             return list(self._spans)[-limit:]
 
     def by_trace(self, trace_id: int) -> List[Span]:
+        # O(spans-in-trace) via the index maintained at submit/eviction
+        # (a full-ring scan here stalled the submit path under the lock)
         with self._lock:
-            return [s for s in self._spans if s.trace_id == trace_id]
+            return list(self._by_trace.get(trace_id, ()))
 
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
+            self._by_trace.clear()
 
     def __len__(self) -> int:
         with self._lock:
@@ -430,6 +471,18 @@ def in_trace_context() -> bool:
     return getattr(_tls, "parent_span", None) is not None
 
 
+def current_trace_context():
+    """The ambient (thread-local) trace context, or ``(0, 0)``: the
+    active server span's ``(trace_id, span_id)`` — what a piece of
+    non-RPC work started inside a handler (a collective session
+    proposal, a background pump) should stamp on ITS outbound calls so
+    the whole fan-out joins the caller's trace."""
+    parent: Optional[Span] = getattr(_tls, "parent_span", None)
+    if parent is None:
+        return 0, 0
+    return parent.trace_id, parent.span_id
+
+
 def rpcz_enabled() -> bool:
     return bool(get_flag("enable_rpcz"))
 
@@ -440,10 +493,16 @@ def rpcz_enabled() -> bool:
 def start_client_span(cntl) -> Optional[Span]:
     """Create a sampled client span; always propagates trace ids into the
     controller (so downstream server spans correlate even when this hop
-    doesn't sample)."""
+    doesn't sample).  Also decides the HEAD-BASED sampled bit for the
+    wire (``cntl.trace_sampled``): set when this hop collects a span, or
+    when it is inside an already-sampled trace (the ambient server span
+    exists, or the caller pre-set the bit) — the decision is made once
+    at the edge and then propagated like the deadline, so a sampled
+    trace yields spans at EVERY hop instead of an incoherent scatter."""
     parent: Optional[Span] = getattr(_tls, "parent_span", None)
     if parent is not None:
         cntl.trace_id = parent.trace_id
+        cntl.parent_span_id = parent.span_id
         if not cntl.span_id:
             cntl.span_id = _new_id()
     elif not cntl.trace_id:
@@ -451,19 +510,24 @@ def start_client_span(cntl) -> Optional[Span]:
         cntl.span_id = _new_id()
     elif not cntl.span_id:
         cntl.span_id = _new_id()
-    if not rpcz_enabled() or not _limiter.grab():
-        return None
-    return Span(
-        trace_id=cntl.trace_id,
-        span_id=cntl.span_id,
-        parent_span_id=parent.span_id if parent is not None else 0,
-        span_type=SPAN_TYPE_CLIENT,
-        service=cntl._service,
-        method=cntl._method,
-        log_id=cntl.log_id,
-        start_real_us=int(time.time() * 1e6),
-        request_size=len(cntl._request_payload),
-    )
+    span = None
+    if rpcz_enabled() and _limiter.grab():
+        span = Span(
+            trace_id=cntl.trace_id,
+            span_id=cntl.span_id,
+            parent_span_id=parent.span_id if parent is not None else 0,
+            span_type=SPAN_TYPE_CLIENT,
+            service=cntl._service,
+            method=cntl._method,
+            log_id=cntl.log_id,
+            start_real_us=int(time.time() * 1e6),
+            request_size=len(cntl._request_payload),
+        )
+    if span is not None or parent is not None:
+        # this hop sampled, or the serving span upstream did: the bit
+        # rides the wire so downstream hops sample coherently
+        cntl.trace_sampled = 1
+    return span
 
 
 def end_client_span(cntl) -> None:
@@ -482,7 +546,13 @@ def end_client_span(cntl) -> None:
 
 
 def start_server_span(cntl, meta) -> Optional[Span]:
-    if not rpcz_enabled() or not _limiter.grab():
+    """Server span for one request.  The wire's head-based sampled bit
+    (``meta.sampled`` — RpcRequestMeta field 9 / the tbus ``sampled``
+    key) OVERRIDES the local token-bucket election: the edge already
+    decided this trace is observed, so this hop must not break it (the
+    edge's own limiter bounded how many traces start sampled)."""
+    forced = bool(getattr(meta, "sampled", 0))
+    if not rpcz_enabled() or (not _limiter.grab() and not forced):
         return None
     span = Span(
         trace_id=meta.trace_id or _new_id(),
@@ -514,13 +584,16 @@ def start_custom_span(
     method: str,
     trace_id: int = 0,
     parent_span_id: int = 0,
+    forced: bool = False,
 ) -> Optional[Span]:
     """Sampled span for non-RPC work (collective sessions, background
     pumps). With no explicit ids it parents to this thread's active server
     span (the tls_bls.rpcz_parent_span rule, span.h:72-75); a caller that
     has the proposing RPC's ids passes them so the span lands in the
-    client's trace even across the async handoff."""
-    if not rpcz_enabled() or not _limiter.grab():
+    client's trace even across the async handoff.  ``forced`` is the
+    head-based coherent-sampling override: work inside a trace the edge
+    already sampled must not drop its span to a dry local bucket."""
+    if not rpcz_enabled() or (not _limiter.grab() and not forced):
         return None
     parent: Optional[Span] = getattr(_tls, "parent_span", None)
     if not trace_id and parent is not None:

@@ -62,6 +62,10 @@ class TelemetryRecord(ctypes.Structure):
         ("response_size", ctypes.c_uint32),
         ("sampled", ctypes.c_uint32),
         ("reactor_id", ctypes.c_uint32),
+        # wire-propagated trace context (0 = the request carried none):
+        # the drain parents the server span into the caller's trace
+        ("trace_id", ctypes.c_uint64),
+        ("span_id", ctypes.c_uint64),
     ]
 
 
@@ -379,6 +383,13 @@ SIGNATURES = {
         ctypes.c_int,
         [b] + [ctypes.c_uint32] * 5,
     ),
+    # ambient trace context for the pipelined pump: every Nth frame
+    # carries the Dapper fields (counter-scheduled, exact-rate like the
+    # fault seam), span_id incremented per traced frame
+    "tb_channel_set_trace": (
+        ctypes.c_int,
+        [b] + [ctypes.c_uint64] * 4 + [ctypes.c_int, ctypes.c_uint32],
+    ),
     "tb_channel_call": (
         ctypes.c_long,
         [
@@ -473,6 +484,12 @@ SIGNATURES = {
             ctypes.c_void_p,
             ctypes.c_size_t,
             ctypes.POINTER(ctypes.c_size_t),
+            # trace out-params (RpcRequestMeta 3/4/5/6 + field-9 sampled)
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint32),
         ],
     ),
     # ---- work-stealing deque (Chase–Lev; the dispatch pool's queue) ----

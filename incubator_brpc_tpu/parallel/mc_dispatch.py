@@ -1502,6 +1502,7 @@ def _start_session_span(
     parent_span_id: int = 0,
     resume_from: int = 0,
     extra: str = "",
+    forced: bool = False,
 ):
     from incubator_brpc_tpu.builtin.rpcz import (
         SPAN_TYPE_COLLECTIVE,
@@ -1514,6 +1515,7 @@ def _start_session_span(
         method,
         trace_id=trace_id,
         parent_span_id=parent_span_id,
+        forced=forced,
     )
     if span is not None:
         note = (
@@ -1887,6 +1889,14 @@ def make_dispatch_handler(server):
             service, method, dm.fingerprint(), party_ids, own_index, steps,
             trace_id=cntl.trace_id, parent_span_id=cntl.span_id,
             resume_from=resume_from, extra=quant_note,
+            # the proposal rode in sampled (head-based): this party's
+            # session span must not drop to a dry local bucket, or the
+            # fleet-wide trace loses a whole party
+            forced=bool(
+                getattr(cntl.request_meta, "sampled", 0)
+                if cntl.request_meta is not None
+                else 0
+            ),
         )
         try:
             own_row, own_n, elapsed = run_dispatch_session(
@@ -2181,9 +2191,28 @@ def propose_dispatch(
                     }
         return json.dumps(d).encode()
 
+    # fleet-wide trace: the proposer's ambient trace context (the RPC
+    # handler this proposal runs inside, if any) or a fresh trace id
+    # rides EVERY control RPC of this session, so each party's handler
+    # span + session/step/chunk spans join one cross-process trace —
+    # `rpc_view --trace <id> --targets ...` assembles it.  The sampled
+    # bit propagates the head-based decision: sessions are heavyweight
+    # (one proposal, N parties), so a proposer with rpcz on samples its
+    # sessions at the edge and every party honors that.
+    from incubator_brpc_tpu.builtin.rpcz import (
+        _new_id as _new_trace_id,
+        current_trace_context,
+        rpcz_enabled,
+    )
+
+    amb_trace, amb_parent = current_trace_context()
+    session_trace = amb_trace or (_new_trace_id() if rpcz_enabled() else 0)
+    session_sampled = 1 if session_trace else 0
+    fleet_trace = (session_trace, amb_parent, session_sampled)
+
     def _call(ch, payload):
         # scheduling rides the host plane — the shared control-call shape
-        return _control_call(ch, payload, timeout_ms)
+        return _control_call(ch, payload, timeout_ms, trace=fleet_trace)
 
     # fan-out order: slowest measured link FIRST (TASP) — that party's
     # accept/run RPC needs the longest lead before each barrier; parties
@@ -2334,7 +2363,9 @@ def propose_dispatch(
         # (index=-1 marks the scheduler role)
         sched_span = _start_session_span(
             service, method, fingerprint, party_ids, -1, final,
+            trace_id=session_trace, parent_span_id=amb_parent,
             resume_from=resume_from, extra=sched_extra,
+            forced=bool(session_sampled),
         )
     try:
         if proposer_index is not None:
@@ -2351,7 +2382,9 @@ def propose_dispatch(
 
             span = _start_session_span(
                 service, method, fingerprint, party_ids, proposer_index,
-                final, resume_from=resume_from, extra=sched_extra,
+                final, trace_id=session_trace, parent_span_id=amb_parent,
+                resume_from=resume_from, extra=sched_extra,
+                forced=bool(session_sampled),
             )
             try:
                 own_row, own_n, own_elapsed = run_dispatch_session(
@@ -2364,8 +2397,12 @@ def propose_dispatch(
                     session_epoch=epoch,
                     chunks=chunks, double_buffer=double_buffer,
                     chunk_order=chunk_order,
-                    trace_id=span.trace_id if span is not None else 0,
-                    parent_span_id=span.span_id if span is not None else 0,
+                    trace_id=(
+                        span.trace_id if span is not None else session_trace
+                    ),
+                    parent_span_id=(
+                        span.span_id if span is not None else amb_parent
+                    ),
                 )
             except SessionAborted as e:
                 _end_session_span(span, error_code=ErrorCode.ESESSION)
@@ -2460,9 +2497,12 @@ def propose_dispatch(
     }
 
 
-def _control_call(ch, payload: bytes, timeout_ms: float):
+def _control_call(ch, payload: bytes, timeout_ms: float, trace=None):
     """One control-stream RPC (resume barrier traffic rides the same
-    host-plane method the proposals do)."""
+    host-plane method the proposals do).  ``trace`` is the proposer's
+    ``(trace_id, parent_span_id, sampled)`` fleet-trace context: stamped
+    on the controller so the proposal crosses the wire inside the
+    proposer's trace and every party's spans join it."""
     import threading as _threading
 
     from incubator_brpc_tpu.rpc.controller import Controller
@@ -2470,6 +2510,10 @@ def _control_call(ch, payload: bytes, timeout_ms: float):
 
     cntl = Controller(timeout_ms=timeout_ms)
     cntl._force_host = True
+    if trace is not None and trace[0]:
+        cntl.trace_id = int(trace[0])
+        cntl.parent_span_id = int(trace[1])
+        cntl.trace_sampled = 1 if trace[2] else 0
     ev = _threading.Event()
     ch.call_method(
         HANDSHAKE_SERVICE, DISPATCH_METHOD, payload, cntl=cntl,

@@ -17,6 +17,10 @@ interop-tested against reference binaries over TCP):
     RpcRequestMeta: 1 service_name(str)  2 method_name(str)  3 log_id(i64)
                     4 trace_id(i64)  5 span_id(i64)  6 parent_span_id(i64)
                     8 timeout_ms(i32)  — the propagated deadline budget
+                    9 traced_sampled(i32) — head-based coherent-sampling
+                      bit (this stack's extension; docs/PARITY.md): the
+                      edge's sampling decision rides every hop and
+                      overrides local 1/N election, like the deadline
     RpcResponseMeta: 1 error_code(i32)  2 error_text(str)
 
 CompressType values follow options.proto (NONE=0 SNAPPY=1 GZIP=2 ZLIB=3);
@@ -135,13 +139,16 @@ def encode_request_submeta(
     span_id: int = 0,
     parent_span_id: int = 0,
     timeout_ms: int = 0,
+    sampled: int = 0,
 ) -> bytes:
     """The RpcRequestMeta SUBMESSAGE bytes (RpcMeta field 1) — the single
     source of the request field table, shared by RpcMeta.encode and the
     native client plane (src/tbnet wraps these bytes into a full RpcMeta,
     splicing in its own correlation_id/attachment_size, so native frames
     stay byte-identical to this codec's pack_request). ``timeout_ms`` is
-    the propagated deadline budget (RpcRequestMeta field 8)."""
+    the propagated deadline budget (RpcRequestMeta field 8); ``sampled``
+    is the head-based coherent-sampling bit (field 9) — propagated once
+    from the edge, it forces span collection at every hop."""
     return (
         _f_bytes(1, service.encode())
         + _f_bytes(2, method.encode())
@@ -150,6 +157,7 @@ def encode_request_submeta(
         + _f_varint(5, span_id)
         + _f_varint(6, parent_span_id)
         + _f_varint(8, timeout_ms)
+        + _f_varint(9, 1 if sampled else 0)
     )
 
 
@@ -167,6 +175,7 @@ class RpcMeta:
     span_id: int = 0
     parent_span_id: int = 0
     timeout_ms: int = 0
+    sampled: int = 0  # head-based coherent-sampling bit (field 9)
     is_response: bool = False
     error_code: int = 0
     error_text: str = ""
@@ -192,6 +201,7 @@ class RpcMeta:
                 self.span_id,
                 self.parent_span_id,
                 self.timeout_ms,
+                self.sampled,
             )
             out += _tag(1, 2) + _varint(len(sub)) + sub
         out += _f_varint(3, self.compress_type)
@@ -210,16 +220,22 @@ class RpcMeta:
                         m.service_name = bytes(v2).decode(errors="replace")
                     elif f2 == 2 and w2 == 2:
                         m.method_name = bytes(v2).decode(errors="replace")
-                    elif f2 == 3:
-                        m.log_id = v2
-                    elif f2 == 4:
-                        m.trace_id = v2
-                    elif f2 == 5:
-                        m.span_id = v2
-                    elif f2 == 6:
-                        m.parent_span_id = v2
+                    # trace ids are 64-bit on every plane: masked here so
+                    # an overlong wire varint decodes to the SAME value
+                    # the C++ scanner's u64 arithmetic yields (the
+                    # wire-differential fuzz pins the twins field-exact)
+                    elif f2 == 3 and w2 == 0:
+                        m.log_id = v2 & ((1 << 64) - 1)
+                    elif f2 == 4 and w2 == 0:
+                        m.trace_id = v2 & ((1 << 64) - 1)
+                    elif f2 == 5 and w2 == 0:
+                        m.span_id = v2 & ((1 << 64) - 1)
+                    elif f2 == 6 and w2 == 0:
+                        m.parent_span_id = v2 & ((1 << 64) - 1)
                     elif f2 == 8 and w2 == 0:
                         m.timeout_ms = v2
+                    elif f2 == 9 and w2 == 0:
+                        m.sampled = 1 if v2 else 0
             elif field_no == 2 and wt == 2:
                 m.is_response = True
                 for f2, w2, v2 in _walk_fields(v):
@@ -289,6 +305,7 @@ def rpc_meta_to_meta(rm: RpcMeta) -> Meta:
         trace_id=rm.trace_id,
         span_id=rm.span_id,
         parent_span_id=rm.parent_span_id,
+        sampled=rm.sampled,
         error_text=rm.error_text,
     )
     if rm.authentication_data:
@@ -346,6 +363,8 @@ def pack_request(
         log_id=meta.log_id if meta else 0,
         trace_id=meta.trace_id if meta else 0,
         span_id=meta.span_id if meta else 0,
+        parent_span_id=meta.parent_span_id if meta else 0,
+        sampled=meta.sampled if meta else 0,
         timeout_ms=meta.timeout_ms if meta else 0,
         compress_type=_COMPRESS_TO_WIRE.get(meta.compress if meta else "", 0),
         correlation_id=correlation_id,

@@ -698,8 +698,15 @@ def process_request(sock, frame: HttpFrame) -> None:
 
     server = sock.context.get("server")
     frame.sock = sock  # the rpc gateway threads the connection through
+    extra_headers = None
     try:
-        status, ctype, body = pages.handle(server, frame)
+        resp = pages.handle(server, frame)
+        # handlers return (status, ctype, body) or, when the response
+        # needs headers of its own (Retry-After on a 503, cache
+        # control...), (status, ctype, body, {header: value})
+        status, ctype, body = resp[0], resp[1], resp[2]
+        if len(resp) > 3:
+            extra_headers = resp[3]
     except Exception as e:
         logger.exception("http handler failed for %s", frame.path)
         status, ctype, body = 500, "text/plain", f"error: {e!r}".encode()
@@ -763,13 +770,17 @@ def process_request(sock, frame: HttpFrame) -> None:
                 status,
                 body,
                 content_type=ctype,
+                extra_headers=extra_headers,
                 keep_alive=not close,
             )
             head_only = head_only[: len(head_only) - len(body)]
             sock.write(head_only)
         else:
             sock.write(
-                build_response(status, body, content_type=ctype, keep_alive=not close)
+                build_response(
+                    status, body, content_type=ctype,
+                    extra_headers=extra_headers, keep_alive=not close,
+                )
             )
         if close:
             _close_when_drained(sock)

@@ -85,6 +85,10 @@ class Meta:
     trace_id: int = 0
     span_id: int = 0
     parent_span_id: int = 0
+    # head-based coherent-sampling bit: the edge's sampling decision,
+    # propagated hop to hop like the deadline (the PRPC twin is
+    # RpcRequestMeta field 9); 1 forces span collection at this hop
+    sampled: int = 0
     stream_id: int = 0
     stream_offset: int = 0
     stream_close: bool = False
@@ -116,6 +120,8 @@ class Meta:
             d["span_id"] = self.span_id
         if self.parent_span_id:
             d["parent_span_id"] = self.parent_span_id
+        if self.sampled:
+            d["sampled"] = 1
         if self.stream_id:
             d["stream_id"] = self.stream_id
         if self.stream_offset:
@@ -143,6 +149,7 @@ class Meta:
             m.trace_id = g("trace_id", 0)
             m.span_id = g("span_id", 0)
             m.parent_span_id = g("parent_span_id", 0)
+            m.sampled = 1 if g("sampled", 0) else 0
             m.stream_id = g("stream_id", 0)
             m.stream_offset = g("stream_offset", 0)
             m.stream_close = g("stream_close", False)

@@ -699,15 +699,18 @@ class Channel:
         # captured BEFORE start_client_span stamps fresh ids: a caller
         # continuing an external trace (cntl.trace_id pre-set) is
         # indistinguishable from a generated id afterwards
-        preset_trace = bool(cntl.trace_id or cntl.span_id)
+        preset_trace = bool(
+            cntl.trace_id or cntl.span_id or cntl.trace_sampled
+        )
         cntl._span = start_client_span(cntl)
-        # start_client_span ALWAYS stamps trace ids on the controller, but
-        # putting them on the wire routes the frame to the server's Python
-        # plane (which owns rpcz semantics) — so only do it when the trace
-        # is actually observable: this hop sampled a span, the caller set
-        # a log_id or their own trace ids, or we're inside a server
-        # handler's trace context. Otherwise the ids are write-only noise
-        # and the request keeps the interpreter-free server fast path.
+        # start_client_span ALWAYS stamps trace ids on the controller.
+        # Traced frames now stay on the server's C++ fast path (the
+        # cutter decodes RpcRequestMeta fields 3-6/9 natively and the
+        # telemetry drain parents the server span), but untraced calls
+        # still skip the per-call submeta encode — so stamp the wire only
+        # when the trace is actually observable: this hop sampled a span,
+        # the caller set a log_id or their own trace ids/sampled bit, or
+        # we're inside a server handler's trace context.
         traced = (
             cntl._span is not None
             or bool(cntl.log_id)
@@ -728,6 +731,8 @@ class Channel:
             log_id=cntl.log_id if traced else 0,
             trace_id=cntl.trace_id if traced else 0,
             span_id=cntl.span_id if traced else 0,
+            parent_span_id=cntl.parent_span_id if traced else 0,
+            sampled=cntl.trace_sampled if traced else 0,
             compress=cntl.compress_type or "",
         )
         if rc < 0:
@@ -1025,6 +1030,8 @@ class Channel:
             log_id=cntl.log_id,
             trace_id=cntl.trace_id,
             span_id=cntl.span_id,
+            parent_span_id=cntl.parent_span_id,
+            sampled=cntl.trace_sampled,
             stream_id=(
                 cntl._request_stream.id if cntl._request_stream is not None else 0
             ),

@@ -60,6 +60,11 @@ class Controller:
         self.latency_us: float = 0.0
         self.trace_id: int = 0
         self.span_id: int = 0
+        self.parent_span_id: int = 0
+        # head-based coherent-sampling bit: set by start_client_span (or
+        # preset by the caller) and stamped on the wire — a downstream
+        # hop seeing 1 collects its span regardless of local election
+        self.trace_sampled: int = 0
 
         # -- internals (owned by channel.py / server.py) --
         self._start_ts: float = 0.0

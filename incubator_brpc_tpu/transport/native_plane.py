@@ -74,6 +74,20 @@ _FLAG_CONN_AUTHED = 0x200
 # tb_channel_set_protocol values (tbnet.h)
 _CH_PROTO = {"tbus_std": 0, "baidu_std": 1}
 
+# tb_telemetry_record ABI size — the fourth copy of the layout contract
+# (header struct / ctypes mirror / numpy dtype are cross-checked by
+# fabriclint's ffi-struct pass; fabricscan's plane-parity pass diffs
+# this constant against the static_assert in src/tbnet/tbnet.cc)
+_TELEMETRY_RECORD_BYTES = 64
+
+# sampled-word bit layout (tbnet.cc kTeleSampleBit/kTeleCodecShift/
+# kTeleWireForced): bit 0 = rpcz sample election, bits 1-2 = request
+# codec id, bit 3 = the sampled bit arrived ON THE WIRE (head-based
+# coherent sampling — the edge's decision, which already forced bit 0)
+_TEL_SAMPLE_BIT = 1
+_TEL_CODEC_SHIFT = 1
+_TEL_WIRE_FORCED = 8
+
 # wire CompressType <-> codec names the native plane implements (the
 # baidu_std table restricted to what the C++ codec table speaks)
 _NATIVE_COMPRESS_WIRE = {"snappy": 1, "gzip": 2, "zlib1": 3}
@@ -784,7 +798,12 @@ class NativeServerPlane:
                     ("response_size", "<u4"),
                     ("sampled", "<u4"),
                     ("reactor_id", "<u4"),
+                    ("trace_id", "<u8"),
+                    ("span_id", "<u8"),
                 ]
+            )
+            assert cls._REC_DTYPE.itemsize == _TELEMETRY_RECORD_BYTES, (
+                "telemetry drain dtype drifted from the 64-byte record ABI"
             )
         return cls._REC_DTYPE
 
@@ -906,32 +925,49 @@ class NativeServerPlane:
         for done, full, err, lat in feed:
             server._on_native_completion(full, err, lat, now_us=done)
         if rpcz_mod.rpcz_enabled():
-            # bit 0 = sample election; bits 1-2 = request codec id
-            sampled_idx = np.flatnonzero(arr["sampled"] & 1)
+            # bit 0 = sample election (local 1/N OR wire-forced)
+            sampled_idx = np.flatnonzero(arr["sampled"] & _TEL_SAMPLE_BIT)
             if len(sampled_idx):
                 # wall/monotonic anchor: record timestamps are
                 # CLOCK_MONOTONIC ns, spans carry wall-clock start_real_us
                 wall_anchor_us = time.time() * 1e6
                 mono_anchor_ns = native.monotonic_ns()
-                # fabriclint: allow(hotpath-loop) iterates 1/N sample-flagged records only, and breaks as soon as the rpcz token bucket runs dry
+                # fabriclint: allow(hotpath-loop) iterates 1/N sample-flagged + wire-forced records only (bounded well below batch size)
                 for i in sampled_idx:
                     rec = arr[int(i)]
                     idx = int(rec["method_idx"])
                     if idx >= len(names):
                         continue
+                    sampled_word = int(rec["sampled"])
+                    forced = bool(sampled_word & _TEL_WIRE_FORCED)
                     # the 1/N flag elects; the shared token bucket still
                     # bounds spans/second (rpcz_samples_per_second) like
                     # every other producer — a ring-rate native flood
-                    # must not turn the drain into a disk-append loop
-                    if not rpcz_mod._limiter.grab():
-                        break
+                    # must not turn the drain into a disk-append loop.
+                    # Wire-FORCED records (the edge's head-based decision)
+                    # ride through a dry bucket: coherent sampling means a
+                    # trace sampled at the edge must not lose this hop —
+                    # the edge's own limiter already bounded trace starts.
+                    # CONTINUE (not break) past refused locally-elected
+                    # records: a forced record later in the batch must
+                    # still be scanned, or a dry bucket would tear the
+                    # fleet trace this bit exists to keep coherent.
+                    if not rpcz_mod._limiter.grab() and not forced:
+                        continue
                     service, _, method = names[idx].partition(".")
-                    codec = (int(rec["sampled"]) >> 1) & 3
+                    codec = (sampled_word >> _TEL_CODEC_SHIFT) & 3
+                    # wire trace context: parent the server span into the
+                    # CALLER's trace (the caller's span id becomes this
+                    # span's parent); fresh ids only when the wire
+                    # carried none — a Dapper trace no longer breaks at a
+                    # natively-dispatched hop
+                    wire_trace = int(rec["trace_id"])
+                    wire_span = int(rec["span_id"])
                     rpcz_mod.span_store.submit(
                         rpcz_mod.Span(
-                            trace_id=rpcz_mod._new_id(),
+                            trace_id=wire_trace or rpcz_mod._new_id(),
                             span_id=rpcz_mod._new_id(),
-                            parent_span_id=0,
+                            parent_span_id=wire_span,
                             span_type=rpcz_mod.SPAN_TYPE_SERVER,
                             service=service,
                             method=method,
@@ -1436,6 +1472,37 @@ class NativeClientChannel:
         if rc != 0:  # current C++ always accepts; guard future revs
             raise RuntimeError("tb_channel_set_fault rejected the schedule")
 
+    def set_trace(
+        self,
+        trace_id: int,
+        span_id: int = 0,
+        parent_span_id: int = 0,
+        log_id: int = 0,
+        sampled: int = 1,
+        every: int = 1,
+    ) -> None:
+        """Arm ambient trace context for the pipelined ``pump``
+        (tb_channel_set_trace): every ``every``'th pump frame carries the
+        Dapper fields in its RpcRequestMeta — counter-scheduled exact
+        rate like the fault seam — with a distinct per-frame span id
+        (``span_id + sequence``).  ``sampled=1`` is the head-based
+        coherent-sampling election: every traced frame forces a span at
+        every hop it touches.  baidu_std channels only; ``every=0``
+        disarms."""
+        rc = LIB.tb_channel_set_trace(
+            self._ch,
+            int(log_id) & ((1 << 64) - 1),
+            int(trace_id) & ((1 << 64) - 1),
+            int(span_id) & ((1 << 64) - 1),
+            int(parent_span_id) & ((1 << 64) - 1),
+            1 if sampled else 0,
+            max(0, int(every)),
+        )
+        if rc != 0:
+            raise ValueError(
+                "traced pumps ride the PRPC wire: use protocol='baidu_std'"
+            )
+
     def _meta_bytes(
         self,
         service: str,
@@ -1444,9 +1511,13 @@ class NativeClientChannel:
         log_id: int = 0,
         trace_id: int = 0,
         span_id: int = 0,
+        parent_span_id: int = 0,
+        sampled: int = 0,
         timeout_ms: int = 0,
     ) -> bytes:
-        traced = bool(log_id or trace_id or span_id)
+        traced = bool(
+            log_id or trace_id or span_id or parent_span_id or sampled
+        )
         # the propagated deadline (RpcRequestMeta field 8 / JSON
         # timeout_ms) joins the cache KEY, not the uncached path: clients
         # overwhelmingly reuse one configured timeout per channel, so the
@@ -1465,7 +1536,7 @@ class NativeClientChannel:
             if traced:
                 return encode_request_submeta(
                     service, method, log_id, trace_id, span_id,
-                    timeout_ms=timeout_ms,
+                    parent_span_id, timeout_ms=timeout_ms, sampled=sampled,
                 )
             key = (service, method, timeout_ms)
             m = self._meta_cache.get(key)
@@ -1491,6 +1562,8 @@ class NativeClientChannel:
                 log_id=log_id,
                 trace_id=trace_id,
                 span_id=span_id,
+                parent_span_id=parent_span_id,
+                sampled=sampled,
             ).to_bytes(attachment_size=att_len)
         key = (service, method, timeout_ms)
         m = self._meta_cache.get(key)
@@ -1530,13 +1603,19 @@ class NativeClientChannel:
         log_id: int = 0,
         trace_id: int = 0,
         span_id: int = 0,
+        parent_span_id: int = 0,
+        sampled: int = 0,
         compress: str = "",
     ):
         """One native round trip. Returns (rc, err_code, resp_meta_bytes,
         body: IOBuf) — rc < 0 is a transport errno, err_code the server's
-        RPC error. Nonzero log_id/trace_id/span_id travel in the request
-        meta exactly as the Python packers send them (Dapper
-        propagation).  ``compress`` (baidu_std only) names the codec the
+        RPC error. Nonzero log_id/trace_id/span_id/parent_span_id travel
+        in the request meta exactly as the Python packers send them
+        (Dapper propagation); ``sampled`` is the head-based coherent-
+        sampling bit — set at the edge, it forces span collection at
+        every downstream hop.  Traced frames STAY on the server's C++
+        fast path (the cutter decodes the trace fields natively).
+        ``compress`` (baidu_std only) names the codec the
         CALLER already compressed ``payload`` with — it rides the wire's
         compress_type; the response body comes back as wire bytes (the
         caller decompresses per the response meta)."""
@@ -1553,6 +1632,7 @@ class NativeClientChannel:
         try:
             meta = self._meta_bytes(
                 service, method, len(attachment), log_id, trace_id, span_id,
+                parent_span_id, sampled,
                 timeout_ms=(
                     max(1, int(timeout_ms))
                     if timeout_ms and timeout_ms > 0 else 0

@@ -96,6 +96,13 @@ struct MetaLite {
   std::string method;
   long attachment = 0;
   long timeout_ms = 0;  // propagated deadline budget (0 = none)
+  // Dapper trace context (same keys as protocol/tbus_std.py Meta):
+  // decoded natively so OBSERVED tbus traffic keeps the fast path
+  uint64_t log_id = 0;
+  uint64_t trace_id = 0;
+  uint64_t span_id = 0;
+  uint64_t parent_span_id = 0;
+  uint32_t sampled = 0;  // head-based coherent-sampling bit ("sampled":1)
 };
 
 struct Scan {
@@ -206,9 +213,30 @@ MetaLite scan_meta(const char* s, size_t n) {
       m.timeout_ms = strtol(sc.p, &endp, 10);
       if (endp == sc.p || m.timeout_ms < 0) return m;
       sc.p = endp;
+    } else if (key == "log_id" || key == "trace_id" || key == "span_id" ||
+               key == "parent_span_id" || key == "sampled") {
+      // trace context is native-fast-path territory too: observed
+      // traffic must not pay the interpreter tax (ROADMAP item 1) —
+      // the ids ride the telemetry record, the sampled bit is the
+      // head-based coherent-sampling election
+      sc.ws();
+      if (sc.p >= sc.end || *sc.p == '-') {
+        m.to_python = true;  // negative/odd ids: Python owns the edge case
+        if (!sc.skip_value()) return m;
+      } else {
+        char* endp = nullptr;
+        uint64_t v = strtoull(sc.p, &endp, 10);
+        if (endp == sc.p) return m;
+        sc.p = endp;
+        if (key == "log_id") m.log_id = v;
+        else if (key == "trace_id") m.trace_id = v;
+        else if (key == "span_id") m.span_id = v;
+        else if (key == "parent_span_id") m.parent_span_id = v;
+        else m.sampled = v != 0 ? 1u : 0u;
+      }
     } else {
-      // compress, stream ids, trace ids, error_text, extra...: semantics
-      // the native fast path doesn't implement — Python handles them
+      // compress, stream ids, error_text, extra...: semantics the
+      // native fast path doesn't implement — Python handles them
       if (!sc.skip_value()) return m;
       m.to_python = true;
     }
@@ -232,12 +260,14 @@ MetaLite scan_meta(const char* s, size_t n) {
 //                   4 correlation_id  5 attachment_size
 //                   7 authentication_data  8 stream_settings(msg)
 //   RpcRequestMeta: 1 service_name  2 method_name  3 log_id  4 trace_id
-//                   5 span_id  6 parent_span_id
+//                   5 span_id  6 parent_span_id  8 timeout_ms
+//                   9 traced_sampled (this stack's extension — the
+//                     head-based coherent-sampling bit; docs/PARITY.md)
 //   RpcResponseMeta: 1 error_code  2 error_text
 // Same routing philosophy as the JSON scanner above: the native fast path
-// only vouches for service/method/cid/attachment_size; anything else
-// (compression, tracing ids, auth, streams) routes to Python, which
-// implements the full semantics.
+// vouches for service/method/cid/attachment_size, the propagated deadline,
+// compression, auth, AND the Dapper trace fields; anything else (streams,
+// unknown fields) routes to Python, which implements the full semantics.
 // ---------------------------------------------------------------------------
 
 size_t varint_len(uint64_t v) {
@@ -301,6 +331,14 @@ struct PrpcMeta {
   uint64_t cid = 0;
   long attachment = 0;
   long timeout_ms = 0;  // RpcRequestMeta.timeout_ms (field 8); 0 = none
+  // Dapper trace context (RpcRequestMeta fields 3-6) + the field-9
+  // sampled bit: decoded natively so traced frames keep the fast path;
+  // the ids ride the telemetry record, the bit overrides 1/N election
+  uint64_t log_id = 0;
+  uint64_t trace_id = 0;
+  uint64_t span_id = 0;
+  uint64_t parent_span_id = 0;
+  uint32_t sampled = 0;
   uint32_t error_code = 0;
   // compress_type (field 3): dispatched through the native codec table —
   // out-of-enum values stay here too (run_native answers the clean
@@ -373,9 +411,18 @@ PrpcMeta scan_prpc_meta(const char* s, size_t n) {
               // timeout_ms: the deadline shed runs natively (run_native)
               if (v2 > (1ull << 31)) return m;
               m.timeout_ms = static_cast<long>(v2);
+            } else if (f2 == 3) {  // log_id
+              m.log_id = v2;
+            } else if (f2 == 4) {  // trace_id: the caller's trace
+              m.trace_id = v2;
+            } else if (f2 == 5) {  // span_id: the server span's parent
+              m.span_id = v2;
+            } else if (f2 == 6) {  // parent_span_id
+              m.parent_span_id = v2;
+            } else if (f2 == 9) {  // head-based sampled bit (extension)
+              m.sampled = v2 != 0 ? 1u : 0u;
             } else if (v2 != 0) {
-              // log_id/trace_id/span ids: rpcz semantics live in Python
-              m.to_python = true;
+              m.to_python = true;  // unknown request-meta varint
             }
           } else if (w2 == 1 || w2 == 5) {
             size_t skip = w2 == 1 ? 8 : 4;
@@ -1027,6 +1074,15 @@ struct NetConn : PollObj {
   uint64_t memo_idx = 0;  // fabricscan: owner(loop)
   long memo_attachment = -1;  // -1 = no memo  // fabricscan: owner(loop)
   long memo_timeout = 0;      // timeout_ms of the memoized meta bytes  // fabricscan: owner(loop)
+  // name-keyed second memo for TRACED PRPC frames: their submessage
+  // bytes change every call (span ids), so the byte-keyed memo above
+  // can never hit — this one compares the decoded service/method slices
+  // instead, keeping a traced flood at two memcmps per frame instead of
+  // a per-request flatmap probe + name join (the prpc_traced_pump_ns
+  // gate's margin lives here)
+  std::string memo_svc;  // fabricscan: owner(loop)
+  std::string memo_mth;  // fabricscan: owner(loop)
+  long memo_name_idx = -1;  // -1 = no memo  // fabricscan: owner(loop)
   // stamped once per readable burst (deadline shed baseline + idle reap);
   // written by the loop thread, read by tb_server_close_idle callers
   std::atomic<uint64_t> last_active_ms{0};
@@ -1187,6 +1243,22 @@ inline uint64_t telemetry_ticks() {
 #endif
 }
 
+// The record ABI is checked THREE ways (header struct, ctypes mirror,
+// numpy drain dtype) by fabriclint; this sizeof anchor is the fourth,
+// diffed against native_plane.py's _TELEMETRY_RECORD_BYTES by
+// fabricscan's plane-parity pass so a grown record cannot ship with a
+// stale drain overlay.
+static_assert(sizeof(tb_telemetry_record) == 64,
+              "tb_telemetry_record ABI is 64 bytes (header/ctypes/numpy "
+              "move in lockstep)");
+
+// sampled-word bit layout (mirrored in native_plane._consume_records):
+// bit 0 = rpcz sample election, bits 1-2 = request codec id, bit 3 =
+// the sampled bit arrived ON THE WIRE (head-based coherent sampling)
+constexpr uint32_t kTeleSampleBit = 1u;
+constexpr uint32_t kTeleCodecShift = 1;
+constexpr uint32_t kTeleWireForced = 8u;
+
 struct TelemetryCell {
   std::atomic<uint64_t> seq{0};
   tb_telemetry_record rec;  // fabricscan: owner(shared)
@@ -1229,10 +1301,17 @@ void telemetry_push(TelemetryRing* r, tb_telemetry_record& rec) {
   }
   // the claimed position doubles as the sample counter (exact 1/N
   // without a second atomic on the hot path; drops never claim one).
-  // Bit 0 only: the producer's codec bits (>> 1) ride through untouched.
+  // Bit 0 only: the producer's codec/forced bits (>> 1) ride through
+  // untouched.  A wire-forced record (bit 3: the head-based sampled bit
+  // arrived on the wire) OVERRIDES the local election — the edge's
+  // decision propagates like the deadline, so a trace sampled there
+  // yields spans at every hop instead of an incoherent scatter.
   rec.sampled =
-      (rec.sampled & ~1u) |
-      (r->sample_every != 0 && pos % r->sample_every == 0 ? 1u : 0u);
+      (rec.sampled & ~kTeleSampleBit) |
+      ((rec.sampled & kTeleWireForced) != 0 ||
+               (r->sample_every != 0 && pos % r->sample_every == 0)
+           ? kTeleSampleBit
+           : 0u);
   cell->rec = rec;
   cell->seq.store(pos + 1, std::memory_order_release);
 }
@@ -1264,6 +1343,12 @@ struct ReqCtx {
   long attachment;     // request attachment size (PRPC echo re-stamps it)
   long timeout_ms;     // propagated deadline budget (0 = none rides this)
   uint32_t compress;   // request compress_type (0 = plain; PRPC only)
+  // wire-propagated trace context: the ids land in the telemetry record
+  // (the drain parents this hop's span into the caller's trace), the
+  // sampled bit forces the record's rpcz election (coherent sampling)
+  uint64_t trace_id = 0;
+  uint64_t span_id = 0;
+  uint32_t traced_sampled = 0;
 };
 
 // ---------------------------------------------------------------------------
@@ -1570,13 +1655,14 @@ void append_error(tb_iobuf* out, const ReqCtx& rc, uint32_t code,
 }
 
 // ONE completion-record fill for every dispatch path (inline, pool run,
-// pool shed): the 48-byte ABI has a single writer, so a layout change
+// pool shed): the 64-byte ABI has a single writer, so a layout change
 // cannot silently diverge between the inline and deferred planes.
 void push_completion_record(TelemetryRing* tr, NativeMethod* nm,
                             uint32_t err, uint64_t t_start, uint64_t cid64,
                             size_t req_len, size_t resp_len,
-                            int reactor_id, uint32_t codec) {
+                            int reactor_id, const ReqCtx& rc) {
   if (tr == nullptr) return;
+  const uint32_t codec = rc.compress;
   tb_telemetry_record rec;
   rec.method_idx = nm->index;
   rec.error_code = err;
@@ -1588,11 +1674,17 @@ void push_completion_record(TelemetryRing* tr, NativeMethod* nm,
   rec.response_size = static_cast<uint32_t>(
       resp_len > 0xFFFFFFFFu ? 0xFFFFFFFFu : resp_len);
   // bits 1-2 carry the request's codec id (0 = uncompressed); bit 0 is
-  // the sample election telemetry_push stamps from the claimed position.
+  // the sample election telemetry_push stamps from the claimed position
+  // (bit 3 — the wire-propagated sampled bit — forces it there).
   // Out-of-enum wire values (rejected EREQUEST upstream) record as 0 —
   // a plain mask would alias compress_type=9 onto "snappy" in /rpcz.
-  rec.sampled = (codec <= 3u ? codec : 0u) << 1;
+  rec.sampled = ((codec <= 3u ? codec : 0u) << kTeleCodecShift) |
+                (rc.traced_sampled != 0 ? kTeleWireForced : 0u);
   rec.reactor_id = static_cast<uint32_t>(reactor_id);
+  // wire trace context: the drain parents this hop's server span into
+  // the CALLER's trace (fresh ids are minted only when these are 0)
+  rec.trace_id = rc.trace_id;
+  rec.span_id = rc.span_id;
   telemetry_push(tr, rec);
 }
 
@@ -1683,8 +1775,7 @@ void run_pool_task(WorkTask* t) {
   if (t->t_start != 0)  // dispatch entry: queue wait is in the latency
     push_completion_record(
         t->loop->telemetry.load(std::memory_order_acquire), nm, t_err,
-        t->t_start, cid64, t->req_len, t_resp, t->loop->id,
-        t->rc.compress);
+        t->t_start, cid64, t->req_len, t_resp, t->loop->id, t->rc);
   free(t->req);
   delete t;
 }
@@ -1741,7 +1832,7 @@ void run_native(NetConn* c, NativeMethod* nm, const ReqCtx& rc,
   const size_t req_len = tr != nullptr ? tb_iobuf_size(body) : 0;
   auto telemetry_done = [&](uint32_t err, size_t resp_len) {
     push_completion_record(tr, nm, err, t_start, cid64, req_len, resp_len,
-                           c->loop->id, rc.compress);
+                           c->loop->id, rc);
   };
   // deadline shed (reference server-side timeout_ms handling): budget
   // expired between the frame's ARRIVAL (burst read stamp) and this
@@ -2075,13 +2166,19 @@ FrameStatus process_frames_tbus(NetConn* c) {
           if (s->methods != nullptr &&
               tb_flatmap_get(s->methods, method_key(full, fn), &idx) == 1 &&
               s->native_methods[idx]->full_name == full) {
-            c->memo_meta.assign(cb_meta, hdr.meta_len);
-            c->memo_idx = idx;
-            c->memo_attachment = ml.attachment;
-            c->memo_timeout = ml.timeout_ms;
+            // traced metas never seed the memo (see the PRPC loop: the
+            // ids change per call and the memo'd ReqCtx carries none)
+            if (ml.trace_id == 0 && ml.span_id == 0 && ml.log_id == 0 &&
+                ml.parent_span_id == 0 && ml.sampled == 0) {
+              c->memo_meta.assign(cb_meta, hdr.meta_len);
+              c->memo_idx = idx;
+              c->memo_attachment = ml.attachment;
+              c->memo_timeout = ml.timeout_ms;
+            }
             ReqCtx rc2{kProtoTbus, hdr.cid_lo, hdr.cid_hi,
                        hdr.flags & kFlagBodyCrc, ml.attachment,
-                       ml.timeout_ms, 0};
+                       ml.timeout_ms, 0,
+                       ml.trace_id, ml.span_id, ml.sampled};
             run_native(c, s->native_methods[idx], rc2, scratch, batch);
             tb_iobuf_clear(scratch);
             continue;
@@ -2179,12 +2276,34 @@ FrameStatus process_frames_prpc(NetConn* c) {
       }
       ReqCtx rc{kProtoPrpc, static_cast<uint32_t>(pm.cid),
                 static_cast<uint32_t>(pm.cid >> 32), 0, pm.attachment,
-                pm.timeout_ms, pm.compress};
-      // memo keyed on the request submessage (cid lives outside it)
+                pm.timeout_ms, pm.compress,
+                pm.trace_id, pm.span_id, pm.sampled};
+      const bool traced = pm.trace_id != 0 || pm.span_id != 0 ||
+                          pm.log_id != 0 || pm.parent_span_id != 0 ||
+                          pm.sampled != 0;
+      // memo keyed on the request submessage (cid lives outside it).
+      // Traced submessages never enter the memo: the ids change per
+      // call, and a byte-identical traced repeat hitting a memo seeded
+      // by an UNTRACED frame would drop its trace context — so traced
+      // frames always take the full lookup (they still stay native).
       if (c->memo_attachment >= 0 &&
           pm.req_sub_len == c->memo_meta.size() && pm.req_sub_len > 0 &&
           memcmp(pm.req_sub, c->memo_meta.data(), pm.req_sub_len) == 0) {
         run_native(c, s->native_methods[c->memo_idx], rc, scratch, batch);
+        tb_iobuf_clear(scratch);
+        continue;
+      }
+      // traced frames: the per-call span ids defeat the byte memo, so
+      // route through the NAME-keyed memo (two memcmps) before paying
+      // the full name join + flatmap probe
+      if (traced && c->memo_name_idx >= 0 &&
+          pm.svc_len == c->memo_svc.size() &&
+          pm.mth_len == c->memo_mth.size() && pm.svc != nullptr &&
+          pm.mth != nullptr &&
+          memcmp(pm.svc, c->memo_svc.data(), pm.svc_len) == 0 &&
+          memcmp(pm.mth, c->memo_mth.data(), pm.mth_len) == 0) {
+        run_native(c, s->native_methods[c->memo_name_idx], rc, scratch,
+                   batch);
         tb_iobuf_clear(scratch);
         continue;
       }
@@ -2200,9 +2319,15 @@ FrameStatus process_frames_prpc(NetConn* c) {
         if (s->methods != nullptr &&
             tb_flatmap_get(s->methods, method_key(full, fn), &idx) == 1 &&
             s->native_methods[idx]->full_name == full) {
-          c->memo_meta.assign(pm.req_sub, pm.req_sub_len);
-          c->memo_idx = idx;
-          c->memo_attachment = 0;  // >=0 marks the memo live (PRPC mode)
+          if (!traced) {
+            c->memo_meta.assign(pm.req_sub, pm.req_sub_len);
+            c->memo_idx = idx;
+            c->memo_attachment = 0;  // >=0 marks the memo live (PRPC mode)
+          } else {
+            c->memo_svc.assign(pm.svc, pm.svc_len);
+            c->memo_mth.assign(pm.mth, pm.mth_len);
+            c->memo_name_idx = static_cast<long>(idx);
+          }
           run_native(c, s->native_methods[idx], rc, scratch, batch);
           tb_iobuf_clear(scratch);
           continue;
@@ -2987,6 +3112,16 @@ struct tb_channel {
   uint32_t req_compress = 0;  // fabricscan: owner(init)
   std::string auth_data;  // fabricscan: owner(init)
   std::atomic<bool> auth_proven{false};
+  // ambient trace context for the pipelined pump (tb_channel_set_trace):
+  // every trace_every'th pump frame carries the Dapper fields in its
+  // RpcRequestMeta, span_id incremented per traced frame — counter-
+  // scheduled exact-rate like the fault seam.  Set before concurrent use.
+  uint64_t tr_log_id = 0;  // fabricscan: owner(init)
+  uint64_t tr_trace_id = 0;  // fabricscan: owner(init)
+  uint64_t tr_span_id = 0;  // fabricscan: owner(init)
+  uint64_t tr_parent_span_id = 0;  // fabricscan: owner(init)
+  int tr_sampled = 0;  // fabricscan: owner(init)
+  uint32_t trace_every = 0;  // 0 = untraced pump  // fabricscan: owner(init)
 };
 
 namespace {
@@ -3352,6 +3487,22 @@ int tb_channel_set_fault(tb_channel* ch, uint32_t fail_every,
   return 0;
 }
 
+// fabricscan: role(init)
+int tb_channel_set_trace(tb_channel* ch, uint64_t log_id, uint64_t trace_id,
+                         uint64_t span_id, uint64_t parent_span_id,
+                         int sampled, uint32_t every) {
+  // trace fields ride the PRPC RpcRequestMeta; the tbus pump's meta is
+  // caller-built JSON, so a traced tbus pump has no seam here
+  if (every != 0 && ch->proto != 1) return -1;
+  ch->tr_log_id = log_id;
+  ch->tr_trace_id = trace_id;
+  ch->tr_span_id = span_id;
+  ch->tr_parent_span_id = parent_span_id;
+  ch->tr_sampled = sampled != 0 ? 1 : 0;
+  ch->trace_every = every;
+  return 0;
+}
+
 long tb_channel_call(tb_channel* ch, const void* meta, size_t meta_len,
                      const void* payload, size_t payload_len, const void* att,
                      size_t att_len, uint32_t flags_extra, tb_iobuf* body_out,
@@ -3561,6 +3712,7 @@ long tb_channel_pump(tb_channel* ch, const void* meta, size_t meta_len,
     }
     if (payload_len) memcpy(t + o, payload, payload_len);
   } else {
+    // (tbus template below; the traced PRPC template is built after it)
     tmpl.resize(32 + meta_len + payload_len);
     uint32_t h[8];
     h[0] = kMagic;
@@ -3575,7 +3727,79 @@ long tb_channel_pump(tb_channel* ch, const void* meta, size_t meta_len,
     if (meta_len) memcpy(tmpl.data() + 32, meta, meta_len);
     if (payload_len) memcpy(tmpl.data() + 32 + meta_len, payload, payload_len);
   }
+  // traced-frame template (tb_channel_set_trace): the caller's
+  // RpcRequestMeta submessage grown with the Dapper fields — trace_id /
+  // parent_span_id / log_id / sampled are run-constant minimal varints,
+  // span_id is a padded 10-byte varint patched per traced frame
+  // (span = base + sequence, so every traced request is its own span).
+  // Built ONCE like the plain template; every trace_every'th frame uses
+  // it, the rest the plain one — counter-scheduled exact rate with zero
+  // per-frame re-encoding, which is what keeps a traced flood within a
+  // hair of the bare pump (the prpc_traced_pump_ns bench gate).
+  std::vector<char> ttmpl;
+  size_t tcid_off = 0, tspan_off = 0;
+  const uint32_t trace_every = ch->proto == 1 ? ch->trace_every : 0;
+  if (trace_every != 0) {
+    const uint32_t compress = ch->req_compress;
+    const bool stamp_auth =
+        !ch->auth_data.empty() &&
+        !ch->auth_proven.load(std::memory_order_relaxed);
+    const size_t auth_len = stamp_auth ? ch->auth_data.size() : 0;
+    size_t sub_total =
+        meta_len + (ch->tr_log_id ? 1 + varint_len(ch->tr_log_id) : 0) +
+        (ch->tr_trace_id ? 1 + varint_len(ch->tr_trace_id) : 0) + 1 + 10 +
+        (ch->tr_parent_span_id ? 1 + varint_len(ch->tr_parent_span_id)
+                               : 0) +
+        (ch->tr_sampled ? 2 : 0);
+    size_t meta_total = 1 + varint_len(sub_total) + sub_total +
+                        (compress ? 1 + varint_len(compress) : 0) + 1 + 10 +
+                        (auth_len ? 1 + varint_len(auth_len) + auth_len : 0);
+    ttmpl.resize(kPrpcHeader + meta_total + payload_len);
+    uint8_t* t = reinterpret_cast<uint8_t*>(ttmpl.data());
+    memcpy(t, "PRPC", 4);
+    put_be32(t + 4, static_cast<uint32_t>(meta_total + payload_len));
+    put_be32(t + 8, static_cast<uint32_t>(meta_total));
+    size_t o = kPrpcHeader;
+    t[o++] = 0x0A;  // RpcMeta.request wrapping the grown submessage
+    o += put_varint(t + o, sub_total);
+    if (meta_len) memcpy(t + o, meta, meta_len);
+    o += meta_len;
+    if (ch->tr_log_id) {
+      t[o++] = 0x18;  // RpcRequestMeta.log_id (field 3)
+      o += put_varint(t + o, ch->tr_log_id);
+    }
+    if (ch->tr_trace_id) {
+      t[o++] = 0x20;  // RpcRequestMeta.trace_id (field 4)
+      o += put_varint(t + o, ch->tr_trace_id);
+    }
+    t[o++] = 0x28;  // RpcRequestMeta.span_id (field 5)
+    tspan_off = o;
+    o += 10;  // patched per traced frame
+    if (ch->tr_parent_span_id) {
+      t[o++] = 0x30;  // RpcRequestMeta.parent_span_id (field 6)
+      o += put_varint(t + o, ch->tr_parent_span_id);
+    }
+    if (ch->tr_sampled) {
+      t[o++] = 0x48;  // RpcRequestMeta.traced_sampled (field 9, extension)
+      t[o++] = 1;
+    }
+    if (compress) {
+      t[o++] = 0x18;  // RpcMeta.compress_type (field 3)
+      o += put_varint(t + o, compress);
+    }
+    t[o++] = 0x20;  // RpcMeta.correlation_id (field 4)
+    tcid_off = o;
+    o += 10;  // patched per request
+    if (auth_len) {
+      t[o++] = 0x3A;  // authentication_data (field 7)
+      o += put_varint(t + o, auth_len);
+      memcpy(t + o, ch->auth_data.data(), auth_len);
+      o += auth_len;
+    }
+    if (payload_len) memcpy(t + o, payload, payload_len);
+  }
   auto t0 = std::chrono::steady_clock::now();
+  uint64_t trace_seq = 0;  // counter schedule: frame 0 is traced
   while (done < n && result == 0) {
     // fill the window: pack EVERY frame the window allows, then flush the
     // whole batch with as few writev calls as the kernel accepts (one
@@ -3583,6 +3807,15 @@ long tb_channel_pump(tb_channel* ch, const void* meta, size_t meta_len,
     while (outstanding < inflight && sent < n) {
       uint64_t cid = channel_next_cid(ch);
       if (ch->proto == 1) {
+        if (trace_every != 0 && trace_seq++ % trace_every == 0) {
+          uint8_t* t = reinterpret_cast<uint8_t*>(ttmpl.data());
+          put_varint_fixed10(t + tspan_off, ch->tr_span_id + trace_seq);
+          put_varint_fixed10(t + tcid_off, cid);
+          tb_iobuf_append(frame, ttmpl.data(), ttmpl.size());
+          ++sent;
+          ++outstanding;
+          continue;
+        }
         put_varint_fixed10(
             reinterpret_cast<uint8_t*>(tmpl.data()) + cid_off, cid);
       } else {
@@ -3779,7 +4012,10 @@ long tb_scan_prpc_meta(const void* meta, size_t meta_len,
                        long* timeout_ms_out, uint32_t* compress_out,
                        uint32_t* error_code_out,
                        char* svc_out, size_t svc_cap, size_t* svc_len_out,
-                       char* mth_out, size_t mth_cap, size_t* mth_len_out) {
+                       char* mth_out, size_t mth_cap, size_t* mth_len_out,
+                       uint64_t* log_id_out, uint64_t* trace_id_out,
+                       uint64_t* span_id_out, uint64_t* parent_span_id_out,
+                       uint32_t* sampled_out) {
   PrpcMeta pm = scan_prpc_meta(static_cast<const char*>(meta), meta_len);
   if (!pm.ok) return -1;  // the connection-kill reject verdict
   if (pm.svc_len > svc_cap || pm.mth_len > mth_cap) return -2;
@@ -3792,6 +4028,11 @@ long tb_scan_prpc_meta(const void* meta, size_t meta_len,
   *svc_len_out = pm.svc_len;
   if (pm.mth_len != 0) memcpy(mth_out, pm.mth, pm.mth_len);
   *mth_len_out = pm.mth_len;
+  *log_id_out = pm.log_id;
+  *trace_id_out = pm.trace_id;
+  *span_id_out = pm.span_id;
+  *parent_span_id_out = pm.parent_span_id;
+  *sampled_out = pm.sampled;
   return (pm.to_python ? 1 : 0) | (pm.is_response ? 2 : 0);
 }
 

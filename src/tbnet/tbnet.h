@@ -27,9 +27,14 @@
 //     decompress on cut + floor-honoring recompress on pack) and
 //     authentication_data (verified once per connection — constant-time
 //     token table or registered verifier — rejects answered ERPCAUTH)
-//     are handled here, byte-identical to the Python codecs.  Frames
+//     are handled here, byte-identical to the Python codecs, and so is
+//     trace context: RpcRequestMeta fields 3/4/5/6 (log_id/trace_id/
+//     span_id/parent_span_id, the reference's Dapper fields) plus the
+//     head-based sampled bit (field 9, this stack's extension — see
+//     docs/PARITY.md) decode on the cut path and ride the telemetry
+//     record, so OBSERVED traffic stays on the fast path.  Frames
 //     whose meta carries semantics the fast path doesn't implement
-//     (tracing ids, stream settings, responses) route per-frame to
+//     (stream settings, responses) route per-frame to
 //     Python with flag bit 8 (0x100) set in the callback's `flags` so
 //     the Python side decodes the meta as RpcMeta instead of JSON (bit
 //     9, 0x200, marks a connection whose credential already verified
@@ -84,8 +89,10 @@ typedef int (*tb_auth_fn)(void* ud, const char* auth_data, size_t auth_len,
                           const char* peer_ip, int peer_port);
 
 // One completion record per natively-dispatched request (the telemetry
-// ring's element; see tb_server_set_telemetry).  Field layout is ABI:
-// transport/native_plane.py mirrors it as a ctypes.Structure.
+// ring's element; see tb_server_set_telemetry).  Field layout is ABI —
+// 64 bytes, checked THREE ways (this header, the ctypes.Structure in
+// transport/native_plane.py, and the numpy drain dtype) by fabriclint's
+// ffi-struct pass.
 typedef struct {
   uint32_t method_idx;      // index into the server's native method table
   uint32_t error_code;      // 0 = success (ELIMIT for admission refusals)
@@ -94,8 +101,17 @@ typedef struct {
   uint64_t correlation_id;
   uint32_t request_size;    // payload + attachment bytes
   uint32_t response_size;   // payload + attachment bytes (0 on error)
-  uint32_t sampled;         // counter-based 1/N sample flag (rpcz)
+  // bit 0: rpcz sample election (counter-based 1/N, OR wire-forced);
+  // bits 1-2: request codec id; bit 3: the sampled bit arrived ON THE
+  // WIRE (head-based coherent sampling — the edge's decision, which
+  // overrides the local 1/N election)
+  uint32_t sampled;
   uint32_t reactor_id;      // reactor that cut/dispatched the request
+  // wire-propagated trace context (RpcRequestMeta fields 4/5; 0 = the
+  // request carried none): the drain parents this hop's server span
+  // into the CALLER's trace instead of minting a fresh one
+  uint64_t trace_id;
+  uint64_t span_id;
 } tb_telemetry_record;
 
 // ---- server ----
@@ -299,6 +315,19 @@ int tb_channel_set_compress(tb_channel* ch, int compress_type);
 // the reference's first-request auth fight.  NULL/0 clears.  Set before
 // concurrent use.  Returns 0.
 int tb_channel_set_auth(tb_channel* ch, const void* data, size_t len);
+// Ambient trace context for the pipelined pump (tb_channel_pump):
+// every `every`'th frame of a pump carries the trace fields in its
+// RpcRequestMeta (3 log_id / 4 trace_id / 5 span_id / 6 parent_span_id
+// / 9 sampled) — counter-scheduled exact-rate like the fault seam, so a
+// traced flood is one call.  Per traced frame the span_id is
+// `span_id + sequence` (patched in the pump's fixed-width template), so
+// every traced request is its own child span of `parent_span_id`.
+// `every` 0 disables; 1 = every frame.  baidu_std channels only (the
+// tbus pump meta is caller-built); set before concurrent use.
+// Returns 0, or -1 on a tbus_std channel with every != 0.
+int tb_channel_set_trace(tb_channel* ch, uint64_t log_id, uint64_t trace_id,
+                         uint64_t span_id, uint64_t parent_span_id,
+                         int sampled, uint32_t every);
 // Counter-scheduled client-side fault injection (the native analog of
 // the Python Socket.write seam, rpc/fault_injector.py): every
 // fail_every'th tb_channel_call answers err_code (0 -> EINTERNAL)
@@ -365,14 +394,19 @@ long tb_codec_decompress(int codec, const void* in, size_t in_len,
 // flags bitmask: bit 0 = fields beyond the native fast path's scope
 // (the frame would route to Python), bit 1 = response meta.  On accept
 // every out-param is filled (names copied raw — they may contain NULs;
-// read *svc_len_out/*mth_len_out, not strlen).  Diagnostic surface, not
-// a hot path.
+// read *svc_len_out/*mth_len_out, not strlen).  The trace out-params
+// carry RpcRequestMeta fields 3/4/5/6 (+ the field-9 sampled bit) so
+// the wire-differential fuzz diffs the trace decode too.  Diagnostic
+// surface, not a hot path.
 long tb_scan_prpc_meta(const void* meta, size_t meta_len,
                        uint64_t* cid_out, long* attachment_out,
                        long* timeout_ms_out, uint32_t* compress_out,
                        uint32_t* error_code_out,
                        char* svc_out, size_t svc_cap, size_t* svc_len_out,
-                       char* mth_out, size_t mth_cap, size_t* mth_len_out);
+                       char* mth_out, size_t mth_cap, size_t* mth_len_out,
+                       uint64_t* log_id_out, uint64_t* trace_id_out,
+                       uint64_t* span_id_out, uint64_t* parent_span_id_out,
+                       uint32_t* sampled_out);
 
 // ---- work-stealing deque (Chase–Lev) ----
 // The dispatch pool's per-reactor queue, exported standalone so the

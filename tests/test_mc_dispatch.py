@@ -257,6 +257,56 @@ class TestInProcessSessions:
                 s.stop()
                 s.join(timeout=5)
 
+    def test_party_spans_carry_proposer_trace(
+        self, registered_scale, tuned_flags
+    ):
+        """ISSUE 15 acceptance: every party's collective session span
+        carries the PROPOSER's trace id — the session proposal stamps
+        the fleet trace context on its control RPCs, and each party
+        parents its spans into it (forced by the sampled bit, so no
+        party drops out to a dry local bucket)."""
+        import jax
+
+        from incubator_brpc_tpu.builtin.rpcz import span_store
+        from incubator_brpc_tpu.parallel.mc_dispatch import propose_dispatch
+
+        tuned_flags("enable_rpcz", True)
+        span_store.clear()
+        servers = _collective_servers(2)
+        try:
+            chans = _host_channels(servers)
+            party_ids = [jax.devices()[1].id, jax.devices()[2].id]
+            operands = [bytes(range(40)), bytes(range(100, 180))]
+            out = propose_dispatch(
+                chans, party_ids, "dsvc", "scale", operands,
+                steps=2, proposer_index=None, timeout_ms=60000,
+            )
+            assert out["final_steps"] == 2
+        finally:
+            for s in servers:
+                s.stop()
+                s.join(timeout=5)
+        collective = [
+            sp
+            for sp in span_store.recent(limit=1000)
+            if sp.span_type == "collective"
+        ]
+        # one session span per party (both servers are in-process, so
+        # the shared store holds every party's)
+        assert len(collective) >= 2
+        traces = {sp.trace_id for sp in collective}
+        assert len(traces) == 1 and 0 not in traces, (
+            f"party session spans scattered across traces: {traces}"
+        )
+        # and the parties' handler (server) spans joined the same trace
+        servers_spans = [
+            sp
+            for sp in span_store.by_trace(traces.pop())
+            if sp.span_type == "server"
+        ]
+        assert len(servers_spans) >= 2
+        span_store.clear()
+
     def test_nparty_close_converges_on_max_target(
         self, registered_scale, tuned_flags
     ):

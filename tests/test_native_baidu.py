@@ -323,14 +323,14 @@ class TestCrossClientEcho:
             assert c.ok(), c.error_text
             assert c.response_payload == b"x-" + tag
             assert c.response_attachment == b"A" + tag
-        # both NATIVE-client echoes were served without the interpreter;
-        # the pure-Python client's frames carry rpcz trace ids, which the
-        # C++ parser correctly routes to the Python plane (tracing
-        # semantics live there — same policy as the tbus JSON scanner).
-        # Nobody was handed off: baidu_std is a native protocol now.
+        # ALL three echoes were served without the interpreter — the
+        # pure-Python client's frames carry rpcz trace ids, and the C++
+        # parser now decodes them natively (trace context is a fast-path
+        # citizen; the drain parents the server spans).  Nobody was
+        # handed off: baidu_std is a native protocol.
         stats = srv._native_plane.stats()
-        assert stats["native_reqs"] >= 2
-        assert stats["cb_frames"] >= 1
+        assert stats["native_reqs"] >= 3
+        assert stats["cb_frames"] == 0
         assert stats["handoffs"] == 0
 
     def test_native_baidu_client_against_python_server(self):
@@ -928,8 +928,11 @@ class TestNativeCompressAuth:
         # callback flags into sock.context
         from incubator_brpc_tpu.rpc import ServerOptions
 
+        def py_echo(cntl, request):
+            return request
+
         srv = native_server(
-            {"svc": {"echo": native_echo}},
+            {"svc": {"echo": native_echo, "pyecho": py_echo}},
             options=ServerOptions(
                 native_plane=True, usercode_inline=True, auth=self._auth()
             ),
@@ -941,15 +944,13 @@ class TestNativeCompressAuth:
                 native_plane=True, protocol="baidu_std", auth=self._auth()
             ),
         )
-        # first call authenticates natively
+        # first call authenticates natively (traced frames stay native
+        # now, so a plain-Python-handler method is the route trigger)
         assert ch.call_method("svc", "echo", b"a").ok()
-        # a traced call routes to Python; the credential is no longer on
-        # the wire, so only the cached verdict can admit it
-        from incubator_brpc_tpu.rpc import Controller
-
-        cntl = Controller()
-        cntl.log_id = 42
-        c = ch.call_method("svc", "echo", b"traced", cntl=cntl)
+        # a Python-dispatched method's frame carries no credential (the
+        # first response proved the connection), so only the cached
+        # verdict can admit it
+        c = ch.call_method("svc", "pyecho", b"pyroute")
         assert c.ok(), (c.error_code, c.error_text)
         assert srv._native_plane.stats()["cb_frames"] >= 1
 

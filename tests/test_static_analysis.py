@@ -90,8 +90,9 @@ class TestHeaderParser:
 
         assert set(merged.funcs) == set(native.SIGNATURES)
 
-    def test_telemetry_record_is_48_bytes(self, merged):
-        assert merged.structs["tb_telemetry_record"].size_bits == 48 * 8
+    def test_telemetry_record_is_64_bytes(self, merged):
+        # grown 48 -> 64 in ISSUE 15 (wire trace_id + span_id ride it)
+        assert merged.structs["tb_telemetry_record"].size_bits == 64 * 8
 
     def test_callback_typedefs_present(self, merged):
         assert {
@@ -181,6 +182,36 @@ class TestFfiCheckerCatchesDrift:
         vs = ffi_check.check(tbnet_text=mut)
         assert any(
             v.rule == "ffi-type" and "tb_server_set_auth_tokens" in v.message
+            for v in vs
+        ), _fmt(vs)
+
+    def test_skewed_telemetry_record_layout(self, tbnet_text):
+        # ISSUE 15 acceptance: the record grew 48 -> 64 bytes (trace_id
+        # + span_id); a skewed field width in the header flips the
+        # 3-way struct check red — the ctypes mirror AND the numpy
+        # drain dtype both disagree with the mutated C layout
+        mut = self._mutate(
+            tbnet_text,
+            "  uint64_t trace_id;\n  uint64_t span_id;\n} tb_telemetry_record;",
+            "  uint32_t trace_id;\n  uint32_t span_id;\n} tb_telemetry_record;",
+        )
+        vs = ffi_check.check(tbnet_text=mut)
+        assert any(
+            v.rule == "ffi-struct" and "tb_telemetry_record" in v.message
+            for v in vs
+        ), _fmt(vs)
+
+    def test_skewed_scan_trace_out_param_flips_red(self, tbnet_text):
+        # the grown tb_scan_prpc_meta trace out-params are covered by
+        # the signature gate too: narrowing trace_id_out flips red
+        mut = self._mutate(
+            tbnet_text,
+            "uint64_t* log_id_out, uint64_t* trace_id_out,",
+            "uint64_t* log_id_out, uint32_t* trace_id_out,",
+        )
+        vs = ffi_check.check(tbnet_text=mut)
+        assert any(
+            v.rule == "ffi-type" and "tb_scan_prpc_meta" in v.message
             for v in vs
         ), _fmt(vs)
 
@@ -1404,6 +1435,64 @@ class TestPlaneParityCatchesMutations:
         vs = scan_parity.check(tbnet_text=mut)
         assert any(
             v.rule == "plane-parity" and "hash multiplier" in v.message
+            for v in vs
+        ), _fmt(vs)
+
+    def test_skewed_trace_decode_field_number(self, tbnet_cc_text):
+        # ISSUE 15: the cutter decoding trace_id from the wrong
+        # RpcRequestMeta field would silently break every distributed
+        # trace — the decode-side anchor flips red
+        mut = _mutate_cc(
+            tbnet_cc_text,
+            "} else if (f2 == 4) {  // trace_id: the caller's trace",
+            "} else if (f2 == 14) {  // trace_id: the caller's trace",
+        )
+        vs = scan_parity.check(tbnet_text=mut)
+        assert any(
+            v.rule == "plane-parity" and "trace_id" in v.message
+            for v in vs
+        ), _fmt(vs)
+
+    def test_skewed_sampled_bit_field_number(self, tbnet_cc_text):
+        mut = _mutate_cc(
+            tbnet_cc_text,
+            "} else if (f2 == 9) {  // head-based sampled bit (extension)",
+            "} else if (f2 == 7) {  // head-based sampled bit (extension)",
+        )
+        vs = scan_parity.check(tbnet_text=mut)
+        assert any(
+            v.rule == "plane-parity" and "traced_sampled" in v.message
+            for v in vs
+        ), _fmt(vs)
+
+    def test_skewed_traced_pump_pack_tag(self, tbnet_cc_text):
+        # pack side: the traced pump template stamping log_id under the
+        # wrong tag byte (field 7 instead of 3) must flip red against
+        # encode_request_submeta's field table
+        mut = _mutate_cc(
+            tbnet_cc_text,
+            "t[o++] = 0x18;  // RpcRequestMeta.log_id (field 3)",
+            "t[o++] = 0x38;  // RpcRequestMeta.log_id (field 3)",
+        )
+        vs = scan_parity.check(tbnet_text=mut)
+        assert any(
+            v.rule == "plane-parity"
+            and "traced pump-template field number of log_id" in v.message
+            for v in vs
+        ), _fmt(vs)
+
+    def test_skewed_telemetry_record_size_anchor(self, tbnet_cc_text):
+        # the 48 -> 64 byte record growth, pinned: one side's size
+        # constant left behind flips the parity anchor red
+        mut = _mutate_cc(
+            tbnet_cc_text,
+            "static_assert(sizeof(tb_telemetry_record) == 64,",
+            "static_assert(sizeof(tb_telemetry_record) == 48,",
+        )
+        vs = scan_parity.check(tbnet_text=mut)
+        assert any(
+            v.rule == "plane-parity"
+            and "telemetry record ABI bytes" in v.message
             for v in vs
         ), _fmt(vs)
 

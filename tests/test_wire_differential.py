@@ -64,10 +64,17 @@ def _native_scan(meta: bytes):
     mth = ctypes.create_string_buffer(_CAP)
     sl = ctypes.c_size_t()
     ml = ctypes.c_size_t()
+    log_id = ctypes.c_uint64()
+    trace_id = ctypes.c_uint64()
+    span_id = ctypes.c_uint64()
+    parent_span_id = ctypes.c_uint64()
+    sampled = ctypes.c_uint32()
     rc = LIB.tb_scan_prpc_meta(
         meta, len(meta), ctypes.byref(cid), ctypes.byref(att),
         ctypes.byref(tmo), ctypes.byref(comp), ctypes.byref(ec),
         svc, _CAP, ctypes.byref(sl), mth, _CAP, ctypes.byref(ml),
+        ctypes.byref(log_id), ctypes.byref(trace_id), ctypes.byref(span_id),
+        ctypes.byref(parent_span_id), ctypes.byref(sampled),
     )
     if rc == -1:
         return None
@@ -80,6 +87,11 @@ def _native_scan(meta: bytes):
         "error_code": ec.value,
         "svc": svc.raw[: sl.value],
         "mth": mth.raw[: ml.value],
+        "log_id": log_id.value,
+        "trace_id": trace_id.value,
+        "span_id": span_id.value,
+        "parent_span_id": parent_span_id.value,
+        "sampled": sampled.value,
         "to_python": bool(rc & 1),
         "is_response": bool(rc & 2),
     }
@@ -124,6 +136,13 @@ def _assert_agree(meta: bytes):
     assert nat["timeout_ms"] == py.timeout_ms & _M64, label
     assert nat["error_code"] == py.error_code & 0xFFFFFFFF, label
     assert nat["is_response"] == py.is_response, label
+    # trace context decodes field-exactly on both planes (the Python
+    # decoder masks to u64 exactly like the C++ scanner's arithmetic)
+    assert nat["log_id"] == py.log_id & _M64, label
+    assert nat["trace_id"] == py.trace_id & _M64, label
+    assert nat["span_id"] == py.span_id & _M64, label
+    assert nat["parent_span_id"] == py.parent_span_id & _M64, label
+    assert nat["sampled"] == py.sampled, label
     assert nat["svc"].decode("utf-8", errors="replace") == py.service_name, (
         label
     )
@@ -255,6 +274,85 @@ class TestMetaScannerDifferential:
         ]
         for blob in cases:
             _assert_agree(blob)
+
+    def test_traced_metas_agree_exactly(self):
+        # ISSUE 15: structured metas with Dapper trace fields — the
+        # trace decode branches are new fast-path territory, so the
+        # differential pins them field-exact
+        rng = random.Random(0x15A)
+        for _ in range(300):
+            rm = RpcMeta(
+                service_name="TraceSvc",
+                method_name="Echo",
+                log_id=rng.choice([0, 1, rng.getrandbits(63)]),
+                trace_id=rng.choice([0, 1, rng.getrandbits(63),
+                                     rng.getrandbits(64)]),
+                span_id=rng.choice([0, rng.getrandbits(64)]),
+                parent_span_id=rng.choice([0, rng.getrandbits(63)]),
+                sampled=rng.choice([0, 1]),
+                correlation_id=rng.getrandbits(32),
+            )
+            blob = rm.encode()
+            nat = _native_scan(blob)
+            assert nat is not None, blob.hex()
+            assert not nat["to_python"], (
+                f"a traced meta fell off the fast path: {blob.hex()}"
+            )
+            _assert_agree(blob)
+
+    def test_traced_meta_fuzz_huge_zero_duplicate_varints(self):
+        # the satellite's adversarial trio — huge (overlong/10-byte)
+        # trace varints, zero-valued fields, and DUPLICATED fields
+        # (proto2 last-wins on both planes) — through the differential
+        tag = baidu_std._tag
+        varint = baidu_std._varint
+
+        def sub(fields: bytes) -> bytes:
+            return tag(1, 2) + varint(len(fields)) + fields
+
+        cases = [
+            # huge: 10-byte varints with bits at/beyond 64 (both planes
+            # reduce mod 2^64)
+            sub(tag(4, 0) + b"\xff" * 9 + b"\x01"),
+            sub(tag(5, 0) + b"\x80" * 9 + b"\x7f"),
+            sub(tag(3, 0) + b"\xff" * 9 + b"\x7f"),
+            # overlong-but-small: non-minimal zero (wire-legal)
+            sub(tag(4, 0) + b"\x80\x80\x80\x00"),
+            # zero-valued trace fields: present but 0 — must NOT route
+            # to Python (the pre-ISSUE-15 scanner only fast-pathed the
+            # zero case; now both are native)
+            sub(tag(4, 0) + varint(0) + tag(5, 0) + varint(0)),
+            sub(tag(9, 0) + varint(0)),
+            # duplicates: last wins on both planes
+            sub(tag(4, 0) + varint(111) + tag(4, 0) + varint(222)),
+            sub(tag(5, 0) + varint(1) + tag(5, 0) + varint(0)),
+            sub(tag(9, 0) + varint(1) + tag(9, 0) + varint(0)),
+            # sampled with a huge value: both planes normalize to 1
+            sub(tag(9, 0) + b"\xff" * 9 + b"\x01"),
+            # trace fields with the WRONG wire type (fixed64/fixed32):
+            # ignored by Python, to_python'd by the scanner — values 0
+            sub(tag(4, 1) + b"\x01" * 8),
+            sub(tag(5, 5) + b"\x01" * 4),
+            # truncated trace varint: reject on both planes
+            sub(tag(4, 0) + b"\x80"),
+        ]
+        for blob in cases:
+            _assert_agree(blob)
+        # the duplicate case decodes last-wins, pinned explicitly
+        nat = _native_scan(cases[6])
+        assert nat is not None and nat["trace_id"] == 222
+        # and randomized trace-field soup
+        rng = random.Random(0x15B)
+        for _ in range(400):
+            fields = b""
+            for _ in range(rng.randrange(1, 6)):
+                f = rng.choice([3, 4, 5, 6, 9])
+                v = rng.choice([
+                    0, 1, rng.getrandbits(7), rng.getrandbits(63),
+                    rng.getrandbits(64),
+                ])
+                fields += tag(f, 0) + varint(v)
+            _assert_agree(sub(fields))
 
     def test_native_stricter_rejects_are_exactly_the_caps(self):
         # the three documented clamps DO reject natively while Python

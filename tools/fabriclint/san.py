@@ -59,7 +59,9 @@ ASAN_TESTS = [
     "tests/test_native_baidu.py",
     # differential wire-decoder fuzz (ISSUE 12): random/mutated RpcMeta
     # blobs through the native scanner — exactly the hand-rolled parsing
-    # ASAN exists to watch
+    # ASAN exists to watch.  ISSUE 15 grew it with the traced-meta fuzz
+    # (huge/zero/duplicate trace varints through the trace decode
+    # branches and the traced pump template).
     "tests/test_wire_differential.py",
 ]
 TSAN_TESTS = [

@@ -57,6 +57,7 @@ PY_FILES = {
     "status": os.path.join(PKG, "utils", "status.py"),
     "flags": os.path.join(PKG, "utils", "flags.py"),
     "server": os.path.join(PKG, "rpc", "server.py"),
+    "native_plane": os.path.join(PKG, "transport", "native_plane.py"),
 }
 
 
@@ -172,6 +173,12 @@ _CC_SUB_ATTRS = {
     "method_name": r"m\.mth\b",
     "timeout_ms": r"m\.timeout_ms\b",
     "error_code": r"m\.error_code\b",
+    # Dapper trace context (decode side): the cutter's fast-path fields
+    "log_id": r"m\.log_id\b",
+    "trace_id": r"m\.trace_id\b",
+    "span_id": r"m\.span_id\b",
+    "parent_span_id": r"m\.parent_span_id\b",
+    "traced_sampled": r"m\.sampled\b",
 }
 _PY_DECODE_ATTRS = {
     "compress_type": r"m\.compress_type = ",
@@ -184,6 +191,11 @@ _PY_SUB_ATTRS = {
     "method_name": r"m\.method_name = ",
     "timeout_ms": r"m\.timeout_ms = ",
     "error_code": r"m\.error_code = ",
+    "log_id": r"m\.log_id = ",
+    "trace_id": r"m\.trace_id = ",
+    "span_id": r"m\.span_id = ",
+    "parent_span_id": r"m\.parent_span_id = ",
+    "traced_sampled": r"m\.sampled = ",
 }
 
 
@@ -263,6 +275,22 @@ _CC_PUMP_CTX = {
     "correlation_id": r"cid_off = o",
     "authentication_data": r"put_varint\(t \+ o, auth_len\)",
 }
+# the TRACED pump template's RpcRequestMeta trace tags (decode twin:
+# the scanner's f2 branches; pack twin: encode_request_submeta)
+_CC_PUMP_TRACE_CTX = {
+    "log_id": r"put_varint\(t \+ o, ch->tr_log_id\)",
+    "trace_id": r"put_varint\(t \+ o, ch->tr_trace_id\)",
+    "span_id": r"tspan_off = o",
+    "parent_span_id": r"put_varint\(t \+ o, ch->tr_parent_span_id\)",
+    "traced_sampled": r"RpcRequestMeta\.traced_sampled",
+}
+_PUMP_TRACE_PY = {
+    "log_id": r"_f_varint\((\d+), log_id\)",
+    "trace_id": r"_f_varint\((\d+), trace_id\)",
+    "span_id": r"_f_varint\((\d+), span_id\)",
+    "parent_span_id": r"_f_varint\((\d+), parent_span_id\)",
+    "traced_sampled": r"_f_varint\((\d+), 1 if sampled else 0\)",
+}
 
 
 def _cc_pack_tags(side: _Side, ctxmap: Dict[str, str],
@@ -326,18 +354,37 @@ def _rpc_meta_pack(out, cc: _Side, baidu: _Side) -> None:
         if sem in py:
             _diff(out, f"RpcMeta pump-template field number of {sem}",
                   ccv, cc.path, py[sem], baidu.path)
-    # submeta twins (service/method/timeout) ride encode_request_submeta
+    # submeta twins (service/method/timeout/trace context) ride
+    # encode_request_submeta: the PACK side of every RpcRequestMeta
+    # field is diffed against the C++ scanner's DECODE branch for the
+    # same semantic — a client stamping trace_id into field N that the
+    # cutter decodes from field M is exactly the drift this pins
     cm = _classify_branches(cc, r"\bf2 == (\d+)\b", _CC_SUB_ATTRS, 200)
     for pat, sem in (
         (r"_f_bytes\((\d+), service\.encode\(\)\)", "service_name"),
         (r"_f_bytes\((\d+), method\.encode\(\)\)", "method_name"),
         (r"_f_varint\((\d+), timeout_ms\)", "timeout_ms"),
+        (r"_f_varint\((\d+), log_id\)", "log_id"),
+        (r"_f_varint\((\d+), trace_id\)", "trace_id"),
+        (r"_f_varint\((\d+), span_id\)", "span_id"),
+        (r"_f_varint\((\d+), parent_span_id\)", "parent_span_id"),
+        (r"_f_varint\((\d+), 1 if sampled else 0\)", "traced_sampled"),
     ):
         m = baidu.grab(pat, f"submeta {sem}")
         if m and sem in cm:
             _diff(out, f"RpcRequestMeta field number of {sem}",
                   cm[sem], cc.path,
                   (int(m.group(1)), 0), baidu.path)
+    # the traced pump template packs the same fields natively: its tag
+    # bytes (classified by the emit call that follows each) must agree
+    # with encode_request_submeta's field numbers too
+    pump_trace = _cc_pack_tags(cc, _CC_PUMP_TRACE_CTX,
+                               "tb_channel_pump's traced template")
+    for sem, ccv in pump_trace.items():
+        m = baidu.grab(_PUMP_TRACE_PY[sem], f"submeta {sem}")
+        if m:
+            _diff(out, f"traced pump-template field number of {sem}",
+                  ccv, cc.path, (int(m.group(1)), 0), baidu.path)
 
 
 def _codec_enum(out, cc: _Side, baidu: _Side) -> None:
@@ -482,6 +529,21 @@ def _snappy_constants(out, cc: _Side, snappy: _Side) -> None:
           snappy.path)
 
 
+def _telemetry_record(out, cc: _Side, nplane: _Side) -> None:
+    """The telemetry record ABI size, anchored on BOTH planes: the
+    static_assert in tbnet.cc vs native_plane.py's
+    ``_TELEMETRY_RECORD_BYTES`` (which the drain dtype asserts against
+    at runtime).  fabriclint's ffi-struct pass checks the field-level
+    layout three ways; this is the textual tripwire that a grown record
+    cannot ship with one side's size constant left behind."""
+    _diff(out, "telemetry record ABI bytes",
+          cc.int_at(
+              r"static_assert\(sizeof\(tb_telemetry_record\) == (\d+)",
+              "telemetry record static_assert"), cc.path,
+          nplane.int_at(r"_TELEMETRY_RECORD_BYTES = (\d+)",
+                        "telemetry record size constant"), nplane.path)
+
+
 def _int_expr(s: str) -> Optional[int]:
     s = s.strip().rstrip(",")
     if not re.fullmatch(r"[\d\s*+<u()]+", s):
@@ -545,6 +607,7 @@ def check(tbnet_text: Optional[str] = None,
                       sides["server"], sides["baidu_std"])
     _snappy_constants(out, cc, sides["snappy"])
     _flag_defaults(out, cc, sides["flags"])
+    _telemetry_record(out, cc, sides["native_plane"])
 
     # exemptions are looked up in the file each violation is anchored in
     # (a C++ drift in tbnet.cc, a missing-anchor scream in the Python

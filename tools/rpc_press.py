@@ -16,6 +16,31 @@ import threading
 import time
 
 
+def _trace_schedule(trace_sample_rate: float):
+    """Counter-scheduled trace stamping (exact-rate, like the fault
+    seam): returns ``(every, trace_id, next_span_id)`` — every
+    ``every``'th operation carries the run's trace id, a fresh span id,
+    and the head-based ``sampled`` bit, so one command produces a
+    coherent fleet-observable traced flood.  ``every`` is 0 when the
+    rate is 0 (untraced run)."""
+    import itertools
+    import random
+
+    if trace_sample_rate <= 0:
+        return 0, 0, None
+    every = max(1, round(1.0 / trace_sample_rate))
+    trace_id = random.getrandbits(63) | 1
+    counter = itertools.count()
+
+    def next_span_id():
+        # one shared counter across every worker thread: seq % every == 0
+        # elects, seq + 1 is the traced call's distinct span id
+        seq = next(counter)
+        return (seq + 1) if seq % every == 0 else 0
+
+    return every, trace_id, next_span_id
+
+
 def run_press(
     server: str,
     service: str,
@@ -30,6 +55,7 @@ def run_press(
     fault_delay_ms: float = 0.0,
     compress_type: str = "",
     auth_token: str = "",
+    trace_sample_rate: float = 0.0,
 ) -> dict:
     from incubator_brpc_tpu.bvar import LatencyRecorder
     from incubator_brpc_tpu.rpc import (
@@ -101,6 +127,19 @@ def run_press(
     ):
         raise SystemExit(f"cannot init channel to {server}")
 
+    # counter-scheduled traced flood: every Nth call carries the run's
+    # trace id + a fresh span id + the head-based sampled bit — a traced
+    # flood is one command, and the whole run is one fleet-assemblable
+    # trace (rpc_view --trace <id> --targets ...)
+    trace_every, run_trace_id, next_span_id = _trace_schedule(
+        trace_sample_rate
+    )
+    if trace_every:
+        print(
+            f"traced flood: every {trace_every}th call carries "
+            f"trace {run_trace_id:x} (sampled bit set)",
+            file=sys.stderr,
+        )
     latency = LatencyRecorder(name=None)
     stop_at = time.monotonic() + duration
     counts = {"ok": 0, "fail": 0}
@@ -114,6 +153,13 @@ def run_press(
             if compress_type:
                 cntl = Controller()
                 cntl.compress_type = compress_type
+            if trace_every:
+                span = next_span_id()
+                if span:
+                    cntl = cntl or Controller()
+                    cntl.trace_id = run_trace_id
+                    cntl.span_id = span
+                    cntl.trace_sampled = 1
             cntl = ch.call_method(service, method, payload, cntl=cntl)
             if cntl.ok():
                 ok += 1
@@ -139,6 +185,7 @@ def run_press(
         "latency_us_p50": latency.latency_percentile(0.5),
         "latency_us_p99": latency.latency_percentile(0.99),
         "latency_us_max": latency.max_latency(),
+        "trace_id": run_trace_id,
     }
 
 
@@ -155,6 +202,7 @@ def run_reactor_press(
     fault_delay_ms: float = 0.0,
     compress_type: str = "",
     auth_token: str = "",
+    trace_sample_rate: float = 0.0,
 ) -> dict:
     """Sharded-accept load run: ``reactors * conns_per_reactor`` native
     client channels (each pinned to its own client reactor shard at
@@ -216,6 +264,19 @@ def run_reactor_press(
                 ch.set_auth(auth_token)
             if compress_type:
                 ch.set_request_compress(compress_type)
+    # traced floods on the REACTOR path stamp the native client seam
+    # directly: the trace fields ride each traced call's RpcRequestMeta
+    # (or tbus JSON meta) and the server's C++ cutter keeps them on the
+    # fast path — same counter schedule as the plain path
+    trace_every, run_trace_id, next_span_id = _trace_schedule(
+        trace_sample_rate
+    )
+    if trace_every:
+        print(
+            f"traced flood: every {trace_every}th call carries "
+            f"trace {run_trace_id:x} (sampled bit set)",
+            file=sys.stderr,
+        )
     latency = LatencyRecorder(name=None)
     stop_at = time.monotonic() + duration
     counts = {"ok": 0, "fail": 0}
@@ -225,8 +286,11 @@ def run_reactor_press(
         ok = fail = 0
         while time.monotonic() < stop_at:
             t0 = time.perf_counter()
+            span = next_span_id() if trace_every else 0
             rc, err, _meta, _body = ch.call(
-                service, method, payload, timeout_ms=int(timeout_ms)
+                service, method, payload, timeout_ms=int(timeout_ms),
+                trace_id=run_trace_id if span else 0,
+                span_id=span, sampled=1 if span else 0,
             )
             if rc >= 0 and err == 0:
                 ok += 1
@@ -273,6 +337,7 @@ def run_reactor_press(
         "reactor_conns": distribution,
         "client_shards": client_shards,
         "cid_misroutes": misroutes,
+        "trace_id": run_trace_id,
     }
 
 
@@ -472,6 +537,14 @@ def main(argv=None) -> int:
         "with a server running TokenAuthenticator)",
     )
     p.add_argument(
+        "--trace-sample-rate", type=float, default=0.0,
+        help="stamp trace context (run trace id, fresh span id, the "
+        "head-based sampled bit) on this fraction of calls — "
+        "counter-scheduled exact rate like the fault seam, on both the "
+        "plain and --reactors load paths; the run's trace id is printed "
+        "so `rpc_view --trace <id> --targets ...` can assemble it",
+    )
+    p.add_argument(
         "--fault-rate", type=float, default=0.0,
         help="inject transport-write failures on this fraction of "
         "operations (deterministic counter schedule; drives the "
@@ -542,6 +615,7 @@ def main(argv=None) -> int:
                 "" if args.compress_type == "none" else args.compress_type
             ),
             auth_token=args.auth_token,
+            trace_sample_rate=args.trace_sample_rate,
         )
         if stats["reactor_conns"]:
             dist = " ".join(
@@ -584,6 +658,7 @@ def main(argv=None) -> int:
             "" if args.compress_type == "none" else args.compress_type
         ),
         auth_token=args.auth_token,
+        trace_sample_rate=args.trace_sample_rate,
     )
     print(
         f"qps={stats['qps']:.0f} ok={stats['ok']} fail={stats['fail']} "

@@ -26,6 +26,12 @@ Two modes:
     python tools/rpc_view.py --rpcz --target 127.0.0.1:8000
     python tools/rpc_view.py --rpcz --target 127.0.0.1:8000 \
         --trace-id 1f00dbeef --min-latency-us 500 --error-only
+
+  Assemble ONE distributed trace across a fleet (pulls
+  /rpcz?trace_id=&json=1 from every node, merges by span id, renders
+  the cross-process parent→child tree):
+    python tools/rpc_view.py --trace 1f00dbeef \
+        --targets 10.0.0.1:8000,10.0.0.2:8000
 """
 
 from __future__ import annotations
@@ -306,6 +312,63 @@ def rpcz_mode(
     return 0
 
 
+def fleet_trace_mode(targets: list, trace_id: str) -> int:
+    """Assemble ONE distributed trace from many processes: pull
+    ``/rpcz?trace_id=<id>&json=1`` from every target and render the
+    merged cross-process parent→child tree (plus the overlap report when
+    the trace carries collective chunk spans).
+
+    Span identity is the 63-bit span id, so parent links stitch across
+    process boundaries exactly; clock skew between nodes follows the
+    overlap verdict's discipline — parent→child EDGES come from ids,
+    never clocks, and start-time ordering among siblings from different
+    nodes is best-effort (each span keeps its producer's wall clock).
+    Spans are tagged ``node=<target>`` so the origin of every line is
+    visible in the merged rendering."""
+    from incubator_brpc_tpu.builtin.rpcz import (
+        overlap_report,
+        render_trace_tree,
+    )
+
+    merged = {}
+    counts = []
+    failures = 0
+    for target in targets:
+        host, _, port = target.rpartition(":")
+        if not host or not port.isdigit():
+            print(f"bad target {target!r} (want host:port)", file=sys.stderr)
+            return 2
+        try:
+            spans = scrape_rpcz(target, trace_id)
+        except (OSError, ValueError) as e:
+            print(
+                f"rpc_view: rpcz scrape of {target} failed: {e}",
+                file=sys.stderr,
+            )
+            failures += 1
+            counts.append((target, -1))
+            continue
+        counts.append((target, len(spans)))
+        for sp in spans:
+            sp.annotations.append((0.0, f"node={target}"))
+            # first pull wins on a duplicate span id (a node scraped
+            # twice, or a persisted+live copy): the tree must not show
+            # the same span as two children
+            merged.setdefault(sp.span_id, sp)
+    spans = sorted(merged.values(), key=lambda s: s.start_real_us)
+    print(
+        f"# trace {trace_id} across {len(targets)} nodes — "
+        f"{len(spans)} spans"
+    )
+    for target, n in counts:
+        print(f"#   {target}: " + ("unreachable" if n < 0 else f"{n} spans"))
+    for line in render_trace_tree(spans) + overlap_report(spans):
+        print(line)
+    if failures == len(targets):
+        return 1
+    return 0
+
+
 def make_proxy_server(target: str):
     """Build (but do not start) the rpc_view front server: every path
     relays to the target's portal, renderings are tagged with the origin
@@ -454,6 +517,18 @@ def main(argv=None) -> int:
         help="rpcz mode: assemble and print this trace (hex) as a tree",
     )
     p.add_argument(
+        "--trace",
+        default="",
+        help="fleet mode: the (hex) trace id to assemble across --targets",
+    )
+    p.add_argument(
+        "--targets",
+        default="",
+        help="fleet trace assembly: comma-separated host:port list — pull "
+        "/rpcz?trace_id=&json=1 from every node and render the merged "
+        "cross-process tree (rpc_view --trace <id> --targets a:p,b:p)",
+    )
+    p.add_argument(
         "--min-latency-us",
         type=float,
         default=None,
@@ -466,6 +541,13 @@ def main(argv=None) -> int:
     )
     args = p.parse_args(argv)
 
+    if args.targets:
+        trace = args.trace or args.trace_id
+        if not trace:
+            p.error("--targets requires --trace <hex trace id>")
+        return fleet_trace_mode(
+            [t for t in args.targets.split(",") if t], trace
+        )
     if args.links:
         if not args.target:
             p.error("--links requires --target host:port")
